@@ -1,0 +1,13 @@
+"""Test harness config: force JAX onto 8 virtual CPU devices.
+
+Multi-chip TPU hardware is not available in CI; sharding/pjit tests run on a
+virtual 8-device CPU mesh instead (same program, same GSPMD partitioner).
+Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

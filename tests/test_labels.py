@@ -1,0 +1,59 @@
+import pytest
+
+from yoda_scheduler_tpu.utils import LabelError, WorkloadSpec, Pod
+
+
+def test_defaults_when_no_labels():
+    spec = WorkloadSpec.from_labels({})
+    # matches reference default: need 1 card when scv/number absent
+    # (reference pkg/yoda/filter/filter.go:15)
+    assert spec.chips == 1
+    assert spec.min_free_mb == 0
+    assert spec.min_clock_mhz == 0
+    assert spec.priority == 0
+    assert spec.accelerator is None
+    assert not spec.is_gang
+
+
+def test_parses_reference_labels():
+    spec = WorkloadSpec.from_labels(
+        {"scv/memory": "16000", "scv/number": "4", "scv/clock": "940", "scv/priority": "3"}
+    )
+    assert spec == WorkloadSpec(chips=4, min_free_mb=16000, min_clock_mhz=940, priority=3)
+
+
+def test_malformed_labels_raise_not_zero():
+    # the reference silently coerced these to 0 (filter.go:60-86) — we refuse
+    with pytest.raises(LabelError):
+        WorkloadSpec.from_labels({"scv/memory": "lots"})
+    with pytest.raises(LabelError):
+        WorkloadSpec.from_labels({"scv/number": "-2"})  # uint wraparound hazard
+    with pytest.raises(LabelError):
+        WorkloadSpec.from_labels({"tpu/accelerator": "fpga"})
+    with pytest.raises(LabelError):
+        WorkloadSpec.from_labels({"tpu/topology": "2y3"})
+
+
+def test_negative_priority_allowed():
+    assert WorkloadSpec.from_labels({"scv/priority": "-5"}).priority == -5
+
+
+def test_gang_labels():
+    spec = WorkloadSpec.from_labels(
+        {"tpu/gang-name": "llama", "tpu/gang-size": "4", "scv/number": "4"}
+    )
+    assert spec.is_gang and spec.gang_size == 4
+    with pytest.raises(LabelError):
+        WorkloadSpec.from_labels({"tpu/gang-name": "llama"})  # size required
+
+
+def test_pod_from_manifest():
+    pod = Pod.from_manifest(
+        {
+            "metadata": {"name": "p", "labels": {"scv/memory": "1000"}},
+            "spec": {"schedulerName": "yoda-scheduler"},
+        }
+    )
+    assert pod.key == "default/p"
+    assert pod.scheduler_name == "yoda-scheduler"
+    assert pod.labels["scv/memory"] == "1000"

@@ -1,0 +1,91 @@
+import time
+
+from yoda_scheduler_tpu.telemetry import (
+    Chip,
+    TpuNodeMetrics,
+    TelemetryStore,
+    FakePublisher,
+    make_tpu_node,
+    make_gpu_node,
+    make_v4_slice,
+)
+from yoda_scheduler_tpu.telemetry.schema import aggregate_slice
+
+
+def test_node_aggregates_derived():
+    n = make_tpu_node("n1", chips=4, hbm_free_mb=1000, hbm_total_mb=2000)
+    assert n.chip_count == 4
+    assert n.hbm_free_sum == 4000
+    assert n.hbm_total_sum == 8000
+    assert len(n.healthy_chips()) == 4
+
+
+def test_unhealthy_chips_excluded():
+    n = make_tpu_node("n1", chips=4, unhealthy=2)
+    assert len(n.healthy_chips()) == 2
+
+
+def test_store_put_get_list_delete():
+    s = TelemetryStore()
+    s.put(make_tpu_node("a"))
+    s.put(make_tpu_node("b"))
+    assert s.get("a").node == "a"
+    assert sorted(m.node for m in s.list()) == ["a", "b"]
+    s.delete("a")
+    assert s.get("a") is None
+
+
+def test_store_watch_callbacks():
+    s = TelemetryStore()
+    events = []
+    cancel = s.watch(lambda node, m: events.append((node, m is not None)))
+    s.put(make_tpu_node("a"))
+    s.delete("a")
+    assert events == [("a", True), ("a", False)]
+    cancel()
+    s.put(make_tpu_node("b"))
+    assert len(events) == 2
+
+
+def test_store_generation_monotonic():
+    s = TelemetryStore()
+    s.put(make_tpu_node("a"))
+    g1 = s.get("a").generation
+    s.put(make_tpu_node("a"))
+    assert s.get("a").generation > g1
+
+
+def test_cr_roundtrip():
+    n = make_tpu_node("node-7", chips=2, slice_id="s0", host_index=1)
+    cr = n.to_cr()
+    assert cr["metadata"]["name"] == "node-7"
+    assert cr["apiVersion"].startswith("metrics.yoda.tpu/")
+    back = TpuNodeMetrics.from_cr(cr)
+    assert back.node == n.node
+    assert back.chips == n.chips
+    assert back.slice_id == "s0" and back.host_index == 1
+
+
+def test_v4_slice_layout():
+    nodes = make_v4_slice("llama", slice_topology="2x2x4")
+    assert len(nodes) == 4  # 16 chips / 4 per host
+    coords = {c.coords for n in nodes for c in n.chips}
+    assert len(coords) == 16
+    assert all(n.slice_id == "llama" and n.num_hosts == 4 for n in nodes)
+    assert [n.host_index for n in nodes] == [0, 1, 2, 3]
+    grouped = aggregate_slice(nodes)
+    assert set(grouped) == {"llama"}
+
+
+def test_staleness_and_fault_injection():
+    s = TelemetryStore()
+    pub = FakePublisher(s)
+    pub.publish(make_tpu_node("a"), make_gpu_node("g"))
+    assert not s.get("a").stale()
+    # simulate a frozen heartbeat
+    s.get("a").heartbeat = time.time() - 3600
+    assert s.get("a").stale()
+    pub.fail_chip("g", 0)
+    assert len(s.get("g").healthy_chips()) == 7
+    pub.drop("g")
+    assert s.get("g") is None

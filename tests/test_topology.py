@@ -1,0 +1,84 @@
+import pytest
+
+from yoda_scheduler_tpu.topology import (
+    parse_topology,
+    format_topology,
+    host_blocks,
+    enumerate_subblocks,
+    best_fit_block,
+    contiguity_score,
+    fragmentation_after,
+)
+from yoda_scheduler_tpu.topology.torus import all_coords, largest_free_block
+
+
+def test_parse_topology():
+    assert parse_topology("2x2x4") == (2, 2, 4)
+    assert parse_topology("2x2") == (2, 2, 1)
+    assert parse_topology("4") == (4, 1, 1)
+    assert format_topology((2, 2, 4)) == "2x2x4"
+    for bad in ("", "0x2", "2x-1", "axb", "1x1x1x1"):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+
+def test_host_blocks_v4_32():
+    blocks = host_blocks((2, 2, 4))
+    assert len(blocks) == 4
+    assert all(len(b) == 4 for b in blocks)
+    flat = {c for b in blocks for c in b}
+    assert flat == set(all_coords((2, 2, 4)))
+    # host 0 owns the z=0 board
+    assert set(blocks[0]) == {(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)}
+
+
+def test_enumerate_subblocks_counts():
+    # 4-chip blocks inside 2x2x2: 2x2x1 (2 placements along z), 2x1x2 (2 along y),
+    # 1x2x2 (2 along x), 4x1x1-style shapes don't fit.
+    blocks = enumerate_subblocks((2, 2, 2), 4)
+    shapes = {b for _, b in blocks}
+    assert shapes == {(2, 2, 1), (2, 1, 2), (1, 2, 2)}
+    assert len(blocks) == 6
+
+
+def test_best_fit_prefers_compact_and_low_frag():
+    shape = (2, 2, 4)
+    free = set(all_coords(shape))
+    fit = best_fit_block(shape, free, 4)
+    assert fit is not None
+    origin, block, coords = fit
+    assert block in {(2, 2, 1), (2, 1, 2), (1, 2, 2)}  # compact over 4x-sticks
+    # all 16 free, taking a board off one end keeps the rest contiguous
+    assert fragmentation_after(shape, free - coords) == 0.0
+
+
+def test_best_fit_none_when_fragmented():
+    shape = (2, 2, 2)
+    # free chips form a diagonal — no contiguous 2-block
+    free = {(0, 0, 0), (1, 1, 1)}
+    assert best_fit_block(shape, free, 2) is None
+    assert contiguity_score(shape, free, 2) == 0.0
+
+
+def test_contiguity_score_orders_placements():
+    shape = (4, 1, 1)
+    contiguous = {(0, 0, 0), (1, 0, 0), (2, 0, 0)}
+    split = {(0, 0, 0), (2, 0, 0), (3, 0, 0)}
+    # request 2 chips: contiguous free space leaves 1 isolated chip either way,
+    # but carving from `split` can keep (2,3) together => both schedulable;
+    # a 3-chip request only fits the contiguous set
+    assert contiguity_score(shape, contiguous, 3) > 0
+    assert contiguity_score(shape, split, 3) == 0
+    assert contiguity_score(shape, split, 2) > 0
+
+
+def test_largest_free_block():
+    shape = (2, 2, 1)
+    assert largest_free_block(shape, set(all_coords(shape))) == 4
+    assert largest_free_block(shape, {(0, 0, 0), (1, 1, 0)}) == 1
+    assert largest_free_block(shape, set()) == 0
+
+
+def test_host_blocks_indivisible_raises():
+    with pytest.raises(ValueError):
+        host_blocks((3, 2, 2))

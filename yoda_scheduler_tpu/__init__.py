@@ -1,0 +1,23 @@
+"""yoda_scheduler_tpu — a TPU-native accelerator-telemetry scheduler framework.
+
+A brand-new implementation of the capabilities of Yoda-Scheduler
+(reference: /root/reference, a Kubernetes out-of-tree kube-scheduler plugin that
+places pods by per-node GPU telemetry), redesigned TPU-first:
+
+- The telemetry source is a libtpu/Cloud-TPU node-metrics schema
+  (``telemetry/``) instead of the reference's NVML-backed SCV CRD
+  (reference: go.mod:6, SCV types used at pkg/yoda/filter/filter.go:13-57).
+- The scheduling engine (``scheduler/``) re-implements the kube-scheduler
+  scheduling-framework extension-point architecture natively (queue sort,
+  pre-filter, filter, pre-score, score, normalize, reserve, permit, bind)
+  rather than embedding upstream kube-scheduler
+  (reference: pkg/register/register.go:10-12).
+- Placement understands ICI topology (``topology/``): contiguous-chip
+  bin-packing and multi-host pod-slice gang scheduling — new capability the
+  GPU reference does not have.
+- ``models/``, ``ops/``, ``parallel/`` hold the JAX/Flax/Pallas workloads the
+  scheduler places (ResNet-50, Llama-class transformer) with real
+  dp/fsdp/tp/sp shardings over a ``jax.sharding.Mesh``.
+"""
+
+__version__ = "0.1.0"

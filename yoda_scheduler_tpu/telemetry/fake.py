@@ -1,0 +1,183 @@
+"""Fake telemetry publisher — synthetic TpuNodeMetrics for tests and benches.
+
+The reference has no test fixtures of any kind (zero *_test.go files); its
+telemetry comes only from a live NVML sniffer DaemonSet. This module is the
+well-specified fake that SURVEY.md §5 calls for: it can build single-host TPU
+nodes, multi-host v4-style pod slices with real ICI coordinates, GPU nodes for
+the mixed-cluster scenario, and inject faults (stale heartbeats, unhealthy
+chips, missing telemetry) to test the failure-detection path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from .schema import Chip, TpuNodeMetrics, GPU, TPU, HEALTHY
+from .store import TelemetryStore
+from ..topology.torus import parse_topology, host_blocks
+
+# v4 chip defaults (HBM 32 GB per chip, 940 MHz TensorCore clock).
+V4_HBM_MB = 32_768
+V4_CLOCK_MHZ = 940
+V4_ICI_GBPS = 100
+V4_MXUS = 4
+V4_POWER_W = 170
+
+
+def make_tpu_node(
+    name: str,
+    chips: int = 4,
+    hbm_free_mb: int = V4_HBM_MB,
+    hbm_total_mb: int = V4_HBM_MB,
+    clock_mhz: int = V4_CLOCK_MHZ,
+    unhealthy: int = 0,
+    **kw,
+) -> TpuNodeMetrics:
+    """A standalone single-host TPU node (e.g. one v4-8 host: 4 chips)."""
+    chip_list = [
+        Chip(
+            index=i,
+            hbm_free_mb=hbm_free_mb,
+            hbm_total_mb=hbm_total_mb,
+            clock_mhz=clock_mhz,
+            ici_bandwidth_gbps=V4_ICI_GBPS,
+            core_count=V4_MXUS,
+            power_w=V4_POWER_W,
+            coords=(i % 2, i // 2, 0),
+            health=("Unhealthy" if i < unhealthy else HEALTHY),
+        )
+        for i in range(chips)
+    ]
+    return TpuNodeMetrics(node=name, chips=chip_list, accelerator=TPU, **kw)
+
+
+def make_gpu_node(
+    name: str,
+    cards: int = 8,
+    mem_free_mb: int = 40_000,
+    mem_total_mb: int = 40_000,
+    clock_mhz: int = 1410,
+    **kw,
+) -> TpuNodeMetrics:
+    """A GPU node for the mixed-cluster scenario (BASELINE config #5); the
+    schema is accelerator-agnostic, only `accelerator` differs."""
+    chip_list = [
+        Chip(
+            index=i,
+            hbm_free_mb=mem_free_mb,
+            hbm_total_mb=mem_total_mb,
+            clock_mhz=clock_mhz,
+            ici_bandwidth_gbps=64,  # NVLink-ish
+            core_count=108,
+            power_w=400,
+            coords=(i, 0, 0),
+        )
+        for i in range(cards)
+    ]
+    return TpuNodeMetrics(node=name, chips=chip_list, accelerator=GPU, **kw)
+
+
+def make_v4_slice(
+    slice_id: str,
+    slice_topology: str = "2x2x4",
+    node_prefix: str | None = None,
+    hbm_free_mb: int = V4_HBM_MB,
+) -> list[TpuNodeMetrics]:
+    """A multi-host v4 pod slice: hosts of 4 chips each with real ICI coords.
+
+    v4 packaging: 4 chips per host board in a 2x2x1 block; a v4-32 slice is
+    topology 2x2x4 = 16 chips = 4 hosts. Chip coordinates cover the full
+    torus, partitioned into per-host 2x2x1 blocks — exactly the structure the
+    topology scorer and gang scheduler reason about.
+    """
+    shape = parse_topology(slice_topology)
+    prefix = node_prefix or slice_id
+    nodes: list[TpuNodeMetrics] = []
+    blocks = host_blocks(shape)
+    for host_index, coords_block in enumerate(blocks):
+        chips = [
+            Chip(
+                index=i,
+                hbm_free_mb=hbm_free_mb,
+                hbm_total_mb=V4_HBM_MB,
+                clock_mhz=V4_CLOCK_MHZ,
+                ici_bandwidth_gbps=V4_ICI_GBPS,
+                core_count=V4_MXUS,
+                power_w=V4_POWER_W,
+                coords=coords,
+            )
+            for i, coords in enumerate(coords_block)
+        ]
+        nodes.append(
+            TpuNodeMetrics(
+                node=f"{prefix}-host-{host_index}",
+                chips=chips,
+                accelerator=TPU,
+                slice_id=slice_id,
+                topology="2x2x1",
+                slice_topology=slice_topology,
+                host_index=host_index,
+                num_hosts=len(blocks),
+            )
+        )
+    return nodes
+
+
+class FakePublisher:
+    """Continuously (or on demand) publishes synthetic telemetry to a store,
+    with fault-injection hooks. Stands in for the per-node sniffer DaemonSet."""
+
+    def __init__(self, store: TelemetryStore, seed: int = 0) -> None:
+        self.store = store
+        self.rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._frozen: set[str] = set()  # nodes whose heartbeat we stop (stale)
+
+    # ----------------------------------------------------------- one-shot API
+    def publish(self, *nodes: TpuNodeMetrics) -> None:
+        for n in nodes:
+            n.heartbeat = time.time()
+            self.store.put(n)
+
+    # -------------------------------------------------------- fault injection
+    def freeze(self, node: str) -> None:
+        """Stop heartbeating a node — its telemetry goes stale."""
+        self._frozen.add(node)
+
+    def unfreeze(self, node: str) -> None:
+        self._frozen.discard(node)
+
+    def fail_chip(self, node: str, chip_index: int, health: str = "Unhealthy") -> None:
+        m = self.store.get(node)
+        if m is None:
+            raise KeyError(node)
+        m.chips[chip_index].health = health
+        self.publish(m)
+
+    def drop(self, node: str) -> None:
+        """Remove a node's telemetry entirely (sniffer crash)."""
+        self.store.delete(node)
+
+    # ------------------------------------------------------------- background
+    def start(self, interval_s: float = 1.0, jitter_hbm_mb: int = 0) -> None:
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                for m in self.store.list():
+                    if m.node in self._frozen:
+                        continue
+                    if jitter_hbm_mb:
+                        for c in m.chips:
+                            delta = self.rng.randint(-jitter_hbm_mb, jitter_hbm_mb)
+                            c.hbm_free_mb = max(0, min(c.hbm_total_mb, c.hbm_free_mb + delta))
+                    self.publish(m)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,85 @@
+"""Live telemetry sniffer: reads real TPU metrics from the local JAX runtime.
+
+The reference's telemetry daemon reads NVML on each node and publishes an SCV
+CR (out-of-repo; consumed via the SCV dependency, reference go.mod:6). The
+TPU-native equivalent reads the libtpu-backed runtime through JAX's public
+device API: ``jax.local_devices()`` for chip inventory/coords and
+``Device.memory_stats()`` for live HBM occupancy. Runs anywhere JAX runs;
+on a CPU-only host it reports the host as a zero-chip node (never fabricates
+accelerators).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from .schema import Chip, TpuNodeMetrics, HEALTHY, TPU
+
+# v4 nominal constants for fields libtpu does not expose per-chip.
+_DEFAULT_CLOCK_MHZ = 940
+_DEFAULT_ICI_GBPS = 100
+_DEFAULT_MXUS = 4
+_DEFAULT_POWER_W = 170
+
+
+def _mb(nbytes: int | None) -> int:
+    return int((nbytes or 0) // (1024 * 1024))
+
+
+def local_node_metrics(node_name: str | None = None) -> TpuNodeMetrics:
+    """Snapshot this host's accelerator telemetry as a TpuNodeMetrics."""
+    import jax
+
+    name = node_name or socket.gethostname()
+    devices = [d for d in jax.local_devices() if d.platform == "tpu"]
+    chips: list[Chip] = []
+    for d in devices:
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # memory_stats unsupported on some backends
+            stats = {}
+        total = _mb(stats.get("bytes_limit"))
+        in_use = _mb(stats.get("bytes_in_use"))
+        coords = tuple(getattr(d, "coords", (d.id, 0, 0)))[:3]
+        while len(coords) < 3:
+            coords = coords + (0,)
+        chips.append(
+            Chip(
+                index=d.id,
+                hbm_free_mb=max(total - in_use, 0),
+                hbm_total_mb=total,
+                health=HEALTHY,
+                clock_mhz=_DEFAULT_CLOCK_MHZ,
+                ici_bandwidth_gbps=_DEFAULT_ICI_GBPS,
+                core_count=getattr(d, "num_cores", None) or _DEFAULT_MXUS,
+                power_w=_DEFAULT_POWER_W,
+                coords=coords,  # type: ignore[arg-type]
+            )
+        )
+    return TpuNodeMetrics(
+        node=name,
+        chips=chips,
+        accelerator=TPU,
+        host_index=getattr(jax, "process_index", lambda: 0)(),
+        num_hosts=getattr(jax, "process_count", lambda: 1)(),
+        heartbeat=time.time(),
+    )
+
+
+def run_daemon(store, node_name: str | None = None, interval_s: float = 5.0, stop_event=None):
+    """Publish local metrics into a TelemetryStore on an interval — the
+    in-process stand-in for the per-node sniffer DaemonSet."""
+    import threading
+
+    stop = stop_event or threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval_s):
+            store.put(local_node_metrics(node_name))
+
+    store.put(local_node_metrics(node_name))
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return stop

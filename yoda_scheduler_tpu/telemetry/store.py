@@ -1,0 +1,85 @@
+"""In-memory telemetry store: the analogue of the reference's watch cache.
+
+The reference runs a controller-runtime cache over SCV custom resources for
+the life of the process (reference pkg/yoda/scheduler.go:53-68) so that the
+per-(pod,node) Filter/Score hot path is a pure in-memory read
+(scheduler.go:80,118) and the per-pod aggregation pass is an in-memory list
+(scheduler.go:98).
+
+`TelemetryStore` reproduces that contract: `get(node)` / `list()` are lock-
+protected dict reads, publishers push full objects, and subscribers get
+change callbacks (the watch analogue). The k8s-backed path (k8s/client.py)
+feeds the same store from a CRD watch stream; the fake publisher feeds it in
+tests and benchmarks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from .schema import TpuNodeMetrics
+
+WatchCallback = Callable[[str, TpuNodeMetrics | None], None]
+
+
+class TelemetryStore:
+    """Thread-safe node-name -> TpuNodeMetrics map with watch callbacks."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._by_node: dict[str, TpuNodeMetrics] = {}
+        self._watchers: list[WatchCallback] = []
+        self._resource_version = 0
+
+    # ------------------------------------------------------------- publisher
+    def put(self, metrics: TpuNodeMetrics) -> None:
+        with self._lock:
+            self._resource_version += 1
+            metrics.generation = self._resource_version
+            self._by_node[metrics.node] = metrics
+            watchers = list(self._watchers)
+        for cb in watchers:
+            cb(metrics.node, metrics)
+
+    def delete(self, node: str) -> None:
+        with self._lock:
+            self._by_node.pop(node, None)
+            self._resource_version += 1
+            watchers = list(self._watchers)
+        for cb in watchers:
+            cb(node, None)
+
+    # -------------------------------------------------------------- consumer
+    def get(self, node: str) -> TpuNodeMetrics | None:
+        with self._lock:
+            return self._by_node.get(node)
+
+    def list(self) -> list[TpuNodeMetrics]:
+        with self._lock:
+            return list(self._by_node.values())
+
+    def nodes(self) -> list[str]:
+        with self._lock:
+            return list(self._by_node)
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._resource_version
+
+    def watch(self, cb: WatchCallback) -> Callable[[], None]:
+        """Register a change callback; returns an unsubscribe function."""
+        with self._lock:
+            self._watchers.append(cb)
+
+        def cancel() -> None:
+            with self._lock:
+                if cb in self._watchers:
+                    self._watchers.remove(cb)
+
+        return cancel
+
+    def load(self, items: Iterable[TpuNodeMetrics]) -> None:
+        for m in items:
+            self.put(m)

@@ -1,0 +1,19 @@
+from .torus import (
+    parse_topology,
+    format_topology,
+    host_blocks,
+    enumerate_subblocks,
+    best_fit_block,
+    contiguity_score,
+    fragmentation_after,
+)
+
+__all__ = [
+    "parse_topology",
+    "format_topology",
+    "host_blocks",
+    "enumerate_subblocks",
+    "best_fit_block",
+    "contiguity_score",
+    "fragmentation_after",
+]

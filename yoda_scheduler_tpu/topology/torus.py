@@ -1,0 +1,189 @@
+"""ICI torus topology model: slice shapes, sub-block enumeration, bin-packing.
+
+This is new TPU-native capability with no counterpart in the GPU reference
+(its cards are an unordered list, reference pkg/yoda/filter/filter.go:22).
+TPU chips within a pod slice form an ICI torus (v4: 3-D, e.g. a v4-32 slice
+is 2x2x4 chips over 4 hosts); XLA collectives ride ICI only between chips
+that are torus neighbours, so placement quality = does a job get an
+*axis-aligned contiguous sub-block* of the torus, and does packing leave the
+remaining free chips in large contiguous blocks for future jobs.
+
+Pure functions over coordinate sets — trivially unit-testable, no k8s types.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from itertools import product
+
+Coord = tuple[int, int, int]
+Shape = tuple[int, int, int]
+
+
+def parse_topology(spec: str) -> Shape:
+    """'2x2x4' -> (2, 2, 4); '2x2' -> (2, 2, 1); '4' -> (4, 1, 1)."""
+    parts = [p.strip() for p in spec.lower().split("x") if p.strip()]
+    if not parts or len(parts) > 3:
+        raise ValueError(f"bad topology spec: {spec!r}")
+    dims = [int(p) for p in parts]
+    if any(d <= 0 for d in dims):
+        raise ValueError(f"bad topology spec: {spec!r}")
+    while len(dims) < 3:
+        dims.append(1)
+    return tuple(dims)  # type: ignore[return-value]
+
+
+def format_topology(shape: Shape) -> str:
+    return "x".join(str(d) for d in shape)
+
+
+def chips_in(shape: Shape) -> int:
+    x, y, z = shape
+    return x * y * z
+
+
+def all_coords(shape: Shape) -> list[Coord]:
+    return list(product(range(shape[0]), range(shape[1]), range(shape[2])))
+
+
+def host_blocks(slice_shape: Shape, host_shape: Shape = (2, 2, 1)) -> list[list[Coord]]:
+    """Partition a slice torus into per-host chip blocks (v4 boards hold a
+    2x2x1 block of 4 chips). Host order follows z-major placement, matching
+    how Cloud TPU assigns workers along the slice."""
+    hx, hy, hz = host_shape
+    sx, sy, sz = slice_shape
+    if sx % hx or sy % hy or sz % hz:
+        raise ValueError(f"slice {slice_shape} not divisible by host block {host_shape}")
+    blocks: list[list[Coord]] = []
+    for bz in range(sz // hz):
+        for by in range(sy // hy):
+            for bx in range(sx // hx):
+                blocks.append(
+                    [
+                        (bx * hx + dx, by * hy + dy, bz * hz + dz)
+                        for dz in range(hz)
+                        for dy in range(hy)
+                        for dx in range(hx)
+                    ]
+                )
+    return blocks
+
+
+@lru_cache(maxsize=None)
+def _factor_shapes(n: int) -> tuple[Shape, ...]:
+    """All (x, y, z) with x*y*z == n — candidate block shapes for n chips."""
+    out = []
+    for x in range(1, n + 1):
+        if n % x:
+            continue
+        rem = n // x
+        for y in range(1, rem + 1):
+            if rem % y:
+                continue
+            out.append((x, y, rem // y))
+    return tuple(out)
+
+
+def enumerate_subblocks(shape: Shape, n_chips: int) -> list[tuple[Coord, Shape]]:
+    """All axis-aligned sub-blocks of exactly `n_chips` chips inside `shape`,
+    as (origin, block_shape) pairs. Small closed world (slices are tiny:
+    <=4096 chips, jobs request small factors), so brute force is fine and
+    exact — no heuristics to go wrong."""
+    out: list[tuple[Coord, Shape]] = []
+    sx, sy, sz = shape
+    for bx, by, bz in _factor_shapes(n_chips):
+        if bx > sx or by > sy or bz > sz:
+            continue
+        for ox in range(sx - bx + 1):
+            for oy in range(sy - by + 1):
+                for oz in range(sz - bz + 1):
+                    out.append(((ox, oy, oz), (bx, by, bz)))
+    return out
+
+
+def _block_coords(origin: Coord, block: Shape) -> set[Coord]:
+    ox, oy, oz = origin
+    bx, by, bz = block
+    return {
+        (ox + dx, oy + dy, oz + dz)
+        for dx in range(bx)
+        for dy in range(by)
+        for dz in range(bz)
+    }
+
+
+def _compactness(block: Shape) -> int:
+    """Prefer cube-ish blocks — lower is better. For fixed volume, the sum of
+    dimensions is minimised by the most cube-like factorization, which has the
+    shortest ICI diameter (a 2x2x2 beats an 8x1x1 for the same 8 chips)."""
+    bx, by, bz = block
+    return bx + by + bz
+
+
+def best_fit_block(
+    slice_shape: Shape,
+    free: set[Coord],
+    n_chips: int,
+) -> tuple[Coord, Shape, set[Coord]] | None:
+    """Find the best axis-aligned contiguous block of `n_chips` free chips.
+
+    Best = (1) minimises leftover fragmentation (prefers carving from the
+    corner of free space), (2) prefers compact shapes (low ICI diameter).
+    Returns (origin, block_shape, coords) or None if no contiguous fit.
+    """
+    best: tuple[float, Coord, Shape, set[Coord]] | None = None
+    for origin, block in enumerate_subblocks(slice_shape, n_chips):
+        coords = _block_coords(origin, block)
+        if not coords <= free:
+            continue
+        # leftover contiguity: how big is the largest free block remaining
+        remaining = free - coords
+        frag = fragmentation_after(slice_shape, remaining)
+        key = (frag, _compactness(block), origin[2], origin[1], origin[0])
+        if best is None or key < best[0]:
+            best = (key, origin, block, coords)
+    if best is None:
+        return None
+    return best[1], best[2], best[3]
+
+
+def largest_free_block(shape: Shape, free: set[Coord]) -> int:
+    """Size of the largest axis-aligned sub-block fully inside `free`."""
+    if not free:
+        return 0
+    best = 1
+    sx, sy, sz = shape
+    max_n = len(free)
+    # check decreasing sizes; early-out at first found
+    for n in range(max_n, 0, -1):
+        if n <= best:
+            break
+        for origin, block in enumerate_subblocks(shape, n):
+            if _block_coords(origin, block) <= free:
+                best = n
+                break
+    return best
+
+
+def fragmentation_after(shape: Shape, free: set[Coord]) -> float:
+    """0 = perfectly contiguous free space, 1 = fully fragmented.
+    Defined as 1 - largest_free_block / |free| (0 when nothing free)."""
+    if not free:
+        return 0.0
+    return 1.0 - largest_free_block(shape, free) / len(free)
+
+
+def contiguity_score(shape: Shape, free: set[Coord], n_chips: int) -> float:
+    """How well can a `n_chips` job land contiguously in `free`? 0..100.
+
+    100: an exact-fit contiguous block exists and taking the best one leaves
+    zero extra fragmentation. Decreases with induced fragmentation; 0 when no
+    contiguous block exists (job would span non-adjacent chips — XLA
+    collectives would hop through occupied chips' links).
+    """
+    fit = best_fit_block(shape, free, n_chips)
+    if fit is None:
+        return 0.0
+    _, _, coords = fit
+    frag = fragmentation_after(shape, free - coords)
+    return 100.0 * (1.0 - frag)

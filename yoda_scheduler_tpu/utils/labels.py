@@ -1,0 +1,124 @@
+"""Workload label contract and strict parsing.
+
+The reference's user contract is pod labels ``scv/memory``, ``scv/number``,
+``scv/clock``, ``scv/priority`` (reference readme.md:27-69); we keep that
+exact surface (so a reference user can switch without rewriting manifests)
+and extend it with the TPU namespace:
+
+- ``tpu/accelerator``: "tpu" | "gpu" — mixed-cluster partitioning (BASELINE
+  config #5); absent = any accelerator that satisfies the resource labels.
+- ``tpu/topology``: requested ICI block, e.g. "2x2" — topology-aware packing.
+- ``tpu/gang-name`` + ``tpu/gang-size``: multi-host gang (one worker pod per
+  host of a pod slice; all-or-nothing admission via the Permit plugin).
+
+Parsing is strict: the reference silently coerced malformed or negative
+values to 0 via Atoi-error-swallowing and uint wraparound (reference
+pkg/yoda/filter/filter.go:60-86 — SURVEY §3.3 flags this as a hazard). Here a
+malformed label raises LabelError, which the filter surfaces as an
+Unschedulable status naming the bad label instead of quietly scheduling the
+pod as if it had asked for nothing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+MEMORY_LABEL = "scv/memory"       # min free HBM per chip, MB
+NUMBER_LABEL = "scv/number"       # chips requested on the node
+CLOCK_LABEL = "scv/clock"         # min chip clock, MHz (>= semantics, see below)
+PRIORITY_LABEL = "scv/priority"   # queue priority, higher first
+
+ACCELERATOR_LABEL = "tpu/accelerator"
+TOPOLOGY_LABEL = "tpu/topology"
+GANG_NAME_LABEL = "tpu/gang-name"
+GANG_SIZE_LABEL = "tpu/gang-size"
+
+
+class LabelError(ValueError):
+    """A workload label is present but malformed."""
+
+    def __init__(self, label: str, value: str, why: str = "must be a non-negative integer"):
+        self.label = label
+        super().__init__(f"label {label}={value!r}: {why}")
+
+
+def _parse_uint(labels: dict[str, str], key: str, default: int) -> int:
+    raw = labels.get(key)
+    if raw is None:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise LabelError(key, raw) from None
+    if v < 0:
+        raise LabelError(key, raw)
+    return v
+
+
+def _parse_int(labels: dict[str, str], key: str, default: int) -> int:
+    raw = labels.get(key)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise LabelError(key, raw, "must be an integer") from None
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    """The parsed resource request of one pod.
+
+    Semantics notes vs. the reference:
+    - ``chips`` defaults to 1 when ``scv/number`` is absent, matching
+      PodFitsNumber's default (reference pkg/yoda/filter/filter.go:15).
+    - ``min_clock_mhz`` uses >= ("at least this fast"), resolving the
+      reference's filter-vs-score inconsistency (== at filter.go:57 but >= at
+      collection.go:46 / algorithm.go:48) in favour of the README's stated
+      intent ("high-performance GPU", readme.md:55-63).
+    """
+
+    chips: int = 1
+    min_free_mb: int = 0
+    min_clock_mhz: int = 0
+    priority: int = 0
+    accelerator: str | None = None   # None = any
+    topology: str | None = None      # e.g. "2x2"
+    gang_name: str | None = None
+    gang_size: int = 0
+
+    # Whether the pod opted into accelerator scheduling at all: a pod with no
+    # scv/* labels still defaults to 1 chip (reference behaviour — any pod
+    # routed to the yoda scheduler wants an accelerator node).
+    @classmethod
+    def from_labels(cls, labels: dict[str, str]) -> "WorkloadSpec":
+        gang_size = _parse_uint(labels, GANG_SIZE_LABEL, 0)
+        gang_name = labels.get(GANG_NAME_LABEL)
+        if gang_name is not None and gang_size <= 0:
+            raise LabelError(GANG_SIZE_LABEL, labels.get(GANG_SIZE_LABEL, ""),
+                             "gang pods must set a positive tpu/gang-size")
+        accel = labels.get(ACCELERATOR_LABEL)
+        if accel is not None and accel not in ("tpu", "gpu"):
+            raise LabelError(ACCELERATOR_LABEL, accel, 'must be "tpu" or "gpu"')
+        topo = labels.get(TOPOLOGY_LABEL)
+        if topo is not None:
+            from ..topology.torus import parse_topology  # validate eagerly
+
+            try:
+                parse_topology(topo)
+            except ValueError:
+                raise LabelError(TOPOLOGY_LABEL, topo, "must look like '2x2x1'") from None
+        return cls(
+            chips=_parse_uint(labels, NUMBER_LABEL, 1),
+            min_free_mb=_parse_uint(labels, MEMORY_LABEL, 0),
+            min_clock_mhz=_parse_uint(labels, CLOCK_LABEL, 0),
+            priority=_parse_int(labels, PRIORITY_LABEL, 0),
+            accelerator=accel,
+            topology=topo,
+            gang_name=gang_name,
+            gang_size=gang_size,
+        )
+
+    @property
+    def is_gang(self) -> bool:
+        return self.gang_name is not None

@@ -1,0 +1,48 @@
+"""Minimal pod model — the slice of the Kubernetes Pod object the scheduler
+actually consumes (reference uses *v1.Pod but touches only metadata.labels,
+namespace/name, spec.schedulerName and nodeName)."""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class PodPhase(str, Enum):
+    PENDING = "Pending"
+    BOUND = "Bound"
+    FAILED = "Failed"
+
+
+_uid_counter = itertools.count(1)
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    scheduler_name: str = "yoda-scheduler"
+    node: str | None = None           # spec.nodeName after bind
+    phase: PodPhase = PodPhase.PENDING
+    uid: int = field(default_factory=lambda: next(_uid_counter))
+    created: float = field(default_factory=time.time)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @classmethod
+    def from_manifest(cls, manifest: dict) -> "Pod":
+        """Build from a parsed Kubernetes Pod manifest dict."""
+        meta = manifest.get("metadata", {})
+        spec = manifest.get("spec", {})
+        return cls(
+            name=meta.get("name", "pod"),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels", {})),
+            scheduler_name=spec.get("schedulerName", "default-scheduler"),
+            node=spec.get("nodeName"),
+        )
