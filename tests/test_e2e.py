@@ -1,0 +1,228 @@
+"""Full scheduling-cycle tests on the fake control plane — BASELINE scenarios
+1 and 2, plus retry/backoff, preemption, staleness recovery, and failure
+paths. The reference could only be exercised against a live cluster by hand
+(readme.md:70-73); this is the in-memory equivalent SURVEY.md §4 requires."""
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import FakePublisher, TelemetryStore, make_tpu_node, make_gpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk_sched(*nodes, config=None, clock=None):
+    store = TelemetryStore()
+    pub = FakePublisher(store)
+    clock = clock or FakeClock(start=1000.0)
+    nodes = list(nodes)
+    for n in nodes:
+        n.heartbeat = clock.time()
+    pub_publish_keepalive(pub, nodes, clock)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, config or SchedulerConfig(), clock=clock)
+    return sched, pub, clock
+
+
+def pub_publish_keepalive(pub, nodes, clock):
+    for n in nodes:
+        pub.store.put(n)
+        n.heartbeat = clock.time()
+
+
+def refresh(sched):
+    """Re-stamp heartbeats against the fake clock (stand-in for the sniffer
+    daemon publishing on its interval)."""
+    for m in sched.cluster.telemetry.list():
+        m.heartbeat = sched.clock.time()
+
+
+class TestScenario1:
+    """BASELINE #1: single pod with scv/memory=1000 binds on a node with zero
+    GPU device plugin — telemetry alone drives placement."""
+
+    def test_binds_by_memory_label(self):
+        sched, _, _ = mk_sched(make_tpu_node("kind-node", chips=4))
+        pod = Pod("test-pod", labels={"scv/memory": "1000"})
+        assert sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND
+        assert pod.node == "kind-node"
+        assert pod.labels["tpu/assigned-chips"]  # concrete chip assignment
+        assert sched.metrics.counters["pods_scheduled_total"] == 1
+
+    def test_wrong_scheduler_name_ignored(self):
+        sched, _, _ = mk_sched(make_tpu_node("n"))
+        pod = Pod("p", scheduler_name="default-scheduler")
+        assert not sched.submit(pod)
+        assert pod.phase == PodPhase.PENDING
+
+
+class TestScenario2:
+    """BASELINE #2: 3 replicas requesting 2 chips each; chip accounting must
+    be correct (a 4-chip node holds at most 2 such replicas)."""
+
+    def test_replica_spread_and_accounting(self):
+        sched, _, _ = mk_sched(make_tpu_node("n1", chips=4), make_tpu_node("n2", chips=4))
+        replicas = [
+            Pod(f"deploy-{i}", labels={"scv/number": "2", "scv/memory": "1000"})
+            for i in range(3)
+        ]
+        for p in replicas:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in replicas)
+        per_node = {}
+        for p in replicas:
+            per_node[p.node] = per_node.get(p.node, 0) + 2
+            assert len(p.labels["tpu/assigned-chips"].split(";")) == 2
+        assert all(v <= 4 for v in per_node.values())
+        assert sum(per_node.values()) == 6
+        assert sched.bin_pack_utilization() == pytest.approx(75.0)
+
+    def test_fourth_replica_overflows_and_waits(self):
+        sched, _, clock = mk_sched(make_tpu_node("n1", chips=4))
+        pods = [Pod(f"r{i}", labels={"scv/number": "2"}) for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+        # only run a few cycles: two bind, one backs off
+        for _ in range(6):
+            refresh(sched)
+            info = sched.queue.pop(now=clock.time())
+            if info:
+                sched.schedule_one(info)
+            clock.advance(1.0)
+        bound = [p for p in pods if p.phase == PodPhase.BOUND]
+        assert len(bound) == 2
+        assert sched.metrics.counters.get("pods_unschedulable_total", 0) >= 1
+
+
+class TestRetryAndRecovery:
+    def test_backoff_then_bind_when_capacity_frees(self):
+        sched, _, clock = mk_sched(make_tpu_node("n1", chips=2))
+        first = Pod("first", labels={"scv/number": "2"})
+        second = Pod("second", labels={"scv/number": "2"})
+        sched.submit(first)
+        sched.submit(second)
+        for _ in range(4):
+            refresh(sched)
+            info = sched.queue.pop(now=clock.time())
+            if info:
+                sched.schedule_one(info)
+            clock.advance(0.7)
+        assert first.phase == PodPhase.BOUND and second.phase == PodPhase.PENDING
+        # first finishes: its chips free up
+        sched.cluster.evict(first)
+        for _ in range(10):
+            refresh(sched)
+            info = sched.queue.pop(now=clock.time())
+            if info:
+                sched.schedule_one(info)
+            clock.advance(1.0)
+        assert second.phase == PodPhase.BOUND
+
+    def test_stale_telemetry_blocks_until_heartbeat(self):
+        sched, _, clock = mk_sched(
+            make_tpu_node("n1"), config=SchedulerConfig(telemetry_max_age_s=5.0)
+        )
+        clock.advance(60.0)  # sniffer silent for a minute
+        pod = Pod("p")
+        sched.submit(pod)
+        info = sched.queue.pop(now=clock.time())
+        assert sched.schedule_one(info) == "unschedulable"
+        refresh(sched)  # sniffer comes back
+        info = sched.queue.pop(now=clock.time() + 2.0)
+        assert sched.schedule_one(info) == "bound"
+
+    def test_malformed_labels_fail_permanently(self):
+        sched, _, _ = mk_sched(make_tpu_node("n1"))
+        pod = Pod("bad", labels={"scv/memory": "lots"})
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.FAILED
+        assert "scv/memory" in sched.failed[pod.key]
+        assert len(sched.queue) == 0  # not retried
+
+    def test_max_attempts_gives_up(self):
+        sched, _, _ = mk_sched(
+            make_tpu_node("n1", chips=1),
+            config=SchedulerConfig(max_attempts=3),
+        )
+        pod = Pod("huge", labels={"scv/number": "16"})
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.FAILED
+
+
+class TestPriorityAndPreemption:
+    def test_high_priority_schedules_first(self):
+        sched, _, _ = mk_sched(make_tpu_node("n1", chips=2))
+        lo = Pod("lo", labels={"scv/number": "2", "scv/priority": "1"})
+        hi = Pod("hi", labels={"scv/number": "2", "scv/priority": "9"})
+        sched.submit(lo)
+        sched.submit(hi)
+        info = sched.queue.pop(now=sched.clock.time())
+        assert info.pod.name == "hi"
+
+    def test_preemption_evicts_lower_priority(self):
+        sched, _, clock = mk_sched(make_tpu_node("n1", chips=4))
+        lo = Pod("lo", labels={"scv/number": "4", "scv/priority": "1"})
+        sched.submit(lo)
+        sched.run_until_idle()
+        assert lo.phase == PodPhase.BOUND
+        hi = Pod("hi", labels={"scv/number": "4", "scv/priority": "9"})
+        sched.submit(hi)
+        sched.run_until_idle(max_cycles=50)
+        assert hi.phase == PodPhase.BOUND
+        assert lo.phase == PodPhase.PENDING  # evicted, requeued, no room
+        assert sched.metrics.counters["preemptions_total"] >= 1
+
+    def test_no_preemption_of_equal_priority(self):
+        sched, _, _ = mk_sched(
+            make_tpu_node("n1", chips=4),
+            config=SchedulerConfig(max_attempts=2),
+        )
+        a = Pod("a", labels={"scv/number": "4", "scv/priority": "5"})
+        sched.submit(a)
+        sched.run_until_idle()
+        b = Pod("b", labels={"scv/number": "4", "scv/priority": "5"})
+        sched.submit(b)
+        sched.run_until_idle(max_cycles=50)
+        assert a.phase == PodPhase.BOUND
+        assert b.phase == PodPhase.FAILED  # gave up without evicting a
+
+
+class TestMixedCluster:
+    def test_partition_by_accelerator_label(self):
+        sched, _, _ = mk_sched(make_tpu_node("t1", chips=4), make_gpu_node("g1", cards=8))
+        tpu_pod = Pod("tp", labels={"tpu/accelerator": "tpu", "scv/number": "4"})
+        gpu_pod = Pod("gp", labels={"tpu/accelerator": "gpu", "scv/number": "8"})
+        sched.submit(tpu_pod)
+        sched.submit(gpu_pod)
+        sched.run_until_idle()
+        assert tpu_pod.node == "t1"
+        assert gpu_pod.node == "g1"
+
+    def test_unlabelled_pod_lands_anywhere_feasible(self):
+        sched, _, _ = mk_sched(make_tpu_node("t1"), make_gpu_node("g1"))
+        pod = Pod("any", labels={"scv/memory": "1000"})
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND
+
+
+class TestObservability:
+    def test_traces_and_metrics_emitted(self):
+        sched, _, _ = mk_sched(make_tpu_node("n1"))
+        pod = Pod("p", labels={"scv/memory": "1000"})
+        sched.submit(pod)
+        sched.run_until_idle()
+        traces = sched.traces.recent()
+        assert any(t.outcome == "bound" and t.pod == "default/p" for t in traces)
+        t = traces[-1]
+        assert t.filter_verdicts.get("n1") == "ok"
+        assert "n1" in t.scores
+        text = sched.metrics.render_prometheus()
+        assert "yoda_tpu_pods_scheduled_total 1" in text
+        assert "yoda_tpu_schedule_latency_ms_bucket" in text

@@ -1,0 +1,267 @@
+"""Unit tests for the filter predicates and scoring math (the pure-function
+test layer SURVEY.md §4 calls for; the reference ships zero tests)."""
+
+import time
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler.framework import CycleState, NodeInfo, Code
+from yoda_scheduler_tpu.scheduler.config import ScoreWeights
+from yoda_scheduler_tpu.scheduler.plugins import (
+    ChipAllocator,
+    GangCoordinator,
+    MaxCollection,
+    TelemetryFilter,
+    TelemetryScore,
+    TopologyScore,
+)
+from yoda_scheduler_tpu.telemetry import make_tpu_node, make_gpu_node, make_v4_slice
+from yoda_scheduler_tpu.utils import Pod, WorkloadSpec
+
+
+def mk_state(labels, now=None):
+    s = CycleState()
+    s.write("workload_spec", WorkloadSpec.from_labels(labels))
+    s.write("now", time.time() if now is None else now)
+    return s
+
+
+def node_info(metrics, pods=()):
+    return NodeInfo(name=metrics.node, metrics=metrics, pods=list(pods))
+
+
+def fresh_filter(**kw):
+    return TelemetryFilter(ChipAllocator(), GangCoordinator(), **kw)
+
+
+POD = Pod("p")
+
+
+class TestFilterPredicates:
+    def test_no_telemetry_unschedulable(self):
+        f = fresh_filter()
+        st = f.filter(mk_state({}), POD, NodeInfo(name="n", metrics=None))
+        assert st.code == Code.UNSCHEDULABLE and "telemetry" in st.message
+
+    def test_stale_telemetry_unschedulable(self):
+        f = fresh_filter(telemetry_max_age_s=10)
+        m = make_tpu_node("n")
+        m.heartbeat = 0.0
+        st = f.filter(mk_state({}, now=100.0), POD, node_info(m))
+        assert st.code == Code.UNSCHEDULABLE and "stale" in st.message
+
+    def test_default_one_chip(self):
+        # absent scv/number needs 1 chip (reference filter.go:15)
+        f = fresh_filter()
+        assert f.filter(mk_state({}), POD, node_info(make_tpu_node("n", chips=1))).ok
+        st = f.filter(mk_state({}), POD, node_info(make_tpu_node("n", chips=0)))
+        assert st.code == Code.UNSCHEDULABLE
+
+    def test_chip_count(self):
+        f = fresh_filter()
+        st = f.filter(mk_state({"scv/number": "5"}), POD, node_info(make_tpu_node("n", chips=4)))
+        assert st.code == Code.UNSCHEDULABLE
+        assert f.filter(mk_state({"scv/number": "4"}), POD, node_info(make_tpu_node("n", chips=4))).ok
+
+    def test_memory_per_chip(self):
+        # needs >=N chips with free HBM >= label (reference filter.go:18-33)
+        f = fresh_filter()
+        m = make_tpu_node("n", chips=4, hbm_free_mb=1000)
+        m.chips[0].hbm_free_mb = 5000
+        ok = f.filter(mk_state({"scv/memory": "4000", "scv/number": "1"}), POD, node_info(m))
+        assert ok.ok
+        st = f.filter(mk_state({"scv/memory": "4000", "scv/number": "2"}), POD, node_info(m))
+        assert st.code == Code.UNSCHEDULABLE
+
+    def test_clock_ge_semantics(self):
+        # reference filter demanded Clock == label (filter.go:57); we use >=
+        f = fresh_filter()
+        m = make_tpu_node("n", chips=2, clock_mhz=1000)
+        assert f.filter(mk_state({"scv/clock": "940"}), POD, node_info(m)).ok
+        st = f.filter(mk_state({"scv/clock": "1100"}), POD, node_info(m))
+        assert st.code == Code.UNSCHEDULABLE
+
+    def test_unhealthy_chips_dont_count(self):
+        f = fresh_filter()
+        m = make_tpu_node("n", chips=4, unhealthy=3)
+        st = f.filter(mk_state({"scv/number": "2"}), POD, node_info(m))
+        assert st.code == Code.UNSCHEDULABLE
+
+    def test_accelerator_partition(self):
+        f = fresh_filter()
+        gpu = make_gpu_node("g")
+        tpu = make_tpu_node("t")
+        st = f.filter(mk_state({"tpu/accelerator": "tpu"}), POD, node_info(gpu))
+        assert st.code == Code.UNSCHEDULABLE
+        assert f.filter(mk_state({"tpu/accelerator": "gpu"}), POD, node_info(gpu)).ok
+        assert f.filter(mk_state({"tpu/accelerator": "tpu"}), POD, node_info(tpu)).ok
+
+    def test_claimed_chips_not_reoffered(self):
+        # allocation awareness: bound pods' assigned chips are excluded
+        f = fresh_filter()
+        m = make_tpu_node("n", chips=4)
+        bound = Pod("b", labels={"scv/number": "3", "tpu/assigned-chips": "0,0,0;1,0,0;0,1,0"})
+        st = f.filter(mk_state({"scv/number": "2"}), POD, node_info(m, [bound]))
+        assert st.code == Code.UNSCHEDULABLE
+        assert f.filter(mk_state({"scv/number": "1"}), POD, node_info(m, [bound])).ok
+
+    def test_pending_reservations_not_reoffered(self):
+        alloc = ChipAllocator()
+        f = TelemetryFilter(alloc, GangCoordinator())
+        m = make_tpu_node("n", chips=4)
+        state = mk_state({"scv/number": "3"})
+        state.write("node_info:n", node_info(m))
+        assert f.filter(state, POD, node_info(m)).ok
+        assert alloc.reserve(state, Pod("r"), "n").ok
+        st = f.filter(state, POD, node_info(m))
+        assert st.code == Code.UNSCHEDULABLE  # only 1 chip left unreserved
+
+    def test_topology_label_requires_contiguous_block(self):
+        f = fresh_filter()
+        m = make_tpu_node("n", chips=4)  # coords form a 2x2 board
+        assert f.filter(mk_state({"tpu/topology": "2x2", "scv/number": "4"}), POD, node_info(m)).ok
+        # claim one corner -> 2x2 no longer fits
+        bound = Pod("b", labels={"scv/number": "1", "tpu/assigned-chips": "0,0,0"})
+        st = f.filter(mk_state({"tpu/topology": "2x2", "scv/number": "4"}), POD, node_info(m, [bound]))
+        assert st.code == Code.UNSCHEDULABLE
+
+    def test_gang_needs_big_enough_slice(self):
+        f = fresh_filter()
+        labels = {"tpu/gang-name": "j", "tpu/gang-size": "4", "scv/number": "4"}
+        standalone = make_tpu_node("n")
+        st = f.filter(mk_state(labels), POD, node_info(standalone))
+        assert st.code == Code.UNSCHEDULABLE  # no slice
+        small = make_v4_slice("s2", "2x2x2")[0]  # 2 hosts < gang 4
+        st = f.filter(mk_state(labels), POD, node_info(small))
+        assert st.code == Code.UNSCHEDULABLE
+        big = make_v4_slice("s4", "2x2x4")[0]
+        assert f.filter(mk_state(labels), POD, node_info(big)).ok
+
+    def test_gang_sticks_to_chosen_slice(self):
+        gangs = GangCoordinator()
+        gangs.choose_slice("j", "sliceA")
+        f = TelemetryFilter(ChipAllocator(), gangs)
+        labels = {"tpu/gang-name": "j", "tpu/gang-size": "2", "scv/number": "4"}
+        other = make_v4_slice("sliceB", "2x2x2")[0]
+        st = f.filter(mk_state(labels), POD, node_info(other))
+        assert st.code == Code.UNSCHEDULABLE and "sliceA" in st.message
+
+
+class TestScoringMath:
+    def feasible_pair(self):
+        a = make_tpu_node("a", chips=4, hbm_free_mb=30000)
+        b = make_tpu_node("b", chips=4, hbm_free_mb=10000)
+        return [node_info(a), node_info(b)]
+
+    def test_max_collection(self):
+        alloc = ChipAllocator()
+        state = mk_state({})
+        feas = self.feasible_pair()
+        feas[0].metrics.chips[0].clock_mhz = 1200
+        assert MaxCollection(alloc).pre_score(state, POD, feas).ok
+        mv = state.read("Max")
+        assert mv.free_memory == 30000
+        assert mv.clock == 1200
+        assert mv.total_memory == 32768
+
+    def test_max_collection_only_qualifying_chips(self):
+        alloc = ChipAllocator()
+        state = mk_state({"scv/memory": "20000"})
+        feas = self.feasible_pair()  # b's chips (10000 free) don't qualify
+        assert MaxCollection(alloc).pre_score(state, POD, feas).ok
+        assert state.read("Max").free_memory == 30000
+
+    def test_basic_score_hand_computed(self):
+        alloc = ChipAllocator()
+        state = mk_state({})
+        feas = self.feasible_pair()
+        scorer = TelemetryScore(alloc, ScoreWeights())
+        MaxCollection(alloc).pre_score(state, POD, feas)
+        s, st = scorer.score(state, POD, feas[0])
+        assert st.ok
+        # node a: 4 identical chips at every cluster max except free_memory
+        # (30000/30000) -> per chip: 100*(1+1+1+1) + 100*2 + 100*1 = 700
+        # basic = 2800; allocate = 100*3 = 300; actual = 30000/32768*100*2
+        expected = 2800 + 300 + (30000 / 32768) * 100 * 2
+        assert s == pytest.approx(expected)
+
+    def test_clock_normalised_by_max_clock_not_bandwidth(self):
+        # the reference divided clock by MaxBandwidth (algorithm.go:60);
+        # with bandwidth max 100 and clock max 1200 that inflates the clock
+        # term 12x — verify our clock term is bounded by its weight * 100
+        alloc = ChipAllocator()
+        state = mk_state({})
+        feas = self.feasible_pair()
+        for ni in feas:
+            for c in ni.metrics.chips:
+                c.clock_mhz = 1200
+                c.ici_bandwidth_gbps = 100
+        MaxCollection(alloc).pre_score(state, POD, feas)
+        s, _ = TelemetryScore(alloc, ScoreWeights()).score(state, POD, feas[0])
+        per_chip_max = 100 * (1 + 1 + 1 + 1 + 2 + 1)
+        assert s <= 4 * per_chip_max + 300 + 200  # basic + allocate + actual caps
+
+    def test_allocate_score_counts_multichip_claims(self):
+        alloc = ChipAllocator()
+        m = make_tpu_node("n", chips=4, hbm_total_mb=10000)  # total 40000
+        bound = Pod("b", labels={"scv/memory": "5000", "scv/number": "2"})
+        ni = node_info(m, [bound])
+        scorer = TelemetryScore(alloc, ScoreWeights())
+        # claimed = 5000*2 = 10000 -> headroom 75% * weight 3
+        assert scorer.allocate_score(ni) == pytest.approx(75.0 * 3)
+
+    def test_allocate_score_clamps_oversubscription(self):
+        alloc = ChipAllocator()
+        m = make_tpu_node("n", chips=1, hbm_total_mb=1000)
+        bound = Pod("b", labels={"scv/memory": "5000", "scv/number": "1"})
+        assert TelemetryScore(alloc).allocate_score(node_info(m, [bound])) == 0.0
+
+    def test_actual_score(self):
+        alloc = ChipAllocator()
+        m = make_tpu_node("n", chips=2, hbm_free_mb=8192, hbm_total_mb=32768)
+        assert TelemetryScore(alloc).actual_score(node_info(m)) == pytest.approx(25.0 * 2)
+
+    def test_free_memory_prefers_emptier_node(self):
+        alloc = ChipAllocator()
+        state = mk_state({})
+        feas = self.feasible_pair()
+        MaxCollection(alloc).pre_score(state, POD, feas)
+        scorer = TelemetryScore(alloc)
+        sa, _ = scorer.score(state, POD, feas[0])
+        sb, _ = scorer.score(state, POD, feas[1])
+        assert sa > sb
+
+
+class TestTopologyScore:
+    def test_prefers_contiguous_node(self):
+        alloc = ChipAllocator()
+        scorer = TopologyScore(alloc)
+        state = mk_state({"scv/number": "2"})
+        whole = node_info(make_tpu_node("whole", chips=4))
+        frag = make_tpu_node("frag", chips=4)
+        # claim opposite corners of frag's 2x2 board
+        frag_pods = [Pod("b", labels={"tpu/assigned-chips": "0,0,0;1,1,0"})]
+        fragmented = node_info(frag, frag_pods)
+        scorer.pre_score(state, POD, [whole, fragmented])
+        s_whole, _ = scorer.score(state, POD, whole)
+        s_frag, _ = scorer.score(state, POD, fragmented)
+        assert s_whole > s_frag
+
+    def test_packs_used_slice_first(self):
+        alloc = ChipAllocator()
+        scorer = TopologyScore(alloc, contiguity_frac=0.5)
+        state = mk_state({"scv/number": "4"})
+        used_slice = make_v4_slice("used", "2x2x2")
+        empty_slice = make_v4_slice("empty", "2x2x2")
+        # host 0 of "used" fully claimed
+        used_pods = [Pod("b", labels={"tpu/assigned-chips": "0,0,0;1,0,0;0,1,0;1,1,0"})]
+        feas = [
+            node_info(used_slice[1]),
+            node_info(empty_slice[0]),
+        ]
+        # pre_score must see the claimed host to compute slice usage
+        all_feas = [NodeInfo(name=used_slice[0].node, metrics=used_slice[0], pods=used_pods)] + feas
+        scorer.pre_score(state, POD, all_feas)
+        s_used, _ = scorer.score(state, POD, feas[0])
+        s_empty, _ = scorer.score(state, POD, feas[1])
+        assert s_used > s_empty

@@ -1,0 +1,122 @@
+import pytest
+
+from yoda_scheduler_tpu.scheduler.framework import (
+    CycleState,
+    Status,
+    Code,
+    QueuedPodInfo,
+    min_max_normalize,
+)
+from yoda_scheduler_tpu.scheduler.queue import SchedulingQueue
+from yoda_scheduler_tpu.scheduler.config import adaptive_percentage, SchedulerConfig, ScoreWeights
+from yoda_scheduler_tpu.scheduler.plugins.sort import PrioritySort
+from yoda_scheduler_tpu.utils import Pod
+
+
+def qp(name, prio=None, enqueued=0.0):
+    labels = {} if prio is None else {"scv/priority": str(prio)}
+    info = QueuedPodInfo(pod=Pod(name, labels=labels))
+    info.enqueued = enqueued
+    return info
+
+
+def test_cycle_state_read_write_clone():
+    s = CycleState()
+    s.write("k", 42)
+    assert s.read("k") == 42
+    with pytest.raises(KeyError):
+        s.read("missing")
+    assert s.read_or("missing", "d") == "d"
+    c = s.clone()
+    c.write("k", 7)
+    assert s.read("k") == 42 and c.read("k") == 7
+
+
+def test_status_truthiness_banned():
+    with pytest.raises(TypeError):
+        bool(Status.success())
+    assert Status.success().ok
+    assert Status.unschedulable("x").code == Code.UNSCHEDULABLE
+
+
+def test_min_max_normalize():
+    scores = {"a": 10.0, "b": 20.0, "c": 15.0}
+    min_max_normalize(scores)
+    assert scores == {"a": 0.0, "b": 100.0, "c": 50.0}
+    # all-equal: reference's lowest-- guard maps everything to 100
+    same = {"a": 5.0, "b": 5.0}
+    min_max_normalize(same)
+    assert same == {"a": 100.0, "b": 100.0}
+
+
+def test_priority_sort_orders_and_fifo_ties():
+    less = PrioritySort().less
+    assert less(qp("hi", prio=5), qp("lo", prio=1))
+    assert not less(qp("lo", prio=1), qp("hi", prio=5))
+    # absent/garbage priority behaves as 0 (reference sort.go:12-18)
+    assert less(qp("p1", prio=1), qp("none"))
+    assert not less(qp("garbage"), qp("p1", prio=1))
+    # FIFO tie-break
+    assert less(qp("first", prio=2, enqueued=1.0), qp("second", prio=2, enqueued=2.0))
+
+
+def test_queue_pop_priority_order():
+    q = SchedulingQueue(PrioritySort().less)
+    for name, prio in [("a", 1), ("b", 9), ("c", 5)]:
+        q.add(Pod(name, labels={"scv/priority": str(prio)}), now=0.0)
+    assert [q.pop(now=0.0).pod.name for _ in range(3)] == ["b", "c", "a"]
+    assert q.pop(now=0.0) is None
+
+
+def test_queue_backoff_exponential_and_flush():
+    q = SchedulingQueue(PrioritySort().less, initial_backoff_s=1.0, max_backoff_s=10.0)
+    q.add(Pod("p"), now=0.0)
+    info = q.pop(now=0.0)
+    q.requeue_backoff(info, now=0.0)           # attempt 1 -> 1s
+    assert q.pop(now=0.5) is None
+    info = q.pop(now=1.1)
+    assert info is not None
+    q.requeue_backoff(info, now=1.1)           # attempt 2 -> 2s
+    assert q.pop(now=2.0) is None
+    info = q.pop(now=3.2)
+    for _ in range(6):                         # saturate at max 10s
+        q.requeue_backoff(info, now=10.0)
+        info = q.pop(now=25.0)
+    q.requeue_backoff(info, now=100.0)
+    assert q.next_ready_at() == pytest.approx(110.0)
+
+
+def test_adaptive_percentage():
+    assert adaptive_percentage(50) == 50
+    assert adaptive_percentage(1000) == 42
+    assert adaptive_percentage(10000) == 5   # floor
+
+
+def test_config_from_profile_dict():
+    cfg = SchedulerConfig.from_profile(
+        {
+            "schedulerName": "yoda-scheduler",
+            "percentageOfNodesToScore": 30,
+            "pluginConfig": [
+                {
+                    "name": "yoda-tpu",
+                    "args": {
+                        "scoreWeights": {"free_memory": 4, "allocate": 1},
+                        "gangTimeoutSeconds": 5,
+                        "topologyWeight": 3,
+                    },
+                }
+            ],
+        }
+    )
+    assert cfg.percentage_of_nodes_to_score == 30
+    assert cfg.weights.free_memory == 4 and cfg.weights.allocate == 1
+    assert cfg.weights.bandwidth == 1  # untouched default
+    assert cfg.gang_timeout_s == 5.0 and cfg.topology_weight == 3
+
+
+def test_default_weights_match_reference():
+    # reference pkg/yoda/score/algorithm.go:16-26
+    w = ScoreWeights()
+    assert (w.bandwidth, w.clock, w.core, w.power, w.free_memory,
+            w.total_memory, w.actual, w.allocate) == (1, 1, 1, 1, 2, 1, 2, 3)

@@ -1,0 +1,132 @@
+"""Gang scheduling + multi-host slice placement — BASELINE scenario 4
+(Llama-2-7B on a multi-host v4-32 pod slice) and its failure modes."""
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import FakePublisher, TelemetryStore, make_tpu_node, make_v4_slice
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk_sched(nodes, config=None):
+    store = TelemetryStore()
+    pub = FakePublisher(store)
+    clock = FakeClock(start=1000.0)
+    for n in nodes:
+        store.put(n)
+        n.heartbeat = clock.time()
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return Scheduler(cluster, config or SchedulerConfig(), clock=clock), clock
+
+
+def gang_pods(name, size, chips=4, mem="16000"):
+    return [
+        Pod(
+            f"{name}-worker-{i}",
+            labels={
+                "tpu/gang-name": name,
+                "tpu/gang-size": str(size),
+                "scv/number": str(chips),
+                "scv/memory": mem,
+            },
+        )
+        for i in range(size)
+    ]
+
+
+def refresh(sched):
+    for m in sched.cluster.telemetry.list():
+        m.heartbeat = sched.clock.time()
+
+
+class TestGangAdmission:
+    def test_v4_32_gang_binds_atomically_on_one_slice(self):
+        # BASELINE #4: 4 workers x 4 chips on a 4-host v4-32 slice
+        nodes = make_v4_slice("v4-32-a", "2x2x4") + [make_tpu_node("standalone", chips=4)]
+        sched, _ = mk_sched(nodes)
+        workers = gang_pods("llama", 4)
+        for w in workers:
+            sched.submit(w)
+        sched.run_until_idle(max_cycles=100)
+        assert all(w.phase == PodPhase.BOUND for w in workers)
+        hosts = {w.node for w in workers}
+        assert len(hosts) == 4
+        assert all(h.startswith("v4-32-a-host-") for h in hosts)
+        # every chip of the slice claimed exactly once
+        all_chips = [c for w in workers for c in w.labels["tpu/assigned-chips"].split(";")]
+        assert len(all_chips) == 16 and len(set(all_chips)) == 16
+
+    def test_no_partial_gang_before_completion(self):
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes)
+        workers = gang_pods("job", 4)
+        # submit only 2 of 4 workers
+        for w in workers[:2]:
+            sched.submit(w)
+        for _ in range(4):
+            refresh(sched)
+            info = sched.queue.pop(now=clock.time())
+            if info:
+                sched.schedule_one(info)
+            clock.advance(0.5)
+        # nothing bound; both parked in Permit
+        assert all(w.phase == PodPhase.PENDING for w in workers[:2])
+        assert len(sched.waiting) == 2
+        # remaining workers arrive -> whole gang binds together
+        for w in workers[2:]:
+            sched.submit(w)
+        sched.run_until_idle(max_cycles=100)
+        assert all(w.phase == PodPhase.BOUND for w in workers)
+
+    def test_gang_timeout_rolls_back_reservations(self):
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, SchedulerConfig(gang_timeout_s=10.0, max_attempts=2))
+        workers = gang_pods("doomed", 4)
+        for w in workers[:2]:  # the rest never arrive
+            sched.submit(w)
+        for _ in range(3):
+            refresh(sched)
+            info = sched.queue.pop(now=clock.time())
+            if info:
+                sched.schedule_one(info)
+        assert len(sched.waiting) == 2
+        clock.advance(30.0)  # past the permit deadline
+        sched.check_waiting()
+        assert len(sched.waiting) == 0
+        assert sched.metrics.counters["gang_timeouts_total"] == 1
+        # reservations released: a non-gang 16-chip-per-host job can use the slice
+        refresh(sched)
+        free_pod = Pod("free", labels={"scv/number": "4"})
+        sched.submit(free_pod)
+        info = sched.queue.pop(now=clock.time())
+        while info is not None and info.pod.name != "free":
+            info = sched.queue.pop(now=clock.time())
+        assert info is not None
+        assert sched.schedule_one(info) == "bound"
+
+    def test_two_gangs_compete_one_slice_each(self):
+        nodes = make_v4_slice("sliceA", "2x2x4") + make_v4_slice("sliceB", "2x2x4")
+        sched, _ = mk_sched(nodes)
+        g1 = gang_pods("jobA", 4)
+        g2 = gang_pods("jobB", 4)
+        for w in g1 + g2:
+            sched.submit(w)
+        sched.run_until_idle(max_cycles=200)
+        assert all(w.phase == PodPhase.BOUND for w in g1 + g2)
+        slices1 = {w.node.rsplit("-host-", 1)[0] for w in g1}
+        slices2 = {w.node.rsplit("-host-", 1)[0] for w in g2}
+        assert len(slices1) == 1 and len(slices2) == 1
+        assert slices1 != slices2
+        assert sched.bin_pack_utilization() == pytest.approx(100.0)
+
+    def test_gang_too_big_for_any_slice_fails_cleanly(self):
+        nodes = make_v4_slice("s", "2x2x2")  # only 2 hosts
+        sched, _ = mk_sched(nodes, SchedulerConfig(max_attempts=2))
+        workers = gang_pods("big", 4)
+        for w in workers:
+            sched.submit(w)
+        sched.run_until_idle(max_cycles=200)
+        assert all(w.phase == PodPhase.FAILED for w in workers)
+        assert sched.bin_pack_utilization() == 0.0

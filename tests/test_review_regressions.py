@@ -1,0 +1,122 @@
+"""Regression tests for defects caught in code review: behaviours that unit
+tests of individual plugins missed because they only manifest through the
+default profile wiring or engine integration."""
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock, default_profile
+from yoda_scheduler_tpu.scheduler.framework import BindPlugin, CycleState, Status
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node, make_v4_slice
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk_sched(nodes, config=None, profile=None):
+    store = TelemetryStore()
+    clock = FakeClock(start=1000.0)
+    for n in nodes:
+        store.put(n)
+        n.heartbeat = clock.time()
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return Scheduler(cluster, config or SchedulerConfig(), profile=profile, clock=clock)
+
+
+def test_default_profile_registers_topology_prescore():
+    """TopologyScore must be wired as PreScore too, or slice packing is dead."""
+    profile, _, _ = default_profile(SchedulerConfig())
+    from yoda_scheduler_tpu.scheduler.plugins import TopologyScore
+
+    assert any(isinstance(p, TopologyScore) for p in profile.pre_score)
+    assert any(isinstance(p, TopologyScore) for p in profile.score)
+
+
+def test_slice_packing_live_through_default_profile():
+    """A 4-chip pod must land on the dented slice, not the pristine one."""
+    dented = make_v4_slice("dented", "2x2x2")
+    pristine = make_v4_slice("pristine", "2x2x2")
+    sched = mk_sched(dented + pristine)
+    filler = Pod("filler", labels={"scv/number": "4"})
+    sched.submit(filler)
+    sched.run_until_idle()
+    dent_slice = filler.node.rsplit("-host-", 1)[0]
+    probe = Pod("probe", labels={"scv/number": "4"})
+    sched.submit(probe)
+    sched.run_until_idle()
+    assert probe.node.rsplit("-host-", 1)[0] == dent_slice
+
+
+def test_preemption_minimises_victim_priority():
+    """Given equal victim counts, evict the LOWER-priority victim's node."""
+    sched = mk_sched([make_tpu_node("a", chips=4), make_tpu_node("b", chips=4)])
+    v_lo = Pod("v-lo", labels={"scv/number": "4", "scv/priority": "1"})
+    v_mid = Pod("v-mid", labels={"scv/number": "4", "scv/priority": "5"})
+    sched.submit(v_lo)
+    sched.submit(v_mid)
+    sched.run_until_idle()
+    assert v_lo.phase == PodPhase.BOUND and v_mid.phase == PodPhase.BOUND
+    hi = Pod("hi", labels={"scv/number": "4", "scv/priority": "9"})
+    sched.submit(hi)
+    sched.run_until_idle(max_cycles=40)
+    assert hi.phase == PodPhase.BOUND
+    assert v_lo.phase == PodPhase.PENDING   # the cheap victim was chosen
+    assert v_mid.phase == PodPhase.BOUND    # the pricier one survived
+
+
+class RecordingBinder(BindPlugin):
+    name = "recording-binder"
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.bound = []
+
+    def bind(self, state: CycleState, pod, node: str) -> Status:
+        self.bound.append((pod.key, node))
+        self.cluster.bind(pod, node, None)
+        return Status.success()
+
+
+def test_custom_binder_still_gets_chip_assignment():
+    """With a custom BindPlugin, pods must still carry tpu/assigned-chips so
+    allocation accounting holds next cycle (no double-claims)."""
+    cfg = SchedulerConfig()
+    profile, allocator, gang_permit = default_profile(cfg)
+    store = TelemetryStore()
+    clock = FakeClock(start=1000.0)
+    n = make_tpu_node("n", chips=4)
+    store.put(n)
+    n.heartbeat = clock.time()
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    binder = RecordingBinder(cluster)
+    profile.bind = binder
+    sched = Scheduler(cluster, cfg, profile=profile, clock=clock)
+    p1 = Pod("p1", labels={"scv/number": "2"})
+    p2 = Pod("p2", labels={"scv/number": "2"})
+    p3 = Pod("p3", labels={"scv/number": "2"})
+    for p in (p1, p2, p3):
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20)
+    assert binder.bound  # custom binder used
+    assert p1.labels.get("tpu/assigned-chips")
+    assert p2.labels.get("tpu/assigned-chips")
+    claimed = p1.assigned_chips() | p2.assigned_chips()
+    assert len(claimed) == 4          # no double-claim
+    assert p3.phase == PodPhase.PENDING  # node genuinely full
+
+
+def test_gang_peer_trace_latency_uses_scheduler_clock():
+    nodes = make_v4_slice("s", "2x2x4")
+    sched = mk_sched(nodes)
+    workers = [
+        Pod(f"w{i}", labels={"tpu/gang-name": "g", "tpu/gang-size": "4", "scv/number": "4"})
+        for i in range(4)
+    ]
+    for w in workers:
+        sched.submit(w)
+    sched.run_until_idle(max_cycles=50)
+    assert all(w.phase == PodPhase.BOUND for w in workers)
+    bind_traces = [t for t in sched.traces.recent(100) if t.outcome == "bound"]
+    assert len(bind_traces) == 4
+    for t in bind_traces:
+        assert 0.0 <= t.latency_ms < 60_000  # sane, same-clock latency

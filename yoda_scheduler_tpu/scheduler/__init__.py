@@ -1,0 +1,42 @@
+from .framework import (
+    Status,
+    Code,
+    CycleState,
+    NodeInfo,
+    Snapshot,
+    QueuedPodInfo,
+    QueueSortPlugin,
+    PreFilterPlugin,
+    FilterPlugin,
+    PostFilterPlugin,
+    PreScorePlugin,
+    ScorePlugin,
+    ReservePlugin,
+    PermitPlugin,
+    BindPlugin,
+)
+from .config import SchedulerConfig, ScoreWeights
+from .core import Scheduler
+from .cluster import FakeCluster
+
+__all__ = [
+    "Status",
+    "Code",
+    "CycleState",
+    "NodeInfo",
+    "Snapshot",
+    "QueuedPodInfo",
+    "QueueSortPlugin",
+    "PreFilterPlugin",
+    "FilterPlugin",
+    "PostFilterPlugin",
+    "PreScorePlugin",
+    "ScorePlugin",
+    "ReservePlugin",
+    "PermitPlugin",
+    "BindPlugin",
+    "SchedulerConfig",
+    "ScoreWeights",
+    "Scheduler",
+    "FakeCluster",
+]

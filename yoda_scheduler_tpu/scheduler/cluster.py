@@ -1,0 +1,91 @@
+"""Cluster backends: where pods live and binds land.
+
+The reference's cluster backend is the Kubernetes API server reached through
+client-go/controller-runtime (reference pkg/yoda/scheduler.go:53-68,111).
+Here the backend is an interface with two implementations:
+
+- FakeCluster (this module): in-memory API-server stand-in. Primary target
+  for tests and the benchmark harness — the fake control plane SURVEY.md §4
+  says the reference lacks entirely.
+- KubeCluster (k8s/client.py): the same interface over a real API server,
+  gated on network availability.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol
+
+from ..telemetry.store import TelemetryStore
+from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
+
+
+class Cluster(Protocol):
+    def node_names(self) -> list[str]: ...
+    def pods_on(self, node: str) -> list[Pod]: ...
+    def bind(self, pod: Pod, node: str, assigned_chips: list[tuple[int, int, int]] | None) -> None: ...
+    def evict(self, pod: Pod) -> None: ...
+
+
+class FakeCluster:
+    """In-memory nodes + bound-pod book-keeping, with a telemetry store
+    playing the role of the SCV CRD cache."""
+
+    def __init__(self, telemetry: TelemetryStore | None = None) -> None:
+        self.telemetry = telemetry or TelemetryStore()
+        self._lock = threading.RLock()
+        self._nodes: set[str] = set()
+        self._bound: dict[str, list[Pod]] = {}  # node -> pods
+
+    # ------------------------------------------------------------- node admin
+    def add_node(self, name: str) -> None:
+        with self._lock:
+            self._nodes.add(name)
+            self._bound.setdefault(name, [])
+
+    def add_nodes_from_telemetry(self) -> None:
+        for m in self.telemetry.list():
+            self.add_node(m.node)
+
+    def remove_node(self, name: str) -> list[Pod]:
+        """Node goes away; its pods return to the caller for requeueing."""
+        with self._lock:
+            self._nodes.discard(name)
+            orphans = self._bound.pop(name, [])
+        for p in orphans:
+            p.node = None
+            p.phase = PodPhase.PENDING
+        return orphans
+
+    # ---------------------------------------------------------------- reading
+    def node_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def pods_on(self, node: str) -> list[Pod]:
+        with self._lock:
+            return list(self._bound.get(node, []))
+
+    def all_pods(self) -> list[Pod]:
+        with self._lock:
+            return [p for pods in self._bound.values() for p in pods]
+
+    # ---------------------------------------------------------------- binding
+    def bind(self, pod: Pod, node: str,
+             assigned_chips: list[tuple[int, int, int]] | None = None) -> None:
+        with self._lock:
+            if node not in self._nodes:
+                raise KeyError(f"bind target {node!r} is not a node")
+            pod.node = node
+            pod.phase = PodPhase.BOUND
+            if assigned_chips is not None:
+                pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(assigned_chips)
+            self._bound[node].append(pod)
+
+    def evict(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.node and pod.node in self._bound:
+                self._bound[pod.node] = [p for p in self._bound[pod.node] if p.uid != pod.uid]
+        pod.node = None
+        pod.phase = PodPhase.PENDING
+        pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)

@@ -1,0 +1,22 @@
+from .sort import PrioritySort
+from .filter import TelemetryFilter
+from .prescore import MaxCollection, MAX_KEY, SPEC_KEY
+from .score import TelemetryScore
+from .topology import TopologyScore
+from .allocator import ChipAllocator
+from .gang import GangCoordinator, GangPermit
+from .preempt import PriorityPreemption
+
+__all__ = [
+    "PrioritySort",
+    "TelemetryFilter",
+    "MaxCollection",
+    "TelemetryScore",
+    "TopologyScore",
+    "ChipAllocator",
+    "GangCoordinator",
+    "GangPermit",
+    "PriorityPreemption",
+    "MAX_KEY",
+    "SPEC_KEY",
+]

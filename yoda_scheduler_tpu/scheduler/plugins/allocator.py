@@ -1,0 +1,111 @@
+"""Chip allocation ledger (Reserve plugin).
+
+No counterpart in the reference: it filters/scores on card counts but never
+decides *which* cards a pod gets — that was left to the GPU device plugin.
+On TPU, which chips matters (ICI contiguity), so the scheduler assigns
+concrete chip coordinates at Reserve time, the binder publishes them on the
+pod (``tpu/assigned-chips``), and pending reservations are visible to
+subsequent cycles so gang members accumulating on a slice cannot
+double-claim chips.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..framework import CycleState, NodeInfo, ReservePlugin, Status
+from ...telemetry.schema import TpuNodeMetrics
+from ...topology.torus import Coord, best_fit_block, fits_shape, parse_topology
+from ...utils.labels import WorkloadSpec
+from ...utils.pod import Pod
+
+
+class ChipAllocator(ReservePlugin):
+    name = "chip-allocator"
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._pending: dict[str, tuple[str, list[Coord]]] = {}  # pod.key -> (node, coords)
+
+    # ----------------------------------------------------------------- views
+    def pending_on(self, node: str) -> set[Coord]:
+        with self._lock:
+            return {c for n, coords in self._pending.values() if n == node for c in coords}
+
+    def pending_chip_count(self, node: str) -> int:
+        return len(self.pending_on(node))
+
+    def free_coords(self, node_info: NodeInfo) -> set[Coord]:
+        """Healthy chips not claimed by bound pods nor pending reservations."""
+        m = node_info.metrics
+        if m is None:
+            return set()
+        healthy = {c.coords for c in m.healthy_chips()}
+        return healthy - node_info.assigned_coords() - self.pending_on(node_info.name)
+
+    def assignment_of(self, pod: Pod) -> tuple[str, list[Coord]] | None:
+        with self._lock:
+            return self._pending.get(pod.key)
+
+    # ------------------------------------------------------------ placement
+    def pick_chips(self, spec: WorkloadSpec, node_info: NodeInfo) -> list[Coord] | None:
+        """Choose concrete chips for the spec on this node, best-fit
+        contiguous. Falls back to any qualifying chips when the node's free
+        space has no contiguous block (still schedulable, just lower quality —
+        the topology scorer will have steered away from such nodes)."""
+        m = node_info.metrics
+        if m is None:
+            return None
+        free = self.free_coords(node_info)
+        qualifying = {
+            c.coords
+            for c in m.healthy_chips()
+            if c.coords in free
+            and c.hbm_free_mb >= spec.min_free_mb
+            and c.clock_mhz >= spec.min_clock_mhz
+        }
+        if len(qualifying) < spec.chips:
+            return None
+        shape = _node_shape(m)
+        if spec.topology is not None:
+            fit = fits_shape(shape, qualifying, parse_topology(spec.topology))
+            if fit is None:
+                return None
+            return sorted(fit[2])
+        fit = best_fit_block(shape, qualifying, spec.chips)
+        if fit is not None:
+            return sorted(fit[2])
+        return sorted(qualifying)[: spec.chips]
+
+    # ---------------------------------------------------------- reserve hook
+    def reserve(self, state: CycleState, pod: Pod, node: str) -> Status:
+        node_info = state.read_or("node_info:" + node)
+        spec = state.read_or("workload_spec")
+        if node_info is None or spec is None:
+            return Status.error("allocator: cycle state missing node_info/spec")
+        coords = self.pick_chips(spec, node_info)
+        if coords is None:
+            return Status.unschedulable(f"{node}: chips vanished before reserve")
+        with self._lock:
+            self._pending[pod.key] = (node, coords)
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node: str) -> None:
+        with self._lock:
+            self._pending.pop(pod.key, None)
+
+    def complete(self, pod: Pod) -> list[Coord] | None:
+        """Called by the binder: consume the reservation."""
+        with self._lock:
+            entry = self._pending.pop(pod.key, None)
+        return entry[1] if entry else None
+
+
+def _node_shape(m: TpuNodeMetrics) -> tuple[int, int, int]:
+    """Bounding box of this node's chip coordinates (coords are slice-global,
+    so this is the enclosing box; placement search intersects it with the
+    node's actual free set)."""
+    xs = [c.coords[0] for c in m.chips] or [0]
+    ys = [c.coords[1] for c in m.chips] or [0]
+    zs = [c.coords[2] for c in m.chips] or [0]
+    return (max(xs) + 1, max(ys) + 1, max(zs) + 1)

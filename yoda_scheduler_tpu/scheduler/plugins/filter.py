@@ -1,0 +1,106 @@
+"""Filter plugin: node feasibility from live telemetry + allocation ledger.
+
+Capability parity with the reference's three predicates
+(pkg/yoda/filter/filter.go):
+- PodFitsNumber (filter.go:11-16)  -> enough unclaimed healthy chips
+- PodFitsMemory (filter.go:18-33)  -> >= N chips with free HBM >= scv/memory
+- PodFitsClock  (filter.go:35-50)  -> >= N chips with clock >= scv/clock
+  (>= semantics, resolving the ==-vs->= inconsistency; SURVEY §3.3)
+
+plus TPU-native predicates the reference has no equivalent for:
+- telemetry freshness (stale sniffer = unschedulable, not trusted)
+- accelerator-type partition for mixed GPU+TPU clusters (BASELINE #5)
+- allocation awareness: chips already claimed by bound pods and pending
+  gang reservations are not offered twice (the reference re-offered the
+  same cards until the live telemetry caught up)
+- exact ICI block shape for ``tpu/topology`` requests
+- gang pods only land on slices big enough for the whole gang, and stick
+  to the slice the gang's first member chose.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..framework import CycleState, FilterPlugin, NodeInfo, Status
+from ...topology.torus import fits_shape, parse_topology, best_fit_block
+from ...utils.labels import WorkloadSpec
+from .allocator import ChipAllocator, _node_shape
+from .gang import GangCoordinator
+
+
+class TelemetryFilter(FilterPlugin):
+    name = "telemetry-filter"
+
+    def __init__(self, allocator: ChipAllocator, gangs: GangCoordinator | None = None,
+                 telemetry_max_age_s: float = 60.0, require_contiguous: bool = False) -> None:
+        self.allocator = allocator
+        self.gangs = gangs
+        self.max_age = telemetry_max_age_s
+        self.require_contiguous = require_contiguous
+
+    def filter(self, state: CycleState, pod, node: NodeInfo) -> Status:
+        spec: WorkloadSpec = state.read("workload_spec")
+        m = node.metrics
+        # telemetry presence: reference returns Unschedulable "Node:%v scv is not exist"
+        # on cache miss (pkg/yoda/scheduler.go:80-84)
+        if m is None:
+            return Status.unschedulable(f"{node.name}: no accelerator telemetry")
+        if m.stale(now=state.read_or("now", time.time()), max_age_s=self.max_age):
+            return Status.unschedulable(f"{node.name}: telemetry stale")
+        if spec.accelerator is not None and m.accelerator != spec.accelerator:
+            return Status.unschedulable(
+                f"{node.name}: accelerator {m.accelerator} != requested {spec.accelerator}"
+            )
+
+        # gang constraints: whole gang must fit one slice; follow the chosen slice
+        if spec.is_gang:
+            if not m.slice_id:
+                return Status.unschedulable(f"{node.name}: gang pod needs a pod-slice node")
+            if m.num_hosts < spec.gang_size:
+                return Status.unschedulable(
+                    f"{node.name}: slice {m.slice_id} has {m.num_hosts} hosts < gang size {spec.gang_size}"
+                )
+            if self.gangs is not None:
+                chosen = self.gangs.chosen_slice(spec.gang_name)
+                if chosen is not None and chosen != m.slice_id:
+                    return Status.unschedulable(
+                        f"{node.name}: gang {spec.gang_name} is placing on slice {chosen}"
+                    )
+
+        # chips-count predicate over *unclaimed* healthy chips
+        free = self.allocator.free_coords(node)
+        if len(free) < spec.chips:
+            return Status.unschedulable(
+                f"{node.name}: {len(free)} unclaimed healthy chips < {spec.chips} requested"
+            )
+
+        # per-chip memory + clock predicates over unclaimed healthy chips
+        qualifying = [
+            c for c in m.healthy_chips()
+            if c.coords in free
+            and c.hbm_free_mb >= spec.min_free_mb
+            and c.clock_mhz >= spec.min_clock_mhz
+        ]
+        if len(qualifying) < spec.chips:
+            return Status.unschedulable(
+                f"{node.name}: only {len(qualifying)} chips satisfy "
+                f"hbm>={spec.min_free_mb}MB clock>={spec.min_clock_mhz}MHz "
+                f"(need {spec.chips})"
+            )
+
+        # exact topology request must fit contiguously
+        if spec.topology is not None:
+            qcoords = {c.coords for c in qualifying}
+            if fits_shape(_node_shape(m), qcoords, parse_topology(spec.topology)) is None:
+                return Status.unschedulable(
+                    f"{node.name}: no free contiguous {spec.topology} block"
+                )
+        elif self.require_contiguous and spec.chips > 1:
+            qcoords = {c.coords for c in qualifying}
+            if best_fit_block(_node_shape(m), qcoords, spec.chips) is None:
+                return Status.unschedulable(
+                    f"{node.name}: no contiguous block of {spec.chips} chips"
+                )
+
+        return Status.success()

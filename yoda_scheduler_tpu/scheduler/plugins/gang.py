@@ -1,0 +1,112 @@
+"""Gang scheduling: all-or-nothing admission of multi-host pod-slice jobs.
+
+New TPU-native capability (SURVEY §7 "hard part (1)"): a v4-32 Llama job is
+one worker pod per host of a 4-host slice; binding 3 of 4 workers deadlocks
+the job while holding 12 chips. The k8s framework scores nodes one pod at a
+time, so cross-pod state lives in a shared GangCoordinator and admission
+goes through Permit:
+
+- first member to Reserve picks the slice (members' Filter then sticks to it)
+- every member's Permit returns WAIT until the gang is complete
+- the last member's arrival approves all waiting members (bind together)
+- timeout or any member's failure rejects the whole gang: all reservations
+  roll back, the chosen slice resets, everything requeues with backoff.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..framework import CycleState, PermitPlugin, ReservePlugin, Status
+from ...utils.labels import WorkloadSpec
+from ...utils.pod import Pod
+
+
+class GangCoordinator:
+    """Shared cross-cycle gang state (gang name -> members/slice)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._slice: dict[str, str] = {}          # gang -> chosen slice id
+        self._waiting: dict[str, set[str]] = {}   # gang -> waiting pod keys
+
+    def chosen_slice(self, gang: str) -> str | None:
+        with self._lock:
+            return self._slice.get(gang)
+
+    def choose_slice(self, gang: str, slice_id: str) -> None:
+        with self._lock:
+            self._slice.setdefault(gang, slice_id)
+
+    def add_waiting(self, gang: str, pod_key: str) -> int:
+        with self._lock:
+            s = self._waiting.setdefault(gang, set())
+            s.add(pod_key)
+            return len(s)
+
+    def waiting_members(self, gang: str) -> set[str]:
+        with self._lock:
+            return set(self._waiting.get(gang, set()))
+
+    def reset(self, gang: str) -> set[str]:
+        """Tear down gang state; returns the members that were waiting."""
+        with self._lock:
+            members = self._waiting.pop(gang, set())
+            self._slice.pop(gang, None)
+            return members
+
+
+class GangPermit(PermitPlugin, ReservePlugin):
+    name = "gang-permit"
+
+    def __init__(self, gangs: GangCoordinator, timeout_s: float = 30.0) -> None:
+        self.gangs = gangs
+        self.timeout_s = timeout_s
+
+    # Reserve: the first member fixes the slice choice for the whole gang.
+    def reserve(self, state: CycleState, pod: Pod, node: str) -> Status:
+        spec: WorkloadSpec = state.read("workload_spec")
+        if spec.is_gang:
+            node_info = state.read_or("node_info:" + node)
+            if node_info is not None and node_info.metrics is not None:
+                self.gangs.choose_slice(spec.gang_name, node_info.metrics.slice_id)
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node: str) -> None:
+        return None
+
+    def permit(self, state: CycleState, pod: Pod, node: str) -> tuple[Status, float]:
+        spec: WorkloadSpec = state.read("workload_spec")
+        if not spec.is_gang:
+            return Status.success(), 0.0
+        n_waiting = self.gangs.add_waiting(spec.gang_name, pod.key)
+        if n_waiting >= spec.gang_size:
+            # gang complete: this pod proceeds; the engine approves the rest
+            return Status.success(), 0.0
+        return Status.wait(
+            f"gang {spec.gang_name}: {n_waiting}/{spec.gang_size} members placed"
+        ), self.timeout_s
+
+    # ------------------------------------------------------------ engine hooks
+    def peers_to_approve(self, pod: Pod) -> set[str]:
+        """After `pod`'s Permit succeeded, which waiting pods bind with it."""
+        try:
+            spec = WorkloadSpec.from_labels(pod.labels)
+        except Exception:
+            return set()
+        if not spec.is_gang:
+            return set()
+        members = self.gangs.reset(spec.gang_name)
+        members.discard(pod.key)
+        return members
+
+    def gang_of(self, pod: Pod) -> str | None:
+        try:
+            spec = WorkloadSpec.from_labels(pod.labels)
+        except Exception:
+            return None
+        return spec.gang_name
+
+    def fail_gang(self, gang: str) -> set[str]:
+        """Timeout/failure: tear down and report members needing rollback."""
+        return self.gangs.reset(gang)

@@ -1,0 +1,90 @@
+"""PostFilter plugin: priority preemption.
+
+In the modern scheduling framework PostFilter is the preemption hook — the
+role the reference's upstream engine provided and the reference accidentally
+displaced by registering its aggregation pass there (SURVEY §3.2). Native
+rebuild: when no node passes Filter, evict the cheapest set of strictly
+lower-priority pods (by ``scv/priority``) from one node so the pod fits next
+cycle. The plugin returns the victim plan; the engine evicts.
+
+Fit simulation uses the *allocation* view (chip coords + label claims) and
+chip HBM capacity — measured free HBM cannot be simulated for evicted pods
+because their memory is only released once they actually terminate.
+"""
+
+from __future__ import annotations
+
+from ..framework import CycleState, NodeInfo, PostFilterPlugin, QueuedPodInfo, Snapshot, Status
+from ...utils.labels import WorkloadSpec
+from ...utils.pod import Pod
+from .allocator import ChipAllocator
+from .sort import pod_priority
+
+
+def _priority(pod: Pod) -> int:
+    return pod_priority(QueuedPodInfo(pod=pod))
+
+
+class PriorityPreemption(PostFilterPlugin):
+    name = "priority-preemption"
+
+    def __init__(self, allocator: ChipAllocator) -> None:
+        self.allocator = allocator
+
+    def post_filter(self, state: CycleState, pod: Pod, snapshot: Snapshot,
+                    failures: dict[str, str]) -> tuple[str | None, list[Pod], Status]:
+        spec: WorkloadSpec = state.read("workload_spec")
+        now = state.read_or("now")
+        my_prio = _priority(pod)
+        # minimal disruption: fewest victims, then lowest max victim priority
+        best: tuple[tuple, str, list[Pod]] | None = None
+        for node in snapshot.list():
+            plan = self._plan_eviction(spec, my_prio, node, now=now)
+            if plan is None:
+                continue
+            key = (len(plan), max(_priority(v) for v in plan), node.name)
+            if best is None or key < best[0]:
+                best = (key, node.name, plan)
+        if best is None:
+            return None, [], Status.unschedulable(
+                f"preemption: no node can fit {pod.key} even after evicting "
+                f"lower-priority pods"
+            )
+        return best[1], best[2], Status.success()
+
+    def _plan_eviction(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
+                       now: float | None = None) -> list[Pod] | None:
+        """Smallest non-empty victim set on this node that frees enough
+        qualifying chips; victims chosen lowest-priority-first. None if
+        impossible — or if no eviction is needed at all, in which case the
+        pod's infeasibility has a non-capacity cause preemption cannot cure
+        (stale telemetry, accelerator mismatch, gang constraints)."""
+        m = node.metrics
+        if m is None:
+            return None
+        if now is not None and m.stale(now=now):
+            return None
+        if spec.accelerator is not None and m.accelerator != spec.accelerator:
+            return None
+        if spec.is_gang:
+            return None  # gangs don't preempt in v1: cross-node all-or-nothing eviction
+        # capacity check against chip HBM totals (see module docstring)
+        ok_coords = {
+            c.coords for c in m.healthy_chips()
+            if c.hbm_total_mb >= spec.min_free_mb and c.clock_mhz >= spec.min_clock_mhz
+        }
+        if len(ok_coords) < spec.chips:
+            return None
+        pool = sorted(
+            (p for p in node.pods if _priority(p) < my_prio),
+            key=_priority,
+        )
+        free = self.allocator.free_coords(node)
+        victims: list[Pod] = []
+        while len(free & ok_coords) < spec.chips:
+            if not pool:
+                return None
+            v = pool.pop(0)
+            victims.append(v)
+            free = free | v.assigned_chips()
+        return victims or None

@@ -1,0 +1,63 @@
+"""PreScore plugin: one cluster-wide aggregation pass per pod.
+
+Capability from the reference's collection step (pkg/yoda/collection/
+collection.go:30-57): fold per-chip maxima across all *feasible* nodes'
+*qualifying* chips into cycle state so per-node scoring can normalise each
+attribute to a percentage of the cluster max. The reference ran this in
+PostFilter — a hook that only fires for unschedulable pods on its pinned
+k8s (SURVEY §3.2 hazard); here it runs where it belongs, between Filter and
+Score, fed exactly the feasible node list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..framework import CycleState, NodeInfo, PreScorePlugin, Status
+from ...utils.labels import WorkloadSpec
+from .allocator import ChipAllocator
+
+MAX_KEY = "Max"              # same cycle-state key name as the reference
+SPEC_KEY = "workload_spec"
+
+
+@dataclass
+class MaxValue:
+    """Cluster maxima among qualifying chips (reference collection.go:14-21).
+    Initialised to 1 so normalisation never divides by zero (reference
+    collection.go:31-38)."""
+
+    bandwidth: int = 1
+    clock: int = 1
+    core: int = 1
+    free_memory: int = 1
+    power: int = 1
+    total_memory: int = 1
+
+
+class MaxCollection(PreScorePlugin):
+    name = "max-collection"
+
+    def __init__(self, allocator: ChipAllocator) -> None:
+        self.allocator = allocator
+
+    def pre_score(self, state: CycleState, pod, feasible: list[NodeInfo]) -> Status:
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        mv = MaxValue()
+        for node in feasible:
+            m = node.metrics
+            if m is None:
+                continue
+            free = self.allocator.free_coords(node)
+            for c in m.healthy_chips():
+                if (c.coords in free
+                        and c.hbm_free_mb >= spec.min_free_mb
+                        and c.clock_mhz >= spec.min_clock_mhz):
+                    mv.bandwidth = max(mv.bandwidth, c.ici_bandwidth_gbps)
+                    mv.clock = max(mv.clock, c.clock_mhz)
+                    mv.core = max(mv.core, c.core_count)
+                    mv.free_memory = max(mv.free_memory, c.hbm_free_mb)
+                    mv.power = max(mv.power, c.power_w)
+                    mv.total_memory = max(mv.total_memory, c.hbm_total_mb)
+        state.write(MAX_KEY, mv)
+        return Status.success()

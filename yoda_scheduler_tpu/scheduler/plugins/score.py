@@ -1,0 +1,94 @@
+"""Score plugin: max-normalised weighted telemetry score with
+allocated-vs-actual dual accounting.
+
+Capability parity with the reference's scoring algorithm (pkg/yoda/score/
+algorithm.go:28-87): node score = Basic + Allocate + Actual where
+
+- Basic (algorithm.go:41-68): for each qualifying chip, each attribute is
+  scaled to a percentage of the cluster max from PreScore, then weighted and
+  summed. Two reference defects not replicated: the clock term divided by
+  MaxBandwidth instead of MaxClock (algorithm.go:60), and integer division
+  losing sub-percent resolution (we score in float).
+- Allocate (algorithm.go:74-87): label-*claimed* HBM headroom — resists
+  over-commit when telemetry lags bound-but-not-yet-running pods. We count
+  per-chip claims x chips (the reference summed the per-chip ``scv/memory``
+  label as if it were a node total, under-counting multi-chip pods).
+- Actual (algorithm.go:70-72): *measured* free/total HBM ratio — resists
+  stale labels. Keeping both views is the deliberate capability (SURVEY §3.3).
+
+Weights are configuration (ScoreWeights), not compile-time constants.
+Normalisation to [0,100] follows the reference's min-max NormalizeScore
+(pkg/yoda/scheduler.go:132-157).
+"""
+
+from __future__ import annotations
+
+from ..config import ScoreWeights
+from ..framework import CycleState, NodeInfo, ScorePlugin, Status, min_max_normalize
+from ...utils.labels import WorkloadSpec
+from .allocator import ChipAllocator
+from .prescore import MAX_KEY, SPEC_KEY, MaxValue
+
+
+class TelemetryScore(ScorePlugin):
+    name = "telemetry-score"
+
+    def __init__(self, allocator: ChipAllocator, weights: ScoreWeights | None = None,
+                 weight: int = 1) -> None:
+        self.allocator = allocator
+        self.weights = weights or ScoreWeights()
+        self.weight = weight
+
+    # ------------------------------------------------------------ components
+    def basic_score(self, mv: MaxValue, spec: WorkloadSpec, node: NodeInfo) -> float:
+        m = node.metrics
+        if m is None:
+            return 0.0
+        w = self.weights
+        free = self.allocator.free_coords(node)
+        total = 0.0
+        for c in m.healthy_chips():
+            if (c.coords in free
+                    and c.hbm_free_mb >= spec.min_free_mb
+                    and c.clock_mhz >= spec.min_clock_mhz):
+                total += (
+                    100.0 * c.ici_bandwidth_gbps / mv.bandwidth * w.bandwidth
+                    + 100.0 * c.clock_mhz / mv.clock * w.clock
+                    + 100.0 * c.core_count / mv.core * w.core
+                    + 100.0 * c.power_w / mv.power * w.power
+                    + 100.0 * c.hbm_free_mb / mv.free_memory * w.free_memory
+                    + 100.0 * c.hbm_total_mb / mv.total_memory * w.total_memory
+                )
+        return total
+
+    def allocate_score(self, node: NodeInfo) -> float:
+        """Label-claimed headroom, clamped at 0 when oversubscribed
+        (reference algorithm.go:82-84)."""
+        m = node.metrics
+        if m is None or m.hbm_total_sum == 0:
+            return 0.0
+        claimed = node.claimed_hbm_mb()
+        if claimed > m.hbm_total_sum:
+            return 0.0
+        return 100.0 * (m.hbm_total_sum - claimed) / m.hbm_total_sum * self.weights.allocate
+
+    def actual_score(self, node: NodeInfo) -> float:
+        m = node.metrics
+        if m is None or m.hbm_total_sum == 0:
+            return 0.0
+        return 100.0 * m.hbm_free_sum / m.hbm_total_sum * self.weights.actual
+
+    # -------------------------------------------------------------- plugin API
+    def score(self, state: CycleState, pod, node: NodeInfo) -> tuple[float, Status]:
+        mv: MaxValue = state.read_or(MAX_KEY)
+        if mv is None:
+            # the reference hard-errors here because its PostFilter never ran
+            # (algorithm.go:29-32); with a real PreScore this cannot happen —
+            # keep the guard as an internal error, not a scheduling failure
+            return 0.0, Status.error("PreScore never wrote Max")
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        s = self.basic_score(mv, spec, node) + self.allocate_score(node) + self.actual_score(node)
+        return s, Status.success()
+
+    def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
+        min_max_normalize(scores)

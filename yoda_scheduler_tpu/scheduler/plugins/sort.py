@@ -1,0 +1,32 @@
+"""Queue-sort plugin: strict priority by ``scv/priority`` label.
+
+Reference: pkg/yoda/sort/sort.go:8-18 — higher label value schedules first,
+absent/unparseable treated as 0. We add a FIFO tie-break on enqueue time so
+equal-priority pods cannot starve each other (the reference's comparator is
+not a strict weak order on ties; upstream's queue happened to mask that).
+"""
+
+from __future__ import annotations
+
+from ..framework import QueueSortPlugin, QueuedPodInfo
+from ...utils.labels import PRIORITY_LABEL
+
+
+def pod_priority(info: QueuedPodInfo) -> int:
+    raw = info.pod.labels.get(PRIORITY_LABEL)
+    if raw is None:
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        return 0  # queue sort cannot reject; the filter will surface the error
+
+
+class PrioritySort(QueueSortPlugin):
+    name = "priority-sort"
+
+    def less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        pa, pb = pod_priority(a), pod_priority(b)
+        if pa != pb:
+            return pa > pb
+        return a.enqueued < b.enqueued

@@ -1,0 +1,83 @@
+"""Topology-aware score plugin — new TPU-native capability (SURVEY §7.7).
+
+Two terms, both absent from the GPU reference:
+
+- contiguity: how cleanly the pod's chips can be carved as one axis-aligned
+  ICI block on this node, and how little fragmentation the best placement
+  leaves behind (torus.contiguity_score). XLA collectives ride ICI between
+  torus neighbours; non-contiguous assignments force longer paths.
+- slice conservation/packing: single-host jobs prefer standalone nodes, and
+  among slice nodes prefer already-dented slices over pristine ones — whole
+  slices stay free for multi-host gangs, and fragmentation concentrates
+  (classic best-fit bin-packing behaviour).
+
+Both scored 0..100 and blended; the plugin's weight (config.topology_weight)
+sets its strength against the telemetry score.
+"""
+
+from __future__ import annotations
+
+from ..framework import CycleState, NodeInfo, PreScorePlugin, ScorePlugin, Status, min_max_normalize
+from ...topology.torus import contiguity_score
+from ...utils.labels import WorkloadSpec
+from .allocator import ChipAllocator, _node_shape
+from .prescore import SPEC_KEY
+
+SLICE_USE_KEY = "slice_usage"
+
+
+class TopologyScore(ScorePlugin, PreScorePlugin):
+    name = "topology-score"
+
+    def __init__(self, allocator: ChipAllocator, weight: int = 2,
+                 contiguity_frac: float = 0.7) -> None:
+        self.allocator = allocator
+        self.weight = weight
+        self.contiguity_frac = contiguity_frac
+
+    def pre_score(self, state: CycleState, pod, feasible: list[NodeInfo]) -> Status:
+        """Compute per-slice usage over the WHOLE snapshot — a slice's full
+        hosts are exactly the ones missing from the feasible list, and they
+        are what makes the slice 'dented'."""
+        snapshot = state.read_or("snapshot")
+        nodes = snapshot.list() if snapshot is not None else feasible
+        usage: dict[str, tuple[int, int]] = {}  # slice -> (used, total)
+        for node in nodes:
+            m = node.metrics
+            if m is None or not m.slice_id:
+                continue
+            used_here = m.chip_count - len(self.allocator.free_coords(node))
+            u, t = usage.get(m.slice_id, (0, 0))
+            usage[m.slice_id] = (u + used_here, t + m.chip_count)
+        state.write(SLICE_USE_KEY, usage)
+        return Status.success()
+
+    def score(self, state: CycleState, pod, node: NodeInfo) -> tuple[float, Status]:
+        m = node.metrics
+        if m is None:
+            return 0.0, Status.success()
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        free = self.allocator.free_coords(node)
+        cont = contiguity_score(_node_shape(m), free, min(spec.chips, len(free)))
+        if not m.slice_id or m.num_hosts <= 1:
+            # standalone node: perfect from a slice-conservation standpoint
+            # for non-gang work (gang pods never reach here: Filter requires
+            # a slice for them)
+            packing = 100.0
+        else:
+            used, total = state.read_or(SLICE_USE_KEY, {}).get(m.slice_id, (0, 0))
+            if spec.is_gang:
+                # a gang consumes hosts wholesale; pristine slices are ideal
+                packing = 100.0 * (total - used) / total if total else 0.0
+            else:
+                # single-node job on a multi-host slice: only attractive if the
+                # slice is already dented (concentrate fragmentation)
+                packing = 100.0 * used / total if total else 0.0
+        s = self.contiguity_frac * cont + (1.0 - self.contiguity_frac) * packing
+        return s, Status.success()
+
+    def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
+        # already on a 0..100 scale by construction; min-max would erase the
+        # absolute meaning (a lone feasible node with poor contiguity must not
+        # inflate to 100)
+        return None

@@ -1,0 +1,85 @@
+"""Active queue + backoff for pending pods.
+
+The upstream engine the reference embeds provides the priority queue and the
+unschedulable-pod backoff (configured 1s initial / 10s max in reference
+deploy/yoda-scheduler.yaml:19-20); the plugin only supplies the comparator
+(reference pkg/yoda/sort/sort.go:8-10). This module is the native
+equivalent: a comparator-ordered active queue plus a backoff parking lot.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from .framework import QueuedPodInfo
+from ..utils.pod import Pod
+
+LessFn = Callable[[QueuedPodInfo, QueuedPodInfo], bool]
+
+
+class SchedulingQueue:
+    def __init__(self, less: LessFn, initial_backoff_s: float = 1.0, max_backoff_s: float = 10.0):
+        self._less = less
+        self._initial = initial_backoff_s
+        self._max = max_backoff_s
+        self._active: list[QueuedPodInfo] = []
+        self._backoff: list[QueuedPodInfo] = []
+
+    def add(self, pod: Pod, now: float | None = None) -> None:
+        info = QueuedPodInfo(pod=pod)
+        if now is not None:
+            info.enqueued = now
+        self._active.append(info)
+
+    def __len__(self) -> int:
+        return len(self._active) + len(self._backoff)
+
+    def pending(self) -> int:
+        return len(self)
+
+    def _flush_backoff(self, now: float) -> None:
+        ready = [q for q in self._backoff if q.not_before <= now]
+        if ready:
+            self._backoff = [q for q in self._backoff if q.not_before > now]
+            self._active.extend(ready)
+
+    def pop(self, now: float | None = None) -> QueuedPodInfo | None:
+        """Pop the highest-priority ready pod (None if all are backing off).
+
+        Selection sort via the comparator — the queue is small relative to the
+        cost of a cycle, and the comparator contract (strict weak order via
+        `less`) matches the framework interface exactly.
+        """
+        now = time.time() if now is None else now
+        self._flush_backoff(now)
+        if not self._active:
+            return None
+        best_i = 0
+        for i in range(1, len(self._active)):
+            if self._less(self._active[i], self._active[best_i]):
+                best_i = i
+        return self._active.pop(best_i)
+
+    def requeue_backoff(self, info: QueuedPodInfo, now: float | None = None) -> None:
+        """Return an unschedulable pod with exponential backoff 1s -> 10s."""
+        now = time.time() if now is None else now
+        info.attempts += 1
+        delay = min(self._initial * (2 ** (info.attempts - 1)), self._max)
+        info.not_before = now + delay
+        self._backoff.append(info)
+
+    def requeue_immediate(self, info: QueuedPodInfo) -> None:
+        """Return a pod to the active queue with no backoff — used for a
+        preemptor after its victims were evicted, so its priority wins the
+        next pop (the nominated-node fast-retry analogue)."""
+        info.not_before = 0.0
+        self._active.append(info)
+
+    def next_ready_at(self) -> float | None:
+        """Earliest not_before among parked pods (None if active non-empty)."""
+        if self._active:
+            return 0.0
+        if not self._backoff:
+            return None
+        return min(q.not_before for q in self._backoff)

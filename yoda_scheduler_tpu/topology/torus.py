@@ -14,7 +14,7 @@ Pure functions over coordinate sets — trivially unit-testable, no k8s types.
 from __future__ import annotations
 
 from functools import lru_cache
-from itertools import product
+from itertools import permutations, product
 
 Coord = tuple[int, int, int]
 Shape = tuple[int, int, int]
@@ -120,31 +120,50 @@ def _compactness(block: Shape) -> int:
     return bx + by + bz
 
 
+def _best_placement(
+    slice_shape: Shape,
+    free: set[Coord],
+    candidate_shapes: tuple[Shape, ...],
+) -> tuple[Coord, Shape, set[Coord]] | None:
+    """Shared placement search: try every candidate block shape at every
+    origin; keep the placement that (1) minimises leftover fragmentation,
+    (2) prefers compact shapes (short ICI diameter), (3) carves from the
+    low corner. Returns (origin, block_shape, coords) or None."""
+    sx, sy, sz = slice_shape
+    best: tuple[tuple, Coord, Shape, set[Coord]] | None = None
+    for block in candidate_shapes:
+        bx, by, bz = block
+        if bx > sx or by > sy or bz > sz:
+            continue
+        for ox in range(sx - bx + 1):
+            for oy in range(sy - by + 1):
+                for oz in range(sz - bz + 1):
+                    coords = _block_coords((ox, oy, oz), block)
+                    if not coords <= free:
+                        continue
+                    frag = fragmentation_after(slice_shape, free - coords)
+                    key = (frag, _compactness(block), oz, oy, ox)
+                    if best is None or key < best[0]:
+                        best = (key, (ox, oy, oz), block, coords)
+    if best is None:
+        return None
+    return best[1], best[2], best[3]
+
+
 def best_fit_block(
     slice_shape: Shape,
     free: set[Coord],
     n_chips: int,
 ) -> tuple[Coord, Shape, set[Coord]] | None:
-    """Find the best axis-aligned contiguous block of `n_chips` free chips.
+    """Best contiguous block of exactly `n_chips` free chips, any shape
+    whose volume is n_chips."""
+    return _best_placement(slice_shape, free, _factor_shapes(n_chips))
 
-    Best = (1) minimises leftover fragmentation (prefers carving from the
-    corner of free space), (2) prefers compact shapes (low ICI diameter).
-    Returns (origin, block_shape, coords) or None if no contiguous fit.
-    """
-    best: tuple[float, Coord, Shape, set[Coord]] | None = None
-    for origin, block in enumerate_subblocks(slice_shape, n_chips):
-        coords = _block_coords(origin, block)
-        if not coords <= free:
-            continue
-        # leftover contiguity: how big is the largest free block remaining
-        remaining = free - coords
-        frag = fragmentation_after(slice_shape, remaining)
-        key = (frag, _compactness(block), origin[2], origin[1], origin[0])
-        if best is None or key < best[0]:
-            best = (key, origin, block, coords)
-    if best is None:
-        return None
-    return best[1], best[2], best[3]
+
+def fits_shape(slice_shape: Shape, free: set[Coord], req_shape: Shape) -> tuple[Coord, Shape, set[Coord]] | None:
+    """Place an exact requested block shape (any axis permutation) into free
+    space. Used for the ``tpu/topology`` label."""
+    return _best_placement(slice_shape, free, tuple(set(permutations(req_shape))))
 
 
 def largest_free_block(shape: Shape, free: set[Coord]) -> int:

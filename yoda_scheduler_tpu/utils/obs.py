@@ -1,0 +1,131 @@
+"""Observability: structured per-cycle traces + a metrics registry.
+
+The reference has neither (metrics explicitly disabled at reference
+pkg/yoda/scheduler.go:55, tracing = leveled klog strings only; SURVEY §5).
+Here every scheduling cycle emits one structured trace record (pod, filter
+verdicts per node, scores, outcome, latency) and the registry exposes the
+BASELINE metrics: schedule-latency histogram and bin-pack utilisation gauge,
+renderable in Prometheus text exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CycleTrace:
+    pod: str
+    outcome: str = "unknown"        # bound | unschedulable | waiting | error | failed
+    node: str | None = None
+    reason: str = ""
+    filter_verdicts: dict[str, str] = field(default_factory=dict)
+    scores: dict[str, float] = field(default_factory=dict)
+    started: float = field(default_factory=time.time)
+    latency_ms: float = 0.0
+
+    def finish(self, outcome: str, node: str | None = None, reason: str = "",
+               now: float | None = None) -> "CycleTrace":
+        """`now` must come from the same clock that stamped `started` (the
+        scheduler's injectable clock); defaults to wall time."""
+        self.outcome = outcome
+        self.node = node
+        self.reason = reason
+        self.latency_ms = ((time.time() if now is None else now) - self.started) * 1e3
+        return self
+
+
+class Histogram:
+    DEFAULT_BOUNDS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000)
+
+    def __init__(self, bounds: tuple[float, ...] = DEFAULT_BOUNDS,
+                 keep_values: int = 100_000) -> None:
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.total = 0.0
+        self.n = 0
+        # bounded sample for exact quantiles in benches; a long-running
+        # scheduler keeps at most the most recent `keep_values` observations
+        self._values: deque[float] = deque(maxlen=keep_values)
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.total += v
+        self._values.append(v)
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        if not self._values:
+            return 0.0
+        xs = sorted(self._values)
+        idx = min(int(q * len(xs)), len(xs) - 1)
+        return xs[idx]
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, Histogram] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self.histograms.setdefault(name, Histogram())
+        h.observe(value)
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self.histograms.setdefault(name, Histogram())
+
+    # --------------------------------------------------- prometheus exposition
+    def render_prometheus(self, prefix: str = "yoda_tpu") -> str:
+        lines: list[str] = []
+        with self._lock:
+            for k, v in sorted(self.counters.items()):
+                lines.append(f"# TYPE {prefix}_{k} counter")
+                lines.append(f"{prefix}_{k} {v}")
+            for k, v in sorted(self.gauges.items()):
+                lines.append(f"# TYPE {prefix}_{k} gauge")
+                lines.append(f"{prefix}_{k} {v}")
+            for k, h in sorted(self.histograms.items()):
+                lines.append(f"# TYPE {prefix}_{k} histogram")
+                cum = 0
+                for b, c in zip(h.bounds, h.counts):
+                    cum += c
+                    lines.append(f'{prefix}_{k}_bucket{{le="{b}"}} {cum}')
+                lines.append(f'{prefix}_{k}_bucket{{le="+Inf"}} {h.n}')
+                lines.append(f"{prefix}_{k}_sum {h.total}")
+                lines.append(f"{prefix}_{k}_count {h.n}")
+        return "\n".join(lines) + "\n"
+
+
+class TraceLog:
+    """Bounded ring of recent cycle traces."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._buf: deque[CycleTrace] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def add(self, t: CycleTrace) -> None:
+        with self._lock:
+            self._buf.append(t)
+
+    def recent(self, n: int = 50) -> list[CycleTrace]:
+        with self._lock:
+            return list(self._buf)[-n:]

@@ -16,6 +16,15 @@ class PodPhase(str, Enum):
     FAILED = "Failed"
 
 
+# Bind-time chip assignment, published on the pod (the device-plugin handshake
+# analogue). Wire format: ";"-joined "x,y,z" coordinate triples.
+ASSIGNED_CHIPS_LABEL = "tpu/assigned-chips"
+
+
+def format_assigned_chips(coords) -> str:
+    return ";".join(f"{x},{y},{z}" for x, y, z in coords)
+
+
 _uid_counter = itertools.count(1)
 
 
@@ -33,6 +42,15 @@ class Pod:
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+    def assigned_chips(self) -> set[tuple[int, int, int]]:
+        """ICI coords assigned to this pod at bind time (empty if unbound)."""
+        out: set[tuple[int, int, int]] = set()
+        for part in self.labels.get(ASSIGNED_CHIPS_LABEL, "").split(";"):
+            if part:
+                x, y, z = part.split(",")
+                out.add((int(x), int(y), int(z)))
+        return out
 
     @classmethod
     def from_manifest(cls, manifest: dict) -> "Pod":
