@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""Benchmark: 200-pod mixed GPU+TPU burst (BASELINE scenario 5).
+
+Builds the in-memory mixed cluster (8 multi-host v4-32 slices, 8 standalone
+v4-8 hosts, 20 GPU nodes), bursts 200 pods (gangs, multi-chip TPU jobs,
+GPU jobs, unlabeled), and measures:
+
+- pod schedule p50 latency (enqueue -> bind, ms)
+- TPU-chip bin-pack utilisation (% of healthy chips claimed)
+- gang success + placement quality
+
+vs_baseline compares p50 latency against the reference-semantics plugin set
+(scheduler/plugins/reference_emulation.py) run on the identical engine,
+cluster, and burst — the reference itself publishes no numbers
+(BASELINE.md), so its emulated behaviour is the baseline.
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.plugins.reference_emulation import (
+    TelemetryDecrementingCluster,
+    reference_profile,
+)
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore,
+    make_gpu_node,
+    make_tpu_node,
+    make_v4_slice,
+)
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def build_nodes():
+    nodes = []
+    for i in range(8):
+        nodes += make_v4_slice(f"v4-32-{i}", "2x2x4")          # 8 x 16 chips
+    for i in range(8):
+        nodes.append(make_tpu_node(f"v4-8-{i}", chips=4))      # 8 x 4 chips
+    for i in range(20):
+        nodes.append(make_gpu_node(f"gpu-{i}", cards=8))       # 20 x 8 cards
+    return nodes
+
+
+def build_burst():
+    """200 pods: 5 gangs x 4 workers, 45 TPU jobs, 85 GPU jobs, 50 unlabeled."""
+    pods = []
+    for g in range(5):
+        for w in range(4):
+            pods.append(Pod(
+                f"gang{g}-w{w}",
+                labels={
+                    "tpu/gang-name": f"gang{g}", "tpu/gang-size": "4",
+                    "scv/number": "4", "scv/memory": "16000",
+                    "scv/priority": "5", "tpu/accelerator": "tpu",
+                },
+            ))
+    for i in range(25):
+        pods.append(Pod(f"tpu-1c-{i}", labels={
+            "scv/number": "1", "scv/memory": "8000", "tpu/accelerator": "tpu"}))
+    for i in range(15):
+        pods.append(Pod(f"tpu-2c-{i}", labels={
+            "scv/number": "2", "scv/memory": "4000", "tpu/accelerator": "tpu",
+            "scv/priority": "2"}))
+    for i in range(5):
+        pods.append(Pod(f"tpu-topo-{i}", labels={
+            "scv/number": "4", "tpu/topology": "2x2", "tpu/accelerator": "tpu"}))
+    for i in range(85):
+        pods.append(Pod(f"gpu-job-{i}", labels={
+            "scv/number": "1", "scv/memory": "10000", "tpu/accelerator": "gpu"}))
+    for i in range(50):
+        pods.append(Pod(f"any-{i}", labels={"scv/memory": "1000"}))
+    assert len(pods) == 200
+    return pods
+
+
+def run_burst(profile_kind: str):
+    store = TelemetryStore()
+    now = time.time()
+    for n in build_nodes():
+        n.heartbeat = now
+        store.put(n)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    config = SchedulerConfig(max_attempts=8, gang_timeout_s=20.0)
+    if profile_kind == "reference":
+        sched = Scheduler(
+            TelemetryDecrementingCluster(cluster), config,
+            profile=reference_profile(config),
+        )
+    else:
+        sched = Scheduler(cluster, config)
+    pods = build_burst()
+    t0 = time.perf_counter()
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=5000)
+    wall = time.perf_counter() - t0
+
+    bound = sum(1 for p in pods if p.phase == PodPhase.BOUND)
+    gang_ok = sum(
+        1 for g in range(5)
+        if all(p.phase == PodPhase.BOUND for p in pods
+               if p.labels.get("tpu/gang-name") == f"gang{g}")
+    )
+    h = sched.metrics.histogram("schedule_latency_ms")
+    return {
+        "p50_ms": h.quantile(0.5),
+        "p99_ms": h.quantile(0.99),
+        "bound": bound,
+        "failed": sum(1 for p in pods if p.phase == PodPhase.FAILED),
+        "gangs_complete": gang_ok,
+        "bin_pack_util_pct": round(sched.bin_pack_utilization(), 2),
+        "wall_s": round(wall, 3),
+        "cycles": sched.metrics.counters.get("pods_scheduled_total", 0),
+    }
+
+
+def main():
+    ours = run_burst("yoda-tpu")
+    ref = run_burst("reference")
+    vs_baseline = (ref["p50_ms"] / ours["p50_ms"]) if ours["p50_ms"] > 0 else 1.0
+    print(json.dumps({
+        "metric": "pod_schedule_p50_latency_ms",
+        "value": round(ours["p50_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 3),
+        "extra": {
+            "ours": ours,
+            "reference_emulation": ref,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,12 +2,18 @@
 
 Multi-chip TPU hardware is not available in CI; sharding/pjit tests run on a
 virtual 8-device CPU mesh instead (same program, same GSPMD partitioner).
-Must run before jax is imported anywhere in the test process.
+
+Note: this environment's TPU plugin (sitecustomize) force-selects its own
+platform regardless of the JAX_PLATFORMS env var, so the override must go
+through jax.config before any backend is initialised.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -113,7 +113,10 @@ class TestFilterPredicates:
         state.write("node_info:n", node_info(m))
         assert f.filter(state, POD, node_info(m)).ok
         assert alloc.reserve(state, Pod("r"), "n").ok
-        st = f.filter(state, POD, node_info(m))
+        # the next pod's cycle gets a fresh CycleState (free_coords is
+        # memoised per cycle), exactly as the engine does
+        state2 = mk_state({"scv/number": "3"})
+        st = f.filter(state2, POD, node_info(m))
         assert st.code == Code.UNSCHEDULABLE  # only 1 chip left unreserved
 
     def test_topology_label_requires_contiguous_block(self):

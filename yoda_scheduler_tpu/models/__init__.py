@@ -1,0 +1,11 @@
+from .llama import LlamaConfig, init_llama, llama_forward, llama_loss
+from .resnet import ResNet50, resnet_forward_fn
+
+__all__ = [
+    "LlamaConfig",
+    "init_llama",
+    "llama_forward",
+    "llama_loss",
+    "ResNet50",
+    "resnet_forward_fn",
+]

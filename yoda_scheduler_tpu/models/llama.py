@@ -1,0 +1,172 @@
+"""Llama-class decoder-only transformer, TPU-first pure JAX.
+
+This is the flagship workload the scheduler places in BASELINE scenario 4
+(Llama-2-7B on a multi-host v4-32 slice) and the model behind
+``__graft_entry__.entry()``. Design choices for the MXU/XLA:
+
+- functional: params are a plain pytree; forward is a jit-able function of
+  (params, tokens) — shardable with NamedSharding without framework glue
+- bfloat16 matmuls with fp32 accumulation (preferred_element_type), fp32
+  RMSNorm/softmax/rotary for stability
+- GQA (n_kv_heads <= n_heads) with KV head broadcast at attention time
+- fused causal flash attention (ops/attention.py) on the hot path
+- static shapes everywhere; layers iterated with lax.scan over stacked
+  per-layer params so XLA compiles ONE layer body (compile time stays flat
+  as depth grows — the pjit-friendly idiom)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls()  # defaults are the 7B shape
+
+    @classmethod
+    def tiny(cls, vocab: int = 256) -> "LlamaConfig":
+        """Test/dryrun shape: big enough to exercise every code path and
+        sharding axis, small enough to compile in seconds."""
+        return cls(vocab_size=vocab, dim=128, n_layers=2, n_heads=4,
+                   n_kv_heads=2, ffn_dim=256, max_seq_len=512)
+
+
+# ---------------------------------------------------------------------- init
+def init_llama(config: LlamaConfig, key: jax.Array) -> dict:
+    """Params pytree. Per-layer weights are stacked on a leading layer axis
+    for the scan-over-layers forward."""
+    dt = jnp.dtype(config.dtype)
+    d, f, L = config.dim, config.ffn_dim, config.n_layers
+    hd = config.head_dim
+    k_emb, k_attn, k_mlp, k_out = jax.random.split(key, 4)
+
+    def norm_init(fan_in, shape, key):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dt)
+
+    ka = jax.random.split(k_attn, 4 * L).reshape(L, 4, 2)
+    km = jax.random.split(k_mlp, 3 * L).reshape(L, 3, 2)
+    layers = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": jnp.stack([norm_init(d, (d, config.n_heads * hd), ka[i, 0]) for i in range(L)]),
+        "wk": jnp.stack([norm_init(d, (d, config.n_kv_heads * hd), ka[i, 1]) for i in range(L)]),
+        "wv": jnp.stack([norm_init(d, (d, config.n_kv_heads * hd), ka[i, 2]) for i in range(L)]),
+        "wo": jnp.stack([norm_init(config.n_heads * hd, (config.n_heads * hd, d), ka[i, 3]) for i in range(L)]),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+        "w_gate": jnp.stack([norm_init(d, (d, f), km[i, 0]) for i in range(L)]),
+        "w_up": jnp.stack([norm_init(d, (d, f), km[i, 1]) for i in range(L)]),
+        "w_down": jnp.stack([norm_init(f, (f, d), km[i, 2]) for i in range(L)]),
+    }
+    return {
+        "embed": norm_init(1.0, (config.vocab_size, d), k_emb),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": norm_init(d, (d, config.vocab_size), k_out),
+    }
+
+
+# ------------------------------------------------------------------- pieces
+def rms_norm(x, weight, eps: float):
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms * weight).astype(x.dtype)
+
+
+def rotary(x, theta: float):
+    """Apply RoPE to [B, S, H, hd] (fp32 internally)."""
+    b, s, h, hd = x.shape
+    pos = jnp.arange(s, dtype=jnp.float32)
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = pos[:, None] * inv_freq[None, :]           # [S, hd/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(b, s, h, hd).astype(x.dtype)
+
+
+def _attention_block(x, layer, config: LlamaConfig, attn_impl):
+    b, s, d = x.shape
+    h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    xn = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = (xn @ layer["wq"]).reshape(b, s, h, hd)
+    k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
+    v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
+    q = rotary(q, config.rope_theta)
+    k = rotary(k, config.rope_theta)
+    if kvh != h:  # GQA: broadcast KV heads to Q heads
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # [B, S, H, hd] -> [B, H, S, hd]
+    o = attn_impl(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                  v.transpose(0, 2, 1, 3))
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    return x + o @ layer["wo"]
+
+
+def _mlp_block(x, layer, config: LlamaConfig):
+    xn = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    gate = jax.nn.silu((xn @ layer["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    return x + (gate * (xn @ layer["w_up"])) @ layer["w_down"]
+
+
+# ------------------------------------------------------------------ forward
+def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
+                  attn_impl=None, remat: bool = False) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    if attn_impl is None:
+        attn_impl = partial(flash_attention, causal=True)
+    x = params["embed"][tokens]
+
+    def layer_body(x, layer):
+        y = _attention_block(x, layer, config, attn_impl)
+        return _mlp_block(y, layer, config), None
+
+    if remat:
+        # rematerialise each layer's activations in backward: trades FLOPs
+        # for HBM, the standard long-context posture
+        layer_body = jax.checkpoint(layer_body)
+    x, _ = jax.lax.scan(layer_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def llama_loss(params: dict, tokens: jax.Array, config: LlamaConfig,
+               attn_impl=None, remat: bool = False) -> jax.Array:
+    """Next-token cross-entropy over tokens [B, S].
+
+    Runs the full sequence and masks the final position (rather than slicing
+    to S-1) so the sequence axis keeps its static, sp-divisible length under
+    sequence parallelism."""
+    s = tokens.shape[1]
+    logits = llama_forward(params, tokens, config, attn_impl, remat)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = (jnp.arange(s) < s - 1).astype(nll.dtype)[None, :]
+    return jnp.sum(nll * mask) / (tokens.shape[0] * (s - 1))

@@ -1,0 +1,80 @@
+"""ResNet-50 in Flax Linen — the BASELINE scenario-3 workload (a JAX
+ResNet-50 training pod requesting 4 chips on a v4-8 host).
+
+Convolutions are MXU work on TPU; NHWC layout and bfloat16 compute with
+fp32 batch-norm statistics are the TPU-idiomatic defaults.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(bn()(y))
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(bn()(y))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = bn()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 dtype=jnp.float32)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(64 * 2 ** i, strides=strides, dtype=self.dtype)(
+                    x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def resnet_forward_fn(num_classes: int = 1000):
+    """(init_fn, apply_fn) pair for the training harness."""
+    model = ResNet50(num_classes)
+
+    def init_fn(key, sample):
+        return model.init(key, sample, train=False)
+
+    def apply_fn(variables, batch, train=True):
+        if train:
+            return model.apply(variables, batch, train=True,
+                               mutable=["batch_stats"])
+        return model.apply(variables, batch, train=False)
+
+    return init_fn, apply_fn

@@ -1,0 +1,164 @@
+"""Fused causal flash attention — Pallas TPU kernel with a portable fallback.
+
+The attention inner loop is the HBM-bandwidth hot spot of the transformer
+workloads this framework schedules (BASELINE scenarios 3-4). The kernel
+keeps the running softmax statistics in VMEM and never materialises the
+[S, S] score matrix in HBM (online-softmax/FlashAttention scheme), tiling
+Q into MXU-friendly blocks and streaming K/V blocks through VMEM.
+
+Layout: q, k, v are [batch, heads, seq, head_dim]; grid is (batch*heads,
+q_blocks); causal masking skips fully-masked K blocks via predication.
+Backward is a jnp recompute (custom_vjp) — correct everywhere; a fused
+backward kernel is a later optimisation.
+
+On non-TPU backends (CPU tests) the same kernel runs in Pallas interpret
+mode, or callers can use `reference_attention` directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Plain-XLA attention; the numerical reference for the kernel and the
+    backward-pass recompute. [B, H, S, D] in/out; fp32 softmax accumulation."""
+    _, _, sq, d = q.shape
+    sk = k.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, scores.dtype))
+    if causal:
+        qi = jnp.arange(sq)[:, None] + (sk - sq)  # support kv longer than q
+        ki = jnp.arange(sk)[None, :]
+        scores = jnp.where(ki <= qi, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
+                  causal: bool, sm_scale: float, block_q: int,
+                  kv_offset: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0, :, :].astype(jnp.float32) * sm_scale  # [block_q, d]
+
+    m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)   # running max
+    l = jnp.zeros((block_q, 1), jnp.float32)            # running denom
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            # align q to the END of the kv sequence when kv is longer
+            # (matches reference_attention's sk-sq offset)
+            q_pos = kv_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # K blocks strictly above the diagonal contribute nothing; stop early
+        last_kb = kv_offset + (qi + 1) * block_q  # exclusive bound in tokens
+        num_iter = jnp.minimum((last_kb + block_k - 1) // block_k, num_kb)
+    else:
+        num_iter = num_kb
+    m, l, acc = jax.lax.fori_loop(0, num_iter, body, (m, l, acc))
+    o_ref[0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (
+        f"seq lengths ({sq},{sk}) must tile by blocks ({block_q},{block_k})"
+    )
+    sm_scale = 1.0 / (d ** 0.5)
+    bh = b * h
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, seq_k=sk, causal=causal,
+        sm_scale=sm_scale, block_q=block_q, kv_offset=sk - sq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda bhi, qi: (bhi, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bhi, qi: (bhi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi: (bhi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _flash_forward(q, k, v, causal, block_q, block_k,
+                          interpret=_use_interpret())
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused attention entry point; [B, H, S, D] -> [B, H, S, D].
+
+    Compiles to the Pallas kernel on TPU; interpret-mode (same code path)
+    elsewhere. Falls back to `reference_attention` for shapes the kernel
+    cannot tile (ragged sequence lengths).
+    """
+    sq, sk = q.shape[2], k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if sq % bq or sk % bk:
+        return reference_attention(q, k, v, causal)
+    return _flash(q, k, v, causal, block_q, block_k)

@@ -1,0 +1,15 @@
+from .mesh import make_mesh, mesh_shape_for
+from .sharding import llama_param_specs, llama_shardings, batch_spec
+from .ring import ring_attention, make_ring_attn
+from .train import build_llama_train_step
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "llama_param_specs",
+    "llama_shardings",
+    "batch_spec",
+    "ring_attention",
+    "make_ring_attn",
+    "build_llama_train_step",
+]

@@ -1,0 +1,57 @@
+"""Device-mesh construction for dp/fsdp/tp/sp parallelism.
+
+The reference has no parallelism of its own (SURVEY §2.3) — but the
+workloads this scheduler places are pjit programs over a
+``jax.sharding.Mesh``, and the scheduler's job is to hand them contiguous
+ICI blocks those meshes map onto. This module is the workload-side
+counterpart: it builds meshes whose axis order puts the most
+communication-hungry axis (tp) innermost, where Cloud TPU device order
+gives torus-neighbour ICI links.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# outer-to-inner order: tp innermost (all-reduce every layer) rides the
+# fastest ICI neighbourhoods; dp outermost tolerates DCN between hosts
+AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+
+
+def mesh_shape_for(n_devices: int, tp: int = 1, sp: int = 1, fsdp: int | None = None,
+                   dp: int | None = None) -> dict[str, int]:
+    """Fill in unspecified axes to cover n_devices: fsdp absorbs what dp
+    doesn't claim."""
+    rest = n_devices // (tp * sp)
+    if rest * tp * sp != n_devices:
+        raise ValueError(f"tp*sp={tp * sp} does not divide {n_devices} devices")
+    if dp is None and fsdp is None:
+        dp, fsdp = 1, rest
+    elif dp is None:
+        dp = rest // fsdp
+    elif fsdp is None:
+        fsdp = rest // dp
+    if dp * fsdp * tp * sp != n_devices:
+        raise ValueError(
+            f"dp*fsdp*sp*tp = {dp}*{fsdp}*{sp}*{tp} != {n_devices} devices")
+    return {"dp": dp, "fsdp": fsdp, "sp": sp, "tp": tp}
+
+
+def make_mesh(shape: dict[str, int] | None = None, devices=None, **axes) -> Mesh:
+    """Build a Mesh. `shape` maps axis name -> size in AXIS_ORDER; axes not
+    named get size 1 (kept in the mesh so PartitionSpecs always resolve)."""
+    if shape is None:
+        shape = axes or None
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = mesh_shape_for(len(devices))
+    sizes = [shape.get(a, 1) for a in AXIS_ORDER]
+    want = math.prod(sizes)
+    if want > len(devices):
+        raise ValueError(f"mesh {shape} wants {want} devices, have {len(devices)}")
+    grid = np.asarray(devices[:want]).reshape(sizes)
+    return Mesh(grid, AXIS_ORDER)

@@ -1,0 +1,103 @@
+"""Ring attention: causal attention over a sequence sharded on the `sp` axis.
+
+Long-context sequence/context parallelism for the transformer workloads:
+each device of the `sp` mesh axis holds a contiguous sequence chunk of
+Q/K/V. K/V chunks rotate around the ring with `jax.lax.ppermute` (XLA maps
+this onto neighbour ICI links) while each device folds every chunk into its
+local queries' online-softmax state — full causal attention with O(S/n)
+activation memory per device, overlap-friendly, never materialising the
+global [S, S] score matrix.
+
+Written with shard_map + collectives (not raw RDMA) so the identical code
+runs on a CPU test mesh and a TPU pod slice.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_off, k_off, m, l, acc, scale):
+    """Fold one K/V chunk into the online-softmax state. All [B,H,*,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    sq, sk = q.shape[2], k.shape[2]
+    q_pos = q_off + jnp.arange(sq)[:, None]
+    k_pos = k_off + jnp.arange(sk)[None, :]
+    s = jnp.where(k_pos[None, None] <= q_pos[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def _ring_body(q, k, v, axis_name: str, axis_size: int, chunk: int):
+    """Per-shard body under shard_map. q,k,v: [B, H, S/n, D] local chunks."""
+    rank = jax.lax.axis_index(axis_name)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    qf = q.astype(jnp.float32)
+    # derive the carry from qf so it inherits q's varying-manual-axes type —
+    # literals would be device-invariant and fail the scan carry type check
+    m = qf[..., :1] * 0.0 + _NEG_INF
+    l = qf[..., :1] * 0.0
+    acc = qf * 0.0
+    q_off = rank * chunk
+
+    def step(i, carry):
+        m, l, acc, k, v = carry
+        # after i rotations we hold the chunk originally on rank - i
+        src = (rank - i) % axis_size
+        m, l, acc = _block_attend(qf, k.astype(jnp.float32),
+                                  v.astype(jnp.float32),
+                                  q_off, src * chunk, m, l, acc, scale)
+        # rotate kv to the next rank (last rotation is skipped by the loop
+        # bound arithmetic below feeding a dummy — keep it simple: rotate
+        # every step; the final rotated copy is unused)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return m, l, acc, k, v
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, axis_size, step, (m, l, acc, k, v))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp"):
+    """Causal attention with q,k,v [B, H, S, D], S sharded over `axis_name`.
+
+    Call under jit with the global arrays; shard_map splits them per the
+    specs and the ring runs over the mesh axis.
+    """
+    axis_size = mesh.shape[axis_name]
+    seq = q.shape[2]
+    if seq % axis_size:
+        raise ValueError(f"seq {seq} not divisible by {axis_name}={axis_size}")
+    chunk = seq // axis_size
+    spec = P(("dp", "fsdp"), "tp", axis_name, None)
+    body = functools.partial(_ring_body, axis_name=axis_name,
+                             axis_size=axis_size, chunk=chunk)
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+
+
+def make_ring_attn(mesh, axis_name: str = "sp"):
+    """attn_impl adapter for models.llama.llama_forward."""
+    def attn(q, k, v):
+        return ring_attention(q, k, v, mesh, axis_name)
+    return attn

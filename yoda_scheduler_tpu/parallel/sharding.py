@@ -1,0 +1,50 @@
+"""Sharding rules for the Llama params/activations over the mesh.
+
+The scheme is the standard Megatron-style column/row split on tp with
+FSDP-style weight sharding on fsdp, expressed as PartitionSpecs and handed
+to jit — XLA's GSPMD partitioner inserts the collectives (all-gather for
+fsdp weights, psum for tp row-parallel matmuls) so they ride ICI per the
+mesh layout (parallel/mesh.py).
+
+Per-layer weights carry a leading stacked-layer axis (models/llama.py scan),
+which is never sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def llama_param_specs() -> dict:
+    """PartitionSpec pytree matching init_llama's params structure."""
+    return {
+        "embed": P(None, "fsdp"),             # [vocab, d]
+        "layers": {
+            "attn_norm": P(None, None),       # [L, d]
+            "wq": P(None, "fsdp", "tp"),      # [L, d, h*hd]   column-parallel
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),      # [L, h*hd, d]   row-parallel
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),  # [L, d, f]
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),  # [L, f, d]
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),           # [d, vocab]
+    }
+
+
+def batch_spec(sp: bool = False) -> P:
+    """tokens [B, S]: batch over dp+fsdp; seq over sp when sequence
+    parallelism is on."""
+    return P(("dp", "fsdp"), "sp" if sp else None)
+
+
+def llama_shardings(mesh) -> dict:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        llama_param_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -48,8 +48,13 @@ class SchedulerConfig:
     gang_timeout_s: float = 30.0
     # enable priority preemption when no node fits (modern PostFilter role)
     preemption: bool = True
-    # topology-aware scoring weight (new TPU capability; 0 disables)
-    topology_weight: int = 2
+    # topology-aware scoring weight (new TPU capability; 0 disables).
+    # must outweigh the telemetry score's emptier-node preference (all three
+    # emptiness signals are anti-packing and min-max normalisation amplifies
+    # them to 0-100): with identical chips, packing decides placement so
+    # contiguous blocks survive for tpu/topology requests; with heterogeneous
+    # chips the quality signals still move the needle
+    topology_weight: int = 6
     # give up on a pod after this many unschedulable attempts (0 = retry
     # forever, the kube-scheduler posture; benches set a finite cap)
     max_attempts: int = 0

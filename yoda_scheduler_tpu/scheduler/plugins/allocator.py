@@ -35,8 +35,17 @@ class ChipAllocator(ReservePlugin):
     def pending_chip_count(self, node: str) -> int:
         return len(self.pending_on(node))
 
-    def free_coords(self, node_info: NodeInfo) -> set[Coord]:
-        """Healthy chips not claimed by bound pods nor pending reservations."""
+    def free_coords(self, node_info: NodeInfo, state: CycleState | None = None) -> set[Coord]:
+        """Healthy chips not claimed by bound pods nor pending reservations.
+        With `state`, memoised per scheduling cycle (every plugin asks for
+        the same node's free set several times per cycle)."""
+        if state is not None:
+            key = "free_coords:" + node_info.name
+            cached = state.read_or(key)
+            if cached is None:
+                cached = self.free_coords(node_info)
+                state.write(key, cached)
+            return cached
         m = node_info.metrics
         if m is None:
             return set()

@@ -1,0 +1,166 @@
+"""Reference-semantics plugin set, used as the benchmark baseline.
+
+Re-creates the *intended* scheduling behaviour of the reference
+(pkg/yoda: filter predicates over live telemetry only, max-normalised
+weighted scoring) WITHOUT this framework's TPU-native improvements, so
+`bench.py` can compare like for like on the same engine:
+
+- no allocation awareness: chips/memory claimed by bound-but-running pods
+  are invisible until telemetry catches up (the reference trusts only the
+  live SCV numbers; chip count checks against the node's TOTAL CardNumber,
+  reference pkg/yoda/filter/filter.go:13 — never decremented)
+- no topology, no gang admission, no staleness gate, no preemption
+- scoring keeps the reference's integer arithmetic and its clock-divided-
+  by-MaxBandwidth defect (algorithm.go:60) — baseline behaviour includes
+  baseline bugs
+- to be fair to the reference's deployment reality (a sniffer DaemonSet
+  updating the CR within its poll interval), the emulation binder
+  decrements telemetry free-HBM immediately on bind.
+"""
+
+from __future__ import annotations
+
+from ..config import SchedulerConfig
+from ..framework import CycleState, FilterPlugin, NodeInfo, ScorePlugin, Status, min_max_normalize
+from ...utils.labels import WorkloadSpec
+from .prescore import MAX_KEY, SPEC_KEY, MaxValue
+
+
+class RefFilter(FilterPlugin):
+    """Count/memory/clock predicates exactly as the reference applies them
+    (filter.go:11-58), minus every TPU-native addition."""
+
+    name = "ref-filter"
+
+    def filter(self, state: CycleState, pod, node: NodeInfo) -> Status:
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        m = node.metrics
+        if m is None:
+            return Status.unschedulable(f"{node.name}: scv is not exist")
+        if m.chip_count < max(spec.chips, 1):
+            return Status.unschedulable(f"{node.name}: not enough cards")
+        fits_mem = sum(
+            1 for c in m.chips
+            if c.healthy and c.hbm_free_mb >= spec.min_free_mb
+        )
+        if fits_mem < spec.chips:
+            return Status.unschedulable(f"{node.name}: memory")
+        fits_clock = sum(
+            1 for c in m.chips
+            if c.healthy and c.clock_mhz >= spec.min_clock_mhz
+        )
+        if fits_clock < spec.chips:
+            return Status.unschedulable(f"{node.name}: clock")
+        return Status.success()
+
+
+class RefMaxCollection:
+    """PreScore collecting reference maxima (collection.go:30-57) over ALL
+    chips that fit the request, without free-coordinate awareness."""
+
+    name = "ref-max-collection"
+
+    def pre_score(self, state: CycleState, pod, feasible) -> Status:
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        mv = MaxValue()
+        for node in feasible:
+            m = node.metrics
+            if m is None:
+                continue
+            for c in m.chips:
+                if (c.healthy and c.hbm_free_mb >= spec.min_free_mb
+                        and c.clock_mhz >= spec.min_clock_mhz):
+                    mv.bandwidth = max(mv.bandwidth, c.ici_bandwidth_gbps)
+                    mv.clock = max(mv.clock, c.clock_mhz)
+                    mv.core = max(mv.core, c.core_count)
+                    mv.free_memory = max(mv.free_memory, c.hbm_free_mb)
+                    mv.power = max(mv.power, c.power_w)
+                    mv.total_memory = max(mv.total_memory, c.hbm_total_mb)
+        state.write(MAX_KEY, mv)
+        return Status.success()
+
+
+class RefScore(ScorePlugin):
+    """Reference scoring math with its integer truncation and the clock/
+    MaxBandwidth bug preserved (algorithm.go:28-87)."""
+
+    name = "ref-score"
+    weight = 1
+
+    def score(self, state: CycleState, pod, node: NodeInfo) -> tuple[float, Status]:
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        mv: MaxValue = state.read_or(MAX_KEY)
+        m = node.metrics
+        if mv is None or m is None:
+            return 0.0, Status.error("no Max in cycle state")
+        basic = 0
+        for c in m.chips:
+            if (c.healthy and c.hbm_free_mb >= spec.min_free_mb
+                    and c.clock_mhz >= spec.min_clock_mhz):
+                basic += (
+                    c.ici_bandwidth_gbps * 100 // mv.bandwidth       # w=1
+                    + c.clock_mhz * 100 // mv.bandwidth              # the bug
+                    + c.core_count * 100 // mv.core                  # w=1
+                    + c.power_w * 100 // mv.power                    # w=1
+                    + (c.hbm_free_mb * 100 // mv.free_memory) * 2    # w=2
+                    + c.hbm_total_mb * 100 // mv.total_memory        # w=1
+                )
+        # allocate: label-claimed headroom, per-chip label treated as the
+        # node total exactly as the reference does (algorithm.go:76-80)
+        claimed = 0
+        for p in node.pods:
+            try:
+                claimed += WorkloadSpec.from_labels(p.labels).min_free_mb
+            except Exception:
+                pass
+        total = m.hbm_total_sum
+        allocate = 0 if (total == 0 or claimed > total) else (
+            (total - claimed) * 100 // total * 3)
+        actual = 0 if total == 0 else m.hbm_free_sum * 100 // total * 2
+        return float(basic + allocate + actual), Status.success()
+
+    def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
+        min_max_normalize(scores)
+
+
+class TelemetryDecrementingCluster:
+    """Wraps a FakeCluster: on bind, immediately debits the node's live
+    telemetry (the ideal-sniffer assumption that favours the baseline)."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def bind(self, pod, node, assigned_chips=None):
+        self._inner.bind(pod, node, assigned_chips)
+        m = self._inner.telemetry.get(node)
+        if m is None:
+            return
+        try:
+            spec = WorkloadSpec.from_labels(pod.labels)
+        except Exception:
+            return
+        need = spec.chips
+        for c in sorted(m.chips, key=lambda c: -c.hbm_free_mb):
+            if need == 0:
+                break
+            if c.healthy and c.hbm_free_mb >= spec.min_free_mb:
+                c.hbm_free_mb = max(
+                    0, c.hbm_free_mb - max(spec.min_free_mb, c.hbm_total_mb // max(m.chip_count, 1)))
+                need -= 1
+        self._inner.telemetry.put(m)
+
+
+def reference_profile(config: SchedulerConfig):
+    """A Profile wired with only reference-equivalent capability."""
+    from ..core import Profile
+    from .sort import PrioritySort
+
+    return Profile(
+        queue_sort=PrioritySort(),
+        filter=[RefFilter()],
+        pre_score=[RefMaxCollection()],
+        score=[RefScore()],
+    )

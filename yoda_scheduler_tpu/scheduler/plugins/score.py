@@ -40,12 +40,13 @@ class TelemetryScore(ScorePlugin):
         self.weight = weight
 
     # ------------------------------------------------------------ components
-    def basic_score(self, mv: MaxValue, spec: WorkloadSpec, node: NodeInfo) -> float:
+    def basic_score(self, mv: MaxValue, spec: WorkloadSpec, node: NodeInfo,
+                    state: CycleState | None = None) -> float:
         m = node.metrics
         if m is None:
             return 0.0
         w = self.weights
-        free = self.allocator.free_coords(node)
+        free = self.allocator.free_coords(node, state)
         total = 0.0
         for c in m.healthy_chips():
             if (c.coords in free
@@ -87,7 +88,8 @@ class TelemetryScore(ScorePlugin):
             # keep the guard as an internal error, not a scheduling failure
             return 0.0, Status.error("PreScore never wrote Max")
         spec: WorkloadSpec = state.read(SPEC_KEY)
-        s = self.basic_score(mv, spec, node) + self.allocate_score(node) + self.actual_score(node)
+        s = (self.basic_score(mv, spec, node, state)
+             + self.allocate_score(node) + self.actual_score(node))
         return s, Status.success()
 
     def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:

@@ -30,7 +30,7 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
     name = "topology-score"
 
     def __init__(self, allocator: ChipAllocator, weight: int = 2,
-                 contiguity_frac: float = 0.7) -> None:
+                 contiguity_frac: float = 0.5) -> None:
         self.allocator = allocator
         self.weight = weight
         self.contiguity_frac = contiguity_frac
@@ -46,7 +46,7 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
             m = node.metrics
             if m is None or not m.slice_id:
                 continue
-            used_here = m.chip_count - len(self.allocator.free_coords(node))
+            used_here = m.chip_count - len(self.allocator.free_coords(node, state))
             u, t = usage.get(m.slice_id, (0, 0))
             usage[m.slice_id] = (u + used_here, t + m.chip_count)
         state.write(SLICE_USE_KEY, usage)
@@ -57,22 +57,29 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
         if m is None:
             return 0.0, Status.success()
         spec: WorkloadSpec = state.read(SPEC_KEY)
-        free = self.allocator.free_coords(node)
+        free = self.allocator.free_coords(node, state)
         cont = contiguity_score(_node_shape(m), free, min(spec.chips, len(free)))
         if not m.slice_id or m.num_hosts <= 1:
-            # standalone node: perfect from a slice-conservation standpoint
-            # for non-gang work (gang pods never reach here: Filter requires
-            # a slice for them)
-            packing = 100.0
+            # standalone node: always preferable to denting a pristine slice
+            # for non-gang work (base 50), and among standalone nodes prefer
+            # the already-dented one (intra-node bin-pack) so whole boards
+            # survive for block-shaped requests
+            node_used = 1.0 - len(free) / m.chip_count if m.chip_count else 0.0
+            packing = 50.0 + 50.0 * node_used
         else:
             used, total = state.read_or(SLICE_USE_KEY, {}).get(m.slice_id, (0, 0))
             if spec.is_gang:
                 # a gang consumes hosts wholesale; pristine slices are ideal
                 packing = 100.0 * (total - used) / total if total else 0.0
             else:
-                # single-node job on a multi-host slice: only attractive if the
-                # slice is already dented (concentrate fragmentation)
-                packing = 100.0 * used / total if total else 0.0
+                # single-node job on a multi-host slice: prefer dented slices
+                # (concentrate fragmentation) and, within a slice, dented
+                # hosts — a leftover lone chip is "contiguous" by the frag
+                # metric but useless to block-shaped requests, so host-level
+                # consolidation must be rewarded explicitly
+                slice_used = used / total if total else 0.0
+                node_used = 1.0 - len(free) / m.chip_count if m.chip_count else 0.0
+                packing = 100.0 * (0.5 * slice_used + 0.5 * node_used)
         s = self.contiguity_frac * cont + (1.0 - self.contiguity_frac) * packing
         return s, Status.success()
 

@@ -84,11 +84,13 @@ def _factor_shapes(n: int) -> tuple[Shape, ...]:
     return tuple(out)
 
 
-def enumerate_subblocks(shape: Shape, n_chips: int) -> list[tuple[Coord, Shape]]:
+@lru_cache(maxsize=8192)
+def enumerate_subblocks(shape: Shape, n_chips: int) -> tuple[tuple[Coord, Shape], ...]:
     """All axis-aligned sub-blocks of exactly `n_chips` chips inside `shape`,
     as (origin, block_shape) pairs. Small closed world (slices are tiny:
     <=4096 chips, jobs request small factors), so brute force is fine and
-    exact — no heuristics to go wrong."""
+    exact — no heuristics to go wrong. Cached: the scheduler asks for the
+    same (shape, n) thousands of times per burst."""
     out: list[tuple[Coord, Shape]] = []
     sx, sy, sz = shape
     for bx, by, bz in _factor_shapes(n_chips):
@@ -98,18 +100,19 @@ def enumerate_subblocks(shape: Shape, n_chips: int) -> list[tuple[Coord, Shape]]
             for oy in range(sy - by + 1):
                 for oz in range(sz - bz + 1):
                     out.append(((ox, oy, oz), (bx, by, bz)))
-    return out
+    return tuple(out)
 
 
-def _block_coords(origin: Coord, block: Shape) -> set[Coord]:
+@lru_cache(maxsize=65536)
+def _block_coords(origin: Coord, block: Shape) -> frozenset[Coord]:
     ox, oy, oz = origin
     bx, by, bz = block
-    return {
+    return frozenset(
         (ox + dx, oy + dy, oz + dz)
         for dx in range(bx)
         for dy in range(by)
         for dz in range(bz)
-    }
+    )
 
 
 def _compactness(block: Shape) -> int:
@@ -120,15 +123,19 @@ def _compactness(block: Shape) -> int:
     return bx + by + bz
 
 
+@lru_cache(maxsize=131072)
 def _best_placement(
     slice_shape: Shape,
-    free: set[Coord],
+    free: frozenset[Coord],
     candidate_shapes: tuple[Shape, ...],
-) -> tuple[Coord, Shape, set[Coord]] | None:
+) -> tuple[Coord, Shape, frozenset[Coord]] | None:
     """Shared placement search: try every candidate block shape at every
     origin; keep the placement that (1) minimises leftover fragmentation,
     (2) prefers compact shapes (short ICI diameter), (3) carves from the
-    low corner. Returns (origin, block_shape, coords) or None."""
+    low corner. Returns (origin, block_shape, coords) or None.
+
+    Cached: node free-sets repeat across the many scheduling cycles of a
+    burst, making placement search effectively O(1) amortised."""
     sx, sy, sz = slice_shape
     best: tuple[tuple, Coord, Shape, set[Coord]] | None = None
     for block in candidate_shapes:
@@ -141,7 +148,7 @@ def _best_placement(
                     coords = _block_coords((ox, oy, oz), block)
                     if not coords <= free:
                         continue
-                    frag = fragmentation_after(slice_shape, free - coords)
+                    frag = fragmentation_after(slice_shape, frozenset(free - coords))
                     key = (frag, _compactness(block), oz, oy, ox)
                     if best is None or key < best[0]:
                         best = (key, (ox, oy, oz), block, coords)
@@ -154,24 +161,29 @@ def best_fit_block(
     slice_shape: Shape,
     free: set[Coord],
     n_chips: int,
-) -> tuple[Coord, Shape, set[Coord]] | None:
+) -> tuple[Coord, Shape, frozenset[Coord]] | None:
     """Best contiguous block of exactly `n_chips` free chips, any shape
     whose volume is n_chips."""
-    return _best_placement(slice_shape, free, _factor_shapes(n_chips))
+    return _best_placement(slice_shape, frozenset(free), _factor_shapes(n_chips))
 
 
-def fits_shape(slice_shape: Shape, free: set[Coord], req_shape: Shape) -> tuple[Coord, Shape, set[Coord]] | None:
+def fits_shape(slice_shape: Shape, free: set[Coord], req_shape: Shape) -> tuple[Coord, Shape, frozenset[Coord]] | None:
     """Place an exact requested block shape (any axis permutation) into free
     space. Used for the ``tpu/topology`` label."""
-    return _best_placement(slice_shape, free, tuple(set(permutations(req_shape))))
+    return _best_placement(slice_shape, frozenset(free),
+                           tuple(sorted(set(permutations(req_shape)))))
 
 
 def largest_free_block(shape: Shape, free: set[Coord]) -> int:
     """Size of the largest axis-aligned sub-block fully inside `free`."""
+    return _largest_free_block(shape, frozenset(free))
+
+
+@lru_cache(maxsize=131072)
+def _largest_free_block(shape: Shape, free: frozenset[Coord]) -> int:
     if not free:
         return 0
     best = 1
-    sx, sy, sz = shape
     max_n = len(free)
     # check decreasing sizes; early-out at first found
     for n in range(max_n, 0, -1):
@@ -189,7 +201,17 @@ def fragmentation_after(shape: Shape, free: set[Coord]) -> float:
     Defined as 1 - largest_free_block / |free| (0 when nothing free)."""
     if not free:
         return 0.0
-    return 1.0 - largest_free_block(shape, free) / len(free)
+    return 1.0 - largest_free_block(shape, frozenset(free)) / len(free)
+
+
+@lru_cache(maxsize=131072)
+def _contiguity_cached(shape: Shape, free: frozenset[Coord], n_chips: int) -> float:
+    fit = _best_placement(shape, free, _factor_shapes(n_chips))
+    if fit is None:
+        return 0.0
+    _, _, coords = fit
+    frag = fragmentation_after(shape, free - coords)
+    return 100.0 * (1.0 - frag)
 
 
 def contiguity_score(shape: Shape, free: set[Coord], n_chips: int) -> float:
@@ -200,9 +222,4 @@ def contiguity_score(shape: Shape, free: set[Coord], n_chips: int) -> float:
     contiguous block exists (job would span non-adjacent chips — XLA
     collectives would hop through occupied chips' links).
     """
-    fit = best_fit_block(shape, free, n_chips)
-    if fit is None:
-        return 0.0
-    _, _, coords = fit
-    frag = fragmentation_after(shape, free - coords)
-    return 100.0 * (1.0 - frag)
+    return _contiguity_cached(shape, frozenset(free), n_chips)
