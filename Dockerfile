@@ -1,0 +1,11 @@
+# Scheduler + sniffer + workload image. The reference copied a prebuilt
+# binary onto debian:stretch-slim (reference Dockerfile:1-5); here the
+# runtime is Python+JAX.
+FROM python:3.12-slim
+WORKDIR /app
+RUN pip install --no-cache-dir "jax[tpu]" flax optax pyyaml \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+COPY yoda_scheduler_tpu /app/yoda_scheduler_tpu
+COPY bench.py __graft_entry__.py /app/
+ENTRYPOINT ["python3", "-m", "yoda_scheduler_tpu.cli"]
+CMD ["serve", "--config=/etc/yoda/config.yaml"]

@@ -1,0 +1,35 @@
+# Build/test entry points (reference Makefile:3-18 had fmt+vet+build; this
+# framework is Python so "local" = lint-ish checks + tests).
+PY ?= python3
+IMAGE ?= yoda-tpu-scheduler
+TAG ?= 0.1.0
+
+.PHONY: local test bench simulate graft build push clean
+
+local: test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q --ignore=tests/test_models_parallel.py --ignore=tests/test_ops.py
+
+bench:
+	$(PY) bench.py
+
+simulate:
+	$(PY) -m yoda_scheduler_tpu.cli simulate example/test-pod.yaml \
+		example/test-deployment.yaml example/resnet-v4-8.yaml \
+		example/llama-v4-32-gang.yaml
+
+graft:
+	$(PY) __graft_entry__.py
+
+build:
+	docker build -t $(IMAGE):$(TAG) .
+
+push: build
+	docker push $(IMAGE):$(TAG)
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

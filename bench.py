@@ -23,6 +23,7 @@ import json
 import time
 
 from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import HybridClock
 from yoda_scheduler_tpu.scheduler.plugins.reference_emulation import (
     TelemetryDecrementingCluster,
     reference_profile,
@@ -87,19 +88,24 @@ def run_burst(profile_kind: str):
         store.put(n)
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
-    config = SchedulerConfig(max_attempts=8, gang_timeout_s=20.0)
+    # telemetry_max_age generous: the one-shot heartbeat above stands in for
+    # a continuously-publishing sniffer; the clock's virtual backoff sleeps
+    # must not age it out asymmetrically
+    config = SchedulerConfig(max_attempts=8, gang_timeout_s=20.0,
+                             telemetry_max_age_s=3600.0)
+    clock = HybridClock()
     if profile_kind == "reference":
         sched = Scheduler(
             TelemetryDecrementingCluster(cluster), config,
-            profile=reference_profile(config),
+            profile=reference_profile(config), clock=clock,
         )
     else:
-        sched = Scheduler(cluster, config)
+        sched = Scheduler(cluster, config, clock=clock)
     pods = build_burst()
     t0 = time.perf_counter()
     for p in pods:
         sched.submit(p)
-    sched.run_until_idle(max_cycles=5000)
+    cycles = sched.run_until_idle(max_cycles=5000)
     wall = time.perf_counter() - t0
 
     bound = sum(1 for p in pods if p.phase == PodPhase.BOUND)
@@ -117,7 +123,7 @@ def run_burst(profile_kind: str):
         "gangs_complete": gang_ok,
         "bin_pack_util_pct": round(sched.bin_pack_utilization(), 2),
         "wall_s": round(wall, 3),
-        "cycles": sched.metrics.counters.get("pods_scheduled_total", 0),
+        "cycles": cycles,
     }
 
 

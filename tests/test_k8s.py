@@ -1,0 +1,150 @@
+"""k8s client path against a fake transport: verbs, cluster adapter, leader
+election state machine. No real API server needed (SURVEY §4 fake-store
+strategy applied to the REST layer)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from yoda_scheduler_tpu.k8s.client import KubeClient, KubeCluster
+from yoda_scheduler_tpu.k8s.leaderelect import LeaderElector
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils.pod import Pod
+
+
+class FakeApiServer:
+    """Records requests; serves canned objects for the paths the scheduler
+    uses."""
+
+    def __init__(self):
+        self.requests = []
+        self.leases = {}
+        self.metrics = [make_tpu_node("n1", chips=4)]
+        self.pods = [{
+            "metadata": {"name": "p1", "namespace": "default",
+                         "labels": {"scv/number": "2"}},
+            "spec": {"schedulerName": "yoda-scheduler"},
+        }]
+        self.bound = []
+
+    def transport(self, method, path, body, timeout):
+        self.requests.append((method, path, body))
+        if path == "/version":
+            return 200, b'{"gitVersion": "fake"}'
+        if path.startswith("/apis/metrics.yoda.tpu"):
+            return 200, json.dumps(
+                {"items": [m.to_cr() for m in self.metrics]}).encode()
+        if "pods?fieldSelector" in path and "Pending" in path:
+            return 200, json.dumps({"items": self.pods}).encode()
+        if "pods?fieldSelector" in path:
+            return 200, json.dumps({"items": []}).encode()
+        if path == "/api/v1/nodes":
+            return 200, json.dumps(
+                {"items": [{"metadata": {"name": "n1"}}]}).encode()
+        if path.endswith("/binding"):
+            self.bound.append(body)
+            return 201, b"{}"
+        if "/leases/" in path or path.endswith("/leases"):
+            return self._lease(method, path, body)
+        if method == "PATCH":
+            return 200, b"{}"
+        if method == "DELETE":
+            return 200, b"{}"
+        return 404, b"{}"
+
+    def _lease(self, method, path, body):
+        name = path.rsplit("/", 1)[-1]
+        if method == "GET":
+            if name in self.leases:
+                return 200, json.dumps(self.leases[name]).encode()
+            return 404, b"{}"
+        if method == "POST":
+            name = body["metadata"]["name"]
+            self.leases[name] = body
+            return 201, b"{}"
+        if method == "PUT":
+            self.leases[name] = body
+            return 200, b"{}"
+        return 405, b"{}"
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+@pytest.fixture
+def client(api):
+    return KubeClient("https://fake", transport=api.transport)
+
+
+def test_list_metrics_roundtrip(client):
+    metrics = client.list_metrics()
+    assert len(metrics) == 1
+    assert metrics[0].node == "n1" and metrics[0].chip_count == 4
+
+
+def test_list_pending_pods_filters_scheduler_name(client, api):
+    pods = client.list_pending_pods("yoda-scheduler")
+    assert [p.name for p in pods] == ["p1"]
+    assert client.list_pending_pods("other-sched") == []
+
+
+def test_bind_posts_binding_and_patches_chips(client, api):
+    pod = Pod("p1")
+    client.bind(pod, "n1", [(0, 0, 0), (1, 0, 0)])
+    assert api.bound[0]["target"]["name"] == "n1"
+    patch = [r for r in api.requests if r[0] == "PATCH"]
+    assert patch and "tpu/assigned-chips" in json.dumps(patch[0][2])
+
+
+def test_kube_cluster_adapter(client):
+    store = TelemetryStore()
+    cluster = KubeCluster(client, store)
+    cluster.resync()
+    assert cluster.node_names() == ["n1"]
+    assert store.get("n1") is not None
+    pod = Pod("x")
+    cluster.bind(pod, "n1", [(0, 0, 0)])
+    assert [p.key for p in cluster.pods_on("n1")] == ["default/x"]
+    cluster.evict(pod)
+    assert cluster.pods_on("n1") == []
+
+
+class TestLeaderElection:
+    def test_acquire_fresh_lease(self, client):
+        le = LeaderElector(client, identity="me")
+        assert le.try_acquire_or_renew()
+        assert le.is_leader
+
+    def test_respects_live_holder(self, client, api):
+        other = LeaderElector(client, identity="other")
+        other.try_acquire_or_renew()
+        me = LeaderElector(client, identity="me")
+        assert not me.try_acquire_or_renew()
+        assert not me.is_leader
+
+    def test_takes_over_expired_lease(self, client, api):
+        other = LeaderElector(client, identity="other", lease_duration_s=0.05)
+        other.try_acquire_or_renew()
+        time.sleep(0.1)
+        me = LeaderElector(client, identity="me")
+        assert me.try_acquire_or_renew()
+        assert me.is_leader
+
+    def test_run_until_leader_sets_up_renewal(self, client):
+        le = LeaderElector(client, identity="me", renew_deadline_s=0.1)
+        stop = threading.Event()
+        le.run_until_leader(stop)
+        assert le.is_leader
+        time.sleep(0.15)  # at least one background renewal
+        assert not stop.is_set()
+        stop.set()
+
+
+def test_from_env_returns_none_without_cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nope"))
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    assert KubeClient.from_env() is None

@@ -1,0 +1,129 @@
+"""Registry, config-driven profile assembly, CLI simulate, metrics server."""
+
+import json
+import urllib.request
+
+import pytest
+
+from yoda_scheduler_tpu.cli import load_config, main
+from yoda_scheduler_tpu.scheduler import SchedulerConfig
+from yoda_scheduler_tpu.scheduler.framework import PreScorePlugin
+from yoda_scheduler_tpu.scheduler.plugins import (
+    GangPermit,
+    TelemetryFilter,
+    TopologyScore,
+)
+from yoda_scheduler_tpu.scheduler.registry import build_profile, registered
+from yoda_scheduler_tpu.utils.obs import Metrics, TraceLog
+
+
+def test_registry_lists_builtins():
+    names = registered()
+    for expected in ("priority-sort", "telemetry-filter", "telemetry-score",
+                     "topology-score", "gang-permit", "priority-preemption"):
+        assert expected in names
+
+
+def test_build_profile_from_enablement():
+    enabled = {
+        "queueSort": ["priority-sort"],
+        "filter": ["telemetry-filter"],
+        "preScore": ["max-collection"],
+        "score": ["telemetry-score", "topology-score"],
+        "permit": ["gang-permit"],
+    }
+    profile = build_profile(SchedulerConfig(), enabled)
+    assert isinstance(profile.filter[0], TelemetryFilter)
+    # topology-score auto-registers its PreScore half
+    assert any(isinstance(p, TopologyScore) for p in profile.pre_score)
+    # gang-permit's Reserve hook (slice choice) auto-registers
+    assert any(isinstance(p, GangPermit) for p in profile.reserve)
+
+
+def test_build_profile_unknown_plugin():
+    with pytest.raises(KeyError):
+        build_profile(SchedulerConfig(), {"filter": ["no-such-plugin"]})
+
+
+def test_load_config_yaml(tmp_path):
+    cfg_file = tmp_path / "config.yaml"
+    cfg_file.write_text(
+        """
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: custom-sched
+    percentageOfNodesToScore: 25
+    plugins:
+      filter:
+        enabled: [{name: telemetry-filter}]
+      score:
+        enabled: [{name: telemetry-score}]
+    pluginConfig:
+      - name: yoda-tpu
+        args:
+          topologyWeight: 9
+          scoreWeights: {free_memory: 7}
+"""
+    )
+    cfg, enabled = load_config(str(cfg_file))
+    assert cfg.scheduler_name == "custom-sched"
+    assert cfg.percentage_of_nodes_to_score == 25
+    assert cfg.topology_weight == 9
+    assert cfg.weights.free_memory == 7
+    assert enabled["filter"] == ["telemetry-filter"]
+
+
+def test_cli_simulate_end_to_end(capsys):
+    rc = main([
+        "simulate",
+        "example/test-pod.yaml",
+        "example/test-deployment.yaml",
+        "example/resnet-v4-8.yaml",
+        "example/llama-v4-32-gang.yaml",
+        "--tpu-slices", "2", "--tpu-nodes", "2", "--gpu-nodes", "1",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["bound"] == 9
+    # every BASELINE workload bound
+    assert out["pods"]["default/test-pod"]["phase"] == "Bound"
+    assert out["pods"]["default/resnet50-train"]["chips"].count(";") == 3
+    gang_nodes = {
+        v["node"] for k, v in out["pods"].items() if "llama2-7b" in k}
+    assert len(gang_nodes) == 4
+    slices = {n.rsplit("-host-", 1)[0] for n in gang_nodes}
+    assert len(slices) == 1  # whole gang on one slice
+
+
+def test_cli_sniff(capsys):
+    rc = main(["sniff", "--node-name", "test-host"])
+    assert rc == 0
+    cr = json.loads(capsys.readouterr().out)
+    assert cr["metadata"]["name"] == "test-host"
+    assert cr["kind"] == "TpuNodeMetrics"
+    # CPU-only test host: zero chips, never fabricated
+    assert cr["status"]["chips"] == []
+
+
+def test_metrics_http_server():
+    from yoda_scheduler_tpu.utils.httpserv import serve
+
+    metrics = Metrics()
+    metrics.inc("pods_scheduled_total", 3)
+    metrics.observe("schedule_latency_ms", 1.5)
+    traces = TraceLog()
+    server, _ = serve(metrics, traces, port=0)
+    host, port = server.server_address
+    try:
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics").read().decode()
+        assert "yoda_tpu_pods_scheduled_total 3" in body
+        assert "schedule_latency_ms_bucket" in body
+        assert urllib.request.urlopen(
+            f"http://{host}:{port}/healthz").read() == b"ok"
+        traces_doc = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/traces").read())
+        assert traces_doc == []
+    finally:
+        server.shutdown()

@@ -1,0 +1,195 @@
+"""Process entry point: ``python -m yoda_scheduler_tpu.cli``.
+
+The reference's entry is a cobra command wrapping upstream kube-scheduler
+(reference cmd/scheduler/main.go:12-21 + pkg/register/register.go). Native
+equivalent with three modes:
+
+- ``serve``    — run against a real Kubernetes API server (gated on
+                 reachability; watches pods + TpuNodeMetrics CRs)
+- ``simulate`` — run a full scheduling session on the in-memory fake
+                 cluster from YAML manifests (the kind-cluster stand-in)
+- ``sniff``    — run the local telemetry sniffer once and print the CR
+
+``--config`` accepts a KubeSchedulerConfiguration-style YAML (the shape in
+deploy/yoda-tpu-scheduler.yaml); ``--v`` sets log verbosity, as the
+reference's klog flag does (deploy/yoda-scheduler.yaml:63).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+from .scheduler import FakeCluster, Scheduler, SchedulerConfig
+from .scheduler.registry import build_profile
+from .telemetry import FakePublisher, TelemetryStore, make_gpu_node, make_tpu_node, make_v4_slice
+from .utils.pod import Pod, PodPhase
+
+log = logging.getLogger("yoda-tpu")
+
+
+def load_config(path: str | None) -> tuple[SchedulerConfig, dict | None]:
+    """Load (SchedulerConfig, plugin-enablement dict) from a scheduler
+    config YAML; defaults when path is None."""
+    if path is None:
+        return SchedulerConfig(), None
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    profiles = doc.get("profiles") or [{}]
+    profile = profiles[0]
+    cfg = SchedulerConfig.from_profile(profile)
+    enabled = None
+    plugins = profile.get("plugins")
+    if plugins:
+        enabled = {
+            point: [e["name"] for e in block.get("enabled", [])]
+            for point, block in plugins.items()
+            if isinstance(block, dict)
+        }
+    return cfg, enabled
+
+
+def _build_scheduler(cfg: SchedulerConfig, enabled, cluster) -> Scheduler:
+    profile = build_profile(cfg, enabled) if enabled else None
+    return Scheduler(cluster, cfg, profile=profile)
+
+
+def cmd_simulate(args) -> int:
+    cfg, enabled = load_config(args.config)
+    store = TelemetryStore()
+    pub = FakePublisher(store)
+
+    # cluster topology from flags
+    nodes = []
+    for i in range(args.tpu_slices):
+        nodes += make_v4_slice(f"v4-32-{i}", "2x2x4")
+    for i in range(args.tpu_nodes):
+        nodes.append(make_tpu_node(f"v4-8-{i}", chips=4))
+    for i in range(args.gpu_nodes):
+        nodes.append(make_gpu_node(f"gpu-{i}", cards=8))
+    pub.publish(*nodes)
+
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = _build_scheduler(cfg, enabled, cluster)
+
+    if args.metrics_port is not None:
+        from .utils.httpserv import serve
+
+        server, _ = serve(sched.metrics, sched.traces, port=args.metrics_port)
+        log.info("metrics on http://%s:%d/metrics", *server.server_address)
+
+    pods: list[Pod] = []
+    import yaml
+
+    for path in args.manifests:
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if not doc:
+                    continue
+                kind = doc.get("kind")
+                if kind == "Pod":
+                    pods.append(Pod.from_manifest(doc))
+                elif kind == "Deployment":
+                    replicas = doc.get("spec", {}).get("replicas", 1)
+                    tmpl = doc.get("spec", {}).get("template", {})
+                    meta = doc.get("metadata", {})
+                    for r in range(replicas):
+                        p = Pod.from_manifest(
+                            {"metadata": {
+                                "name": f"{meta.get('name', 'deploy')}-{r}",
+                                "namespace": meta.get("namespace", "default"),
+                                "labels": dict(
+                                    tmpl.get("metadata", {}).get("labels", {})),
+                            },
+                             "spec": tmpl.get("spec", {})})
+                        pods.append(p)
+
+    accepted = sum(sched.submit(p) for p in pods)
+    log.info("submitted %d/%d pods (schedulerName=%s)", accepted, len(pods),
+             cfg.scheduler_name)
+    sched.run_until_idle(max_cycles=args.max_cycles)
+
+    out = {
+        "pods": {
+            p.key: {"phase": p.phase.value, "node": p.node,
+                    "chips": p.labels.get("tpu/assigned-chips")}
+            for p in pods
+        },
+        "bound": sum(1 for p in pods if p.phase == PodPhase.BOUND),
+        "bin_pack_util_pct": round(sched.bin_pack_utilization(), 2),
+        "p50_latency_ms": round(
+            sched.metrics.histogram("schedule_latency_ms").quantile(0.5), 3),
+    }
+    print(json.dumps(out, indent=2))
+    if args.serve_forever:
+        while True:
+            time.sleep(3600)
+    return 0 if out["bound"] == accepted else 1
+
+
+def cmd_sniff(args) -> int:
+    from .telemetry.sniffer import local_node_metrics
+
+    print(json.dumps(local_node_metrics(args.node_name).to_cr(), indent=2))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    cfg, enabled = load_config(args.config)
+    from .k8s.client import KubeClient, run_scheduler_against_cluster
+
+    client = KubeClient.from_env(args.kubeconfig, args.apiserver)
+    if client is None:
+        log.error("no reachable Kubernetes API server; use `simulate` for "
+                  "the in-memory cluster")
+        return 2
+    return run_scheduler_against_cluster(
+        client, cfg, enabled, metrics_port=args.metrics_port,
+        leader_elect=args.leader_elect)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="yoda-tpu-scheduler")
+    ap.add_argument("--v", type=int, default=1, help="log verbosity (klog-style)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sim = sub.add_parser("simulate", help="schedule manifests on a fake cluster")
+    sim.add_argument("manifests", nargs="*", help="Pod/Deployment YAML files")
+    sim.add_argument("--config", default=None)
+    sim.add_argument("--tpu-slices", type=int, default=2)
+    sim.add_argument("--tpu-nodes", type=int, default=2)
+    sim.add_argument("--gpu-nodes", type=int, default=2)
+    sim.add_argument("--metrics-port", type=int, default=None)
+    sim.add_argument("--max-cycles", type=int, default=10_000)
+    sim.add_argument("--serve-forever", action="store_true")
+    sim.set_defaults(fn=cmd_simulate)
+
+    sn = sub.add_parser("sniff", help="print this host's telemetry CR")
+    sn.add_argument("--node-name", default=None)
+    sn.set_defaults(fn=cmd_sniff)
+
+    srv = sub.add_parser("serve", help="run against a real API server")
+    srv.add_argument("--config", default=None)
+    srv.add_argument("--kubeconfig", default=None)
+    srv.add_argument("--apiserver", default=None)
+    srv.add_argument("--metrics-port", type=int, default=10251)
+    srv.add_argument("--leader-elect", action="store_true")
+    srv.set_defaults(fn=cmd_serve)
+
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.v >= 3 else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,295 @@
+"""Minimal Kubernetes API client + cluster adapter (stdlib only, gated).
+
+The reference talks to the API server through client-go/controller-runtime
+(reference pkg/yoda/scheduler.go:53-72). This environment has no kubernetes
+Python package and no cluster, so the real-cluster path is a small REST
+client over urllib that implements exactly the verbs the scheduler needs:
+
+- list/watch TpuNodeMetrics CRs  -> feed the TelemetryStore (watch cache)
+- list/watch pending Pods with our schedulerName -> feed the queue
+- POST pods/<name>/binding        -> bind (with the chip-assignment
+  annotation the in-memory binder writes as a label)
+- DELETE pod (eviction) for preemption
+- Lease get/update for leader election (leaderelect.py)
+
+Everything is injectable (the `transport` callable) so the full path is
+unit-testable against a fake transport without a cluster; `from_env`
+returns None when no API server is reachable (the CLI then tells the user
+to use `simulate`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from ..telemetry.schema import CRD_GROUP, CRD_PLURAL, CRD_VERSION, TpuNodeMetrics
+from ..telemetry.store import TelemetryStore
+from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
+
+log = logging.getLogger("yoda-tpu.k8s")
+
+
+class KubeClient:
+    def __init__(self, base_url: str, token: str | None = None,
+                 ca_file: str | None = None, transport=None) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self._ctx = None
+        if transport is not None:
+            self._transport = transport
+        else:
+            if ca_file and os.path.exists(ca_file):
+                self._ctx = ssl.create_default_context(cafile=ca_file)
+            elif base_url.startswith("https"):
+                self._ctx = ssl._create_unverified_context()  # lab clusters
+            self._transport = self._urllib_transport
+
+    # ------------------------------------------------------------- transport
+    def _urllib_transport(self, method: str, path: str, body: dict | None,
+                          timeout: float):
+        req = urllib.request.Request(
+            self.base_url + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+        )
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(req, timeout=timeout, context=self._ctx) as r:
+            return r.status, r.read()
+
+    def request(self, method: str, path: str, body: dict | None = None,
+                timeout: float = 10.0) -> dict:
+        status, raw = self._transport(method, path, body, timeout)
+        if status >= 300:
+            raise RuntimeError(f"{method} {path} -> {status}: {raw[:200]}")
+        return json.loads(raw) if raw else {}
+
+    # ------------------------------------------------------------ finding us
+    @classmethod
+    def from_env(cls, kubeconfig: str | None = None,
+                 apiserver: str | None = None) -> "KubeClient | None":
+        """In-cluster service account, explicit --apiserver, or kubeconfig;
+        None when nothing is reachable."""
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        candidates: list[KubeClient] = []
+        if apiserver:
+            candidates.append(cls(apiserver))
+        if os.path.exists(f"{sa}/token"):
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if host:
+                with open(f"{sa}/token") as f:
+                    token = f.read()
+                candidates.append(cls(f"https://{host}:{port}", token=token,
+                                      ca_file=f"{sa}/ca.crt"))
+        cfg_path = kubeconfig or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        if os.path.exists(cfg_path):
+            try:
+                import yaml
+
+                with open(cfg_path) as f:
+                    doc = yaml.safe_load(f)
+                server = doc["clusters"][0]["cluster"]["server"]
+                candidates.append(cls(server))
+            except Exception:
+                pass
+        for c in candidates:
+            try:
+                c.request("GET", "/version", timeout=3.0)
+                return c
+            except Exception as e:
+                log.debug("api server %s unreachable: %s", c.base_url, e)
+        return None
+
+    # ----------------------------------------------------------------- verbs
+    def list_metrics(self) -> list[TpuNodeMetrics]:
+        doc = self.request(
+            "GET", f"/apis/{CRD_GROUP}/{CRD_VERSION}/{CRD_PLURAL}")
+        return [TpuNodeMetrics.from_cr(item) for item in doc.get("items", [])]
+
+    def list_pending_pods(self, scheduler_name: str) -> list[Pod]:
+        doc = self.request(
+            "GET",
+            "/api/v1/pods?fieldSelector=spec.nodeName%3D,status.phase%3DPending")
+        pods = []
+        for item in doc.get("items", []):
+            p = Pod.from_manifest(item)
+            if p.scheduler_name == scheduler_name and p.node is None:
+                pods.append(p)
+        return pods
+
+    def bind(self, pod: Pod, node: str,
+             assigned_chips: list | None = None) -> None:
+        body = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": pod.name, "namespace": pod.namespace},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+        }
+        self.request(
+            "POST",
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
+            body)
+        if assigned_chips:
+            patch = {"metadata": {"annotations": {
+                ASSIGNED_CHIPS_LABEL: format_assigned_chips(assigned_chips)}}}
+            try:
+                self.request(
+                    "PATCH",
+                    f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+                    patch)
+            except Exception as e:  # annotation is best-effort
+                log.warning("chip-assignment patch failed for %s: %s",
+                            pod.key, e)
+
+    def evict(self, pod: Pod) -> None:
+        self.request(
+            "DELETE",
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}")
+
+    def list_bound_pods(self) -> dict[str, list[Pod]]:
+        doc = self.request(
+            "GET", "/api/v1/pods?fieldSelector=status.phase%3DRunning")
+        by_node: dict[str, list[Pod]] = {}
+        for item in doc.get("items", []):
+            p = Pod.from_manifest(item)
+            # chip assignment travels as an annotation on real clusters
+            ann = item.get("metadata", {}).get("annotations", {})
+            if ASSIGNED_CHIPS_LABEL in ann:
+                p.labels[ASSIGNED_CHIPS_LABEL] = ann[ASSIGNED_CHIPS_LABEL]
+            if p.node:
+                by_node.setdefault(p.node, []).append(p)
+        return by_node
+
+    def list_nodes(self) -> list[str]:
+        doc = self.request("GET", "/api/v1/nodes")
+        return [i["metadata"]["name"] for i in doc.get("items", [])]
+
+
+class KubeCluster:
+    """Cluster interface (scheduler/cluster.py contract) over a KubeClient,
+    with a periodic re-list loop standing in for watch streams."""
+
+    def __init__(self, client: KubeClient, telemetry: TelemetryStore,
+                 resync_s: float = 2.0) -> None:
+        self.client = client
+        self.telemetry = telemetry
+        self.resync_s = resync_s
+        self._lock = threading.RLock()
+        self._nodes: list[str] = []
+        self._bound: dict[str, list[Pod]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def resync(self) -> None:
+        nodes = self.client.list_nodes()
+        bound = self.client.list_bound_pods()
+        for m in self.client.list_metrics():
+            self.telemetry.put(m)
+        with self._lock:
+            self._nodes = nodes
+            self._bound = bound
+
+    def start(self) -> None:
+        self.resync()
+
+        def loop():
+            while not self._stop.wait(self.resync_s):
+                try:
+                    self.resync()
+                except Exception as e:
+                    log.warning("resync failed: %s", e)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---------------------------------------------------- cluster interface
+    def node_names(self) -> list[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def pods_on(self, node: str) -> list[Pod]:
+        with self._lock:
+            return list(self._bound.get(node, []))
+
+    def bind(self, pod: Pod, node: str, assigned_chips=None) -> None:
+        self.client.bind(pod, node, assigned_chips)
+        pod.node = node
+        pod.phase = PodPhase.BOUND
+        if assigned_chips:
+            pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(assigned_chips)
+        with self._lock:
+            self._bound.setdefault(node, []).append(pod)
+
+    def evict(self, pod: Pod) -> None:
+        self.client.evict(pod)
+        with self._lock:
+            if pod.node and pod.node in self._bound:
+                self._bound[pod.node] = [
+                    p for p in self._bound[pod.node] if p.key != pod.key]
+        pod.node = None
+        pod.phase = PodPhase.PENDING
+
+
+def run_scheduler_against_cluster(client: KubeClient, config, enabled=None,
+                                  metrics_port: int | None = 10251,
+                                  leader_elect: bool = False,
+                                  poll_s: float = 1.0,
+                                  stop_event: threading.Event | None = None) -> int:
+    """The serve loop: leader-elect (optional), watch pending pods, run
+    scheduling cycles, bind through the API server."""
+    from ..scheduler.core import Scheduler
+    from ..scheduler.registry import build_profile
+
+    stop = stop_event or threading.Event()
+    if leader_elect:
+        from .leaderelect import LeaderElector
+
+        elector = LeaderElector(client)
+        elector.run_until_leader(stop)
+        if stop.is_set():
+            return 0
+
+    telemetry = TelemetryStore()
+    cluster = KubeCluster(client, telemetry)
+    cluster.start()
+    profile = build_profile(config, enabled) if enabled else None
+    sched = Scheduler(cluster, config, profile=profile)
+
+    if metrics_port is not None:
+        from ..utils.httpserv import serve
+
+        serve(sched.metrics, sched.traces, host="0.0.0.0", port=metrics_port)
+
+    seen: set[str] = set()
+    log.info("scheduler %s serving against %s", config.scheduler_name,
+             client.base_url)
+    while not stop.is_set():
+        try:
+            for pod in client.list_pending_pods(config.scheduler_name):
+                if pod.key not in seen:
+                    seen.add(pod.key)
+                    sched.submit(pod)
+            sched.check_waiting()
+            info = sched.queue.pop()
+            if info is None:
+                stop.wait(poll_s)
+                continue
+            sched.schedule_one(info)
+        except Exception as e:
+            log.error("cycle error: %s", e)
+            stop.wait(poll_s)
+    return 0

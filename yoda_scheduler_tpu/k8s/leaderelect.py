@@ -1,0 +1,144 @@
+"""Lease-based leader election.
+
+The reference inherits leader election from upstream kube-scheduler,
+configured lease 15s / renew 10s / retry 2s (reference
+deploy/yoda-scheduler.yaml:10-17). Native equivalent over the
+coordination.k8s.io/v1 Lease API with the same timing defaults, injectable
+clock + client so the state machine is unit-testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import uuid
+
+log = logging.getLogger("yoda-tpu.le")
+
+LEASE_PATH = ("/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}")
+
+
+class LeaderElector:
+    def __init__(self, client, name: str = "yoda-tpu-scheduler",
+                 namespace: str = "kube-system",
+                 lease_duration_s: float = 15.0,
+                 renew_deadline_s: float = 10.0,
+                 retry_period_s: float = 2.0,
+                 identity: str | None = None,
+                 clock=time) -> None:
+        self.client = client
+        self.path = LEASE_PATH.format(ns=namespace, name=name)
+        self.name = name
+        self.namespace = namespace
+        self.lease_duration_s = lease_duration_s
+        self.renew_deadline_s = renew_deadline_s
+        self.retry_period_s = retry_period_s
+        self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.clock = clock
+        self.is_leader = False
+
+    # ------------------------------------------------------------ lease CRUD
+    def _get(self) -> dict | None:
+        try:
+            return self.client.request("GET", self.path)
+        except Exception:
+            return None
+
+    def _create(self) -> bool:
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": self._spec(),
+        }
+        try:
+            self.client.request(
+                "POST",
+                f"/apis/coordination.k8s.io/v1/namespaces/{self.namespace}/leases",
+                body)
+            return True
+        except Exception:
+            return False
+
+    def _update(self, lease: dict) -> bool:
+        lease = dict(lease)
+        lease["spec"] = self._spec()
+        try:
+            self.client.request("PUT", self.path, lease)
+            return True
+        except Exception:
+            return False
+
+    def _spec(self) -> dict:
+        now = self.clock.time()
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration_s),
+            "renewTime": _micro_time(now),
+            "acquireTime": _micro_time(now),
+        }
+
+    # --------------------------------------------------------- state machine
+    def try_acquire_or_renew(self) -> bool:
+        lease = self._get()
+        if lease is None:
+            acquired = self._create()
+            self.is_leader = acquired
+            return acquired
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        if holder == self.identity:
+            self.is_leader = self._update(lease)
+            return self.is_leader
+        renew = _parse_micro_time(spec.get("renewTime"))
+        expired = (renew is None or
+                   self.clock.time() - renew > spec.get(
+                       "leaseDurationSeconds", self.lease_duration_s))
+        if expired and self._update(lease):
+            log.info("%s acquired expired lease from %s", self.identity, holder)
+            self.is_leader = True
+            return True
+        self.is_leader = False
+        return False
+
+    def run_until_leader(self, stop: threading.Event) -> None:
+        """Block until we hold the lease (retry every retry_period_s), then
+        keep renewing in a daemon thread; on renew failure, release
+        leadership and set `stop` (the reference posture: losing the lease
+        kills the process so a standby takes over)."""
+        while not stop.is_set() and not self.try_acquire_or_renew():
+            stop.wait(self.retry_period_s)
+        if stop.is_set():
+            return
+        log.info("became leader: %s", self.identity)
+
+        def renew_loop():
+            while not stop.wait(self.renew_deadline_s / 2):
+                if not self.try_acquire_or_renew():
+                    log.error("lost leadership; stopping")
+                    stop.set()
+                    return
+
+        threading.Thread(target=renew_loop, daemon=True).start()
+
+
+def _micro_time(t: float) -> str:
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(t, timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def _parse_micro_time(s: str | None) -> float | None:
+    if not s:
+        return None
+    from datetime import datetime, timezone
+
+    try:
+        return datetime.strptime(
+            s.replace("Z", ""), "%Y-%m-%dT%H:%M:%S.%f").replace(
+                tzinfo=timezone.utc).timestamp()
+    except ValueError:
+        return None

@@ -158,6 +158,11 @@ def flash_attention(q, k, v, causal: bool = True,
     cannot tile (ragged sequence lengths).
     """
     sq, sk = q.shape[2], k.shape[2]
+    if causal and sq > sk:
+        # rows beyond the kv horizon would attend to nothing — the math is
+        # ill-defined (the reference would emit uniform attention over fully
+        # masked scores); refuse rather than silently diverge per path
+        raise ValueError(f"causal attention needs seq_q <= seq_kv, got {sq} > {sk}")
     bq, bk = min(block_q, sq), min(block_k, sk)
     if sq % bq or sk % bk:
         return reference_attention(q, k, v, causal)

@@ -92,12 +92,18 @@ def _shard_opt_state_like(tx, config: LlamaConfig, param_sh, mesh):
     return jax.tree.map(assign, opt_shape, is_leaf=is_params_like)
 
 
-def quick_mesh_and_step(n_devices: int | None = None, tp: int = 2, sp: int = 1,
+def quick_mesh_and_step(n_devices: int | None = None,
                         config: LlamaConfig | None = None):
-    """Convenience used by the multichip dryrun: tiny model, full stack."""
+    """Tiny model over the richest mesh n devices allow: tp always, sp when
+    divisible, remaining split dp x fsdp. Used by __graft_entry__.
+    dryrun_multichip and handy for smoke tests."""
     devices = jax.devices()
     n = n_devices or len(devices)
-    shape = mesh_shape_for(n, tp=tp, sp=sp)
+    tp = 2 if n % 2 == 0 else 1
+    sp = 2 if n % (tp * 2) == 0 and n // tp >= 2 else 1
+    rest = n // (tp * sp)
+    dp = 2 if rest % 2 == 0 else 1
+    shape = mesh_shape_for(n, tp=tp, sp=sp, dp=dp)
     mesh = make_mesh(shape, devices=devices[:n])
     config = config or LlamaConfig.tiny()
     init_fn, step_fn, batch_sh = build_llama_train_step(config, mesh)

@@ -86,6 +86,24 @@ class FakeClock(Clock):
         self._now += s
 
 
+class HybridClock(Clock):
+    """Real elapsed compute time + virtual sleeps. Benchmarks use this so
+    measured latencies include genuine scheduling-cycle cost and queue wait,
+    while backoff/permit sleeps advance time instantly instead of stalling
+    the harness for real seconds."""
+
+    def __init__(self) -> None:
+        self._t0 = time.time()
+        self._p0 = time.perf_counter()
+        self._virtual = 0.0
+
+    def time(self) -> float:
+        return self._t0 + (time.perf_counter() - self._p0) + self._virtual
+
+    def sleep(self, s: float) -> None:
+        self._virtual += s
+
+
 class Profile:
     """A wired plugin set (the KubeSchedulerConfiguration profile analogue)."""
 

@@ -1,0 +1,106 @@
+"""Plugin registry: name -> factory, and profile assembly from config.
+
+The reference registers its plugin into the upstream framework registry via
+``app.NewSchedulerCommand(app.WithPlugin(yoda.Name, yoda.New))`` (reference
+pkg/register/register.go:9-13). Native equivalent: a registry mapping plugin
+names to factories plus `build_profile`, which wires a Profile from a
+KubeSchedulerConfiguration-style plugin enablement block so deployments can
+enable/disable/weight plugins in config rather than code.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .config import SchedulerConfig
+from .core import Profile, default_profile
+from .plugins import (
+    ChipAllocator,
+    GangCoordinator,
+    GangPermit,
+    MaxCollection,
+    PriorityPreemption,
+    PrioritySort,
+    TelemetryFilter,
+    TelemetryScore,
+    TopologyScore,
+)
+
+# shared-state objects (allocator, gang coordinator) are built once per
+# profile and injected into every plugin factory that wants them
+Factory = Callable[[SchedulerConfig, ChipAllocator, GangCoordinator], object]
+
+_REGISTRY: dict[str, Factory] = {}
+
+
+def register(name: str, factory: Factory) -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"plugin {name!r} already registered")
+    _REGISTRY[name] = factory
+
+
+def registered() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+register("priority-sort", lambda cfg, alloc, gangs: PrioritySort())
+register("telemetry-filter",
+         lambda cfg, alloc, gangs: TelemetryFilter(alloc, gangs, cfg.telemetry_max_age_s))
+register("max-collection", lambda cfg, alloc, gangs: MaxCollection(alloc))
+register("telemetry-score",
+         lambda cfg, alloc, gangs: TelemetryScore(alloc, cfg.weights, weight=1))
+register("topology-score",
+         lambda cfg, alloc, gangs: TopologyScore(alloc, weight=cfg.topology_weight))
+register("gang-permit",
+         lambda cfg, alloc, gangs: GangPermit(gangs, timeout_s=cfg.gang_timeout_s))
+register("priority-preemption", lambda cfg, alloc, gangs: PriorityPreemption(alloc))
+
+
+def build_profile(config: SchedulerConfig,
+                  enabled: dict[str, list[str]] | None = None) -> Profile:
+    """Build a Profile. `enabled` maps extension point -> plugin names (the
+    KubeSchedulerConfiguration `plugins:` block); None = the default set."""
+    if enabled is None:
+        profile, _, _ = default_profile(config)
+        return profile
+    alloc = ChipAllocator()
+    gangs = GangCoordinator()
+    built: dict[str, object] = {}
+
+    def get(name: str):
+        if name not in built:
+            if name not in _REGISTRY:
+                raise KeyError(f"unknown plugin {name!r}; known: {registered()}")
+            built[name] = _REGISTRY[name](config, alloc, gangs)
+        return built[name]
+
+    from .framework import PreScorePlugin, ReservePlugin
+
+    qs = enabled.get("queueSort", ["priority-sort"])
+    queue_sort = get(qs[0]) if qs else PrioritySort()
+    filters = [get(n) for n in enabled.get("filter", [])]
+    post_filters = [get(n) for n in enabled.get("postFilter", [])]
+    pre_scores = [get(n) for n in enabled.get("preScore", [])]
+    scores = [get(n) for n in enabled.get("score", [])]
+    permits = [get(n) for n in enabled.get("permit", [])]
+    # a Score plugin that is also a PreScore plugin (topology-score's
+    # slice-usage pass) must run at both points or its score input is empty
+    for p in scores:
+        if isinstance(p, PreScorePlugin) and p not in pre_scores:
+            pre_scores.append(p)
+    explicit_reserves = [get(n) for n in enabled.get("reserve", [])]
+    # the allocator always reserves; any enabled plugin that also implements
+    # Reserve (e.g. gang-permit's slice choice) hooks in automatically
+    reserves: list = [alloc]
+    for p in list(built.values()) + explicit_reserves:
+        if isinstance(p, ReservePlugin) and p not in reserves:
+            reserves.append(p)
+    return Profile(
+        queue_sort=queue_sort,
+        filter=filters,
+        post_filter=post_filters,
+        pre_score=pre_scores,
+        score=scores,
+        reserve=reserves,
+        permit=permits,
+    )

@@ -1,0 +1,49 @@
+"""Tiny HTTP server exposing /metrics (Prometheus text), /healthz, and
+/traces (recent scheduling cycles as JSON).
+
+The reference explicitly disables metrics (MetricsBindAddress "",
+reference pkg/yoda/scheduler.go:55); SURVEY §5 lists observability as a
+must-add. Stdlib-only, runs on a daemon thread next to the scheduler.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def serve(metrics, traces=None, host: str = "127.0.0.1", port: int = 10251):
+    """Start serving in a daemon thread; returns (server, thread). Use
+    port=0 to pick a free port (server.server_address[1])."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path == "/metrics":
+                body = metrics.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path == "/healthz":
+                body = b"ok"
+                ctype = "text/plain"
+            elif self.path == "/traces" and traces is not None:
+                body = json.dumps(
+                    [asdict(t) for t in traces.recent(100)]).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            return
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread
