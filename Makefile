@@ -4,9 +4,14 @@ PY ?= python3
 IMAGE ?= yoda-tpu-scheduler
 TAG ?= 0.1.0
 
-.PHONY: local test bench simulate graft build push clean
+.PHONY: local test bench simulate graft build push clean native
 
-local: test
+local: native test
+
+native: native/libyodaplace.so
+
+native/libyodaplace.so: native/placement.cc
+	g++ -O2 -std=c++17 -shared -fPIC -o $@ $<
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -38,7 +38,7 @@ class FakeApiServer:
                 {"items": [m.to_cr() for m in self.metrics]}).encode()
         if "pods?fieldSelector" in path and "Pending" in path:
             return 200, json.dumps({"items": self.pods}).encode()
-        if "pods?fieldSelector" in path:
+        if path == "/api/v1/pods" or "pods?fieldSelector" in path:
             return 200, json.dumps({"items": []}).encode()
         if path == "/api/v1/nodes":
             return 200, json.dumps(
@@ -148,3 +148,51 @@ def test_from_env_returns_none_without_cluster(tmp_path, monkeypatch):
     monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nope"))
     monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
     assert KubeClient.from_env() is None
+
+
+def test_list_bound_pods_includes_containercreating(client, api):
+    # bound-but-not-Running pods must stay visible or chips double-allocate
+    api_items = [
+        {"metadata": {"name": "creating", "namespace": "default",
+                      "annotations": {"tpu/assigned-chips": "0,0,0"}},
+         "spec": {"nodeName": "n1"}, "status": {"phase": "Pending"}},
+        {"metadata": {"name": "done", "namespace": "default"},
+         "spec": {"nodeName": "n1"}, "status": {"phase": "Succeeded"}},
+    ]
+    def transport(method, path, body, timeout):
+        if path == "/api/v1/pods":
+            return 200, json.dumps({"items": api_items}).encode()
+        return api.transport(method, path, body, timeout)
+    c = KubeClient("https://fake", transport=transport)
+    by_node = c.list_bound_pods()
+    names = [p.name for p in by_node.get("n1", [])]
+    assert names == ["creating"]  # terminal pod excluded, creating included
+    assert by_node["n1"][0].assigned_chips() == {(0, 0, 0)}
+
+
+def test_patch_uses_merge_patch_content_type(api):
+    captured = {}
+    def transport(method, path, body, timeout):
+        return api.transport(method, path, body, timeout)
+    c = KubeClient("https://fake", transport=transport)
+    # inspect the real urllib header logic directly
+    import urllib.request
+    orig = urllib.request.urlopen
+    reqs = []
+    class R:
+        status = 200
+        def read(self): return b"{}"
+        def __enter__(self): return self
+        def __exit__(self, *a): return False
+    def fake_open(req, timeout=None, context=None):
+        reqs.append(req)
+        return R()
+    urllib.request.urlopen = fake_open
+    try:
+        real = KubeClient("https://fake")
+        real.request("PATCH", "/api/v1/namespaces/d/pods/p", {"metadata": {}})
+        real.request("POST", "/api/v1/namespaces/d/pods/p/binding", {"x": 1})
+    finally:
+        urllib.request.urlopen = orig
+    assert reqs[0].get_header("Content-type") == "application/merge-patch+json"
+    assert reqs[1].get_header("Content-type") == "application/json"

@@ -1,0 +1,83 @@
+"""Parity tests: native placement engine vs the pure-Python reference.
+
+The C++ library (native/placement.cc, built by `make native`) must be
+bit-identical to torus.py's search — same winners, same tie-breaks. Skipped
+when the library has not been built.
+"""
+
+import random
+
+import pytest
+
+from yoda_scheduler_tpu.topology import native
+from yoda_scheduler_tpu.topology import torus
+from yoda_scheduler_tpu.topology.torus import all_coords
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native placement library not built")
+
+
+RNG = random.Random(42)
+
+
+def random_cases(shape, n_cases=150, max_free=24, max_chips=8):
+    coords = all_coords(shape)
+    for _ in range(n_cases):
+        n_free = RNG.randint(0, min(max_free, len(coords)))
+        free = frozenset(RNG.sample(coords, n_free))
+        yield free, RNG.randint(1, max_chips)
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 1), (2, 2, 4), (4, 4, 4)])
+def test_best_fit_parity(shape):
+    for free, n in random_cases(shape):
+        py = torus._best_placement(shape, free, torus._factor_shapes(n))
+        nat = native.best_fit_block(shape, free, n)
+        if py is None:
+            assert nat is None
+        else:
+            assert nat is not None
+            assert (py[0], py[1]) == (nat[0], nat[1])
+            assert py[2] == nat[2]
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 4), (4, 4, 2)])
+def test_contiguity_parity(shape):
+    for free, n in random_cases(shape, n_cases=100):
+        py_fit = torus._best_placement(shape, free, torus._factor_shapes(n))
+        py = (100.0 * (1.0 - torus.fragmentation_after(shape, free - py_fit[2]))
+              if py_fit else 0.0)
+        nat = native.contiguity_score(shape, free, n)
+        assert nat == pytest.approx(py, abs=1e-9)
+
+
+def test_fits_shape_parity():
+    shape = (2, 2, 4)
+    for free, _ in random_cases(shape, n_cases=100):
+        for req in [(2, 2, 1), (1, 1, 4), (2, 1, 2)]:
+            py = torus._best_placement(
+                shape, free,
+                tuple(sorted(set(__import__("itertools").permutations(req)))))
+            nat = native.fits_shape(shape, free, req)
+            if py is None:
+                assert nat is None
+            else:
+                assert (py[0], py[1]) == (nat[0], nat[1])
+
+
+def test_largest_free_block_parity():
+    shape = (4, 4, 4)
+    for free, _ in random_cases(shape, n_cases=100, max_free=30):
+        if not free:
+            continue
+        # bypass both caches and the native dispatch inside the python impl
+        import os
+
+        os.environ["YODA_NO_NATIVE"] = "1"
+        torus._native_on.cache_clear()
+        try:
+            py = torus._largest_free_block.__wrapped__(shape, free)
+        finally:
+            del os.environ["YODA_NO_NATIVE"]
+            torus._native_on.cache_clear()
+        assert native.largest_free_block(shape, free) == py

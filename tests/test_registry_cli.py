@@ -127,3 +127,26 @@ def test_metrics_http_server():
         assert traces_doc == []
     finally:
         server.shutdown()
+
+
+def test_merge_enablement_keeps_defaults():
+    from yoda_scheduler_tpu.scheduler.registry import merge_enablement
+
+    # listing only `score:` must not disable filtering/permit (k8s semantics)
+    merged = merge_enablement({"score": {"enabled": [{"name": "telemetry-score"}]}})
+    assert merged["filter"] == ["telemetry-filter"]
+    assert merged["permit"] == ["gang-permit"]
+    assert "telemetry-score" in merged["score"]
+    # explicit disable-all clears a point
+    merged = merge_enablement({"permit": {"disabled": [{"name": "*"}]}})
+    assert merged["permit"] == []
+    # targeted disable
+    merged = merge_enablement({"score": {"disabled": [{"name": "topology-score"}]}})
+    assert merged["score"] == ["telemetry-score"]
+
+
+def test_config_defaults_single_source_of_truth():
+    # from_profile with empty args must equal the dataclass defaults
+    cfg = SchedulerConfig.from_profile({"pluginConfig": [{"name": "yoda-tpu", "args": {}}]})
+    assert cfg.topology_weight == SchedulerConfig().topology_weight
+    assert cfg.telemetry_max_age_s == SchedulerConfig().telemetry_max_age_s

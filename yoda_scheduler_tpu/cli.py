@@ -43,15 +43,14 @@ def load_config(path: str | None) -> tuple[SchedulerConfig, dict | None]:
     profiles = doc.get("profiles") or [{}]
     profile = profiles[0]
     cfg = SchedulerConfig.from_profile(profile)
-    enabled = None
     plugins = profile.get("plugins")
-    if plugins:
-        enabled = {
-            point: [e["name"] for e in block.get("enabled", [])]
-            for point, block in plugins.items()
-            if isinstance(block, dict)
-        }
-    return cfg, enabled
+    if not plugins:
+        return cfg, None
+    from .scheduler.registry import merge_enablement
+
+    # defaults stay enabled at unlisted extension points (k8s semantics);
+    # use disabled: [{name: '*'}] to clear a point
+    return cfg, merge_enablement(plugins)
 
 
 def _build_scheduler(cfg: SchedulerConfig, enabled, cluster) -> Scheduler:

@@ -60,7 +60,11 @@ class KubeClient:
         )
         req.add_header("Accept", "application/json")
         if body is not None:
-            req.add_header("Content-Type", "application/json")
+            # the API server rejects PATCH bodies that don't declare a patch
+            # content type with 415
+            ctype = ("application/merge-patch+json" if method == "PATCH"
+                     else "application/json")
+            req.add_header("Content-Type", ctype)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         with urllib.request.urlopen(req, timeout=timeout, context=self._ctx) as r:
@@ -158,10 +162,15 @@ class KubeClient:
             f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}")
 
     def list_bound_pods(self) -> dict[str, list[Pod]]:
-        doc = self.request(
-            "GET", "/api/v1/pods?fieldSelector=status.phase%3DRunning")
+        """Every pod holding a node — any phase except terminal. Filtering on
+        phase=Running would make bound-but-ContainerCreating pods invisible
+        for a resync window and their chips would be double-allocated."""
+        doc = self.request("GET", "/api/v1/pods")
         by_node: dict[str, list[Pod]] = {}
         for item in doc.get("items", []):
+            phase = item.get("status", {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                continue
             p = Pod.from_manifest(item)
             # chip assignment travels as an annotation on real clusters
             ann = item.get("metadata", {}).get("annotations", {})
@@ -274,15 +283,31 @@ def run_scheduler_against_cluster(client: KubeClient, config, enabled=None,
 
         serve(sched.metrics, sched.traces, host="0.0.0.0", port=metrics_port)
 
-    seen: set[str] = set()
+    # pod.key -> k8s uid of the incarnation we handled. A deleted pod
+    # recreated under the same name arrives with a new uid and must be
+    # scheduled afresh; entries for vanished pods are pruned every poll.
+    seen: dict[str, str] = {}
     log.info("scheduler %s serving against %s", config.scheduler_name,
              client.base_url)
     while not stop.is_set():
         try:
-            for pod in client.list_pending_pods(config.scheduler_name):
-                if pod.key not in seen:
-                    seen.add(pod.key)
-                    sched.submit(pod)
+            pending = client.list_pending_pods(config.scheduler_name)
+            pending_keys = {p.key for p in pending}
+            for pod in pending:
+                if sched.tracks(pod.key):
+                    seen[pod.key] = pod.k8s_uid
+                    continue
+                if seen.get(pod.key) == pod.k8s_uid:
+                    # this incarnation was already handled (bound moments ago
+                    # and the listing is stale, or permanently failed)
+                    continue
+                sched.failed.pop(pod.key, None)  # new incarnation resets failure
+                seen[pod.key] = pod.k8s_uid
+                sched.submit(pod)
+            for key in list(seen):
+                if key not in pending_keys and not sched.tracks(key):
+                    seen.pop(key, None)
+                    sched.failed.pop(key, None)
             sched.check_waiting()
             info = sched.queue.pop()
             if info is None:

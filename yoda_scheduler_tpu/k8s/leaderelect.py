@@ -115,9 +115,17 @@ class LeaderElector:
         log.info("became leader: %s", self.identity)
 
         def renew_loop():
-            while not stop.wait(self.renew_deadline_s / 2):
-                if not self.try_acquire_or_renew():
-                    log.error("lost leadership; stopping")
+            # retry every retry_period; step down only after the renew
+            # deadline elapses without ONE success — a single dropped request
+            # must not kill the only scheduler replica (client-go semantics,
+            # reference deploy/yoda-scheduler.yaml:12-17 timing)
+            last_success = self.clock.time()
+            while not stop.wait(self.retry_period_s):
+                if self.try_acquire_or_renew():
+                    last_success = self.clock.time()
+                elif self.clock.time() - last_success > self.renew_deadline_s:
+                    log.error("lost leadership (no renew within %.0fs); stopping",
+                              self.renew_deadline_s)
                     stop.set()
                     return
 

@@ -73,14 +73,17 @@ class SchedulerConfig:
                 args = p.get("args", {})
         w = args.get("scoreWeights", {})
         weights = ScoreWeights(**{k: int(v) for k, v in w.items()}) if w else ScoreWeights()
+        defaults = cls()  # single source of truth for absent args
         return cls(
-            scheduler_name=profile.get("schedulerName", "yoda-scheduler"),
-            percentage_of_nodes_to_score=int(profile.get("percentageOfNodesToScore", 0)),
+            scheduler_name=profile.get("schedulerName", defaults.scheduler_name),
+            percentage_of_nodes_to_score=int(profile.get(
+                "percentageOfNodesToScore", defaults.percentage_of_nodes_to_score)),
             weights=weights,
-            telemetry_max_age_s=float(args.get("telemetryMaxAgeSeconds", 60.0)),
-            gang_timeout_s=float(args.get("gangTimeoutSeconds", 30.0)),
-            preemption=bool(args.get("preemption", True)),
-            topology_weight=int(args.get("topologyWeight", 2)),
+            telemetry_max_age_s=float(args.get(
+                "telemetryMaxAgeSeconds", defaults.telemetry_max_age_s)),
+            gang_timeout_s=float(args.get("gangTimeoutSeconds", defaults.gang_timeout_s)),
+            preemption=bool(args.get("preemption", defaults.preemption)),
+            topology_weight=int(args.get("topologyWeight", defaults.topology_weight)),
         )
 
 

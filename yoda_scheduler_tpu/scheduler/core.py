@@ -205,6 +205,11 @@ class Scheduler:
         self.metrics.inc("pods_submitted_total")
         return True
 
+    def tracks(self, pod_key: str) -> bool:
+        """Is this pod currently in our hands (queued, backing off, or parked
+        at Permit)? Used by the serve loop to avoid duplicate submission."""
+        return pod_key in self.waiting or self.queue.contains(pod_key)
+
     def _num_feasible_to_find(self, num_nodes: int) -> int:
         """kube-scheduler's numFeasibleNodesToFind: all nodes below 100; above
         that, percentageOfNodesToScore (adaptive when 0) with a floor of 100."""

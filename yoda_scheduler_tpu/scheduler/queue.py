@@ -76,6 +76,10 @@ class SchedulingQueue:
         info.not_before = 0.0
         self._active.append(info)
 
+    def contains(self, pod_key: str) -> bool:
+        return any(q.pod.key == pod_key for q in self._active) or any(
+            q.pod.key == pod_key for q in self._backoff)
+
     def next_ready_at(self) -> float | None:
         """Earliest not_before among parked pods (None if active non-empty)."""
         if self._active:

@@ -56,6 +56,43 @@ register("gang-permit",
 register("priority-preemption", lambda cfg, alloc, gangs: PriorityPreemption(alloc))
 
 
+# the default enablement per extension point (mirrors default_profile);
+# config blocks MERGE into this — listing only `score:` in YAML retunes
+# scoring without silently disabling filtering/permit, matching
+# KubeSchedulerConfiguration semantics where defaults stay enabled unless
+# explicitly disabled
+DEFAULT_ENABLED: dict[str, list[str]] = {
+    "queueSort": ["priority-sort"],
+    "filter": ["telemetry-filter"],
+    "postFilter": ["priority-preemption"],
+    "preScore": ["max-collection"],
+    "score": ["telemetry-score", "topology-score"],
+    "permit": ["gang-permit"],
+}
+
+
+def merge_enablement(user: dict[str, dict] | None) -> dict[str, list[str]]:
+    """Merge a KubeSchedulerConfiguration `plugins:` block into the default
+    enablement. Each point's `enabled` names are appended (deduped) and
+    `disabled` names removed; `disabled: [{name: '*'}]` clears the point's
+    defaults first."""
+    merged = {k: list(v) for k, v in DEFAULT_ENABLED.items()}
+    for point, block in (user or {}).items():
+        if not isinstance(block, dict):
+            continue
+        current = merged.setdefault(point, [])
+        disabled = [e.get("name") for e in block.get("disabled", [])]
+        if "*" in disabled:
+            current = []
+        else:
+            current = [n for n in current if n not in disabled]
+        for e in block.get("enabled", []):
+            if e.get("name") and e["name"] not in current:
+                current.append(e["name"])
+        merged[point] = current
+    return merged
+
+
 def build_profile(config: SchedulerConfig,
                   enabled: dict[str, list[str]] | None = None) -> Profile:
     """Build a Profile. `enabled` maps extension point -> plugin names (the

@@ -123,19 +123,16 @@ def _compactness(block: Shape) -> int:
     return bx + by + bz
 
 
-@lru_cache(maxsize=131072)
 def _best_placement(
     slice_shape: Shape,
     free: frozenset[Coord],
     candidate_shapes: tuple[Shape, ...],
 ) -> tuple[Coord, Shape, frozenset[Coord]] | None:
-    """Shared placement search: try every candidate block shape at every
-    origin; keep the placement that (1) minimises leftover fragmentation,
-    (2) prefers compact shapes (short ICI diameter), (3) carves from the
-    low corner. Returns (origin, block_shape, coords) or None.
-
-    Cached: node free-sets repeat across the many scheduling cycles of a
-    burst, making placement search effectively O(1) amortised."""
+    """Shared placement search (pure, uncached — the public entry points
+    below carry the cache): try every candidate block shape at every origin;
+    keep the placement that (1) minimises leftover fragmentation, (2)
+    prefers compact shapes (short ICI diameter), (3) carves from the low
+    corner. Returns (origin, block_shape, coords) or None."""
     sx, sy, sz = slice_shape
     best: tuple[tuple, Coord, Shape, set[Coord]] | None = None
     for block in candidate_shapes:
@@ -157,21 +154,54 @@ def _best_placement(
     return best[1], best[2], best[3]
 
 
+@lru_cache(maxsize=131072)
+def _best_fit_cached(slice_shape: Shape, free: frozenset[Coord], n_chips: int):
+    if _native_on():
+        from . import native
+
+        out = native.best_fit_block(slice_shape, free, n_chips)
+        if out is not NotImplemented:
+            return out
+    return _best_placement(slice_shape, free, _factor_shapes(n_chips))
+
+
 def best_fit_block(
     slice_shape: Shape,
     free: set[Coord],
     n_chips: int,
 ) -> tuple[Coord, Shape, frozenset[Coord]] | None:
     """Best contiguous block of exactly `n_chips` free chips, any shape
-    whose volume is n_chips."""
-    return _best_placement(slice_shape, frozenset(free), _factor_shapes(n_chips))
+    whose volume is n_chips. Uses the native engine when built
+    (native/placement.cc), pure Python otherwise — identical results."""
+    return _best_fit_cached(slice_shape, frozenset(free), n_chips)
+
+
+@lru_cache(maxsize=131072)
+def _fits_shape_cached(slice_shape: Shape, free: frozenset[Coord], req_shape: Shape):
+    if _native_on():
+        from . import native
+
+        out = native.fits_shape(slice_shape, free, req_shape)
+        if out is not NotImplemented:
+            return out
+    return _best_placement(slice_shape, free,
+                           tuple(sorted(set(permutations(req_shape)))))
 
 
 def fits_shape(slice_shape: Shape, free: set[Coord], req_shape: Shape) -> tuple[Coord, Shape, frozenset[Coord]] | None:
     """Place an exact requested block shape (any axis permutation) into free
     space. Used for the ``tpu/topology`` label."""
-    return _best_placement(slice_shape, frozenset(free),
-                           tuple(sorted(set(permutations(req_shape)))))
+    return _fits_shape_cached(slice_shape, frozenset(free), req_shape)
+
+
+@lru_cache(maxsize=1)
+def _native_on() -> bool:
+    try:
+        from . import native
+
+        return native.available()
+    except Exception:
+        return False
 
 
 def largest_free_block(shape: Shape, free: set[Coord]) -> int:
@@ -181,6 +211,12 @@ def largest_free_block(shape: Shape, free: set[Coord]) -> int:
 
 @lru_cache(maxsize=131072)
 def _largest_free_block(shape: Shape, free: frozenset[Coord]) -> int:
+    if _native_on():
+        from . import native
+
+        out = native.largest_free_block(shape, free)
+        if out is not NotImplemented:
+            return out
     if not free:
         return 0
     best = 1
@@ -206,7 +242,7 @@ def fragmentation_after(shape: Shape, free: set[Coord]) -> float:
 
 @lru_cache(maxsize=131072)
 def _contiguity_cached(shape: Shape, free: frozenset[Coord], n_chips: int) -> float:
-    fit = _best_placement(shape, free, _factor_shapes(n_chips))
+    fit = _best_fit_cached(shape, free, n_chips)
     if fit is None:
         return 0.0
     _, _, coords = fit

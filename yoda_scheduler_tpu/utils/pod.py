@@ -37,6 +37,8 @@ class Pod:
     node: str | None = None           # spec.nodeName after bind
     phase: PodPhase = PodPhase.PENDING
     uid: int = field(default_factory=lambda: next(_uid_counter))
+    k8s_uid: str = ""                 # metadata.uid on real clusters; a
+                                      # recreated same-name pod gets a new one
     created: float = field(default_factory=time.time)
 
     @property
@@ -63,4 +65,5 @@ class Pod:
             labels=dict(meta.get("labels", {})),
             scheduler_name=spec.get("schedulerName", "default-scheduler"),
             node=spec.get("nodeName"),
+            k8s_uid=meta.get("uid", ""),
         )
