@@ -38,10 +38,19 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 4096
     dtype: str = "bfloat16"
+    # MoE (0 experts = dense FFN); experts shard over the `ep` mesh axis
+    num_experts: int = 0
+    experts_per_token: int = 2
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @classmethod
     def llama2_7b(cls) -> "LlamaConfig":
@@ -53,6 +62,12 @@ class LlamaConfig:
         sharding axis, small enough to compile in seconds."""
         return cls(vocab_size=vocab, dim=128, n_layers=2, n_heads=4,
                    n_kv_heads=2, ffn_dim=256, max_seq_len=512)
+
+    @classmethod
+    def tiny_moe(cls, vocab: int = 256) -> "LlamaConfig":
+        """tiny() with a 4-expert top-2 MoE FFN — the ep-axis dryrun shape."""
+        return cls(vocab_size=vocab, dim=128, n_layers=2, n_heads=4,
+                   n_kv_heads=2, ffn_dim=256, max_seq_len=512, num_experts=4)
 
 
 # ---------------------------------------------------------------------- init
@@ -68,7 +83,6 @@ def init_llama(config: LlamaConfig, key: jax.Array) -> dict:
         return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dt)
 
     ka = jax.random.split(k_attn, 4 * L).reshape(L, 4, 2)
-    km = jax.random.split(k_mlp, 3 * L).reshape(L, 3, 2)
     layers = {
         "attn_norm": jnp.ones((L, d), jnp.float32),
         "wq": jnp.stack([norm_init(d, (d, config.n_heads * hd), ka[i, 0]) for i in range(L)]),
@@ -76,10 +90,17 @@ def init_llama(config: LlamaConfig, key: jax.Array) -> dict:
         "wv": jnp.stack([norm_init(d, (d, config.n_kv_heads * hd), ka[i, 2]) for i in range(L)]),
         "wo": jnp.stack([norm_init(config.n_heads * hd, (config.n_heads * hd, d), ka[i, 3]) for i in range(L)]),
         "mlp_norm": jnp.ones((L, d), jnp.float32),
-        "w_gate": jnp.stack([norm_init(d, (d, f), km[i, 0]) for i in range(L)]),
-        "w_up": jnp.stack([norm_init(d, (d, f), km[i, 1]) for i in range(L)]),
-        "w_down": jnp.stack([norm_init(f, (f, d), km[i, 2]) for i in range(L)]),
     }
+    if config.is_moe:
+        from .moe import init_moe_layer
+        layers.update(init_moe_layer(k_mlp, L, d, f, config.num_experts, dt))
+    else:
+        km = jax.random.split(k_mlp, 3 * L).reshape(L, 3, 2)
+        layers.update({
+            "w_gate": jnp.stack([norm_init(d, (d, f), km[i, 0]) for i in range(L)]),
+            "w_up": jnp.stack([norm_init(d, (d, f), km[i, 1]) for i in range(L)]),
+            "w_down": jnp.stack([norm_init(f, (f, d), km[i, 2]) for i in range(L)]),
+        })
     return {
         "embed": norm_init(1.0, (config.vocab_size, d), k_emb),
         "layers": layers,
@@ -130,30 +151,52 @@ def _attention_block(x, layer, config: LlamaConfig, attn_impl):
 
 
 def _mlp_block(x, layer, config: LlamaConfig):
+    """Dense or MoE FFN with residual; returns (y, aux) — aux is the MoE
+    load-balance loss, 0 for the dense path."""
     xn = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    if config.is_moe:
+        from .moe import moe_ffn
+        y, aux = moe_ffn(xn, layer, config.num_experts,
+                         config.experts_per_token,
+                         config.expert_capacity_factor)
+        return x + y, aux
     gate = jax.nn.silu((xn @ layer["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-    return x + (gate * (xn @ layer["w_up"])) @ layer["w_down"]
+    return x + (gate * (xn @ layer["w_up"])) @ layer["w_down"], jnp.float32(0)
+
+
+def transformer_layer(x, layer, config: LlamaConfig, attn_impl):
+    """One decoder layer: attention + (dense|MoE) FFN. Returns (y, aux)."""
+    y = _attention_block(x, layer, config, attn_impl)
+    return _mlp_block(y, layer, config)
 
 
 # ------------------------------------------------------------------ forward
 def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
-                  attn_impl=None, remat: bool = False) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+                  attn_impl=None, remat: bool = False,
+                  return_aux: bool = False):
+    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32); with
+    return_aux, -> (logits, aux) where aux is the mean per-layer MoE
+    load-balance loss (0 when dense)."""
     if attn_impl is None:
         attn_impl = partial(flash_attention, causal=True)
     x = params["embed"][tokens]
 
-    def layer_body(x, layer):
-        y = _attention_block(x, layer, config, attn_impl)
-        return _mlp_block(y, layer, config), None
+    def layer_body(carry, layer):
+        x, aux = carry
+        y, a = transformer_layer(x, layer, config, attn_impl)
+        return (y, aux + a), None
 
     if remat:
         # rematerialise each layer's activations in backward: trades FLOPs
         # for HBM, the standard long-context posture
         layer_body = jax.checkpoint(layer_body)
-    x, _ = jax.lax.scan(layer_body, x, params["layers"])
+    (x, aux), _ = jax.lax.scan(layer_body, (x, jnp.float32(0)),
+                               params["layers"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if return_aux:
+        return logits, aux / config.n_layers
+    return logits
 
 
 def llama_loss(params: dict, tokens: jax.Array, config: LlamaConfig,
@@ -164,9 +207,11 @@ def llama_loss(params: dict, tokens: jax.Array, config: LlamaConfig,
     to S-1) so the sequence axis keeps its static, sp-divisible length under
     sequence parallelism."""
     s = tokens.shape[1]
-    logits = llama_forward(params, tokens, config, attn_impl, remat)
+    logits, aux = llama_forward(params, tokens, config, attn_impl, remat,
+                                return_aux=True)
     targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = (jnp.arange(s) < s - 1).astype(nll.dtype)[None, :]
-    return jnp.sum(nll * mask) / (tokens.shape[0] * (s - 1))
+    ce = jnp.sum(nll * mask) / (tokens.shape[0] * (s - 1))
+    return ce + config.moe_aux_weight * aux

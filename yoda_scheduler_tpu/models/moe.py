@@ -1,0 +1,121 @@
+"""Mixture-of-Experts FFN with expert parallelism, TPU-first.
+
+The reference scheduler has no model code at all (SURVEY §2.3); this module
+exists because the workloads this framework schedules — and the `ep` mesh
+axis the topology scorer must understand — need a real expert-parallel
+program behind them.
+
+Design is the GSPMD/Mesh-TensorFlow scheme (GShard/Switch-style), not a
+gather/scatter port:
+
+- top-k gating with a fixed per-expert **capacity**: every tensor keeps a
+  static shape, so the whole thing jits once and tiles onto the MXU;
+  overflow tokens are dropped (residual path carries them) exactly like
+  GShard.
+- dispatch/combine are one-hot **einsums** ([B,S,E,C] against [B,S,d]),
+  which XLA turns into the all-to-all pair when the expert axis of the
+  weights is sharded over `ep` — no hand-written collectives.
+- expert weights are stacked [L, E, d, f] and sharded
+  P(None, "ep", "fsdp", "tp") (parallel/sharding.py), so each ep group
+  holds E/ep experts and tp still splits each expert's matmuls.
+- load-balance auxiliary loss (Switch §2.2 form): E * Σ_e f_e · p_e,
+  differentiable through the router only.
+
+Capacity C = ceil(k · S · capacity_factor / E), rounded up to a multiple
+of 8 to keep the C axis friendly to VPU lanes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(seq_len: int, num_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    cap = int(seq_len * k * capacity_factor / num_experts) + 1
+    return max(8, (cap + 7) // 8 * 8)
+
+
+def init_moe_layer(key, num_layers: int, dim: int, ffn_dim: int,
+                   num_experts: int, dtype) -> dict:
+    """Stacked-per-layer MoE FFN params: router [L,d,E] (fp32 — routing is
+    numerically sensitive) + expert mats [L,E,d,f]/[L,E,f,d]."""
+    L, E, d, f = num_layers, num_experts, dim, ffn_dim
+    kr, kg, ku, kd = jax.random.split(key, 4)
+
+    def init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / jnp.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "router": jax.random.normal(kr, (L, d, E), jnp.float32) * 0.02,
+        "we_gate": init(kg, (L, E, d, f), d),
+        "we_up": init(ku, (L, E, d, f), d),
+        "we_down": init(kd, (L, E, f, d), f),
+    }
+
+
+def _top_k_dispatch(router_logits, num_experts: int, k: int, capacity: int):
+    """router_logits [B,S,E] fp32 -> (combine [B,S,E,C], dispatch bool mask,
+    aux_loss scalar).
+
+    Tokens are ranked into each expert's queue slot-major (all 1st choices
+    before any 2nd choices, GShard's policy), positions past `capacity`
+    drop.
+    """
+    b, s, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E] fp32
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)           # [B,S,k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [B,S,k,E]
+
+    # queue position per (slot, token): slot-major ordering
+    slot_major = onehot.transpose(0, 2, 1, 3).reshape(b, k * s, e)
+    pos = jnp.cumsum(slot_major, axis=1) - slot_major        # [B,k*S,E]
+    keep = (pos < capacity) * slot_major
+    slots = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                           dtype=jnp.float32) * keep[..., None]  # [B,k*S,E,C]
+    slots = slots.reshape(b, k, s, e, capacity).transpose(0, 2, 1, 3, 4)
+
+    # combine: gate weight routed into the (expert, slot) cell; k collapses
+    combine = jnp.einsum("bsk,bskec->bsec", gate_vals, slots)
+    dispatch = combine > 0.0
+
+    # Switch load-balance loss: E * Σ_e (token fraction)·(mean router prob);
+    # fraction uses first-choice assignment only (standard form)
+    frac = jnp.mean(onehot[:, :, 0, :], axis=(0, 1))         # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))                 # [E]
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return combine, dispatch, aux
+
+
+def moe_ffn(x, layer: dict, num_experts: int, k: int,
+            capacity_factor: float):
+    """x [B,S,d] -> (y [B,S,d], aux scalar). `layer` holds this layer's
+    router/we_* slices (no leading L axis). SwiGLU experts, bf16 matmuls
+    with fp32 accumulation like the dense path."""
+    b, s, d = x.shape
+    cap = expert_capacity(s, num_experts, k, capacity_factor)
+
+    router_logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), layer["router"])
+    combine, dispatch, aux = _top_k_dispatch(router_logits, num_experts, k, cap)
+
+    # dispatch: [B,S,E,C] x [B,S,d] -> [E,B,C,d]; with we_* sharded over ep
+    # this is where GSPMD inserts the forward all-to-all
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x)
+    gate = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["we_gate"],
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["we_up"],
+                    preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, layer["we_down"])
+
+    # combine: the return all-to-all; fp32 weighted sum of expert outputs
+    y = jnp.einsum("bsec,ebcd->bsd", combine.astype(jnp.float32),
+                   expert_out.astype(jnp.float32))
+    return y.astype(x.dtype), aux

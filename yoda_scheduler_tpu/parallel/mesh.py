@@ -18,27 +18,32 @@ import numpy as np
 from jax.sharding import Mesh
 
 # outer-to-inner order: tp innermost (all-reduce every layer) rides the
-# fastest ICI neighbourhoods; dp outermost tolerates DCN between hosts
-AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+# fastest ICI neighbourhoods; ep's all-to-all pair next; pp outermost —
+# stage-boundary transfers are the rarest and tolerate DCN between hosts,
+# with dp just inside it
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 def mesh_shape_for(n_devices: int, tp: int = 1, sp: int = 1, fsdp: int | None = None,
-                   dp: int | None = None) -> dict[str, int]:
+                   dp: int | None = None, pp: int = 1, ep: int = 1) -> dict[str, int]:
     """Fill in unspecified axes to cover n_devices: fsdp absorbs what dp
     doesn't claim."""
-    rest = n_devices // (tp * sp)
-    if rest * tp * sp != n_devices:
-        raise ValueError(f"tp*sp={tp * sp} does not divide {n_devices} devices")
+    fixed = tp * sp * pp * ep
+    rest = n_devices // fixed
+    if rest * fixed != n_devices:
+        raise ValueError(
+            f"pp*ep*sp*tp={fixed} does not divide {n_devices} devices")
     if dp is None and fsdp is None:
         dp, fsdp = 1, rest
     elif dp is None:
         dp = rest // fsdp
     elif fsdp is None:
         fsdp = rest // dp
-    if dp * fsdp * tp * sp != n_devices:
+    if dp * fsdp * fixed != n_devices:
         raise ValueError(
-            f"dp*fsdp*sp*tp = {dp}*{fsdp}*{sp}*{tp} != {n_devices} devices")
-    return {"dp": dp, "fsdp": fsdp, "sp": sp, "tp": tp}
+            f"pp*dp*fsdp*ep*sp*tp = {pp}*{dp}*{fsdp}*{ep}*{sp}*{tp}"
+            f" != {n_devices} devices")
+    return {"pp": pp, "dp": dp, "fsdp": fsdp, "ep": ep, "sp": sp, "tp": tp}
 
 
 def make_mesh(shape: dict[str, int] | None = None, devices=None, **axes) -> Mesh:

@@ -16,8 +16,23 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-def llama_param_specs() -> dict:
-    """PartitionSpec pytree matching init_llama's params structure."""
+def llama_param_specs(config=None) -> dict:
+    """PartitionSpec pytree matching init_llama's params structure. With a
+    MoE config, the FFN entries switch to expert-stacked mats whose expert
+    axis shards over `ep` (tp still splits within each expert)."""
+    if config is not None and getattr(config, "is_moe", False):
+        ffn = {
+            "router": P(None, "fsdp", None),      # [L, d, E]
+            "we_gate": P(None, "ep", "fsdp", "tp"),   # [L, E, d, f]
+            "we_up": P(None, "ep", "fsdp", "tp"),
+            "we_down": P(None, "ep", "tp", "fsdp"),   # [L, E, f, d]
+        }
+    else:
+        ffn = {
+            "w_gate": P(None, "fsdp", "tp"),  # [L, d, f]
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),  # [L, f, d]
+        }
     return {
         "embed": P(None, "fsdp"),             # [vocab, d]
         "layers": {
@@ -27,9 +42,7 @@ def llama_param_specs() -> dict:
             "wv": P(None, "fsdp", "tp"),
             "wo": P(None, "tp", "fsdp"),      # [L, h*hd, d]   row-parallel
             "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),  # [L, d, f]
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),  # [L, f, d]
+            **ffn,
         },
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),           # [d, vocab]
@@ -37,14 +50,16 @@ def llama_param_specs() -> dict:
 
 
 def batch_spec(sp: bool = False) -> P:
-    """tokens [B, S]: batch over dp+fsdp; seq over sp when sequence
-    parallelism is on."""
-    return P(("dp", "fsdp"), "sp" if sp else None)
+    """tokens [B, S]: batch over dp+fsdp+ep (tokens shard over the expert
+    axis too, so non-expert compute is never replicated across ep groups —
+    the dispatch all-to-all is ep's only communication); seq over sp when
+    sequence parallelism is on."""
+    return P(("dp", "fsdp", "ep"), "sp" if sp else None)
 
 
-def llama_shardings(mesh) -> dict:
+def llama_shardings(mesh, config=None) -> dict:
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        llama_param_specs(),
+        llama_param_specs(config),
         is_leaf=lambda x: isinstance(x, P),
     )

@@ -37,7 +37,7 @@ def build_llama_train_step(
         use_ring_attention = sp > 1
     attn_impl = make_ring_attn(mesh) if use_ring_attention else None
 
-    param_sh = llama_shardings(mesh)
+    param_sh = llama_shardings(mesh, config)
     batch_sh = NamedSharding(mesh, batch_spec(sp=sp > 1))
     tx = optax.adamw(learning_rate)
 
