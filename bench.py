@@ -137,8 +137,17 @@ def main():
                        cwd=__import__("os").path.dirname(__file__) or ".")
     except Exception:
         pass
-    ours = run_burst("yoda-tpu")
-    ref = run_burst("reference")
+    # warm both paths once (imports, dict/bytecode caches) so neither profile
+    # pays process cold-start, then take the median of 3 measured runs each —
+    # p50 latency compounds queue wait, so single runs are noisy
+    run_burst("yoda-tpu")
+    run_burst("reference")
+    ours_runs = sorted((run_burst("yoda-tpu") for _ in range(3)),
+                       key=lambda r: r["p50_ms"])
+    ref_runs = sorted((run_burst("reference") for _ in range(3)),
+                      key=lambda r: r["p50_ms"])
+    ours = ours_runs[1]
+    ref = ref_runs[1]
     vs_baseline = (ref["p50_ms"] / ours["p50_ms"]) if ours["p50_ms"] > 0 else 1.0
     print(json.dumps({
         "metric": "pod_schedule_p50_latency_ms",

@@ -57,7 +57,7 @@ from .plugins import (
     TelemetryScore,
     TopologyScore,
 )
-from ..utils.labels import LabelError, WorkloadSpec
+from ..utils.labels import LabelError, spec_for
 from ..utils.obs import CycleTrace, Metrics, TraceLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
@@ -240,7 +240,7 @@ class Scheduler:
         state.write("now", now)
 
         try:
-            spec = WorkloadSpec.from_labels(pod.labels)
+            spec = spec_for(pod)
         except LabelError as e:
             # malformed request: permanent failure, not silent 0-coercion
             pod.phase = PodPhase.FAILED
@@ -431,7 +431,7 @@ class Scheduler:
             return
         state = CycleState()
         try:
-            state.write("workload_spec", WorkloadSpec.from_labels(w.info.pod.labels))
+            state.write("workload_spec", spec_for(w.info.pod))
         except LabelError:
             pass
         for p in reversed(self.profile.reserve):
@@ -477,7 +477,7 @@ class Scheduler:
             m = self.cluster.telemetry.get(name)
             if m is None or m.accelerator != "tpu":
                 continue
-            healthy = {c.coords for c in m.healthy_chips()}
+            healthy = m.healthy_coords()
             total += len(healthy)
             ni = NodeInfo(name=name, metrics=m, pods=self.cluster.pods_on(name))
             used += len(ni.assigned_coords() & healthy)

@@ -116,38 +116,49 @@ class NodeInfo:
     name: str
     metrics: TpuNodeMetrics | None
     pods: list[Pod] = field(default_factory=list)
+    # per-instance memos — NodeInfo objects are rebuilt each scheduling cycle,
+    # so these cache only within one cycle's coherent view
+    _claimed_chips: int | None = field(default=None, repr=False, compare=False)
+    _claimed_hbm: int | None = field(default=None, repr=False, compare=False)
+    _assigned: set | None = field(default=None, repr=False, compare=False)
 
     def claimed_chips(self) -> int:
         """Chips already claimed by bound pods' labels (allocation view)."""
-        from ..utils.labels import WorkloadSpec, LabelError
+        if self._claimed_chips is None:
+            from ..utils.labels import LabelError, spec_for
 
-        total = 0
-        for p in self.pods:
-            try:
-                total += WorkloadSpec.from_labels(p.labels).chips
-            except LabelError:
-                continue  # malformed bound pod: it never passed our filter
-        return total
+            total = 0
+            for p in self.pods:
+                try:
+                    total += spec_for(p).chips
+                except LabelError:
+                    continue  # malformed bound pod: it never passed our filter
+            self._claimed_chips = total
+        return self._claimed_chips
 
     def claimed_hbm_mb(self) -> int:
         """HBM claimed by bound pods (per-chip request × chips), label view."""
-        from ..utils.labels import WorkloadSpec, LabelError
+        if self._claimed_hbm is None:
+            from ..utils.labels import LabelError, spec_for
 
-        total = 0
-        for p in self.pods:
-            try:
-                spec = WorkloadSpec.from_labels(p.labels)
-            except LabelError:
-                continue
-            total += spec.min_free_mb * spec.chips
-        return total
+            total = 0
+            for p in self.pods:
+                try:
+                    spec = spec_for(p)
+                except LabelError:
+                    continue
+                total += spec.min_free_mb * spec.chips
+            self._claimed_hbm = total
+        return self._claimed_hbm
 
     def assigned_coords(self) -> set[tuple[int, int, int]]:
         """ICI coords claimed by bound pods (from bind-time chip assignment)."""
-        out: set[tuple[int, int, int]] = set()
-        for p in self.pods:
-            out |= p.assigned_chips()
-        return out
+        if self._assigned is None:
+            out: set[tuple[int, int, int]] = set()
+            for p in self.pods:
+                out |= p.assigned_chips()
+            self._assigned = out
+        return self._assigned
 
 
 class Snapshot:

@@ -49,15 +49,15 @@ class ChipAllocator(ReservePlugin):
         m = node_info.metrics
         if m is None:
             return set()
-        healthy = {c.coords for c in m.healthy_chips()}
-        return healthy - node_info.assigned_coords() - self.pending_on(node_info.name)
+        return m.healthy_coords() - node_info.assigned_coords() - self.pending_on(node_info.name)
 
     def assignment_of(self, pod: Pod) -> tuple[str, list[Coord]] | None:
         with self._lock:
             return self._pending.get(pod.key)
 
     # ------------------------------------------------------------ placement
-    def pick_chips(self, spec: WorkloadSpec, node_info: NodeInfo) -> list[Coord] | None:
+    def pick_chips(self, spec: WorkloadSpec, node_info: NodeInfo,
+                   state: CycleState | None = None) -> list[Coord] | None:
         """Choose concrete chips for the spec on this node, best-fit
         contiguous. Falls back to any qualifying chips when the node's free
         space has no contiguous block (still schedulable, just lower quality —
@@ -65,7 +65,7 @@ class ChipAllocator(ReservePlugin):
         m = node_info.metrics
         if m is None:
             return None
-        free = self.free_coords(node_info)
+        free = self.free_coords(node_info, state)
         qualifying = {
             c.coords
             for c in m.healthy_chips()
@@ -92,7 +92,10 @@ class ChipAllocator(ReservePlugin):
         spec = state.read_or("workload_spec")
         if node_info is None or spec is None:
             return Status.error("allocator: cycle state missing node_info/spec")
-        coords = self.pick_chips(spec, node_info)
+        # the cycle-state free_coords memo is still coherent here: one pod per
+        # cycle, and this is the first Reserve plugin, so nothing reserved
+        # since Filter computed it
+        coords = self.pick_chips(spec, node_info, state)
         if coords is None:
             return Status.unschedulable(f"{node}: chips vanished before reserve")
         with self._lock:

@@ -18,7 +18,7 @@ from __future__ import annotations
 import threading
 
 from ..framework import CycleState, PermitPlugin, ReservePlugin, Status
-from ...utils.labels import WorkloadSpec
+from ...utils.labels import WorkloadSpec, spec_for
 from ...utils.pod import Pod
 
 
@@ -91,7 +91,7 @@ class GangPermit(PermitPlugin, ReservePlugin):
     def peers_to_approve(self, pod: Pod) -> set[str]:
         """After `pod`'s Permit succeeded, which waiting pods bind with it."""
         try:
-            spec = WorkloadSpec.from_labels(pod.labels)
+            spec = spec_for(pod)
         except Exception:
             return set()
         if not spec.is_gang:
@@ -102,7 +102,7 @@ class GangPermit(PermitPlugin, ReservePlugin):
 
     def gang_of(self, pod: Pod) -> str | None:
         try:
-            spec = WorkloadSpec.from_labels(pod.labels)
+            spec = spec_for(pod)
         except Exception:
             return None
         return spec.gang_name

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from ..config import SchedulerConfig
 from ..framework import CycleState, FilterPlugin, NodeInfo, ScorePlugin, Status, min_max_normalize
-from ...utils.labels import WorkloadSpec
+from ...utils.labels import WorkloadSpec, spec_for
 from .prescore import MAX_KEY, SPEC_KEY, MaxValue
 
 
@@ -110,7 +110,7 @@ class RefScore(ScorePlugin):
         claimed = 0
         for p in node.pods:
             try:
-                claimed += WorkloadSpec.from_labels(p.labels).min_free_mb
+                claimed += spec_for(p).min_free_mb
             except Exception:
                 pass
         total = m.hbm_total_sum
@@ -139,7 +139,7 @@ class TelemetryDecrementingCluster:
         if m is None:
             return
         try:
-            spec = WorkloadSpec.from_labels(pod.labels)
+            spec = spec_for(pod)
         except Exception:
             return
         need = spec.chips

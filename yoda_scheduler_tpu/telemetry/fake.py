@@ -10,6 +10,7 @@ chips, missing telemetry) to test the failure-detection path.
 
 from __future__ import annotations
 
+import copy
 import random
 import threading
 import time
@@ -154,6 +155,10 @@ class FakePublisher:
         m = self.store.get(node)
         if m is None:
             raise KeyError(node)
+        # publish a mutated COPY: the store-held object may be mid-read by the
+        # scheduler thread, and its aggregate memos key on generation — an
+        # in-place edit would be a torn read pinned until the next publish
+        m = copy.deepcopy(m)
         m.chips[chip_index].health = health
         self.publish(m)
 
@@ -168,6 +173,9 @@ class FakePublisher:
                 for m in self.store.list():
                     if m.node in self._frozen:
                         continue
+                    # snapshot semantics (a real sniffer builds a fresh reading
+                    # each poll): never mutate the store-held object in place
+                    m = copy.deepcopy(m)
                     if jitter_hbm_mb:
                         for c in m.chips:
                             delta = self.rng.randint(-jitter_hbm_mb, jitter_hbm_mb)

@@ -31,6 +31,8 @@ import time
 from dataclasses import dataclass, field, asdict
 from typing import Iterable
 
+from ..utils.memo import memo
+
 HEALTHY = "Healthy"
 TPU = "tpu"
 GPU = "gpu"
@@ -85,16 +87,40 @@ class TpuNodeMetrics:
     def chip_count(self) -> int:
         return len(self.chips)
 
+    def _aggregates(self) -> tuple[int, int, list[Chip]]:
+        """Aggregate memo keyed by `generation`: every publisher path bumps it
+        via TelemetryStore.put, and the scheduler reads these on every
+        (pod, node) hot-path visit. Do not mutate `chips` without re-putting."""
+        def compute() -> tuple[int, int, list[Chip]]:
+            free = total = 0
+            healthy: list[Chip] = []
+            for c in self.chips:
+                free += c.hbm_free_mb
+                total += c.hbm_total_mb
+                if c.health == HEALTHY:
+                    healthy.append(c)
+            return free, total, healthy
+
+        return memo(self, "_agg_memo", self.generation, compute)
+
     @property
     def hbm_free_sum(self) -> int:
-        return sum(c.hbm_free_mb for c in self.chips)
+        return self._aggregates()[0]
 
     @property
     def hbm_total_sum(self) -> int:
-        return sum(c.hbm_total_mb for c in self.chips)
+        return self._aggregates()[1]
 
     def healthy_chips(self) -> list[Chip]:
-        return [c for c in self.chips if c.healthy]
+        """Healthy chips (shared memoised list — treat as read-only)."""
+        return self._aggregates()[2]
+
+    def healthy_coords(self) -> frozenset[tuple[int, int, int]]:
+        """ICI coords of healthy chips (memoised like the other aggregates)."""
+        return memo(
+            self, "_coords_memo", self.generation,
+            lambda: frozenset(c.coords for c in self._aggregates()[2]),
+        )
 
     def stale(self, now: float | None = None, max_age_s: float = 60.0) -> bool:
         """Staleness gate — the reference has no heartbeat concept; a dead

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from .memo import memo
+
 MEMORY_LABEL = "scv/memory"       # min free HBM per chip, MB
 NUMBER_LABEL = "scv/number"       # chips requested on the node
 CLOCK_LABEL = "scv/clock"         # min chip clock, MHz (>= semantics, see below)
@@ -122,3 +124,23 @@ class WorkloadSpec:
     @property
     def is_gang(self) -> bool:
         return self.gang_name is not None
+
+
+_SPEC_LABELS = (
+    NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
+    ACCELERATOR_LABEL, TOPOLOGY_LABEL, GANG_NAME_LABEL, GANG_SIZE_LABEL,
+)
+
+
+def spec_for(pod) -> WorkloadSpec:
+    """Parse-once spec cache for a pod-like object (anything with ``labels``).
+
+    Keyed by the values of the labels the spec reads, so in-place label
+    mutation (bind-time chip assignment, eviction cleanup) can never serve a
+    stale spec. The scheduler walks every bound pod's spec on every cycle
+    (allocation accounting), so parse cost is hot-path cost. Raises LabelError
+    exactly like ``WorkloadSpec.from_labels``; errors are not cached (a
+    malformed pod fails its cycle permanently anyway)."""
+    labels = pod.labels
+    key = tuple(labels.get(k) for k in _SPEC_LABELS)
+    return memo(pod, "_spec_cache", key, lambda: WorkloadSpec.from_labels(labels))

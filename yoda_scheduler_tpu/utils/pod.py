@@ -9,6 +9,8 @@ import time
 from dataclasses import dataclass, field
 from enum import Enum
 
+from .memo import memo
+
 
 class PodPhase(str, Enum):
     PENDING = "Pending"
@@ -46,13 +48,20 @@ class Pod:
         return f"{self.namespace}/{self.name}"
 
     def assigned_chips(self) -> set[tuple[int, int, int]]:
-        """ICI coords assigned to this pod at bind time (empty if unbound)."""
-        out: set[tuple[int, int, int]] = set()
-        for part in self.labels.get(ASSIGNED_CHIPS_LABEL, "").split(";"):
-            if part:
-                x, y, z = part.split(",")
-                out.add((int(x), int(y), int(z)))
-        return out
+        """ICI coords assigned to this pod at bind time (empty if unbound).
+        Parsed once per label value — every scheduling cycle asks for every
+        bound pod's coords (allocation accounting), so this is hot-path."""
+        raw = self.labels.get(ASSIGNED_CHIPS_LABEL, "")
+
+        def parse() -> set[tuple[int, int, int]]:
+            out: set[tuple[int, int, int]] = set()
+            for part in raw.split(";"):
+                if part:
+                    x, y, z = part.split(",")
+                    out.add((int(x), int(y), int(z)))
+            return out
+
+        return memo(self, "_chips_cache", raw, parse)
 
     @classmethod
     def from_manifest(cls, manifest: dict) -> "Pod":
