@@ -1,0 +1,152 @@
+"""TPU generation catalog + generation-aware telemetry and scheduling.
+
+The reference models interchangeable GPU cards only; generations.py adds the
+TPU fleet reality (3-D vs 2-D tori, per-generation host packaging and HBM).
+"""
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_slice, make_tpu_node
+from yoda_scheduler_tpu.topology import GENERATIONS, generation
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.labels import LabelError, WorkloadSpec
+
+
+def test_catalog_structure():
+    assert set(GENERATIONS) == {"v2", "v3", "v4", "v5e", "v5p", "v6e"}
+    for g in GENERATIONS.values():
+        assert g.hbm_mb > 0 and g.chips_per_host in (4, 8)
+        assert g.torus_rank in (2, 3)
+    # 2-D generations pack 8-chip hosts; 3-D pack 4-chip boards
+    assert generation("v5e").host_block == (2, 4, 1)
+    assert generation("v4").host_block == (2, 2, 1)
+    with pytest.raises(ValueError, match="unknown TPU generation"):
+        generation("v99")
+
+
+def test_validate_slice_topology():
+    # v4-32: 2x2x4 over 4 hosts — fine
+    assert generation("v4").validate_slice_topology("2x2x4") == (2, 2, 4)
+    # 2-D generation rejects a cube
+    with pytest.raises(ValueError, match="2-D"):
+        generation("v5e").validate_slice_topology("4x4x4")
+    # v5e-64: 8x8 over 8 hosts — fine
+    assert generation("v5e").validate_slice_topology("8x8") == (8, 8, 1)
+    # not divisible into host blocks
+    with pytest.raises(ValueError, match="not divisible"):
+        generation("v5e").validate_slice_topology("6x6")
+    # over pod size
+    with pytest.raises(ValueError, match="max out"):
+        generation("v6e").validate_slice_topology("32x16")
+
+
+def test_make_slice_v5e_2d():
+    nodes = make_slice("s0", "8x8", generation="v5e")
+    assert len(nodes) == 8  # 64 chips / 8 per host
+    coords = {c.coords for n in nodes for c in n.chips}
+    assert len(coords) == 64
+    assert all(z == 0 for _, _, z in coords)  # flat torus
+    n0 = nodes[0]
+    assert n0.tpu_generation == "v5e"
+    assert n0.topology == "2x4x1"
+    assert n0.chips[0].hbm_total_mb == generation("v5e").hbm_mb
+
+
+def test_make_tpu_node_generation_defaults():
+    n = make_tpu_node("a", chips=4, generation="v5p")
+    assert n.tpu_generation == "v5p"
+    assert n.chips[0].hbm_total_mb == generation("v5p").hbm_mb
+    # explicit override still wins
+    n2 = make_tpu_node("b", chips=4, generation="v5p", hbm_total_mb=1234)
+    assert n2.chips[0].hbm_total_mb == 1234
+
+
+def test_generation_label_parsing():
+    spec = WorkloadSpec.from_labels({"tpu/generation": "v6e"})
+    assert spec.tpu_generation == "v6e"
+    with pytest.raises(LabelError, match="tpu/generation"):
+        WorkloadSpec.from_labels({"tpu/generation": "volta"})
+
+
+def _sched(nodes):
+    store = TelemetryStore()
+    for n in nodes:
+        n.heartbeat = 0.0
+        store.put(n)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return Scheduler(cluster, SchedulerConfig(max_attempts=3, telemetry_max_age_s=1e9),
+                     clock=FakeClock())
+
+
+def test_generation_routing_heterogeneous_fleet():
+    """A pod pinning v5e must never land on the v4 node, and vice versa."""
+    sched = _sched([make_tpu_node("v4-node", chips=4, generation="v4"),
+                    make_tpu_node("v5e-node", chips=8, generation="v5e")])
+    p_v5e = Pod("want5e", labels={"tpu/generation": "v5e", "scv/number": "2"})
+    p_v4 = Pod("want4", labels={"tpu/generation": "v4", "scv/number": "2"})
+    any_gen = Pod("any", labels={"scv/number": "1"})
+    for p in (p_v5e, p_v4, any_gen):
+        sched.submit(p)
+    sched.run_until_idle()
+    assert p_v5e.phase == PodPhase.BOUND and p_v5e.node == "v5e-node"
+    assert p_v4.phase == PodPhase.BOUND and p_v4.node == "v4-node"
+    assert any_gen.phase == PodPhase.BOUND
+
+
+def test_generation_unsatisfiable_fails():
+    sched = _sched([make_tpu_node("v4-node", chips=4, generation="v4")])
+    p = Pod("want6e", labels={"tpu/generation": "v6e"})
+    sched.submit(p)
+    sched.run_until_idle()
+    assert p.phase == PodPhase.FAILED
+
+
+def test_device_kind_mapping():
+    """The real-cluster sniffer must label nodes with a catalog generation."""
+    from yoda_scheduler_tpu.telemetry.sniffer import generation_of
+
+    assert generation_of("TPU v4") == "v4"
+    assert generation_of("TPU v2") == "v2"
+    assert generation_of("TPU v5 lite") == "v5e"
+    assert generation_of("TPU v5e") == "v5e"
+    assert generation_of("TPU v5") == "v5p"
+    assert generation_of("TPU v5p") == "v5p"
+    assert generation_of("TPU v6 lite") == "v6e"
+    assert generation_of("TPU v6e") == "v6e"
+    assert generation_of("Tesla V100") == ""
+    assert generation_of("") == ""
+
+
+def test_crd_enum_matches_catalog():
+    """deploy/crd-tpunodemetrics.yaml's tpu_generation enum must track the
+    GENERATIONS catalog, or new-generation CRs get rejected by the apiserver."""
+    import os
+    import re
+
+    crd_path = os.path.join(os.path.dirname(__file__), "..", "deploy",
+                            "crd-tpunodemetrics.yaml")
+    with open(crd_path) as f:
+        src = f.read()
+    m = re.search(r"tpu_generation:\s*\n\s*type: string\s*\n\s*enum: \[(.*?)\]", src)
+    assert m, "tpu_generation enum missing from CRD"
+    enum = {v.strip().strip('"') for v in m.group(1).split(",")}
+    assert enum == set(GENERATIONS) | {""}
+
+
+def test_topology_request_on_2d_slice():
+    """tpu/topology packing works on a flat (v5e) torus."""
+    sched = _sched(make_slice("s0", "4x4", generation="v5e"))
+    p = Pod("flat", labels={"scv/number": "4", "tpu/topology": "2x2",
+                            "tpu/generation": "v5e"})
+    sched.submit(p)
+    sched.run_until_idle()
+    assert p.phase == PodPhase.BOUND
+    chips = p.assigned_chips()
+    xs = sorted(c[0] for c in chips)
+    ys = sorted(c[1] for c in chips)
+    assert len(chips) == 4
+    assert xs[-1] - xs[0] == 1 and ys[-1] - ys[0] == 1  # contiguous 2x2
+    assert all(c[2] == 0 for c in chips)

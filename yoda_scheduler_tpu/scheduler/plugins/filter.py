@@ -52,6 +52,11 @@ class TelemetryFilter(FilterPlugin):
             return Status.unschedulable(
                 f"{node.name}: accelerator {m.accelerator} != requested {spec.accelerator}"
             )
+        if spec.tpu_generation is not None and m.tpu_generation != spec.tpu_generation:
+            return Status.unschedulable(
+                f"{node.name}: generation {m.tpu_generation or 'unset'}"
+                f" != requested {spec.tpu_generation}"
+            )
 
         # gang constraints: whole gang must fit one slice; follow the chosen slice
         if spec.is_gang:

@@ -17,9 +17,11 @@ import time
 
 from .schema import Chip, TpuNodeMetrics, GPU, TPU, HEALTHY
 from .store import TelemetryStore
-from ..topology.torus import parse_topology, host_blocks
+from ..topology.generations import generation as tpu_generation
+from ..topology.torus import host_blocks
 
-# v4 chip defaults (HBM 32 GB per chip, 940 MHz TensorCore clock).
+# v4 chip defaults, kept as module constants for existing callers
+# (canonical per-generation numbers live in topology/generations.py).
 V4_HBM_MB = 32_768
 V4_CLOCK_MHZ = 940
 V4_ICI_GBPS = 100
@@ -30,28 +32,35 @@ V4_POWER_W = 170
 def make_tpu_node(
     name: str,
     chips: int = 4,
-    hbm_free_mb: int = V4_HBM_MB,
-    hbm_total_mb: int = V4_HBM_MB,
-    clock_mhz: int = V4_CLOCK_MHZ,
+    hbm_free_mb: int | None = None,
+    hbm_total_mb: int | None = None,
+    clock_mhz: int | None = None,
     unhealthy: int = 0,
+    generation: str = "v4",
     **kw,
 ) -> TpuNodeMetrics:
-    """A standalone single-host TPU node (e.g. one v4-8 host: 4 chips)."""
+    """A standalone single-host TPU node (e.g. one v4-8 host: 4 chips).
+    Chip attributes default to the generation's catalog entry; explicit
+    keyword values override per-field."""
+    gen = tpu_generation(generation)
+    total = hbm_total_mb if hbm_total_mb is not None else gen.hbm_mb
+    free = hbm_free_mb if hbm_free_mb is not None else total
     chip_list = [
         Chip(
             index=i,
-            hbm_free_mb=hbm_free_mb,
-            hbm_total_mb=hbm_total_mb,
-            clock_mhz=clock_mhz,
-            ici_bandwidth_gbps=V4_ICI_GBPS,
-            core_count=V4_MXUS,
-            power_w=V4_POWER_W,
+            hbm_free_mb=free,
+            hbm_total_mb=total,
+            clock_mhz=clock_mhz if clock_mhz is not None else gen.clock_mhz,
+            ici_bandwidth_gbps=gen.ici_gbps,
+            core_count=gen.mxus,
+            power_w=gen.power_w,
             coords=(i % 2, i // 2, 0),
             health=("Unhealthy" if i < unhealthy else HEALTHY),
         )
         for i in range(chips)
     ]
-    return TpuNodeMetrics(node=name, chips=chip_list, accelerator=TPU, **kw)
+    return TpuNodeMetrics(node=name, chips=chip_list, accelerator=TPU,
+                          tpu_generation=gen.name, **kw)
 
 
 def make_gpu_node(
@@ -80,33 +89,37 @@ def make_gpu_node(
     return TpuNodeMetrics(node=name, chips=chip_list, accelerator=GPU, **kw)
 
 
-def make_v4_slice(
+def make_slice(
     slice_id: str,
-    slice_topology: str = "2x2x4",
+    slice_topology: str,
+    generation: str = "v4",
     node_prefix: str | None = None,
-    hbm_free_mb: int = V4_HBM_MB,
+    hbm_free_mb: int | None = None,
 ) -> list[TpuNodeMetrics]:
-    """A multi-host v4 pod slice: hosts of 4 chips each with real ICI coords.
+    """A multi-host pod slice of any generation: one node per host, chips
+    carrying real ICI coordinates that tile the slice torus.
 
-    v4 packaging: 4 chips per host board in a 2x2x1 block; a v4-32 slice is
-    topology 2x2x4 = 16 chips = 4 hosts. Chip coordinates cover the full
-    torus, partitioned into per-host 2x2x1 blocks — exactly the structure the
-    topology scorer and gang scheduler reason about.
+    Packaging follows the generation catalog: v4/v5p hosts contribute a
+    2x2x1 block of 4 chips to a 3-D torus (a v4-32 slice is 2x2x4 = 16 chips
+    over 4 hosts); v5e/v6e hosts contribute a 2x4 block of 8 chips to a 2-D
+    torus (a v5e-256 slice is 16x16 over 32 hosts). The topology string is
+    validated against what the generation can form.
     """
-    shape = parse_topology(slice_topology)
+    gen = tpu_generation(generation)
+    shape = gen.validate_slice_topology(slice_topology)
     prefix = node_prefix or slice_id
     nodes: list[TpuNodeMetrics] = []
-    blocks = host_blocks(shape)
+    blocks = host_blocks(shape, gen.host_block)
     for host_index, coords_block in enumerate(blocks):
         chips = [
             Chip(
                 index=i,
-                hbm_free_mb=hbm_free_mb,
-                hbm_total_mb=V4_HBM_MB,
-                clock_mhz=V4_CLOCK_MHZ,
-                ici_bandwidth_gbps=V4_ICI_GBPS,
-                core_count=V4_MXUS,
-                power_w=V4_POWER_W,
+                hbm_free_mb=hbm_free_mb if hbm_free_mb is not None else gen.hbm_mb,
+                hbm_total_mb=gen.hbm_mb,
+                clock_mhz=gen.clock_mhz,
+                ici_bandwidth_gbps=gen.ici_gbps,
+                core_count=gen.mxus,
+                power_w=gen.power_w,
                 coords=coords,
             )
             for i, coords in enumerate(coords_block)
@@ -116,14 +129,26 @@ def make_v4_slice(
                 node=f"{prefix}-host-{host_index}",
                 chips=chips,
                 accelerator=TPU,
+                tpu_generation=gen.name,
                 slice_id=slice_id,
-                topology="2x2x1",
+                topology="x".join(str(d) for d in gen.host_block),
                 slice_topology=slice_topology,
                 host_index=host_index,
                 num_hosts=len(blocks),
             )
         )
     return nodes
+
+
+def make_v4_slice(
+    slice_id: str,
+    slice_topology: str = "2x2x4",
+    node_prefix: str | None = None,
+    hbm_free_mb: int = V4_HBM_MB,
+) -> list[TpuNodeMetrics]:
+    """A multi-host v4 pod slice (kept for existing callers; see make_slice)."""
+    return make_slice(slice_id, slice_topology, generation="v4",
+                      node_prefix=node_prefix, hbm_free_mb=hbm_free_mb)
 
 
 class FakePublisher:

@@ -73,6 +73,7 @@ class TpuNodeMetrics:
     node: str
     chips: list[Chip] = field(default_factory=list)
     accelerator: str = TPU         # "tpu" | "gpu" — mixed-cluster partitioning
+    tpu_generation: str = ""       # "v4", "v5e", ... ("" = unspecified)
     slice_id: str = ""             # "" = standalone node (no multi-host slice)
     topology: str = ""             # e.g. "2x2x1" (chips this host contributes)
     slice_topology: str = ""       # e.g. "2x2x4" (whole pod slice)

@@ -27,6 +27,27 @@ def _mb(nbytes: int | None) -> int:
     return int((nbytes or 0) // (1024 * 1024))
 
 
+def generation_of(device_kind: str) -> str:
+    """Map a JAX ``device_kind`` string to a catalog generation name.
+
+    Observed kinds: "TPU v2"/"TPU v3"/"TPU v4"/"TPU v5 lite"/"TPU v5"/
+    "TPU v5p"/"TPU v6 lite"/"TPU v6e". Returns "" when unrecognised (the
+    filter treats unset as not matching any pinned generation)."""
+    from ..topology.generations import GENERATIONS
+
+    kind = device_kind.lower().replace("tpu", "").strip()
+    if not kind.startswith("v"):
+        return ""
+    # "v5 lite" -> v5e, "v6 lite" -> v6e, "v5"/"v5p" -> v5p
+    if "lite" in kind or kind.rstrip().endswith("e"):
+        name = kind.split()[0].rstrip("e") + "e"
+    else:
+        name = kind.split()[0]
+        if name == "v5":
+            name = "v5p"
+    return name if name in GENERATIONS else ""
+
+
 def local_node_metrics(node_name: str | None = None) -> TpuNodeMetrics:
     """Snapshot this host's accelerator telemetry as a TpuNodeMetrics."""
     import jax
@@ -62,6 +83,8 @@ def local_node_metrics(node_name: str | None = None) -> TpuNodeMetrics:
         node=name,
         chips=chips,
         accelerator=TPU,
+        tpu_generation=(generation_of(getattr(devices[0], "device_kind", ""))
+                        if devices else ""),
         host_index=getattr(jax, "process_index", lambda: 0)(),
         num_hosts=getattr(jax, "process_count", lambda: 1)(),
         heartbeat=time.time(),

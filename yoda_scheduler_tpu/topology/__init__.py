@@ -7,6 +7,7 @@ from .torus import (
     contiguity_score,
     fragmentation_after,
 )
+from .generations import GENERATIONS, TpuGeneration, generation
 
 __all__ = [
     "parse_topology",
@@ -16,4 +17,7 @@ __all__ = [
     "best_fit_block",
     "contiguity_score",
     "fragmentation_after",
+    "GENERATIONS",
+    "TpuGeneration",
+    "generation",
 ]

@@ -31,6 +31,7 @@ CLOCK_LABEL = "scv/clock"         # min chip clock, MHz (>= semantics, see below
 PRIORITY_LABEL = "scv/priority"   # queue priority, higher first
 
 ACCELERATOR_LABEL = "tpu/accelerator"
+GENERATION_LABEL = "tpu/generation"  # pin a TPU generation ("v4", "v5e", ...)
 TOPOLOGY_LABEL = "tpu/topology"
 GANG_NAME_LABEL = "tpu/gang-name"
 GANG_SIZE_LABEL = "tpu/gang-size"
@@ -85,6 +86,7 @@ class WorkloadSpec:
     min_clock_mhz: int = 0
     priority: int = 0
     accelerator: str | None = None   # None = any
+    tpu_generation: str | None = None  # None = any generation
     topology: str | None = None      # e.g. "2x2"
     gang_name: str | None = None
     gang_size: int = 0
@@ -102,6 +104,13 @@ class WorkloadSpec:
         accel = labels.get(ACCELERATOR_LABEL)
         if accel is not None and accel not in ("tpu", "gpu"):
             raise LabelError(ACCELERATOR_LABEL, accel, 'must be "tpu" or "gpu"')
+        gen = labels.get(GENERATION_LABEL)
+        if gen is not None:
+            from ..topology.generations import GENERATIONS  # validate eagerly
+
+            if gen not in GENERATIONS:
+                raise LabelError(GENERATION_LABEL, gen,
+                                 f"must be one of {sorted(GENERATIONS)}")
         topo = labels.get(TOPOLOGY_LABEL)
         if topo is not None:
             from ..topology.torus import parse_topology  # validate eagerly
@@ -116,6 +125,7 @@ class WorkloadSpec:
             min_clock_mhz=_parse_uint(labels, CLOCK_LABEL, 0),
             priority=_parse_int(labels, PRIORITY_LABEL, 0),
             accelerator=accel,
+            tpu_generation=gen,
             topology=topo,
             gang_name=gang_name,
             gang_size=gang_size,
@@ -128,7 +138,8 @@ class WorkloadSpec:
 
 _SPEC_LABELS = (
     NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
-    ACCELERATOR_LABEL, TOPOLOGY_LABEL, GANG_NAME_LABEL, GANG_SIZE_LABEL,
+    ACCELERATOR_LABEL, GENERATION_LABEL, TOPOLOGY_LABEL,
+    GANG_NAME_LABEL, GANG_SIZE_LABEL,
 )
 
 
