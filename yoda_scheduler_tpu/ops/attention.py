@@ -90,6 +90,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
     o_ref[0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def _out_shape_like(q, shape):
+    """ShapeDtypeStruct carrying q's varying-manual-axes type when this jax
+    supports vma typing (older versions take no such kwarg)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, q.dtype,
+                                    vma=getattr(jax.typeof(q), "vma", None))
+    except (TypeError, AttributeError):  # pragma: no cover - older jax
+        return jax.ShapeDtypeStruct(shape, q.dtype)
+
+
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                    interpret: bool):
     from jax.experimental import pallas as pl
@@ -120,7 +130,10 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, sk, d), lambda bhi, qi: (bhi, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi: (bhi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        # propagate varying-manual-axes from q so the kernel is callable
+        # inside a partial-manual shard_map region (parallel/pipeline.py)
+        # under check_vma — the output varies over exactly q's axes
+        out_shape=_out_shape_like(q, (bh, sq, d)),
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(b, h, sq, d)

@@ -2,6 +2,12 @@ from .mesh import make_mesh, mesh_shape_for
 from .sharding import llama_param_specs, llama_shardings, batch_spec
 from .ring import ring_attention, make_ring_attn
 from .train import build_llama_train_step
+from .pipeline import (
+    build_pipelined_llama_train_step,
+    llama_pipeline_param_specs,
+    llama_pipeline_shardings,
+    pipelined_llama_loss,
+)
 
 __all__ = [
     "make_mesh",
@@ -12,4 +18,8 @@ __all__ = [
     "ring_attention",
     "make_ring_attn",
     "build_llama_train_step",
+    "build_pipelined_llama_train_step",
+    "llama_pipeline_param_specs",
+    "llama_pipeline_shardings",
+    "pipelined_llama_loss",
 ]

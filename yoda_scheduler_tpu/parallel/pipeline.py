@@ -1,0 +1,246 @@
+"""Pipeline parallelism (`pp` mesh axis) for the Llama workload.
+
+GPipe-style microbatch pipelining, TPU-native: the stacked per-layer params
+(models/llama.py keeps every layer's weights on one leading axis for the
+scan-over-layers forward) shard that leading axis over `pp`, so each pipeline
+stage holds a contiguous block of layers. Activations circulate stage-to-stage
+with `jax.lax.ppermute` — XLA lowers this onto neighbour ICI links (pp is the
+outermost mesh axis, parallel/mesh.py:24, so stage boundaries are also where
+DCN hops land on multi-host slices, the right place for the rarest transfers).
+
+The pipeline is written with *partial-manual* shard_map: manual over `pp`
+only, while tp/fsdp/dp stay auto — GSPMD keeps partitioning the per-stage
+matmuls (Megatron tp splits, fsdp gathers) inside each pipeline step, so
+pp composes with the rest of the 3-D parallelism without hand-written
+collectives. The whole pipelined loss is differentiated by JAX as one
+program: the backward pass is automatically the reverse pipeline (the
+transpose of a `ppermute` shift is the opposite shift).
+
+Schedule: plain GPipe — M microbatches through P stages in M + P - 1 ticks,
+bubble fraction (P-1)/(M+P-1). Each tick every stage runs its layer block
+(invalid ticks are masked; XLA executes them as the price of SPMD, which is
+exactly the pipeline bubble).
+
+The reference scheduler has no parallelism of its own (SURVEY §2.3) — this
+is workload-side capability: the pjit programs whose gang/topology placement
+the scheduler optimises (BASELINE scenario 4, multi-host v4-32).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..models.llama import LlamaConfig, init_llama, rms_norm, transformer_layer
+from ..ops.attention import flash_attention, reference_attention
+from .sharding import batch_spec, llama_param_specs
+
+
+def _pipeline_attn():
+    """Attention for the pipelined stage body. The compiled Pallas flash
+    kernel works under the partial-manual region on TPU; its interpret mode
+    (every other backend, incl. the CPU test mesh) mixes vma'd operands with
+    invariant grid indices inside the HLO interpreter and trips the
+    shard_map vma checker, so fall back to the plain-XLA attention there."""
+    if jax.default_backend() == "tpu":
+        return partial(flash_attention, causal=True)
+    return partial(reference_attention, causal=True)
+
+
+def _pvary(x, axis: str = "pp"):
+    """Promote a device-invariant value to varying over `axis`."""
+    try:
+        return jax.lax.pcast(x, axis, to="varying")
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        return jax.lax.pvary(x, (axis,))
+
+
+def llama_pipeline_param_specs(config: LlamaConfig | None = None) -> dict:
+    """llama_param_specs with the stacked-layer leading axis sharded over
+    `pp` — each stage materialises only its own layer block."""
+    specs = llama_param_specs(config)
+    specs["layers"] = {
+        name: P("pp", *spec[1:]) for name, spec in specs["layers"].items()
+    }
+    return specs
+
+
+def llama_pipeline_shardings(mesh, config: LlamaConfig | None = None) -> dict:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        llama_pipeline_param_specs(config),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _pipeline_apply(layers, x_mb, config: LlamaConfig, mesh, pp: int,
+                    num_microbatches: int, attn_impl, remat: bool):
+    """Run the pipelined layer stack. layers: per-layer stacked params with
+    the leading axis sharded over pp; x_mb: [M, mb, S, d] microbatched
+    activations, replicated over pp. Returns (y_mb [M, mb, S, d], aux)."""
+    M = num_microbatches
+
+    def stage_fn(layers_local, x):
+        """One pipeline tick on this stage: scan its local layer block."""
+        def layer_body(carry, layer):
+            x, aux = carry
+            y, a = transformer_layer(x, layer, config, attn_impl)
+            return (y, aux + a), None
+        if remat:
+            layer_body = jax.checkpoint(layer_body)
+        # the aux init must be varying over pp: the MoE load-balance aux is
+        # computed from the (pp-varying) activations, and an invariant init
+        # would make the scan carry types mismatch
+        (y, aux), _ = jax.lax.scan(layer_body, (x, _pvary(jnp.float32(0))),
+                                   layers_local)
+        return y, aux
+
+    act_dtype = x_mb.dtype
+
+    def body(layers_local, x_mb):
+        stage = jax.lax.axis_index("pp")
+        shift = [(i, (i + 1) % pp) for i in range(pp)]
+        # promote the microbatches to varying-over-pp once, while still f32
+        # (see the caller's cast): the transpose of this pvary is a psum of
+        # the activation cotangent, and a bf16 psum emitted inside the
+        # region crashes XLA-CPU's AllReducePromotion pass (its reduction
+        # body carries a sharding_constraint the pass cannot clone)
+        x_mb = _pvary(x_mb).astype(act_dtype)
+        # scan carries must enter with their steady-state varying-over-pp
+        # type, so promote the zero inits explicitly
+        state = _pvary(jnp.zeros(x_mb.shape[1:], x_mb.dtype))
+        outputs = _pvary(jnp.zeros(x_mb.shape, x_mb.dtype))
+        aux0 = _pvary(jnp.float32(0))
+
+        def tick(carry, t):
+            state, outputs, aux = carry
+            # stage 0 injects microbatch t; everyone else consumes what the
+            # previous stage sent last tick
+            inject = jax.lax.dynamic_index_in_dim(x_mb, t % M, 0,
+                                                  keepdims=False)
+            inp = jnp.where(stage == 0, inject, state)
+            out, a = stage_fn(layers_local, inp)
+            # stage s holds microbatch t - s this tick; mask the bubble
+            valid = jnp.logical_and(t - stage >= 0, t - stage < M)
+            aux = aux + jnp.where(valid, a, 0.0)
+            # the last stage retires microbatch t - (pp-1)
+            oidx = jnp.clip(t - (pp - 1), 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, oidx, 0,
+                                               keepdims=False)
+            retire = jnp.logical_and(stage == pp - 1, t - (pp - 1) >= 0)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(retire, out, cur), oidx, 0)
+            state = jax.lax.ppermute(out, "pp", shift)
+            return (state, outputs, aux), None
+
+        (_, outputs, aux), _ = jax.lax.scan(
+            tick, (state, outputs, aux0), jnp.arange(M + pp - 1))
+        # outputs are valid only on the last stage, aux only per-stage;
+        # psum replicates both back over pp for the (auto-sharded) lm head
+        outputs = jnp.where(stage == pp - 1, outputs, 0)
+        # replicate the retired microbatches back over pp for the lm head.
+        # f32 psum: XLA-CPU's AllReducePromotion pass crashes cloning a bf16
+        # all-reduce, and on TPU the promotion pass would upcast it anyway
+        outputs = jax.lax.psum(outputs.astype(jnp.float32), "pp")
+        return outputs.astype(x_mb.dtype), jax.lax.psum(aux, "pp")
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        axis_names={"pp"},
+        in_specs=(jax.tree.map(lambda _: P("pp"), layers), P()),
+        out_specs=(P(), P()),
+    )(layers, x_mb.astype(jnp.float32))
+
+
+def pipelined_llama_loss(params: dict, tokens: jax.Array,
+                         config: LlamaConfig, mesh,
+                         num_microbatches: int | None = None,
+                         remat: bool = True) -> jax.Array:
+    """Next-token cross-entropy with the layer stack pipelined over `pp`.
+
+    Same math as models.llama.llama_loss (full-sequence CE with the final
+    position masked); embed and lm head run outside the pipeline region,
+    auto-sharded (they replicate over pp, shard over fsdp/tp as usual).
+    """
+    pp = mesh.shape["pp"]
+    M = num_microbatches or max(2 * pp, 2)
+    B, S = tokens.shape
+    if config.n_layers % pp:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by pp={pp}")
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError("pipeline step runs with sp=1 (ring attention's own "
+                         "shard_map does not nest inside the pp region)")
+    attn_impl = _pipeline_attn()
+
+    x = params["embed"][tokens]                     # [B, S, d]
+    x_mb = x.reshape(M, B // M, S, x.shape[-1])
+    # keep the batch shard on the microbatch-local axis, not the M axis
+    x_mb = jax.lax.with_sharding_constraint(
+        x_mb, NamedSharding(mesh, P(None, ("dp", "fsdp"), None, None)))
+    y_mb, aux = _pipeline_apply(params["layers"], x_mb, config, mesh, pp, M,
+                                attn_impl, remat)
+    y = y_mb.reshape(B, S, -1)
+    y = jax.lax.with_sharding_constraint(
+        y, NamedSharding(mesh, P(("dp", "fsdp"), None, None)))
+
+    y = rms_norm(y, params["final_norm"], config.norm_eps)
+    logits = (y @ params["lm_head"]).astype(jnp.float32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = (jnp.arange(S) < S - 1).astype(nll.dtype)[None, :]
+    ce = jnp.sum(nll * mask) / (B * (S - 1))
+    # aux accumulates once per (layer, microbatch) and moe_ffn's
+    # load-balance statistic is batch-size independent, so normalise by M
+    # as well as n_layers to match llama_loss's regularisation strength
+    return ce + config.moe_aux_weight * (aux / (config.n_layers * M))
+
+
+def build_pipelined_llama_train_step(config: LlamaConfig, mesh,
+                                     num_microbatches: int | None = None,
+                                     learning_rate: float = 3e-4,
+                                     remat: bool = True):
+    """Pipelined counterpart of train.build_llama_train_step: returns
+    (init_fn, step_fn, batch_sharding) with params staged over pp."""
+    from .train import _shard_opt_state_like
+
+    param_sh = llama_pipeline_shardings(mesh, config)
+    batch_sh = NamedSharding(mesh, batch_spec(sp=False))
+    tx = optax.adamw(learning_rate)
+
+    loss_fn = partial(pipelined_llama_loss, config=config, mesh=mesh,
+                      num_microbatches=num_microbatches, remat=remat)
+
+    def _init(key):
+        params = init_llama(config, key)
+        return params, tx.init(params)
+
+    opt_sh = _shard_opt_state_like(tx, config, param_sh, mesh)
+    init_fn = jax.jit(_init, out_shardings=(param_sh, opt_sh))
+
+    def _step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step_fn = jax.jit(
+        _step,
+        in_shardings=(param_sh, opt_sh, batch_sh),
+        out_shardings=(param_sh, opt_sh, None),
+        donate_argnums=(0, 1),
+    )
+    return init_fn, step_fn, batch_sh
