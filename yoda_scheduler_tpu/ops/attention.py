@@ -90,6 +90,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
     o_ref[0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def manual_region_attention(q, k, v):
+    """Causal attention safe inside shard_map manual regions ([B,H,S,D]):
+    the compiled Pallas flash kernel on TPU; plain XLA elsewhere, because
+    the kernel's interpret mode (every non-TPU backend) mixes vma'd operands
+    with invariant grid indices in the HLO interpreter and trips the
+    shard_map vma checker. Used by parallel/pipeline.py and
+    parallel/ulysses.py."""
+    if jax.default_backend() == "tpu":
+        return flash_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True)
+
+
 def _out_shape_like(q, shape):
     """ShapeDtypeStruct carrying q's varying-manual-axes type when this jax
     supports vma typing (older versions take no such kwarg)."""

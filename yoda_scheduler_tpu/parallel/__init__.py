@@ -1,6 +1,7 @@
 from .mesh import make_mesh, mesh_shape_for
 from .sharding import llama_param_specs, llama_shardings, batch_spec
 from .ring import ring_attention, make_ring_attn
+from .ulysses import ulysses_attention, make_ulysses_attn
 from .train import build_llama_train_step
 from .pipeline import (
     build_pipelined_llama_train_step,
@@ -17,6 +18,8 @@ __all__ = [
     "batch_spec",
     "ring_attention",
     "make_ring_attn",
+    "ulysses_attention",
+    "make_ulysses_attn",
     "build_llama_train_step",
     "build_pipelined_llama_train_step",
     "llama_pipeline_param_specs",

@@ -41,19 +41,8 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..models.llama import LlamaConfig, init_llama, rms_norm, transformer_layer
-from ..ops.attention import flash_attention, reference_attention
+from ..ops.attention import manual_region_attention
 from .sharding import batch_spec, llama_param_specs
-
-
-def _pipeline_attn():
-    """Attention for the pipelined stage body. The compiled Pallas flash
-    kernel works under the partial-manual region on TPU; its interpret mode
-    (every other backend, incl. the CPU test mesh) mixes vma'd operands with
-    invariant grid indices inside the HLO interpreter and trips the
-    shard_map vma checker, so fall back to the plain-XLA attention there."""
-    if jax.default_backend() == "tpu":
-        return partial(flash_attention, causal=True)
-    return partial(reference_attention, causal=True)
 
 
 def _pvary(x, axis: str = "pp"):
@@ -183,7 +172,7 @@ def pipelined_llama_loss(params: dict, tokens: jax.Array,
     if mesh.shape.get("sp", 1) > 1:
         raise ValueError("pipeline step runs with sp=1 (ring attention's own "
                          "shard_map does not nest inside the pp region)")
-    attn_impl = _pipeline_attn()
+    attn_impl = manual_region_attention
 
     x = params["embed"][tokens]                     # [B, S, d]
     x_mb = x.reshape(M, B // M, S, x.shape[-1])

@@ -25,17 +25,33 @@ def build_llama_train_step(
     learning_rate: float = 3e-4,
     remat: bool = True,
     use_ring_attention: bool | None = None,
+    sp_attention: str = "ring",
 ):
     """Returns (init_fn, step_fn, batch_sharding).
 
     - init_fn(key) -> (params, opt_state), laid out with the model shardings
     - step_fn(params, opt_state, tokens) -> (params, opt_state, loss), jitted
       with explicit in/out shardings over `mesh`
+
+    With sp > 1 the sequence-parallel attention is selected by
+    `sp_attention`: "ring" (parallel/ring.py, default) or "ulysses"
+    (parallel/ulysses.py, all-to-all head re-sharding).
     """
+    if sp_attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sp_attention={sp_attention!r} — expected 'ring' or 'ulysses'")
     sp = mesh.shape.get("sp", 1)
+    # use_ring_attention toggles sequence-parallel attention on/off
+    # (default: on iff sp > 1); sp_attention picks the scheme
     if use_ring_attention is None:
         use_ring_attention = sp > 1
-    attn_impl = make_ring_attn(mesh) if use_ring_attention else None
+    if not use_ring_attention:
+        attn_impl = None
+    elif sp_attention == "ulysses":
+        from .ulysses import make_ulysses_attn
+        attn_impl = make_ulysses_attn(mesh)
+    else:
+        attn_impl = make_ring_attn(mesh)
 
     param_sh = llama_shardings(mesh, config)
     batch_sh = NamedSharding(mesh, batch_spec(sp=sp > 1))

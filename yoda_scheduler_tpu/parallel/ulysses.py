@@ -1,0 +1,80 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second context-parallel scheme next to ring attention (parallel/ring.py),
+after the DeepSpeed-Ulysses construction: activations arrive sharded on the
+sequence axis (`sp`); one `all_to_all` re-shards attention heads across the
+`sp` group so each device holds a head subset with the FULL sequence, plain
+causal attention runs locally (no per-step communication, no online-softmax
+re-normalisation), and a second `all_to_all` restores sequence sharding.
+
+Trade-off vs the ring: Ulysses does two all-to-alls total (XLA lowers them
+onto the ICI torus) instead of `sp` ppermute rounds, and each device runs
+one dense local attention — better when heads are plentiful and sequence
+chunks are small; it requires local_heads % sp == 0, while the ring has no
+head constraint and never materialises the full sequence on any chip.
+Both present the same attn_impl interface, selected per-workload in
+parallel/train.py.
+
+The reference scheduler has no parallelism of any kind (SURVEY §2.3); this
+is workload-side capability for the long-context jobs the scheduler places.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.attention import manual_region_attention
+
+
+def _ulysses_body(q, k, v, axis_name: str):
+    # local shapes [B, H_loc, S/n, D]; scatter heads / gather sequence
+    q = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    o = manual_region_attention(q, k, v)     # [B, H_loc/n, S, D]
+    # scatter sequence / gather heads back
+    return jax.lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "sp"):
+    """Causal attention with q,k,v [B, H, S, D], S sharded over `axis_name`.
+
+    Call under jit with the global arrays (same contract as ring_attention);
+    shard_map splits them per the specs and the two all-to-alls re-shard
+    seq<->heads around the local attention.
+    """
+    n = mesh.shape[axis_name]
+    seq, heads = q.shape[2], q.shape[1]
+    if seq % n:
+        raise ValueError(f"seq {seq} not divisible by {axis_name}={n}")
+    tp = mesh.shape.get("tp", 1)
+    local_heads = heads // tp if heads % tp == 0 else heads
+    if local_heads % n:
+        raise ValueError(
+            f"local head count {local_heads} (H={heads}, tp={tp}) not "
+            f"divisible by {axis_name}={n} — use ring attention for this "
+            "shape")
+    spec = P(("dp", "fsdp"), "tp", axis_name, None)
+    body = partial(_ulysses_body, axis_name=axis_name)
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+
+
+def make_ulysses_attn(mesh, axis_name: str = "sp"):
+    """attn_impl adapter for models.llama.llama_forward."""
+    def attn(q, k, v):
+        return ulysses_attention(q, k, v, mesh, axis_name)
+    return attn
