@@ -3,6 +3,7 @@ from .sharding import llama_param_specs, llama_shardings, batch_spec
 from .ring import ring_attention, make_ring_attn
 from .ulysses import ulysses_attention, make_ulysses_attn
 from .train import build_llama_train_step
+from .checkpoint import TrainCheckpointer
 from .pipeline import (
     build_pipelined_llama_train_step,
     llama_pipeline_param_specs,
@@ -21,6 +22,7 @@ __all__ = [
     "ulysses_attention",
     "make_ulysses_attn",
     "build_llama_train_step",
+    "TrainCheckpointer",
     "build_pipelined_llama_train_step",
     "llama_pipeline_param_specs",
     "llama_pipeline_shardings",

@@ -25,7 +25,7 @@ def build_llama_train_step(
     learning_rate: float = 3e-4,
     remat: bool = True,
     use_ring_attention: bool | None = None,
-    sp_attention: str = "ring",
+    sp_attention: str | None = None,
 ):
     """Returns (init_fn, step_fn, batch_sharding).
 
@@ -33,19 +33,25 @@ def build_llama_train_step(
     - step_fn(params, opt_state, tokens) -> (params, opt_state, loss), jitted
       with explicit in/out shardings over `mesh`
 
-    With sp > 1 the sequence-parallel attention is selected by
-    `sp_attention`: "ring" (parallel/ring.py, default) or "ulysses"
-    (parallel/ulysses.py, all-to-all head re-sharding).
+    Sequence-parallel attention is one knob: `sp_attention` is None (auto:
+    ring iff sp > 1), "ring", "ulysses", or "none". `use_ring_attention`
+    is the deprecated boolean spelling; passing both raises.
     """
-    if sp_attention not in ("ring", "ulysses"):
+    if sp_attention not in (None, "none", "ring", "ulysses"):
         raise ValueError(
-            f"sp_attention={sp_attention!r} — expected 'ring' or 'ulysses'")
+            f"sp_attention={sp_attention!r} — expected None, 'none', "
+            "'ring' or 'ulysses'")
+    if use_ring_attention is not None and sp_attention is not None:
+        raise ValueError(
+            "pass either sp_attention or the deprecated use_ring_attention,"
+            " not both")
     sp = mesh.shape.get("sp", 1)
-    # use_ring_attention toggles sequence-parallel attention on/off
-    # (default: on iff sp > 1); sp_attention picks the scheme
-    if use_ring_attention is None:
-        use_ring_attention = sp > 1
-    if not use_ring_attention:
+    if sp_attention is None:
+        if use_ring_attention is None:
+            sp_attention = "ring" if sp > 1 else "none"
+        else:
+            sp_attention = "ring" if use_ring_attention else "none"
+    if sp_attention == "none":
         attn_impl = None
     elif sp_attention == "ulysses":
         from .ulysses import make_ulysses_attn

@@ -60,7 +60,9 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp"):
     if seq % n:
         raise ValueError(f"seq {seq} not divisible by {axis_name}={n}")
     tp = mesh.shape.get("tp", 1)
-    local_heads = heads // tp if heads % tp == 0 else heads
+    if heads % tp:
+        raise ValueError(f"heads {heads} not divisible by tp={tp}")
+    local_heads = heads // tp
     if local_heads % n:
         raise ValueError(
             f"local head count {local_heads} (H={heads}, tp={tp}) not "
