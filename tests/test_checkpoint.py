@@ -50,6 +50,17 @@ class TestRoundTrip:
             with pytest.raises(FileNotFoundError):
                 ckpt.restore((params, opt))
 
+    def test_non_increasing_save_raises_not_silently_skips(
+            self, tmp_path, step_bits):
+        # orbax's should_save guard skips steps <= latest; a silent skip
+        # after restoring an older step would resume from divergent weights
+        init_fn, _, _ = step_bits
+        params, opt = init_fn(jax.random.PRNGKey(0))
+        with TrainCheckpointer(str(tmp_path / "skip")) as ckpt:
+            ckpt.save(3, params, opt)
+            with pytest.raises(ValueError, match="not saved"):
+                ckpt.save(3, params, opt)
+
     def test_max_to_keep_garbage_collects(self, tmp_path, step_bits):
         init_fn, _, _ = step_bits
         params, opt = init_fn(jax.random.PRNGKey(0))

@@ -226,3 +226,49 @@ class TestObservability:
         text = sched.metrics.render_prometheus()
         assert "yoda_tpu_pods_scheduled_total 1" in text
         assert "yoda_tpu_schedule_latency_ms_bucket" in text
+
+
+class TestCacheCoherence:
+    """Cross-cycle snapshot/free-set caches (core.snapshot, ChipAllocator)
+    must invalidate on every mutation path and prune on node removal."""
+
+    def test_bind_invalidates_only_that_node(self):
+        sched, _, clock = mk_sched(make_tpu_node("a", chips=4),
+                                   make_tpu_node("b", chips=4))
+        sched.submit(Pod("p1", labels={"scv/number": "4"}))
+        sched.run_until_idle()
+        snap = sched.snapshot()
+        bound_node = next(p.node for p in sched.cluster.all_pods())
+        other = "a" if bound_node == "b" else "b"
+        # the untouched node's NodeInfo is reused; the bound one rebuilt
+        first = {n.name: n.serial for n in snap.list()}
+        again = {n.name: n.serial for n in sched.snapshot().list()}
+        assert first == again
+        # free set reflects the bind immediately
+        assert len(sched.allocator.free_coords(snap.get(bound_node))) == 0
+        assert len(sched.allocator.free_coords(snap.get(other))) == 4
+
+    def test_eviction_refreshes_free_set(self):
+        sched, _, clock = mk_sched(make_tpu_node("a", chips=4))
+        p = Pod("p1", labels={"scv/number": "4"})
+        sched.submit(p)
+        sched.run_until_idle()
+        ni = sched.snapshot().get("a")
+        assert len(sched.allocator.free_coords(ni)) == 0
+        sched.cluster.evict(p)
+        ni2 = sched.snapshot().get("a")
+        assert ni2.serial != ni.serial  # rebuilt after the version bump
+        assert len(sched.allocator.free_coords(ni2)) == 4
+
+    def test_node_removal_prunes_caches(self):
+        sched, _, clock = mk_sched(make_tpu_node("gone", chips=4),
+                                   make_tpu_node("stays", chips=4))
+        sched.submit(Pod("p1", labels={"scv/number": "1"}))
+        sched.run_until_idle()
+        # both nodes now have cache entries (filter touched both)
+        sched.cluster.remove_node("gone")
+        sched.cluster.telemetry.delete("gone")
+        sched.snapshot()
+        assert "gone" not in sched._ni_cache
+        assert "gone" not in sched.allocator._free_cache
+        assert "gone" not in sched.allocator._pending_ver

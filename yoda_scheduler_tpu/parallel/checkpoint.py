@@ -62,7 +62,16 @@ class TrainCheckpointer:
         so an async save could still be reading them when the next
         step_fn call invalidates them."""
         state = {"params": params, "opt_state": opt_state}
-        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        saved = self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        if not saved:
+            # orbax's should_save guard silently skips steps <= latest; a
+            # skipped save after restoring an older step would resume from
+            # divergent weights on the next crash — surface it instead
+            raise ValueError(
+                f"checkpoint step {step} was not saved (latest existing step"
+                f" is {self.latest_step()}; orbax skips non-increasing"
+                " steps). After restoring an older step, delete the newer"
+                " checkpoints or save under a fresh step number.")
         self.manager.wait_until_finished()
 
     def latest_step(self) -> int | None:

@@ -36,6 +36,15 @@ class FakeCluster:
         self._lock = threading.RLock()
         self._nodes: set[str] = set()
         self._bound: dict[str, list[Pod]] = {}  # node -> pods
+        # monotonic per-node change counter (bind/evict/removal): lets the
+        # scheduler reuse per-node snapshot state across cycles — a bind
+        # invalidates one node, not the whole cluster
+        self._pods_ver: dict[str, int] = {}
+
+    def _bump(self, node: str) -> None:
+        # callers hold self._lock; every mutation of a node's bound-pod set
+        # MUST bump, or cross-cycle snapshot reuse serves stale NodeInfos
+        self._pods_ver[node] = self._pods_ver.get(node, 0) + 1
 
     # ------------------------------------------------------------- node admin
     def add_node(self, name: str) -> None:
@@ -47,11 +56,16 @@ class FakeCluster:
         for m in self.telemetry.list():
             self.add_node(m.node)
 
+    def pods_version(self, node: str) -> int:
+        with self._lock:
+            return self._pods_ver.get(node, 0)
+
     def remove_node(self, name: str) -> list[Pod]:
         """Node goes away; its pods return to the caller for requeueing."""
         with self._lock:
             self._nodes.discard(name)
             orphans = self._bound.pop(name, [])
+            self._bump(name)
         for p in orphans:
             p.node = None
             p.phase = PodPhase.PENDING
@@ -81,11 +95,13 @@ class FakeCluster:
             if assigned_chips is not None:
                 pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(assigned_chips)
             self._bound[node].append(pod)
+            self._bump(node)
 
     def evict(self, pod: Pod) -> None:
         with self._lock:
             if pod.node and pod.node in self._bound:
                 self._bound[pod.node] = [p for p in self._bound[pod.node] if p.uid != pod.uid]
+                self._bump(pod.node)
         pod.node = None
         pod.phase = PodPhase.PENDING
         pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)

@@ -194,6 +194,9 @@ class Scheduler:
         self.traces = TraceLog()
         self.rng = random.Random(self.config.rng_seed)
         self._filter_start = 0  # rotating offset for percentageOfNodesToScore
+        # node -> ((telemetry generation, pods version), NodeInfo) — see
+        # snapshot() for the cross-cycle reuse contract
+        self._ni_cache: dict[str, tuple[tuple, NodeInfo]] = {}
 
     # ----------------------------------------------------------------- intake
     def submit(self, pod: Pod) -> bool:
@@ -222,13 +225,35 @@ class Scheduler:
 
     # --------------------------------------------------------------- snapshot
     def snapshot(self) -> Snapshot:
+        """Per-cycle view. NodeInfo objects (and their claimed/assigned
+        memos) are reused across cycles while the node's telemetry
+        generation and bound-pod version are unchanged — a bind touches one
+        node, so the other N-1 infos carry over untouched. Falls back to
+        full rebuilds on backends without pods_version."""
+        pods_version = getattr(self.cluster, "pods_version", None)
         infos: dict[str, NodeInfo] = {}
-        for name in self.cluster.node_names():
-            infos[name] = NodeInfo(
-                name=name,
-                metrics=self.cluster.telemetry.get(name),
-                pods=self.cluster.pods_on(name),
-            )
+        names = self.cluster.node_names()
+        for name in names:
+            metrics = self.cluster.telemetry.get(name)
+            if pods_version is not None:
+                key = (getattr(metrics, "generation", None), pods_version(name))
+                cached = self._ni_cache.get(name)
+                if cached is not None and cached[0] == key:
+                    infos[name] = cached[1]
+                    continue
+                ni = NodeInfo(name=name, metrics=metrics,
+                              pods=self.cluster.pods_on(name))
+                self._ni_cache[name] = (key, ni)
+            else:
+                ni = NodeInfo(name=name, metrics=metrics,
+                              pods=self.cluster.pods_on(name))
+            infos[name] = ni
+        if len(self._ni_cache) > len(names):  # drop removed nodes
+            gone = set(self._ni_cache) - set(infos)
+            self._ni_cache = {n: v for n, v in self._ni_cache.items()
+                              if n in infos}
+            if self.allocator is not None:
+                self.allocator.forget_nodes(gone)
         return Snapshot(infos)
 
     # ------------------------------------------------------------- the cycle

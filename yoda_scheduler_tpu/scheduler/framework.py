@@ -28,8 +28,12 @@ from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import Any
 
+from itertools import count as _count
+
 from ..telemetry.schema import TpuNodeMetrics
 from ..utils.pod import Pod
+
+_NODE_INFO_SERIAL = _count(1)
 
 
 class Code(IntEnum):
@@ -116,8 +120,13 @@ class NodeInfo:
     name: str
     metrics: TpuNodeMetrics | None
     pods: list[Pod] = field(default_factory=list)
-    # per-instance memos — NodeInfo objects are rebuilt each scheduling cycle,
-    # so these cache only within one cycle's coherent view
+    # process-unique identity for version-keyed caches (id() can be reused
+    # after GC; the serial never is). A NodeInfo is immutable once built, so
+    # serial equality == same telemetry + same bound-pod set.
+    serial: int = field(default_factory=lambda: next(_NODE_INFO_SERIAL),
+                        repr=False, compare=False)
+    # per-instance memos — a NodeInfo is built for one coherent view of the
+    # node and may be reused across cycles while that view is unchanged
     _claimed_chips: int | None = field(default=None, repr=False, compare=False)
     _claimed_hbm: int | None = field(default=None, repr=False, compare=False)
     _assigned: set | None = field(default=None, repr=False, compare=False)

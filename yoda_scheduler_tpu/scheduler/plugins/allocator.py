@@ -26,6 +26,24 @@ class ChipAllocator(ReservePlugin):
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._pending: dict[str, tuple[str, list[Coord]]] = {}  # pod.key -> (node, coords)
+        # per-node free-set cache, keyed by (NodeInfo.serial, pending
+        # version): NodeInfos persist across cycles while a node is
+        # untouched (core.snapshot), so the free set does too; any
+        # reserve/unreserve/complete on the node bumps its version
+        self._pending_ver: dict[str, int] = {}
+        self._free_cache: dict[str, tuple[tuple[int, int], set[Coord]]] = {}
+
+    def _bump(self, node: str) -> None:
+        self._pending_ver[node] = self._pending_ver.get(node, 0) + 1
+
+    def forget_nodes(self, gone: set[str]) -> None:
+        """Drop cached per-node state for nodes that left the cluster
+        (called by the scheduler's snapshot prune; without it, node churn
+        grows the caches without bound)."""
+        with self._lock:
+            for n in gone:
+                self._free_cache.pop(n, None)
+                self._pending_ver.pop(n, None)
 
     # ----------------------------------------------------------------- views
     def pending_on(self, node: str) -> set[Coord]:
@@ -37,19 +55,26 @@ class ChipAllocator(ReservePlugin):
 
     def free_coords(self, node_info: NodeInfo, state: CycleState | None = None) -> set[Coord]:
         """Healthy chips not claimed by bound pods nor pending reservations.
-        With `state`, memoised per scheduling cycle (every plugin asks for
-        the same node's free set several times per cycle)."""
-        if state is not None:
-            key = "free_coords:" + node_info.name
-            cached = state.read_or(key)
-            if cached is None:
-                cached = self.free_coords(node_info)
-                state.write(key, cached)
-            return cached
+
+        Memoised across cycles: the key pairs the NodeInfo's serial (a new
+        serial appears whenever telemetry or the bound-pod set changed) with
+        this allocator's per-node pending version. Every plugin asks for the
+        same node's free set several times per cycle, and most nodes are
+        untouched between cycles. The legacy `state` parameter is accepted
+        for compatibility but no longer needed."""
+        with self._lock:
+            key = (node_info.serial, self._pending_ver.get(node_info.name, 0))
+            cached = self._free_cache.get(node_info.name)
+            if cached is not None and cached[0] == key:
+                return cached[1]
         m = node_info.metrics
         if m is None:
             return set()
-        return m.healthy_coords() - node_info.assigned_coords() - self.pending_on(node_info.name)
+        free = (m.healthy_coords() - node_info.assigned_coords()
+                - self.pending_on(node_info.name))
+        with self._lock:
+            self._free_cache[node_info.name] = (key, free)
+        return free
 
     def assignment_of(self, pod: Pod) -> tuple[str, list[Coord]] | None:
         with self._lock:
@@ -92,24 +117,26 @@ class ChipAllocator(ReservePlugin):
         spec = state.read_or("workload_spec")
         if node_info is None or spec is None:
             return Status.error("allocator: cycle state missing node_info/spec")
-        # the cycle-state free_coords memo is still coherent here: one pod per
-        # cycle, and this is the first Reserve plugin, so nothing reserved
-        # since Filter computed it
         coords = self.pick_chips(spec, node_info, state)
         if coords is None:
             return Status.unschedulable(f"{node}: chips vanished before reserve")
         with self._lock:
             self._pending[pod.key] = (node, coords)
+            self._bump(node)
         return Status.success()
 
     def unreserve(self, state: CycleState, pod: Pod, node: str) -> None:
         with self._lock:
-            self._pending.pop(pod.key, None)
+            entry = self._pending.pop(pod.key, None)
+            if entry is not None:
+                self._bump(entry[0])
 
     def complete(self, pod: Pod) -> list[Coord] | None:
         """Called by the binder: consume the reservation."""
         with self._lock:
             entry = self._pending.pop(pod.key, None)
+            if entry is not None:
+                self._bump(entry[0])
         return entry[1] if entry else None
 
 
