@@ -1,4 +1,11 @@
 from .llama import LlamaConfig, init_llama, llama_forward, llama_loss
+from .generate import (
+    KVCache,
+    decode_step,
+    generate,
+    make_generate_fn,
+    prefill,
+)
 from .resnet import ResNet50, resnet_forward_fn
 
 __all__ = [
@@ -6,6 +13,11 @@ __all__ = [
     "init_llama",
     "llama_forward",
     "llama_loss",
+    "KVCache",
+    "decode_step",
+    "generate",
+    "make_generate_fn",
+    "prefill",
     "ResNet50",
     "resnet_forward_fn",
 ]

@@ -1,0 +1,208 @@
+"""Autoregressive generation for the Llama workload: prefill + KV-cache
+decode, TPU-first.
+
+The reference scheduler ships no model code at all (SURVEY §2.3); this is
+workload-side capability — the serving-shaped jobs (BASELINE's inference
+pods) the scheduler places, and the proof that the model stack covers both
+training and inference.
+
+XLA-friendly design:
+- static shapes end to end: the KV cache is a pre-allocated
+  [L, B, max_len, kvH, D] buffer written with dynamic_update_slice; the
+  decode loop is one `lax.scan` over `max_new_tokens` steps, so the whole
+  generation compiles to a single program (no per-token retrace)
+- prefill runs the full-sequence forward once (MXU-friendly batched
+  matmuls) and seeds the cache; decode steps are [B, 1] queries against the
+  cache with explicit length masking
+- GQA: the cache stores n_kv_heads only; Q-head broadcast happens at
+  attention time, so cache HBM = kv_heads/heads of the naive size
+- sharding: cache axes follow the attention heads, so the same
+  NamedShardings that split wq/wk/wv over tp split the cache; decode runs
+  under jit over the same mesh as training (tests drive this on the
+  8-device CPU mesh)
+
+Positions use the same RoPE as training (models/llama.py `rotary` is
+re-derived here with an offset so cached keys keep their absolute
+positions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, rms_norm
+
+
+@dataclass(frozen=True)
+class KVCache:
+    """Per-layer stacked K/V buffers + current length (static max size)."""
+    k: jax.Array  # [L, B, max_len, kvH, D]
+    v: jax.Array
+    length: jax.Array  # scalar int32: valid prefix length
+
+    @classmethod
+    def zeros(cls, config: LlamaConfig, batch: int, max_len: int,
+              dtype=None) -> "KVCache":
+        dt = dtype or jnp.dtype(config.dtype)
+        shape = (config.n_layers, batch, max_len, config.n_kv_heads,
+                 config.head_dim)
+        return cls(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+                   length=jnp.int32(0))
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["k", "v", "length"], meta_fields=[])
+
+
+def _rotary_at(x, positions, theta: float):
+    """RoPE for [B, S, H, hd] at absolute `positions` [B, S] (fp32 inside)."""
+    b, s, h, hd = x.shape
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,S,hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(b, s, h, hd).astype(x.dtype)
+
+
+def _cached_attention(q, k_cache, v_cache, q_positions, cache_len):
+    """q [B, Sq, H, D] against cache [B, max_len, kvH, D]; causal against
+    absolute positions, masked beyond cache_len. Returns [B, Sq, H, D]."""
+    b, sq, h, d = q.shape
+    kvh = k_cache.shape[2]
+    if kvh != h:  # GQA broadcast at attention time
+        rep = h // kvh
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    k_pos = jnp.arange(k_cache.shape[1])
+    mask = (k_pos[None, None, None, :] <= q_positions[:, None, :, None]) & (
+        k_pos[None, None, None, :] < cache_len)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _forward_with_cache(params, tokens, positions, cache: KVCache,
+                        config: LlamaConfig):
+    """Run tokens [B, S] at absolute `positions` [B, S], reading + appending
+    to the cache at [cache.length, cache.length + S). Returns
+    (logits [B, S, vocab], new cache). S is static (prefill chunk or 1)."""
+    max_len = cache.k.shape[2]
+    # under jit cache.length is a tracer and this is generate()'s static
+    # check; eagerly (prefill/decode_step used as building blocks) the
+    # overflow is catchable — dynamic_update_slice would otherwise clamp
+    # and silently corrupt the last cache slot
+    if not isinstance(cache.length, jax.core.Tracer):
+        if int(cache.length) + tokens.shape[1] > max_len:
+            raise ValueError(
+                f"KV cache full: length {int(cache.length)} + "
+                f"{tokens.shape[1]} new > max_len {max_len}")
+    x = params["embed"][tokens]
+    new_len = cache.length + tokens.shape[1]
+
+    def layer_body(carry, inputs):
+        x, = carry
+        layer, k_cache, v_cache = inputs
+        b, s, d = x.shape
+        h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+        xn = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = (xn @ layer["wq"]).reshape(b, s, h, hd)
+        k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
+        v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
+        q = _rotary_at(q, positions, config.rope_theta)
+        k = _rotary_at(k, positions, config.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k, (0, cache.length, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v, (0, cache.length, 0, 0))
+        o = _cached_attention(q, k_cache, v_cache, positions, new_len)
+        x = x + o.reshape(b, s, h * hd) @ layer["wo"]
+        xn = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        if config.is_moe:
+            from .moe import moe_ffn
+            y, _ = moe_ffn(xn, layer, config.num_experts,
+                           config.experts_per_token,
+                           config.expert_capacity_factor)
+            x = x + y
+        else:
+            gate = jax.nn.silu((xn @ layer["w_gate"]).astype(jnp.float32))
+            x = x + (gate.astype(x.dtype) * (xn @ layer["w_up"])) @ layer["w_down"]
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer_body, (x,), (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, length=new_len)
+
+
+def prefill(params, tokens, cache: KVCache, config: LlamaConfig):
+    """Seed the cache with a prompt [B, S]; returns (last-token logits
+    [B, vocab], cache)."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s)) + cache.length
+    logits, cache = _forward_with_cache(params, tokens, positions, cache,
+                                        config)
+    return logits[:, -1], cache
+
+
+def decode_step(params, token, cache: KVCache, config: LlamaConfig):
+    """One decode step: token [B] -> (logits [B, vocab], cache)."""
+    positions = jnp.broadcast_to(cache.length, (token.shape[0], 1))
+    logits, cache = _forward_with_cache(params, token[:, None], positions,
+                                        cache, config)
+    return logits[:, 0], cache
+
+
+def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
+             temperature: float = 0.0, key: jax.Array | None = None,
+             max_len: int | None = None):
+    """Generate `max_new_tokens` continuations of prompt [B, S].
+
+    temperature 0 = greedy argmax; > 0 = categorical sampling (requires
+    `key`). Returns [B, max_new_tokens]. Jit-able as a whole: prefill once,
+    then one lax.scan over decode steps.
+    """
+    b, s = prompt.shape
+    max_len = max_len or (s + max_new_tokens)
+    if max_len < s + max_new_tokens:
+        raise ValueError(
+            f"max_len {max_len} < prompt {s} + new {max_new_tokens}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires `key`")
+    cache = KVCache.zeros(config, b, max_len)
+    logits, cache = prefill(params, prompt, cache, config)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    def pick(logits, k):
+        if temperature > 0.0:
+            return jax.random.categorical(k, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, k):
+        logits, cache = carry
+        tok = pick(logits, k)
+        logits, cache = decode_step(params, tok, cache, config)
+        return (logits, cache), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), tokens = jax.lax.scan(step, (logits, cache), keys)
+    return tokens.T  # [B, max_new_tokens]
+
+
+def make_generate_fn(config: LlamaConfig, max_new_tokens: int,
+                     temperature: float = 0.0):
+    """jit-compiled generate with static config/length (the serving entry)."""
+    return jax.jit(partial(generate, config=config,
+                           max_new_tokens=max_new_tokens,
+                           temperature=temperature))

@@ -197,6 +197,7 @@ class Scheduler:
         # node -> ((telemetry generation, pods version), NodeInfo) — see
         # snapshot() for the cross-cycle reuse contract
         self._ni_cache: dict[str, tuple[tuple, NodeInfo]] = {}
+        self._known_nodes: set[str] = set()
 
     # ----------------------------------------------------------------- intake
     def submit(self, pod: Pod) -> bool:
@@ -248,12 +249,16 @@ class Scheduler:
                 ni = NodeInfo(name=name, metrics=metrics,
                               pods=self.cluster.pods_on(name))
             infos[name] = ni
-        if len(self._ni_cache) > len(names):  # drop removed nodes
-            gone = set(self._ni_cache) - set(infos)
-            self._ni_cache = {n: v for n, v in self._ni_cache.items()
-                              if n in infos}
+        # prune per-node caches for departed nodes on EVERY backend — the
+        # allocator's free-set cache fills from free_coords() regardless of
+        # whether this backend supports NodeInfo reuse
+        gone = self._known_nodes - set(infos)
+        if gone:
+            for n in gone:
+                self._ni_cache.pop(n, None)
             if self.allocator is not None:
                 self.allocator.forget_nodes(gone)
+        self._known_nodes = set(infos)
         return Snapshot(infos)
 
     # ------------------------------------------------------------- the cycle

@@ -53,15 +53,14 @@ class ChipAllocator(ReservePlugin):
     def pending_chip_count(self, node: str) -> int:
         return len(self.pending_on(node))
 
-    def free_coords(self, node_info: NodeInfo, state: CycleState | None = None) -> set[Coord]:
+    def free_coords(self, node_info: NodeInfo) -> set[Coord]:
         """Healthy chips not claimed by bound pods nor pending reservations.
 
         Memoised across cycles: the key pairs the NodeInfo's serial (a new
         serial appears whenever telemetry or the bound-pod set changed) with
         this allocator's per-node pending version. Every plugin asks for the
         same node's free set several times per cycle, and most nodes are
-        untouched between cycles. The legacy `state` parameter is accepted
-        for compatibility but no longer needed."""
+        untouched between cycles."""
         with self._lock:
             key = (node_info.serial, self._pending_ver.get(node_info.name, 0))
             cached = self._free_cache.get(node_info.name)
@@ -81,8 +80,8 @@ class ChipAllocator(ReservePlugin):
             return self._pending.get(pod.key)
 
     # ------------------------------------------------------------ placement
-    def pick_chips(self, spec: WorkloadSpec, node_info: NodeInfo,
-                   state: CycleState | None = None) -> list[Coord] | None:
+    def pick_chips(self, spec: WorkloadSpec,
+                   node_info: NodeInfo) -> list[Coord] | None:
         """Choose concrete chips for the spec on this node, best-fit
         contiguous. Falls back to any qualifying chips when the node's free
         space has no contiguous block (still schedulable, just lower quality —
@@ -90,7 +89,7 @@ class ChipAllocator(ReservePlugin):
         m = node_info.metrics
         if m is None:
             return None
-        free = self.free_coords(node_info, state)
+        free = self.free_coords(node_info)
         qualifying = {
             c.coords
             for c in m.healthy_chips()
@@ -117,7 +116,7 @@ class ChipAllocator(ReservePlugin):
         spec = state.read_or("workload_spec")
         if node_info is None or spec is None:
             return Status.error("allocator: cycle state missing node_info/spec")
-        coords = self.pick_chips(spec, node_info, state)
+        coords = self.pick_chips(spec, node_info)
         if coords is None:
             return Status.unschedulable(f"{node}: chips vanished before reserve")
         with self._lock:

@@ -74,7 +74,7 @@ class TelemetryFilter(FilterPlugin):
                     )
 
         # chips-count predicate over *unclaimed* healthy chips
-        free = self.allocator.free_coords(node, state)
+        free = self.allocator.free_coords(node)
         if len(free) < spec.chips:
             return Status.unschedulable(
                 f"{node.name}: {len(free)} unclaimed healthy chips < {spec.chips} requested"

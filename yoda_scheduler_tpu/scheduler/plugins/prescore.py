@@ -48,7 +48,7 @@ class MaxCollection(PreScorePlugin):
             m = node.metrics
             if m is None:
                 continue
-            free = self.allocator.free_coords(node, state)
+            free = self.allocator.free_coords(node)
             for c in m.healthy_chips():
                 if (c.coords in free
                         and c.hbm_free_mb >= spec.min_free_mb

@@ -46,7 +46,7 @@ class TelemetryScore(ScorePlugin):
         if m is None:
             return 0.0
         w = self.weights
-        free = self.allocator.free_coords(node, state)
+        free = self.allocator.free_coords(node)
         total = 0.0
         for c in m.healthy_chips():
             if (c.coords in free

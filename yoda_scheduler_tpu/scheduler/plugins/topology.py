@@ -46,7 +46,7 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
             m = node.metrics
             if m is None or not m.slice_id:
                 continue
-            used_here = m.chip_count - len(self.allocator.free_coords(node, state))
+            used_here = m.chip_count - len(self.allocator.free_coords(node))
             u, t = usage.get(m.slice_id, (0, 0))
             usage[m.slice_id] = (u + used_here, t + m.chip_count)
         state.write(SLICE_USE_KEY, usage)
@@ -57,7 +57,7 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
         if m is None:
             return 0.0, Status.success()
         spec: WorkloadSpec = state.read(SPEC_KEY)
-        free = self.allocator.free_coords(node, state)
+        free = self.allocator.free_coords(node)
         cont = contiguity_score(_node_shape(m), free, min(spec.chips, len(free)))
         if not m.slice_id or m.num_hosts <= 1:
             # standalone node: always preferable to denting a pristine slice
