@@ -1,0 +1,151 @@
+"""Multi-profile serving (scheduler/multi.py + cli.load_profiles):
+KubeSchedulerConfiguration `profiles:` parity — every profile is served,
+pods route by spec.schedulerName, and co-hosted profiles share the chip
+ledger so they can never double-book."""
+
+import json
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import (
+    FakeCluster, MultiProfileScheduler, SchedulerConfig)
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk_multi(*nodes, profiles=None):
+    store = TelemetryStore()
+    clock = FakeClock(start=1000.0)
+    for n in nodes:
+        n.heartbeat = clock.time()
+        store.put(n)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    profiles = profiles or [
+        (SchedulerConfig(), None),
+        (SchedulerConfig(scheduler_name="yoda-scheduler2"), None),
+    ]
+    return MultiProfileScheduler(cluster, profiles, clock=clock), clock
+
+
+class TestRouting:
+    def test_pods_route_by_scheduler_name(self):
+        sched, _ = mk_multi(make_tpu_node("a", chips=4))
+        p1 = Pod("p1", labels={"scv/number": "1"},
+                 scheduler_name="yoda-scheduler")
+        p2 = Pod("p2", labels={"scv/number": "1"},
+                 scheduler_name="yoda-scheduler2")
+        assert sched.submit(p1) and sched.submit(p2)
+        sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND and p2.phase == PodPhase.BOUND
+        # each engine scheduled exactly its own pod
+        assert sched.engine("yoda-scheduler").metrics.counters[
+            "pods_submitted_total"] == 1
+        assert sched.engine("yoda-scheduler2").metrics.counters[
+            "pods_submitted_total"] == 1
+
+    def test_unmatched_name_is_rejected(self):
+        sched, _ = mk_multi(make_tpu_node("a", chips=4))
+        p = Pod("p", labels={}, scheduler_name="somebody-else")
+        assert not sched.submit(p)
+        assert p.phase == PodPhase.PENDING
+
+    def test_duplicate_profile_names_raise(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            mk_multi(make_tpu_node("a"),
+                     profiles=[(SchedulerConfig(), None),
+                               (SchedulerConfig(), None)])
+
+
+class TestSharedLedger:
+    def test_profiles_never_double_book_chips(self):
+        # 2 nodes x 4 chips; 4 pods x 2 chips split across two profiles —
+        # every chip may be claimed at most once
+        sched, _ = mk_multi(make_tpu_node("a", chips=4),
+                            make_tpu_node("b", chips=4))
+        pods = []
+        for i, name in enumerate(["yoda-scheduler", "yoda-scheduler2"] * 2):
+            p = Pod(f"p{i}", labels={"scv/number": "2"}, scheduler_name=name)
+            pods.append(p)
+            assert sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        claims = []
+        for p in pods:
+            for c in p.labels["tpu/assigned-chips"].split(";"):
+                claims.append((p.node, c))
+        assert len(claims) == 8
+        assert len(set(claims)) == 8, "a chip was double-booked"
+        assert sched.bin_pack_utilization() == 100.0
+
+    def test_oversubscription_fails_on_one_profile_not_both(self):
+        # 4 chips total; 3 pods x 2 chips: exactly one pod cannot fit
+        cfgs = [(SchedulerConfig(max_attempts=2), None),
+                (SchedulerConfig(scheduler_name="yoda-scheduler2",
+                                 max_attempts=2), None)]
+        sched, _ = mk_multi(make_tpu_node("a", chips=4), profiles=cfgs)
+        pods = [
+            Pod("p0", labels={"scv/number": "2"},
+                scheduler_name="yoda-scheduler"),
+            Pod("p1", labels={"scv/number": "2"},
+                scheduler_name="yoda-scheduler2"),
+            Pod("p2", labels={"scv/number": "2"},
+                scheduler_name="yoda-scheduler"),
+        ]
+        for p in pods:
+            assert sched.submit(p)
+        sched.run_until_idle()
+        bound = [p for p in pods if p.phase == PodPhase.BOUND]
+        assert len(bound) == 2
+
+
+class TestConfigLoading:
+    def test_load_profiles_parses_all(self, tmp_path):
+        from yoda_scheduler_tpu.cli import load_profiles
+
+        cfg = {
+            "profiles": [
+                {"schedulerName": "alpha"},
+                {"schedulerName": "beta",
+                 "pluginConfig": [{"name": "yoda-tpu",
+                                   "args": {"topologyWeight": 9}}]},
+            ]
+        }
+        path = tmp_path / "cfg.yaml"
+        import yaml
+        path.write_text(yaml.safe_dump(cfg))
+        profiles = load_profiles(str(path))
+        assert [c.scheduler_name for c, _ in profiles] == ["alpha", "beta"]
+        assert profiles[1][0].topology_weight == 9
+
+    def test_cli_simulate_serves_both_reference_names(self, tmp_path,
+                                                      capsys):
+        # the reference's mismatched examples (test-pod ->
+        # yoda-scheduler2, test-deployment -> yoda-scheduler) both bind
+        # when both profiles are served
+        import yaml
+        from yoda_scheduler_tpu.cli import main
+
+        cfgfile = tmp_path / "cfg.yaml"
+        cfgfile.write_text(yaml.safe_dump({
+            "profiles": [{"schedulerName": "yoda-scheduler"},
+                         {"schedulerName": "yoda-scheduler2"}]}))
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "ref-pod",
+                            "labels": {"scv/number": "1"}},
+               "spec": {"schedulerName": "yoda-scheduler2"}}
+        dep = {"apiVersion": "apps/v1", "kind": "Deployment",
+               "metadata": {"name": "ref-deploy"},
+               "spec": {"replicas": 2, "template": {
+                   "metadata": {"labels": {"scv/memory": "1000"}},
+                   "spec": {"schedulerName": "yoda-scheduler"}}}}
+        m1, m2 = tmp_path / "pod.yaml", tmp_path / "dep.yaml"
+        m1.write_text(yaml.safe_dump(pod))
+        m2.write_text(yaml.safe_dump(dep))
+        rc = main(["simulate", str(m1), str(m2), "--config", str(cfgfile),
+                   "--tpu-nodes", "2", "--tpu-slices", "0",
+                   "--gpu-nodes", "0"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["bound"] == 3

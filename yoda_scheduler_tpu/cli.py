@@ -23,43 +23,43 @@ import logging
 import sys
 import time
 
-from .scheduler import FakeCluster, Scheduler, SchedulerConfig
-from .scheduler.registry import build_profile
+from .scheduler import FakeCluster, SchedulerConfig
 from .telemetry import FakePublisher, TelemetryStore, make_gpu_node, make_tpu_node, make_v4_slice
 from .utils.pod import Pod, PodPhase
 
 log = logging.getLogger("yoda-tpu")
 
 
-def load_config(path: str | None) -> tuple[SchedulerConfig, dict | None]:
-    """Load (SchedulerConfig, plugin-enablement dict) from a scheduler
-    config YAML; defaults when path is None."""
+def load_profiles(path: str | None) -> list[tuple[SchedulerConfig, dict | None]]:
+    """Load every profile from a KubeSchedulerConfiguration-style YAML as
+    (SchedulerConfig, plugin-enablement) pairs; one default profile when
+    path is None. Upstream kube-scheduler serves ALL profiles in the list,
+    routing pods by spec.schedulerName — so do we (scheduler/multi.py)."""
     if path is None:
-        return SchedulerConfig(), None
+        return [(SchedulerConfig(), None)]
     import yaml
+
+    from .scheduler.registry import merge_enablement
 
     with open(path) as f:
         doc = yaml.safe_load(f) or {}
-    profiles = doc.get("profiles") or [{}]
-    profile = profiles[0]
-    cfg = SchedulerConfig.from_profile(profile)
-    plugins = profile.get("plugins")
-    if not plugins:
-        return cfg, None
-    from .scheduler.registry import merge_enablement
-
-    # defaults stay enabled at unlisted extension points (k8s semantics);
-    # use disabled: [{name: '*'}] to clear a point
-    return cfg, merge_enablement(plugins)
+    out = []
+    for profile in doc.get("profiles") or [{}]:
+        cfg = SchedulerConfig.from_profile(profile)
+        plugins = profile.get("plugins")
+        # defaults stay enabled at unlisted extension points (k8s
+        # semantics); use disabled: [{name: '*'}] to clear a point
+        out.append((cfg, merge_enablement(plugins) if plugins else None))
+    return out
 
 
-def _build_scheduler(cfg: SchedulerConfig, enabled, cluster) -> Scheduler:
-    profile = build_profile(cfg, enabled) if enabled else None
-    return Scheduler(cluster, cfg, profile=profile)
+def load_config(path: str | None) -> tuple[SchedulerConfig, dict | None]:
+    """First profile only (legacy single-profile callers)."""
+    return load_profiles(path)[0]
 
 
 def cmd_simulate(args) -> int:
-    cfg, enabled = load_config(args.config)
+    profiles = load_profiles(args.config)
     store = TelemetryStore()
     pub = FakePublisher(store)
 
@@ -75,12 +75,16 @@ def cmd_simulate(args) -> int:
 
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
-    sched = _build_scheduler(cfg, enabled, cluster)
+    from .scheduler.multi import MultiProfileScheduler
+
+    sched = MultiProfileScheduler(cluster, profiles)
 
     if args.metrics_port is not None:
         from .utils.httpserv import serve
 
-        server, _ = serve(sched.metrics, sched.traces, port=args.metrics_port)
+        # merged view: every profile's counters/latencies/traces
+        server, _ = serve(sched.metrics, sched.traces,
+                          port=args.metrics_port)
         log.info("metrics on http://%s:%d/metrics", *server.server_address)
 
     pods: list[Pod] = []
@@ -110,8 +114,8 @@ def cmd_simulate(args) -> int:
                         pods.append(p)
 
     accepted = sum(sched.submit(p) for p in pods)
-    log.info("submitted %d/%d pods (schedulerName=%s)", accepted, len(pods),
-             cfg.scheduler_name)
+    log.info("submitted %d/%d pods (profiles=%s)", accepted, len(pods),
+             list(sched.engines))
     sched.run_until_idle(max_cycles=args.max_cycles)
 
     out = {
@@ -122,8 +126,8 @@ def cmd_simulate(args) -> int:
         },
         "bound": sum(1 for p in pods if p.phase == PodPhase.BOUND),
         "bin_pack_util_pct": round(sched.bin_pack_utilization(), 2),
-        "p50_latency_ms": round(
-            sched.metrics.histogram("schedule_latency_ms").quantile(0.5), 3),
+        "p50_latency_ms": round(sched.metrics.histogram(
+            "schedule_latency_ms").quantile(0.5), 3),
     }
     print(json.dumps(out, indent=2))
     if args.serve_forever:
@@ -140,7 +144,7 @@ def cmd_sniff(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    cfg, enabled = load_config(args.config)
+    profiles = load_profiles(args.config)
     from .k8s.client import KubeClient, run_scheduler_against_cluster
 
     client = KubeClient.from_env(args.kubeconfig, args.apiserver)
@@ -149,7 +153,7 @@ def cmd_serve(args) -> int:
                   "the in-memory cluster")
         return 2
     return run_scheduler_against_cluster(
-        client, cfg, enabled, metrics_port=args.metrics_port,
+        client, profiles, metrics_port=args.metrics_port,
         leader_elect=args.leader_elect)
 
 

@@ -253,15 +253,20 @@ class KubeCluster:
         pod.phase = PodPhase.PENDING
 
 
-def run_scheduler_against_cluster(client: KubeClient, config, enabled=None,
+def run_scheduler_against_cluster(client: KubeClient, profiles,
                                   metrics_port: int | None = 10251,
                                   leader_elect: bool = False,
                                   poll_s: float = 1.0,
                                   stop_event: threading.Event | None = None) -> int:
-    """The serve loop: leader-elect (optional), watch pending pods, run
-    scheduling cycles, bind through the API server."""
-    from ..scheduler.core import Scheduler
-    from ..scheduler.registry import build_profile
+    """The serve loop: leader-elect (optional), watch pending pods for
+    EVERY configured profile, run scheduling cycles, bind through the API
+    server. `profiles` is a list of (SchedulerConfig, enablement) pairs
+    (cli.load_profiles); a bare (config, enabled) pair is accepted for
+    legacy callers."""
+    from ..scheduler.multi import MultiProfileScheduler
+
+    if profiles and not isinstance(profiles, list):
+        profiles = [(profiles, None)]
 
     stop = stop_event or threading.Event()
     if leader_elect:
@@ -275,8 +280,7 @@ def run_scheduler_against_cluster(client: KubeClient, config, enabled=None,
     telemetry = TelemetryStore()
     cluster = KubeCluster(client, telemetry)
     cluster.start()
-    profile = build_profile(config, enabled) if enabled else None
-    sched = Scheduler(cluster, config, profile=profile)
+    sched = MultiProfileScheduler(cluster, profiles)
 
     if metrics_port is not None:
         from ..utils.httpserv import serve
@@ -287,11 +291,13 @@ def run_scheduler_against_cluster(client: KubeClient, config, enabled=None,
     # recreated under the same name arrives with a new uid and must be
     # scheduled afresh; entries for vanished pods are pruned every poll.
     seen: dict[str, str] = {}
-    log.info("scheduler %s serving against %s", config.scheduler_name,
-             client.base_url)
+    log.info("scheduler profiles %s serving against %s",
+             list(sched.engines), client.base_url)
     while not stop.is_set():
         try:
-            pending = client.list_pending_pods(config.scheduler_name)
+            pending = []
+            for name in sched.engines:
+                pending += client.list_pending_pods(name)
             pending_keys = {p.key for p in pending}
             for pod in pending:
                 if sched.tracks(pod.key):
@@ -301,19 +307,18 @@ def run_scheduler_against_cluster(client: KubeClient, config, enabled=None,
                     # this incarnation was already handled (bound moments ago
                     # and the listing is stale, or permanently failed)
                     continue
-                sched.failed.pop(pod.key, None)  # new incarnation resets failure
+                for e in sched.engines.values():
+                    e.failed.pop(pod.key, None)  # new incarnation resets
                 seen[pod.key] = pod.k8s_uid
                 sched.submit(pod)
             for key in list(seen):
                 if key not in pending_keys and not sched.tracks(key):
                     seen.pop(key, None)
-                    sched.failed.pop(key, None)
-            sched.check_waiting()
-            info = sched.queue.pop()
-            if info is None:
+                    for e in sched.engines.values():
+                        e.failed.pop(key, None)
+            if not any(e.run_one() is not None
+                       for e in sched.engines.values()):
                 stop.wait(poll_s)
-                continue
-            sched.schedule_one(info)
         except Exception as e:
             log.error("cycle error: %s", e)
             stop.wait(poll_s)

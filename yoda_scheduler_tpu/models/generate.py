@@ -34,7 +34,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .llama import LlamaConfig, rms_norm
+from .llama import LlamaConfig, _mlp_block, rms_norm, rotary
 
 
 @dataclass(frozen=True)
@@ -56,19 +56,6 @@ class KVCache:
 
 jax.tree_util.register_dataclass(
     KVCache, data_fields=["k", "v", "length"], meta_fields=[])
-
-
-def _rotary_at(x, positions, theta: float):
-    """RoPE for [B, S, H, hd] at absolute `positions` [B, S] (fp32 inside)."""
-    b, s, h, hd = x.shape
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
-    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,S,hd/2]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    xf = x.astype(jnp.float32)
-    x1, x2 = xf[..., 0::2], xf[..., 1::2]
-    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.reshape(b, s, h, hd).astype(x.dtype)
 
 
 def _cached_attention(q, k_cache, v_cache, q_positions, cache_len):
@@ -119,24 +106,15 @@ def _forward_with_cache(params, tokens, positions, cache: KVCache,
         q = (xn @ layer["wq"]).reshape(b, s, h, hd)
         k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
         v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
-        q = _rotary_at(q, positions, config.rope_theta)
-        k = _rotary_at(k, positions, config.rope_theta)
+        q = rotary(q, config.rope_theta, positions)
+        k = rotary(k, config.rope_theta, positions)
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k, (0, cache.length, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v, (0, cache.length, 0, 0))
         o = _cached_attention(q, k_cache, v_cache, positions, new_len)
         x = x + o.reshape(b, s, h * hd) @ layer["wo"]
-        xn = rms_norm(x, layer["mlp_norm"], config.norm_eps)
-        if config.is_moe:
-            from .moe import moe_ffn
-            y, _ = moe_ffn(xn, layer, config.num_experts,
-                           config.experts_per_token,
-                           config.expert_capacity_factor)
-            x = x + y
-        else:
-            gate = jax.nn.silu((xn @ layer["w_gate"]).astype(jnp.float32))
-            x = x + (gate.astype(x.dtype) * (xn @ layer["w_up"])) @ layer["w_down"]
+        x, _ = _mlp_block(x, layer, config)  # same FFN as training
         return (x,), (k_cache, v_cache)
 
     (x,), (new_k, new_v) = jax.lax.scan(

@@ -116,14 +116,17 @@ def rms_norm(x, weight, eps: float):
     return (xf * rms * weight).astype(x.dtype)
 
 
-def rotary(x, theta: float):
-    """Apply RoPE to [B, S, H, hd] (fp32 internally)."""
+def rotary(x, theta: float, positions=None):
+    """Apply RoPE to [B, S, H, hd] (fp32 internally). `positions` [B, S]
+    gives absolute token positions (KV-cache decode, models/generate.py);
+    None means 0..S-1 (training/full forward)."""
     b, s, h, hd = x.shape
-    pos = jnp.arange(s, dtype=jnp.float32)
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.float32)[None, :]  # [1, S]
     inv_freq = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
-    angles = pos[:, None] * inv_freq[None, :]           # [S, hd/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B?,S,hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., 0::2], xf[..., 1::2]
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)

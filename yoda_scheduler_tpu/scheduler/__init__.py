@@ -17,6 +17,7 @@ from .framework import (
 )
 from .config import SchedulerConfig, ScoreWeights
 from .core import Scheduler
+from .multi import MultiProfileScheduler
 from .cluster import FakeCluster
 
 __all__ = [
@@ -38,5 +39,6 @@ __all__ = [
     "SchedulerConfig",
     "ScoreWeights",
     "Scheduler",
+    "MultiProfileScheduler",
     "FakeCluster",
 ]

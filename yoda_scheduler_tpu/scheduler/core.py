@@ -130,11 +130,18 @@ class Profile:
         self.bind = bind
 
 
-def default_profile(config: SchedulerConfig) -> tuple[Profile, ChipAllocator, GangPermit]:
+def default_profile(config: SchedulerConfig,
+                    allocator: ChipAllocator | None = None,
+                    gangs: GangCoordinator | None = None,
+                    ) -> tuple[Profile, ChipAllocator, GangPermit]:
     """The yoda-tpu plugin set: telemetry filter/score (reference capability)
-    + topology scorer, chip allocator, gang permit, priority preemption."""
-    allocator = ChipAllocator()
-    gangs = GangCoordinator()
+    + topology scorer, chip allocator, gang permit, priority preemption.
+
+    `allocator`/`gangs` may be shared instances: co-hosted profiles
+    (multi.py) must see each other's pending reservations or they would
+    double-book chips between Reserve and Bind."""
+    allocator = allocator or ChipAllocator()
+    gangs = gangs or GangCoordinator()
     gang_permit = GangPermit(gangs, timeout_s=config.gang_timeout_s)
     topo = TopologyScore(allocator, weight=config.topology_weight)
     profile = Profile(
@@ -469,31 +476,44 @@ class Scheduler:
         self.queue.requeue_backoff(w.info, now=self.clock.time())
 
     # -------------------------------------------------------------- main loop
+    def run_one(self) -> str | None:
+        """One scheduling cycle: expire parked gangs, pop the next ready
+        pod, schedule it. Returns the cycle outcome, or None when nothing
+        is ready (queue empty, everyone backing off, or parked at Permit) —
+        callers decide how to wait (next_wake_at)."""
+        self.check_waiting()
+        info = self.queue.pop(now=self.clock.time())
+        if info is None:
+            return None
+        started = self.clock.time()
+        outcome = self.schedule_one(info)
+        self.metrics.observe("cycle_latency_ms",
+                             (self.clock.time() - started) * 1e3)
+        return outcome
+
+    def next_wake_at(self) -> float | None:
+        """Earliest future instant at which run_one could make progress:
+        the nearest gang-permit deadline or backoff expiry. None = idle."""
+        wakes = []
+        if self.waiting:
+            wakes.append(min(w.deadline for w in self.waiting.values()))
+        nxt = self.queue.next_ready_at()
+        if nxt is not None:
+            wakes.append(nxt)
+        return min(wakes) if wakes else None
+
     def run_until_idle(self, max_cycles: int = 100_000) -> int:
         """Drive cycles until no pending work remains (tests/bench harness).
         Returns the number of cycles executed."""
         cycles = 0
         while cycles < max_cycles:
-            self.check_waiting()
-            info = self.queue.pop(now=self.clock.time())
-            if info is None:
-                if self.waiting:
-                    # park until the nearest gang deadline
-                    next_deadline = min(w.deadline for w in self.waiting.values())
-                    nxt = self.queue.next_ready_at()
-                    wake = next_deadline if nxt is None else min(next_deadline, nxt)
-                    self.clock.sleep(max(wake - self.clock.time(), 0.01))
-                    cycles += 1
-                    continue
-                nxt = self.queue.next_ready_at()
-                if nxt is None:
-                    break  # fully idle
-                self.clock.sleep(max(nxt - self.clock.time(), 0.01))
+            if self.run_one() is not None:
                 cycles += 1
                 continue
-            started = self.clock.time()
-            self.schedule_one(info)
-            self.metrics.observe("cycle_latency_ms", (self.clock.time() - started) * 1e3)
+            wake = self.next_wake_at()
+            if wake is None:
+                break  # fully idle
+            self.clock.sleep(max(wake - self.clock.time(), 0.01))
             cycles += 1
         return cycles
 

@@ -29,9 +29,13 @@ class ChipAllocator(ReservePlugin):
         # per-node free-set cache, keyed by (NodeInfo.serial, pending
         # version): NodeInfos persist across cycles while a node is
         # untouched (core.snapshot), so the free set does too; any
-        # reserve/unreserve/complete on the node bumps its version
+        # reserve/unreserve/complete on the node bumps its version. A few
+        # slots per node, because co-hosted profiles (multi.py) share this
+        # allocator but hold distinct NodeInfos (distinct serials) for the
+        # same node — one slot would thrash between engines.
         self._pending_ver: dict[str, int] = {}
-        self._free_cache: dict[str, tuple[tuple[int, int], set[Coord]]] = {}
+        self._free_cache: dict[str, dict[tuple[int, int], set[Coord]]] = {}
+        self._free_cache_slots = 4
 
     def _bump(self, node: str) -> None:
         self._pending_ver[node] = self._pending_ver.get(node, 0) + 1
@@ -63,16 +67,19 @@ class ChipAllocator(ReservePlugin):
         untouched between cycles."""
         with self._lock:
             key = (node_info.serial, self._pending_ver.get(node_info.name, 0))
-            cached = self._free_cache.get(node_info.name)
-            if cached is not None and cached[0] == key:
-                return cached[1]
+            slot = self._free_cache.get(node_info.name)
+            if slot is not None and key in slot:
+                return slot[key]
         m = node_info.metrics
         if m is None:
             return set()
         free = (m.healthy_coords() - node_info.assigned_coords()
                 - self.pending_on(node_info.name))
         with self._lock:
-            self._free_cache[node_info.name] = (key, free)
+            slot = self._free_cache.setdefault(node_info.name, {})
+            slot[key] = free
+            while len(slot) > self._free_cache_slots:
+                slot.pop(next(iter(slot)))  # evict oldest (insertion order)
         return free
 
     def assignment_of(self, pod: Pod) -> tuple[str, list[Coord]] | None:

@@ -94,14 +94,17 @@ def merge_enablement(user: dict[str, dict] | None) -> dict[str, list[str]]:
 
 
 def build_profile(config: SchedulerConfig,
-                  enabled: dict[str, list[str]] | None = None) -> Profile:
+                  enabled: dict[str, list[str]] | None = None,
+                  allocator: ChipAllocator | None = None,
+                  gangs: GangCoordinator | None = None) -> Profile:
     """Build a Profile. `enabled` maps extension point -> plugin names (the
-    KubeSchedulerConfiguration `plugins:` block); None = the default set."""
+    KubeSchedulerConfiguration `plugins:` block); None = the default set.
+    `allocator`/`gangs` may be shared across co-hosted profiles (multi.py)."""
     if enabled is None:
-        profile, _, _ = default_profile(config)
+        profile, _, _ = default_profile(config, allocator, gangs)
         return profile
-    alloc = ChipAllocator()
-    gangs = GangCoordinator()
+    alloc = allocator or ChipAllocator()
+    gangs = gangs or GangCoordinator()
     built: dict[str, object] = {}
 
     def get(name: str):

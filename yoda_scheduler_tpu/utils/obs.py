@@ -68,6 +68,11 @@ class Histogram:
         idx = min(int(q * len(xs)), len(xs) - 1)
         return xs[idx]
 
+    def samples(self) -> list[float]:
+        """Retained raw observations (newest keep_values), for cross-
+        histogram aggregation (e.g. one quantile over several profiles)."""
+        return list(self._values)
+
 
 class Metrics:
     def __init__(self) -> None:
