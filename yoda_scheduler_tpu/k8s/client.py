@@ -261,12 +261,8 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
     """The serve loop: leader-elect (optional), watch pending pods for
     EVERY configured profile, run scheduling cycles, bind through the API
     server. `profiles` is a list of (SchedulerConfig, enablement) pairs
-    (cli.load_profiles); a bare (config, enabled) pair is accepted for
-    legacy callers."""
+    (cli.load_profiles)."""
     from ..scheduler.multi import MultiProfileScheduler
-
-    if profiles and not isinstance(profiles, list):
-        profiles = [(profiles, None)]
 
     stop = stop_event or threading.Event()
     if leader_elect:
@@ -316,8 +312,10 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
                     seen.pop(key, None)
                     for e in sched.engines.values():
                         e.failed.pop(key, None)
-            if not any(e.run_one() is not None
-                       for e in sched.engines.values()):
+            # run every engine each pass (a generator inside any() would
+            # short-circuit and starve later profiles behind a busy first)
+            outcomes = [e.run_one() for e in sched.engines.values()]
+            if all(o is None for o in outcomes):
                 stop.wait(poll_s)
         except Exception as e:
             log.error("cycle error: %s", e)

@@ -24,6 +24,7 @@ kube-scheduler parity details implemented natively:
 from __future__ import annotations
 
 import random
+import threading
 import time
 
 from .cluster import FakeCluster
@@ -174,6 +175,7 @@ class Scheduler:
         config: SchedulerConfig | None = None,
         profile: Profile | None = None,
         clock: Clock | None = None,
+        cycle_lock: "threading.RLock | None" = None,
     ) -> None:
         self.cluster = cluster
         self.config = config or SchedulerConfig()
@@ -205,6 +207,9 @@ class Scheduler:
         # snapshot() for the cross-cycle reuse contract
         self._ni_cache: dict[str, tuple[tuple, NodeInfo]] = {}
         self._known_nodes: set[str] = set()
+        # shared across co-hosted profiles (multi.py) to serialize cycles;
+        # private (uncontended) when this engine runs alone
+        self.cycle_lock = cycle_lock or threading.RLock()
 
     # ----------------------------------------------------------------- intake
     def submit(self, pod: Pod) -> bool:
@@ -270,6 +275,15 @@ class Scheduler:
 
     # ------------------------------------------------------------- the cycle
     def schedule_one(self, info: QueuedPodInfo) -> str:
+        """One pod's cycle. Serialized via cycle_lock: a cycle snapshots the
+        cluster, then reserves/binds against that snapshot — a concurrent
+        bind from a co-hosted profile's engine between the two would
+        double-book chips (upstream kube-scheduler likewise runs ONE
+        scheduleOne loop across all profiles)."""
+        with self.cycle_lock:
+            return self._schedule_one_locked(info)
+
+    def _schedule_one_locked(self, info: QueuedPodInfo) -> str:
         pod = info.pod
         now = self.clock.time()
         trace = CycleTrace(pod=pod.key, started=now)

@@ -20,6 +20,8 @@ upstream's one-pod-at-a-time scheduling cycle across profiles.
 
 from __future__ import annotations
 
+import threading
+
 from .cluster import FakeCluster
 from .config import SchedulerConfig
 from .core import Clock, Scheduler, default_profile
@@ -45,9 +47,14 @@ class MultiProfileScheduler:
             raise ValueError(f"duplicate schedulerName(s): {sorted(dupes)}")
         self.cluster = cluster
         self.clock = clock or Clock()
-        # shared across profiles: reservations + gang state are cluster-wide
+        # shared across profiles: reservations + gang state are cluster-wide,
+        # and scheduling cycles are serialized (upstream kube-scheduler runs
+        # one scheduleOne loop over all profiles) — without the shared lock,
+        # an engine could reserve from a snapshot taken before a co-hosted
+        # engine's bind and double-book chips
         self.allocator = ChipAllocator()
         self.gangs = GangCoordinator()
+        self._cycle_lock = threading.RLock()
         self.engines: dict[str, Scheduler] = {}
         for cfg, enabled in profiles:
             if enabled is None:
@@ -57,7 +64,8 @@ class MultiProfileScheduler:
                 profile = build_profile(cfg, enabled, self.allocator,
                                         self.gangs)
             self.engines[cfg.scheduler_name] = Scheduler(
-                cluster, cfg, profile=profile, clock=self.clock)
+                cluster, cfg, profile=profile, clock=self.clock,
+                cycle_lock=self._cycle_lock)
 
     # ------------------------------------------------------------------ intake
     def submit(self, pod: Pod) -> bool:
@@ -131,8 +139,7 @@ class _MergedMetricsView:
             for k, v in e.metrics.gauges.items():
                 out.set_gauge(k, v)
             for k, h in e.metrics.histograms.items():
-                for v in h.samples():
-                    out.observe(k, v)
+                out.histogram(k).merge_from(h)
         return out
 
     def render_prometheus(self, prefix: str = "yoda_tpu") -> str:

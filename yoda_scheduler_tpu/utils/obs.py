@@ -73,6 +73,18 @@ class Histogram:
         histogram aggregation (e.g. one quantile over several profiles)."""
         return list(self._values)
 
+    def merge_from(self, other: "Histogram") -> None:
+        """Fold another histogram in (cross-profile aggregation): O(buckets)
+        instead of replaying every retained sample through observe()."""
+        if other.bounds == self.bounds:
+            self.counts = [a + b for a, b in zip(self.counts, other.counts)]
+            self.total += other.total
+            self.n += other.n
+            self._values.extend(other._values)
+        else:  # different bucketing: replay is the only faithful merge
+            for v in other.samples():
+                self.observe(v)
+
 
 class Metrics:
     def __init__(self) -> None:
