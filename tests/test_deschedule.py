@@ -1,0 +1,154 @@
+"""Descheduler (scheduler/deschedule.py): slice defragmentation must free
+blocked gang slices, never strand a pod, and respect its safety rails."""
+
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.scheduler.deschedule import Descheduler
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node, make_v4_slice
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk(*nodes, config=None):
+    store = TelemetryStore()
+    clock = FakeClock(start=1000.0)
+    for n in nodes:
+        n.heartbeat = clock.time()
+        store.put(n)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, config or SchedulerConfig(max_attempts=3),
+                      clock=clock)
+    return sched
+
+
+def refresh(sched):
+    """Re-stamp heartbeats against the fake clock (the sniffer daemon's
+    periodic publish) — gang timeouts advance the clock past max age."""
+    for m in sched.cluster.telemetry.list():
+        m.heartbeat = sched.clock.time()
+        sched.cluster.telemetry.put(m)
+
+
+def gang_pods(name, size, chips=4):
+    return [Pod(f"{name}-w{i}", labels={
+        "tpu/gang-name": name, "tpu/gang-size": str(size),
+        "scv/number": str(chips), "tpu/accelerator": "tpu"})
+        for i in range(size)]
+
+
+class TestSliceConservation:
+    def test_stray_pod_moves_off_slice_then_gang_fits(self):
+        nodes = make_v4_slice("s1", "2x2x4") + [make_tpu_node("solo", chips=4)]
+        sched = mk(*nodes)
+        n_hosts = len(nodes) - 1
+        # a small low-priority pod lands on the slice (force it there)
+        stray = Pod("stray", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+        slice_node = nodes[0].node
+        sched.cluster.bind(stray, slice_node, [(0, 0, 0)])
+        # the whole-slice gang cannot fit: one host is dented
+        gang = gang_pods("g", n_hosts)
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert not all(p.phase == PodPhase.BOUND for p in gang)
+
+        refresh(sched)
+        desched = Descheduler(sched)
+        plan = desched.run_once()
+        assert [p.key for p in plan.victims] == ["default/stray"]
+        assert "gang slice s1" in plan.reasons["default/stray"]
+        sched.run_until_idle()
+        refresh(sched)
+        # stray re-placed on the standalone node, slice now whole
+        assert stray.phase == PodPhase.BOUND and stray.node == "solo"
+        # the gang binds on its clean slice
+        gang2 = gang_pods("g2", n_hosts)
+        for p in gang2:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang2)
+
+    def test_high_priority_pod_is_protected(self):
+        nodes = make_v4_slice("s1", "2x2x4") + [make_tpu_node("solo", chips=4)]
+        sched = mk(*nodes)
+        vip = Pod("vip", labels={"scv/number": "1", "scv/priority": "9",
+                                 "tpu/accelerator": "tpu"})
+        sched.cluster.bind(vip, nodes[0].node, [(0, 0, 0)])
+        assert not Descheduler(sched, protect_priority=5).plan()
+
+    def test_gang_members_are_never_victims(self):
+        nodes = make_v4_slice("s1", "2x2x4")
+        sched = mk(*nodes)
+        gang = gang_pods("g", len(nodes))
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang)
+        assert not Descheduler(sched).plan()
+
+    def test_no_eviction_when_nowhere_else_fits(self):
+        # only the slice exists; evicting would strand the pod
+        nodes = make_v4_slice("s1", "2x2x4")
+        sched = mk(*nodes)
+        stray = Pod("stray", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+        sched.cluster.bind(stray, nodes[0].node, [(0, 0, 0)])
+        assert not Descheduler(sched).plan()
+
+    def test_eviction_budget_caps_a_pass(self):
+        nodes = make_v4_slice("s1", "2x2x4") + [
+            make_tpu_node(f"solo{i}", chips=4) for i in range(4)]
+        sched = mk(*nodes)
+        n_hosts = len(nodes) - 4
+        for i in range(min(4, n_hosts)):
+            p = Pod(f"stray{i}", labels={"scv/number": "1",
+                                         "tpu/accelerator": "tpu"})
+            sched.cluster.bind(p, nodes[i].node, [(0, 0, 0)])
+        plan = Descheduler(sched, max_evictions_per_pass=2).plan()
+        assert len(plan.victims) == 2
+
+    def test_maximally_contiguous_node_is_not_churned(self):
+        # 3 free chips on a 2x2 board cannot form a volume-3 box; they are
+        # already as contiguous as the shape allows — no eviction loop
+        sched = mk(make_tpu_node("a", chips=4), make_tpu_node("b", chips=4))
+        for node in ("a", "b"):
+            p = Pod(f"stray-{node}", labels={"scv/number": "1",
+                                             "tpu/accelerator": "tpu"})
+            sched.cluster.bind(p, node, [(0, 0, 0)])
+        assert not Descheduler(sched).plan()
+
+    def test_foreign_profile_pods_are_not_victims(self):
+        nodes = make_v4_slice("s1", "2x2x4") + [make_tpu_node("solo", chips=4)]
+        sched = mk(*nodes)
+        foreign = Pod("theirs", labels={"scv/number": "1",
+                                        "tpu/accelerator": "tpu"},
+                      scheduler_name="other-profile")
+        sched.cluster.bind(foreign, nodes[0].node, [(0, 0, 0)])
+        assert not Descheduler(sched).plan()
+
+    def test_two_victims_cannot_share_one_free_slot(self):
+        # two strays on the slice, but the only standalone destination has
+        # exactly one free chip -> plan must take one victim, not two
+        nodes = make_v4_slice("s1", "2x2x4") + [make_tpu_node("solo", chips=4)]
+        sched = mk(*nodes)
+        for i in range(2):
+            p = Pod(f"stray{i}", labels={"scv/number": "1",
+                                         "tpu/accelerator": "tpu"})
+            sched.cluster.bind(p, nodes[i].node, [(0, 0, 0)])
+        filler = Pod("filler", labels={"scv/number": "3", "scv/priority": "9",
+                                       "tpu/accelerator": "tpu"})
+        sched.cluster.bind(filler, "solo", [(0, 0, 0), (0, 1, 0), (1, 0, 0)])
+        plan = Descheduler(sched).plan()
+        assert len(plan.victims) == 1
+
+    def test_descheduled_metric_increments(self):
+        nodes = make_v4_slice("s1", "2x2x4") + [make_tpu_node("solo", chips=4)]
+        sched = mk(*nodes)
+        stray = Pod("stray", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+        sched.cluster.bind(stray, nodes[0].node, [(0, 0, 0)])
+        Descheduler(sched).run_once()
+        assert sched.metrics.counters["pods_descheduled_total"] == 1

@@ -18,6 +18,7 @@ from .framework import (
 from .config import SchedulerConfig, ScoreWeights
 from .core import Scheduler
 from .multi import MultiProfileScheduler
+from .deschedule import Descheduler, DeschedulePlan
 from .cluster import FakeCluster
 
 __all__ = [
@@ -40,5 +41,7 @@ __all__ = [
     "ScoreWeights",
     "Scheduler",
     "MultiProfileScheduler",
+    "Descheduler",
+    "DeschedulePlan",
     "FakeCluster",
 ]

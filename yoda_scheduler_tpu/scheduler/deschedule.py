@@ -1,0 +1,196 @@
+"""Descheduler: slice defragmentation by evict-and-reschedule.
+
+No counterpart in the reference (it only ever places; fragmentation
+accumulates until operators intervene). On TPU clusters fragmentation is
+the dominant waste: one stray single-chip pod on a multi-host pod-slice
+blocks every whole-slice gang, and scattered free chips on a board block
+`tpu/topology` block requests even when the free count is sufficient.
+This is the k8s-descheduler pattern (strategy passes that pick victims,
+evict, and let the scheduler re-place them) specialised to ICI topology.
+
+Strategies, in order:
+
+1. **Slice conservation**: a multi-host slice hosting only a few small
+   non-gang pods is a blocked gang target; if those pods fit elsewhere
+   (standalone nodes or already-dented slices), evict them.
+2. **Intra-node compaction**: a node whose free chips are scattered
+   (largest contiguous free block < free count) while a small resident
+   pod sits in the middle of the torus; re-placing that pod usually
+   reunites the block (the scheduler's best-fit Reserve does the rest).
+
+Safety rails, k8s-descheduler-style: never touch gang members or pods at
+or above `protect_priority`, never evict more than `max_evictions_per_pass`,
+and only evict what provably fits somewhere else RIGHT NOW (a dry-run
+through the live filter path) — a descheduler that strands pods is worse
+than fragmentation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .core import Scheduler
+from .plugins.allocator import _node_shape
+from ..topology.torus import best_fit_block
+from ..utils.labels import LabelError, spec_for
+from ..utils.pod import Pod
+
+
+@dataclass
+class DeschedulePlan:
+    """What a pass would do: victims + the reasons, for operators/tests."""
+    victims: list[Pod] = field(default_factory=list)
+    reasons: dict[str, str] = field(default_factory=dict)  # pod.key -> why
+
+    def __bool__(self) -> bool:
+        return bool(self.victims)
+
+
+class Descheduler:
+    def __init__(self, sched: Scheduler,
+                 protect_priority: int = 5,
+                 max_evictions_per_pass: int = 4) -> None:
+        self.sched = sched
+        self.protect_priority = protect_priority
+        self.max_evictions = max_evictions_per_pass
+
+    # ------------------------------------------------------------------ plan
+    def plan(self) -> DeschedulePlan:
+        plan = DeschedulePlan()
+        snapshot = self.sched.snapshot()
+        candidates: list[tuple[Pod, str, str]] = []  # (pod, node, reason)
+        for ni in snapshot.list():
+            m = ni.metrics
+            if m is None or m.accelerator != "tpu":
+                continue
+            movable = [p for p in ni.pods if self._movable(p)]
+            if not movable:
+                continue
+            if m.slice_id and m.num_hosts > 1:
+                # strategy 1: small non-gang pods denting a multi-host slice
+                for p in movable:
+                    candidates.append(
+                        (p, ni.name,
+                         f"frees gang slice {m.slice_id} ({m.num_hosts} hosts)"))
+            else:
+                # strategy 2: scattered free chips on a standalone node —
+                # fragmented iff the largest placeable block is smaller
+                # than what len(free) chips COULD form within this node's
+                # shape (3 free chips on a 2x2 board are already maximally
+                # contiguous: no volume-3 box fits, so nothing to gain)
+                free = self.sched.allocator.free_coords(ni)
+                if len(free) < 2:
+                    continue
+                shape = _node_shape(m)
+                achievable = _max_achievable_block(shape, len(free))
+                current = _largest_placeable_block(shape, free, achievable)
+                if current >= achievable:
+                    continue
+                for p in movable:
+                    candidates.append(
+                        (p, ni.name,
+                         f"defragments {ni.name}: largest free block "
+                         f"{current} < achievable {achievable}"))
+        # chips already promised to earlier victims of THIS plan, per
+        # destination — two victims must not be "proven" to fit in the
+        # same free slot
+        planned: dict[str, int] = {}
+        for pod, node, reason in candidates:
+            if len(plan.victims) >= self.max_evictions:
+                break
+            dest = self._fits_elsewhere(pod, node, snapshot, planned)
+            if dest is not None:
+                try:
+                    planned[dest] = planned.get(dest, 0) + spec_for(pod).chips
+                except LabelError:  # _movable already parsed it
+                    pass
+                plan.victims.append(pod)
+                plan.reasons[pod.key] = reason
+        return plan
+
+    def _movable(self, pod: Pod) -> bool:
+        if pod.scheduler_name != self.sched.config.scheduler_name:
+            # another profile's pod: evicting it here would strand it
+            # (our submit() rejects foreign schedulerNames)
+            return False
+        try:
+            spec = spec_for(pod)
+        except LabelError:
+            return False
+        if spec.is_gang:
+            return False  # moving one member breaks the gang
+        if spec.priority >= self.protect_priority:
+            return False
+        return True
+
+    def _fits_elsewhere(self, pod: Pod, current_node: str, snapshot,
+                        planned: dict[str, int]) -> str | None:
+        """Dry-run the live filter path: returns the name of a STANDALONE
+        node that accepts the pod as things stand (not counting space the
+        eviction itself frees, and not counting chips already promised to
+        earlier victims of this plan via `planned`). Multi-host slice
+        hosts are not destinations — moving a stray from one gang slice to
+        another (or around the same slice) just relocates the
+        fragmentation."""
+        from .framework import CycleState
+
+        state = CycleState()
+        state.write("now", self.sched.clock.time())
+        try:
+            spec = spec_for(pod)
+        except LabelError:
+            return None
+        state.write("workload_spec", spec)
+        for ni in snapshot.list():
+            if ni.name == current_node:
+                continue
+            m = ni.metrics
+            if m is None or (m.slice_id and m.num_hosts > 1):
+                continue
+            free = len(self.sched.allocator.free_coords(ni))
+            if free - planned.get(ni.name, 0) < spec.chips:
+                continue
+            ok = True
+            for f in self.sched.profile.filter:
+                if not f.filter(state, pod, ni).ok:
+                    ok = False
+                    break
+            if ok:
+                return ni.name
+        return None
+
+    # --------------------------------------------------------------- execute
+    def run_once(self) -> DeschedulePlan:
+        """Plan, evict, resubmit. Returns the executed plan. Evicted pods
+        re-enter the scheduling queue and re-place through the normal cycle
+        (chips label cleared by evict)."""
+        plan = self.plan()
+        for pod in plan.victims:
+            self.sched.cluster.evict(pod)
+            self.sched.metrics.inc("pods_descheduled_total")
+            if not self.sched.submit(pod):  # _movable guards this; belt and
+                self.sched.metrics.inc("deschedule_requeue_failed_total")
+        return plan
+
+
+def _max_achievable_block(shape: tuple[int, int, int], n: int) -> int:
+    """Largest rectangular-box volume <= n that fits within `shape` — the
+    contiguity ceiling n free chips could reach on this node."""
+    best = 0
+    sx, sy, sz = shape
+    for bx in range(1, sx + 1):
+        for by in range(1, sy + 1):
+            for bz in range(1, sz + 1):
+                v = bx * by * bz
+                if v <= n and v > best:
+                    best = v
+    return best
+
+
+def _largest_placeable_block(shape, free, upper: int) -> int:
+    """Largest box volume actually placeable in `free`, searching down from
+    `upper` (0 if even a single chip cannot be placed)."""
+    for k in range(upper, 0, -1):
+        if best_fit_block(shape, free, k) is not None:
+            return k
+    return 0
