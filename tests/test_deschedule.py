@@ -144,6 +144,39 @@ class TestSliceConservation:
         plan = Descheduler(sched).plan()
         assert len(plan.victims) == 1
 
+    def test_no_churn_when_protected_pod_causes_fragmentation(self):
+        # 2x4 node: a protected vip fills the middle rows; the movable
+        # stray's eviction cannot enlarge any free block beyond what its
+        # own chips already form -> it must not be victimised
+        sched = mk(make_tpu_node("a", chips=8), make_tpu_node("b", chips=8))
+        vip = Pod("vip", labels={"scv/number": "4", "scv/priority": "9",
+                                 "tpu/accelerator": "tpu"})
+        sched.cluster.bind(vip, "a", [(1, 0, 0), (0, 1, 0), (1, 1, 0),
+                                      (0, 2, 0)])
+        stray = Pod("stray", labels={"scv/number": "2",
+                                     "tpu/accelerator": "tpu"})
+        sched.cluster.bind(stray, "a", [(0, 3, 0), (1, 3, 0)])
+        # free: (0,0) and (1,2) — fragmented, but not the stray's doing
+        assert not Descheduler(sched).plan()
+
+    def test_cooldown_prevents_repeat_eviction(self):
+        nodes = make_v4_slice("s1", "2x2x4") + [make_tpu_node("solo", chips=4)]
+        sched = mk(*nodes)
+        stray = Pod("stray", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+        sched.cluster.bind(stray, nodes[0].node, [(0, 0, 0)])
+        d = Descheduler(sched, cooldown_s=300.0)
+        assert d.run_once()
+        # scheduler puts it back on the slice (simulating a re-placement)
+        sched.run_until_idle()
+        sched.cluster.evict(stray)
+        sched.cluster.bind(stray, nodes[0].node, [(0, 0, 0)])
+        refresh(sched)
+        assert not d.plan()          # within cooldown
+        sched.clock.advance(301.0)
+        refresh(sched)
+        assert d.plan()              # cooldown expired
+
     def test_descheduled_metric_increments(self):
         nodes = make_v4_slice("s1", "2x2x4") + [make_tpu_node("solo", chips=4)]
         sched = mk(*nodes)

@@ -120,3 +120,43 @@ def test_default_weights_match_reference():
     w = ScoreWeights()
     assert (w.bandwidth, w.clock, w.core, w.power, w.free_memory,
             w.total_memory, w.actual, w.allocate) == (1, 1, 1, 1, 2, 1, 2, 3)
+
+
+def test_heap_queue_orders_exactly_like_comparator():
+    import random
+
+    sort = PrioritySort()
+    rng = random.Random(7)
+    pods = [Pod(f"p{i}", labels={"scv/priority": str(rng.randint(0, 5))})
+            for i in range(50)]
+    scan = SchedulingQueue(sort.less)
+    heap = SchedulingQueue(sort.less, key=sort.key)
+    for i, p in enumerate(pods):
+        scan.add(p, now=float(i))
+        heap.add(p, now=float(i))
+    order_scan = [scan.pop(now=100.0).pod.name for _ in range(len(pods))]
+    order_heap = [heap.pop(now=100.0).pod.name for _ in range(len(pods))]
+    assert order_scan == order_heap
+
+
+def test_heap_queue_backoff_and_contains():
+    sort = PrioritySort()
+    q = SchedulingQueue(sort.less, key=sort.key, initial_backoff_s=1.0)
+    q.add(Pod("x"), now=0.0)
+    assert q.contains("default/x")
+    info = q.pop(now=0.0)
+    q.requeue_backoff(info, now=0.0)
+    assert q.contains("default/x")
+    assert q.pop(now=0.5) is None
+    assert q.pop(now=1.5).pod.name == "x"
+
+
+def test_config_deschedule_interval_from_profile():
+    from yoda_scheduler_tpu.scheduler import SchedulerConfig
+
+    cfg = SchedulerConfig.from_profile({
+        "schedulerName": "x",
+        "pluginConfig": [{"name": "yoda-tpu",
+                          "args": {"descheduleIntervalSeconds": 30}}]})
+    assert cfg.deschedule_interval_s == 30.0
+    assert SchedulerConfig().deschedule_interval_s == 0.0

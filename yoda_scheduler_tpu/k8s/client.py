@@ -249,8 +249,12 @@ class KubeCluster:
             if pod.node and pod.node in self._bound:
                 self._bound[pod.node] = [
                     p for p in self._bound[pod.node] if p.key != pod.key]
+        # match FakeCluster.evict's contract for the in-memory object: the
+        # deletion ends this incarnation's chip claim, so the stale label
+        # must not ride into any later spec/accounting of this Pod object
         pod.node = None
         pod.phase = PodPhase.PENDING
+        pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
 
 
 def run_scheduler_against_cluster(client: KubeClient, profiles,
@@ -283,6 +287,15 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
 
         serve(sched.metrics, sched.traces, host="0.0.0.0", port=metrics_port)
 
+    # periodic defragmentation per profile that opts in
+    # (descheduleIntervalSeconds > 0)
+    from ..scheduler.deschedule import Descheduler
+
+    deschedulers = [
+        (Descheduler(e), e.config.deschedule_interval_s, [0.0])
+        for e in sched.engines.values() if e.config.deschedule_interval_s > 0
+    ]
+
     # pod.key -> k8s uid of the incarnation we handled. A deleted pod
     # recreated under the same name arrives with a new uid and must be
     # scheduled afresh; entries for vanished pods are pruned every poll.
@@ -312,6 +325,14 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
                     seen.pop(key, None)
                     for e in sched.engines.values():
                         e.failed.pop(key, None)
+            for d, interval, last in deschedulers:
+                now = time.time()
+                if now - last[0] >= interval:
+                    last[0] = now
+                    plan = d.run_once()
+                    if plan:
+                        log.info("descheduled %d pods: %s",
+                                 len(plan.victims), plan.reasons)
             # run every engine each pass (a generator inside any() would
             # short-circuit and starve later profiles behind a busy first)
             outcomes = [e.run_one() for e in sched.engines.values()]

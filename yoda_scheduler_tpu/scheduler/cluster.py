@@ -31,6 +31,11 @@ class FakeCluster:
     """In-memory nodes + bound-pod book-keeping, with a telemetry store
     playing the role of the SCV CRD cache."""
 
+    # evict() here merely unbinds — the same Pod object can be resubmitted
+    # (descheduler local requeue). A real API server's evict is a DELETE,
+    # where the controller recreates a new incarnation instead.
+    supports_local_requeue = True
+
     def __init__(self, telemetry: TelemetryStore | None = None) -> None:
         self.telemetry = telemetry or TelemetryStore()
         self._lock = threading.RLock()

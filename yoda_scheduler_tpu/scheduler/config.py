@@ -59,6 +59,9 @@ class SchedulerConfig:
     # forever, the kube-scheduler posture; benches set a finite cap)
     max_attempts: int = 0
     rng_seed: int = 0
+    # periodic slice-defragmentation pass (scheduler/deschedule.py);
+    # 0 disables. Victim protection + budget use the descheduler defaults.
+    deschedule_interval_s: float = 0.0
 
     def with_(self, **kw) -> "SchedulerConfig":
         return replace(self, **kw)
@@ -84,6 +87,8 @@ class SchedulerConfig:
             gang_timeout_s=float(args.get("gangTimeoutSeconds", defaults.gang_timeout_s)),
             preemption=bool(args.get("preemption", defaults.preemption)),
             topology_weight=int(args.get("topologyWeight", defaults.topology_weight)),
+            deschedule_interval_s=float(args.get(
+                "descheduleIntervalSeconds", defaults.deschedule_interval_s)),
         )
 
 

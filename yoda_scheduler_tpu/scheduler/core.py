@@ -196,6 +196,7 @@ class Scheduler:
             profile.queue_sort.less,
             initial_backoff_s=self.config.pod_initial_backoff_s,
             max_backoff_s=self.config.pod_max_backoff_s,
+            key=getattr(profile.queue_sort, "key", None),
         )
         self.waiting: dict[str, _WaitingPod] = {}
         self.failed: dict[str, str] = {}  # pod.key -> permanent failure reason

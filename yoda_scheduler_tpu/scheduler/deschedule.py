@@ -11,18 +11,21 @@ evict, and let the scheduler re-place them) specialised to ICI topology.
 Strategies, in order:
 
 1. **Slice conservation**: a multi-host slice hosting only a few small
-   non-gang pods is a blocked gang target; if those pods fit elsewhere
-   (standalone nodes or already-dented slices), evict them.
-2. **Intra-node compaction**: a node whose free chips are scattered
-   (largest contiguous free block < free count) while a small resident
-   pod sits in the middle of the torus; re-placing that pod usually
-   reunites the block (the scheduler's best-fit Reserve does the rest).
+   non-gang pods is a blocked gang target; if those pods fit on a
+   STANDALONE node, evict them (slice hosts are never destinations —
+   that would just relocate the fragmentation).
+2. **Intra-node compaction**: a node whose largest placeable block is
+   smaller than what its free count could form, where evicting a small
+   resident pod would actually enlarge that block.
 
-Safety rails, k8s-descheduler-style: never touch gang members or pods at
-or above `protect_priority`, never evict more than `max_evictions_per_pass`,
-and only evict what provably fits somewhere else RIGHT NOW (a dry-run
-through the live filter path) — a descheduler that strands pods is worse
-than fragmentation.
+Safety rails, k8s-descheduler-style: never touch gang members, pods at
+or above `protect_priority`, or other profiles' pods; never evict more
+than `max_evictions_per_pass`; only evict what provably fits somewhere
+else RIGHT NOW (a dry-run through the live filter path, accounting chips
+already promised to earlier victims of the same plan); and a per-pod
+cooldown so a victim the scheduler places back into an equivalent spot
+is not churned every pass — a descheduler that strands or thrashes pods
+is worse than fragmentation.
 """
 
 from __future__ import annotations
@@ -49,10 +52,13 @@ class DeschedulePlan:
 class Descheduler:
     def __init__(self, sched: Scheduler,
                  protect_priority: int = 5,
-                 max_evictions_per_pass: int = 4) -> None:
+                 max_evictions_per_pass: int = 4,
+                 cooldown_s: float = 300.0) -> None:
         self.sched = sched
         self.protect_priority = protect_priority
         self.max_evictions = max_evictions_per_pass
+        self.cooldown_s = cooldown_s
+        self._recent: dict[str, float] = {}  # pod.key -> last eviction time
 
     # ------------------------------------------------------------------ plan
     def plan(self) -> DeschedulePlan:
@@ -77,7 +83,10 @@ class Descheduler:
                 # fragmented iff the largest placeable block is smaller
                 # than what len(free) chips COULD form within this node's
                 # shape (3 free chips on a 2x2 board are already maximally
-                # contiguous: no volume-3 box fits, so nothing to gain)
+                # contiguous: no volume-3 box fits, so nothing to gain),
+                # AND evicting the specific pod would actually enlarge the
+                # block (a hole caused by a protected neighbour is not this
+                # pod's fault — evicting around it churns for no benefit)
                 free = self.sched.allocator.free_coords(ni)
                 if len(free) < 2:
                     continue
@@ -87,17 +96,33 @@ class Descheduler:
                 if current >= achievable:
                     continue
                 for p in movable:
+                    chips = p.assigned_chips()
+                    union = free | chips
+                    better = _largest_placeable_block(
+                        shape, union,
+                        _max_achievable_block(shape, len(union)))
+                    own = _largest_placeable_block(
+                        shape, chips, _max_achievable_block(shape, len(chips)))
+                    # genuine defragmentation only: the enlarged block must
+                    # beat both the current free block AND what the pod's
+                    # own chips form by themselves (a contiguous pod's spot
+                    # reverting to free is relocation, not compaction)
+                    if better <= max(current, own):
+                        continue
                     candidates.append(
                         (p, ni.name,
                          f"defragments {ni.name}: largest free block "
-                         f"{current} < achievable {achievable}"))
+                         f"{current} -> {better} after eviction"))
         # chips already promised to earlier victims of THIS plan, per
         # destination — two victims must not be "proven" to fit in the
         # same free slot
         planned: dict[str, int] = {}
+        now = self.sched.clock.time()
         for pod, node, reason in candidates:
             if len(plan.victims) >= self.max_evictions:
                 break
+            if now - self._recent.get(pod.key, -1e18) < self.cooldown_s:
+                continue  # recently moved; don't thrash the workload
             dest = self._fits_elsewhere(pod, node, snapshot, planned)
             if dest is not None:
                 try:
@@ -165,11 +190,24 @@ class Descheduler:
         re-enter the scheduling queue and re-place through the normal cycle
         (chips label cleared by evict)."""
         plan = self.plan()
+        now = self.sched.clock.time()
+        # resubmit locally only where eviction does NOT destroy the pod
+        # object's identity: on FakeCluster an evicted pod is simply
+        # unbound. On a real API server, evict() is a DELETE — the
+        # controller recreates the pod as a NEW incarnation which the serve
+        # poll loop submits; locally requeueing the dead incarnation would
+        # race it (and bind a pod that no longer exists).
+        local = getattr(self.sched.cluster, "supports_local_requeue", False)
         for pod in plan.victims:
             self.sched.cluster.evict(pod)
             self.sched.metrics.inc("pods_descheduled_total")
-            if not self.sched.submit(pod):  # _movable guards this; belt and
+            self._recent[pod.key] = now
+            if local and not self.sched.submit(pod):
                 self.sched.metrics.inc("deschedule_requeue_failed_total")
+        if self._recent and len(self._recent) > 10_000:
+            cutoff = now - self.cooldown_s
+            self._recent = {k: t for k, t in self._recent.items()
+                            if t >= cutoff}
         return plan
 
 

@@ -30,3 +30,8 @@ class PrioritySort(QueueSortPlugin):
         if pa != pb:
             return pa > pb
         return a.enqueued < b.enqueued
+
+    def key(self, info: QueuedPodInfo):
+        """Sort key consistent with less(): lets the queue use a heap
+        (O(log n) pop) instead of a comparator scan (O(n))."""
+        return (-pod_priority(info), info.enqueued)

@@ -9,6 +9,8 @@ equivalent: a comparator-ordered active queue plus a backoff parking lot.
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import time
 from typing import Callable
 
@@ -19,18 +21,37 @@ LessFn = Callable[[QueuedPodInfo, QueuedPodInfo], bool]
 
 
 class SchedulingQueue:
-    def __init__(self, less: LessFn, initial_backoff_s: float = 1.0, max_backoff_s: float = 10.0):
+    def __init__(self, less: LessFn, initial_backoff_s: float = 1.0,
+                 max_backoff_s: float = 10.0, key=None):
+        """`less` is the framework comparator contract. When the queue-sort
+        plugin also provides an equivalent `key(info)` (PrioritySort does),
+        the active queue is a heap — O(log n) pops instead of an O(n)
+        comparator scan. A key must order exactly like `less`."""
         self._less = less
+        self._key = key
+        self._seq = itertools.count()  # heap tie-break; preserves FIFO
         self._initial = initial_backoff_s
         self._max = max_backoff_s
-        self._active: list[QueuedPodInfo] = []
+        self._active: list = []  # infos, or (key, seq, info) heap entries
         self._backoff: list[QueuedPodInfo] = []
+
+    def _push_active(self, info: QueuedPodInfo) -> None:
+        if self._key is not None:
+            heapq.heappush(self._active,
+                           (self._key(info), next(self._seq), info))
+        else:
+            self._active.append(info)
+
+    def _active_infos(self):
+        if self._key is not None:
+            return (entry[2] for entry in self._active)
+        return iter(self._active)
 
     def add(self, pod: Pod, now: float | None = None) -> None:
         info = QueuedPodInfo(pod=pod)
         if now is not None:
             info.enqueued = now
-        self._active.append(info)
+        self._push_active(info)
 
     def __len__(self) -> int:
         return len(self._active) + len(self._backoff)
@@ -42,19 +63,21 @@ class SchedulingQueue:
         ready = [q for q in self._backoff if q.not_before <= now]
         if ready:
             self._backoff = [q for q in self._backoff if q.not_before > now]
-            self._active.extend(ready)
+            for q in ready:
+                self._push_active(q)
 
     def pop(self, now: float | None = None) -> QueuedPodInfo | None:
         """Pop the highest-priority ready pod (None if all are backing off).
 
-        Selection sort via the comparator — the queue is small relative to the
-        cost of a cycle, and the comparator contract (strict weak order via
-        `less`) matches the framework interface exactly.
-        """
+        Heap pop when the sort plugin provides a key; otherwise a
+        comparator selection scan (the framework contract only guarantees a
+        strict weak order via `less`)."""
         now = time.time() if now is None else now
         self._flush_backoff(now)
         if not self._active:
             return None
+        if self._key is not None:
+            return heapq.heappop(self._active)[2]
         best_i = 0
         for i in range(1, len(self._active)):
             if self._less(self._active[i], self._active[best_i]):
@@ -74,10 +97,10 @@ class SchedulingQueue:
         preemptor after its victims were evicted, so its priority wins the
         next pop (the nominated-node fast-retry analogue)."""
         info.not_before = 0.0
-        self._active.append(info)
+        self._push_active(info)
 
     def contains(self, pod_key: str) -> bool:
-        return any(q.pod.key == pod_key for q in self._active) or any(
+        return any(q.pod.key == pod_key for q in self._active_infos()) or any(
             q.pod.key == pod_key for q in self._backoff)
 
     def next_ready_at(self) -> float | None:
