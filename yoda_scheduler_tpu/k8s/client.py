@@ -334,8 +334,19 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
                         log.info("descheduled %d pods: %s",
                                  len(plan.victims), plan.reasons)
             # run every engine each pass (a generator inside any() would
-            # short-circuit and starve later profiles behind a busy first)
-            outcomes = [e.run_one() for e in sched.engines.values()]
+            # short-circuit and starve later profiles behind a busy first);
+            # isolate failures so one profile's persistent exception can't
+            # starve its co-hosted profiles of cycles
+            outcomes = []
+            for name, e in sched.engines.items():
+                try:
+                    outcomes.append(e.run_one())
+                except Exception as exc:
+                    log.error("profile %s cycle error: %s", name, exc)
+                    # None = "no progress": a persistently-throwing profile
+                    # must not defeat the all-idle poll_s wait below, or the
+                    # loop hot-spins re-listing the API server
+                    outcomes.append(None)
             if all(o is None for o in outcomes):
                 stop.wait(poll_s)
         except Exception as e:

@@ -211,6 +211,12 @@ class Scheduler:
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
+        # preemption victims re-enter scheduling through this callable.
+        # MultiProfileScheduler points it at its schedulerName-routing
+        # submit so a victim owned by profile B evicted by profile A's
+        # engine lands back in B's queue, not A's; standalone engines
+        # default to their own submit (which rejects foreign names).
+        self.victim_router = None
 
     # ----------------------------------------------------------------- intake
     def submit(self, pod: Pod) -> bool:
@@ -318,12 +324,21 @@ class Scheduler:
         # Filter with early-stop (percentageOfNodesToScore)
         nodes = snapshot.list()
         want = self._num_feasible_to_find(len(nodes))
+        order = [(self._filter_start + i) % len(nodes) for i in range(len(nodes))]
+        # a nominated preemptor evaluates its nominated node FIRST (upstream
+        # behavior): its verdict is then always known, so _unschedulable can
+        # release the hold the moment the node stops being feasible
+        nom = (self.allocator.nomination_of(pod.key)
+               if self.allocator is not None else None)
+        if nom is not None:
+            ni = next((i for i in order if nodes[i].name == nom[0]), None)
+            if ni is not None:
+                order.remove(ni)
+                order.insert(0, ni)
         feasible: list[NodeInfo] = []
         checked = 0
-        for i in range(len(nodes)):
-            node = nodes[(self._filter_start + i) % len(nodes)] if nodes else None
-            if node is None:
-                break
+        for i in order:
+            node = nodes[i]
             checked += 1
             st = Status.success()
             for p in self.profile.filter:
@@ -344,10 +359,24 @@ class Scheduler:
             for p in self.profile.post_filter:
                 nominated, victims, st = p.post_filter(state, pod, snapshot, trace.filter_verdicts)
                 if st.ok and nominated is not None:
+                    # on a real API server evict() is a DELETE: the victim's
+                    # controller recreates it as a new incarnation which the
+                    # serve loop submits — requeueing the dead object locally
+                    # would race it (same contract as Descheduler.run_once)
+                    local = getattr(self.cluster, "supports_local_requeue", False)
                     for victim in victims:
                         self.cluster.evict(victim)
-                        self.queue.add(victim, now=self.clock.time())
                         self.metrics.inc("pods_evicted_total")
+                        if local:
+                            router = self.victim_router or self.submit
+                            if not router(victim):
+                                self.metrics.inc("preempt_victims_unrouted_total")
+                    if self.allocator is not None:
+                        # hold the freed capacity for this pod until it binds
+                        # or fails — otherwise requeued victims (or co-hosted
+                        # profiles) refill the hole and the preemptor livelocks
+                        self.allocator.nominate(pod.key, nominated,
+                                                spec.chips, spec.priority)
                     self.metrics.inc("preemptions_total")
                     info.last_failure = f"preempting on {nominated}"
                     self.queue.requeue_immediate(info)
@@ -421,6 +450,8 @@ class Scheduler:
     def _bind(self, info: QueuedPodInfo, node: str, trace: CycleTrace) -> None:
         pod = info.pod
         coords = self.allocator.complete(pod) if self.allocator is not None else None
+        if self.allocator is not None:
+            self.allocator.unnominate(pod.key)  # entitlement consumed
         if coords is not None:
             # publish the chip assignment on the pod regardless of binder, so
             # allocation accounting sees it next cycle
@@ -437,9 +468,19 @@ class Scheduler:
     def _unschedulable(self, info: QueuedPodInfo, trace: CycleTrace, reason: str,
                        outcome: str = "unschedulable") -> str:
         info.last_failure = reason
+        if self.allocator is not None:
+            nom = self.allocator.nomination_of(info.pod.key)
+            if nom is not None and trace.filter_verdicts.get(nom[0]) != "ok":
+                # the nominated node no longer fits this pod (chips went
+                # unhealthy, telemetry stale, node gone): release the hold so
+                # it doesn't block the node's capacity forever — upstream
+                # clears nominatedNodeName the same way
+                self.allocator.unnominate(info.pod.key)
         if self.config.max_attempts and info.attempts + 1 >= self.config.max_attempts:
             info.pod.phase = PodPhase.FAILED
             self.failed[info.pod.key] = reason
+            if self.allocator is not None:
+                self.allocator.unnominate(info.pod.key)  # give the hole back
             self.metrics.inc("pods_failed_total")
             self._finish(trace, "failed", reason=reason)
             return "failed"

@@ -64,7 +64,11 @@ class Descheduler:
     def plan(self) -> DeschedulePlan:
         plan = DeschedulePlan()
         snapshot = self.sched.snapshot()
-        candidates: list[tuple[Pod, str, str]] = []  # (pod, node, reason)
+        # (pod, node, reason, is_defrag): defrag (strategy-2) benefit is
+        # computed against the node's CURRENT free set, so at most one
+        # defrag victim per node per pass — the first eviction may already
+        # deliver the enlarged block a second candidate was credited with
+        candidates: list[tuple[Pod, str, str, bool]] = []
         for ni in snapshot.list():
             m = ni.metrics
             if m is None or m.accelerator != "tpu":
@@ -77,7 +81,8 @@ class Descheduler:
                 for p in movable:
                     candidates.append(
                         (p, ni.name,
-                         f"frees gang slice {m.slice_id} ({m.num_hosts} hosts)"))
+                         f"frees gang slice {m.slice_id} ({m.num_hosts} hosts)",
+                         False))
             else:
                 # strategy 2: scattered free chips on a standalone node —
                 # fragmented iff the largest placeable block is smaller
@@ -112,19 +117,24 @@ class Descheduler:
                     candidates.append(
                         (p, ni.name,
                          f"defragments {ni.name}: largest free block "
-                         f"{current} -> {better} after eviction"))
+                         f"{current} -> {better} after eviction", True))
         # chips already promised to earlier victims of THIS plan, per
         # destination — two victims must not be "proven" to fit in the
         # same free slot
         planned: dict[str, int] = {}
+        defrag_done: set[str] = set()  # nodes with a planned defrag victim
         now = self.sched.clock.time()
-        for pod, node, reason in candidates:
+        for pod, node, reason, is_defrag in candidates:
             if len(plan.victims) >= self.max_evictions:
                 break
+            if is_defrag and node in defrag_done:
+                continue  # benefit already claimed by this pass's eviction
             if now - self._recent.get(pod.key, -1e18) < self.cooldown_s:
                 continue  # recently moved; don't thrash the workload
             dest = self._fits_elsewhere(pod, node, snapshot, planned)
             if dest is not None:
+                if is_defrag:
+                    defrag_done.add(node)
                 try:
                     planned[dest] = planned.get(dest, 0) + spec_for(pod).chips
                 except LabelError:  # _movable already parsed it
@@ -137,6 +147,12 @@ class Descheduler:
         if pod.scheduler_name != self.sched.config.scheduler_name:
             # another profile's pod: evicting it here would strand it
             # (our submit() rejects foreign schedulerNames)
+            return False
+        if not getattr(self.sched.cluster, "supports_local_requeue", False) \
+                and not pod.has_controller:
+            # on a real cluster evict() is a permanent API DELETE; a bare
+            # (controllerless) pod would be destroyed, not rescheduled —
+            # upstream k8s-descheduler refuses ownerless victims the same way
             return False
         try:
             spec = spec_for(pod)

@@ -66,6 +66,10 @@ class MultiProfileScheduler:
             self.engines[cfg.scheduler_name] = Scheduler(
                 cluster, cfg, profile=profile, clock=self.clock,
                 cycle_lock=self._cycle_lock)
+        for engine in self.engines.values():
+            # preemption victims re-route by THEIR schedulerName, not the
+            # preemptor's profile (core.py preemption block)
+            engine.victim_router = self.submit
 
     # ------------------------------------------------------------------ intake
     def submit(self, pod: Pod) -> bool:

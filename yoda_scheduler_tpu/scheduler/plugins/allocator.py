@@ -36,6 +36,14 @@ class ChipAllocator(ReservePlugin):
         self._pending_ver: dict[str, int] = {}
         self._free_cache: dict[str, dict[tuple[int, int], set[Coord]]] = {}
         self._free_cache_slots = 4
+        # nominated capacity claims (upstream nominatedNodeName semantics):
+        # a successful preemption entitles the preemptor to the freed chips
+        # on its nominated node until it binds or fails permanently. Claims
+        # are counts, not coords — the victims' exact chips return to the
+        # free pool, but pods of lower-or-equal priority must not consume
+        # them first (or co-hosted profiles rebind victims into the hole
+        # and the preemptor livelocks).
+        self._nominated: dict[str, tuple[str, int, int]] = {}  # pod.key -> (node, chips, priority)
 
     def _bump(self, node: str) -> None:
         self._pending_ver[node] = self._pending_ver.get(node, 0) + 1
@@ -86,9 +94,34 @@ class ChipAllocator(ReservePlugin):
         with self._lock:
             return self._pending.get(pod.key)
 
+    # ---------------------------------------------------------- nominations
+    def nominate(self, pod_key: str, node: str, chips: int, priority: int) -> None:
+        with self._lock:
+            self._nominated[pod_key] = (node, chips, priority)
+
+    def unnominate(self, pod_key: str) -> None:
+        with self._lock:
+            self._nominated.pop(pod_key, None)
+
+    def nomination_of(self, pod_key: str) -> tuple[str, int, int] | None:
+        """(node, chips, priority) this pod is entitled to, if any."""
+        with self._lock:
+            return self._nominated.get(pod_key)
+
+    def nominated_hold(self, node: str, priority: int,
+                       exclude_key: str | None = None) -> int:
+        """Chips on `node` held for nominated preemptors that outrank (or
+        tie) `priority` — capacity the asking pod must treat as taken. A
+        pod never blocks on its own nomination."""
+        with self._lock:
+            return sum(
+                chips for key, (n, chips, prio) in self._nominated.items()
+                if n == node and prio >= priority and key != exclude_key
+            )
+
     # ------------------------------------------------------------ placement
-    def pick_chips(self, spec: WorkloadSpec,
-                   node_info: NodeInfo) -> list[Coord] | None:
+    def pick_chips(self, spec: WorkloadSpec, node_info: NodeInfo,
+                   pod_key: str | None = None) -> list[Coord] | None:
         """Choose concrete chips for the spec on this node, best-fit
         contiguous. Falls back to any qualifying chips when the node's free
         space has no contiguous block (still schedulable, just lower quality —
@@ -104,7 +137,8 @@ class ChipAllocator(ReservePlugin):
             and c.hbm_free_mb >= spec.min_free_mb
             and c.clock_mhz >= spec.min_clock_mhz
         }
-        if len(qualifying) < spec.chips:
+        hold = self.nominated_hold(node_info.name, spec.priority, pod_key)
+        if len(qualifying) - hold < spec.chips:
             return None
         shape = _node_shape(m)
         if spec.topology is not None:
@@ -123,7 +157,7 @@ class ChipAllocator(ReservePlugin):
         spec = state.read_or("workload_spec")
         if node_info is None or spec is None:
             return Status.error("allocator: cycle state missing node_info/spec")
-        coords = self.pick_chips(spec, node_info)
+        coords = self.pick_chips(spec, node_info, pod_key=pod.key)
         if coords is None:
             return Status.unschedulable(f"{node}: chips vanished before reserve")
         with self._lock:

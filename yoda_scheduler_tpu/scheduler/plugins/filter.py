@@ -73,11 +73,17 @@ class TelemetryFilter(FilterPlugin):
                         f"{node.name}: gang {spec.gang_name} is placing on slice {chosen}"
                     )
 
-        # chips-count predicate over *unclaimed* healthy chips
+        # chips-count predicate over *unclaimed* healthy chips, minus
+        # capacity held for nominated preemptors of >= priority (upstream
+        # nominated-pod semantics: don't schedule into a freshly-preempted
+        # hole that a higher-priority pod is entitled to)
         free = self.allocator.free_coords(node)
-        if len(free) < spec.chips:
+        hold = self.allocator.nominated_hold(node.name, spec.priority, pod.key)
+        if len(free) - hold < spec.chips:
             return Status.unschedulable(
-                f"{node.name}: {len(free)} unclaimed healthy chips < {spec.chips} requested"
+                f"{node.name}: {len(free)} unclaimed healthy chips"
+                + (f" ({hold} held for nominated preemptors)" if hold else "")
+                + f" < {spec.chips} requested"
             )
 
         # per-chip memory + clock predicates over unclaimed healthy chips
@@ -87,7 +93,7 @@ class TelemetryFilter(FilterPlugin):
             and c.hbm_free_mb >= spec.min_free_mb
             and c.clock_mhz >= spec.min_clock_mhz
         ]
-        if len(qualifying) < spec.chips:
+        if len(qualifying) - hold < spec.chips:
             return Status.unschedulable(
                 f"{node.name}: only {len(qualifying)} chips satisfy "
                 f"hbm>={spec.min_free_mb}MB clock>={spec.min_clock_mhz}MHz "

@@ -15,7 +15,7 @@ because their memory is only released once they actually terminate.
 from __future__ import annotations
 
 from ..framework import CycleState, NodeInfo, PostFilterPlugin, QueuedPodInfo, Snapshot, Status
-from ...utils.labels import WorkloadSpec
+from ...utils.labels import LabelError, WorkloadSpec, spec_for
 from ...utils.pod import Pod
 from .allocator import ChipAllocator
 from .sort import pod_priority
@@ -23,6 +23,17 @@ from .sort import pod_priority
 
 def _priority(pod: Pod) -> int:
     return pod_priority(QueuedPodInfo(pod=pod))
+
+
+def _evictable(pod: Pod) -> bool:
+    """Gang members are never preemption victims: evicting one strands its
+    peers bound and holding chips — exactly the partial-gang deadlock
+    GangCoordinator's all-or-nothing admission exists to prevent. (The
+    descheduler applies the same exclusion in its _movable check.)"""
+    try:
+        return not spec_for(pod).is_gang
+    except LabelError:
+        return True  # unparsable labels can't declare a gang
 
 
 class PriorityPreemption(PostFilterPlugin):
@@ -39,7 +50,8 @@ class PriorityPreemption(PostFilterPlugin):
         # minimal disruption: fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
         for node in snapshot.list():
-            plan = self._plan_eviction(spec, my_prio, node, now=now)
+            plan = self._plan_eviction(spec, my_prio, node, now=now,
+                                       pod_key=pod.key)
             if plan is None:
                 continue
             key = (len(plan), max(_priority(v) for v in plan), node.name)
@@ -53,7 +65,8 @@ class PriorityPreemption(PostFilterPlugin):
         return best[1], best[2], Status.success()
 
     def _plan_eviction(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
-                       now: float | None = None) -> list[Pod] | None:
+                       now: float | None = None,
+                       pod_key: str | None = None) -> list[Pod] | None:
         """Smallest non-empty victim set on this node that frees enough
         qualifying chips; victims chosen lowest-priority-first. None if
         impossible — or if no eviction is needed at all, in which case the
@@ -73,15 +86,20 @@ class PriorityPreemption(PostFilterPlugin):
             c.coords for c in m.healthy_chips()
             if c.hbm_total_mb >= spec.min_free_mb and c.clock_mhz >= spec.min_clock_mhz
         }
-        if len(ok_coords) < spec.chips:
+        # capacity already held for OTHER nominated preemptors of >= priority
+        # counts as taken, exactly as in TelemetryFilter — otherwise two
+        # preemptors can be "proven" to fit in the same freshly-freed hole,
+        # nominate overlapping chips, and deadlock each other's holds
+        hold = self.allocator.nominated_hold(node.name, spec.priority, pod_key)
+        if len(ok_coords) - hold < spec.chips:
             return None
         pool = sorted(
-            (p for p in node.pods if _priority(p) < my_prio),
+            (p for p in node.pods if _priority(p) < my_prio and _evictable(p)),
             key=_priority,
         )
         free = self.allocator.free_coords(node)
         victims: list[Pod] = []
-        while len(free & ok_coords) < spec.chips:
+        while len(free & ok_coords) - hold < spec.chips:
             if not pool:
                 return None
             v = pool.pop(0)

@@ -26,7 +26,14 @@ class SchedulingQueue:
         """`less` is the framework comparator contract. When the queue-sort
         plugin also provides an equivalent `key(info)` (PrioritySort does),
         the active queue is a heap — O(log n) pops instead of an O(n)
-        comparator scan. A key must order exactly like `less`."""
+        comparator scan. A key must order exactly like `less`.
+
+        Ordering contract: heap keys are computed when a pod ENTERS the
+        active queue (add / backoff flush — backoff re-entry re-keys), so
+        whatever `key`/`less` reads (e.g. the scv/priority label) must be
+        immutable while the pod sits in the active queue. Kubernetes
+        enforces the same invariant upstream: pod priority is set from the
+        PriorityClass at admission and is immutable thereafter."""
         self._less = less
         self._key = key
         self._seq = itertools.count()  # heap tie-break; preserves FIFO

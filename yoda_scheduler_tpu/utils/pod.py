@@ -41,6 +41,10 @@ class Pod:
     uid: int = field(default_factory=lambda: next(_uid_counter))
     k8s_uid: str = ""                 # metadata.uid on real clusters; a
                                       # recreated same-name pod gets a new one
+    # metadata.ownerReferences carries a controller entry for managed pods
+    # (Deployment/Job/...); bare pods have none and are NOT recreated after
+    # an API DELETE — eviction-based flows must refuse them on real clusters
+    has_controller: bool = False
     created: float = field(default_factory=time.time)
 
     @property
@@ -75,4 +79,8 @@ class Pod:
             scheduler_name=spec.get("schedulerName", "default-scheduler"),
             node=spec.get("nodeName"),
             k8s_uid=meta.get("uid", ""),
+            has_controller=any(
+                ref.get("controller")
+                for ref in meta.get("ownerReferences", []) or []
+            ),
         )
