@@ -31,23 +31,23 @@ class FakeApiServer:
 
     def transport(self, method, path, body, timeout):
         self.requests.append((method, path, body))
-        if path == "/version":
+        # the client appends pagination/watch query params; match on base
+        base, _, query = path.partition("?")
+        if base == "/version":
             return 200, b'{"gitVersion": "fake"}'
-        if path.startswith("/apis/metrics.yoda.tpu"):
+        if base.startswith("/apis/metrics.yoda.tpu"):
             return 200, json.dumps(
                 {"items": [m.to_cr() for m in self.metrics]}).encode()
-        if "pods?fieldSelector" in path and "Pending" in path:
+        if base == "/api/v1/pods":
             return 200, json.dumps({"items": self.pods}).encode()
-        if path == "/api/v1/pods" or "pods?fieldSelector" in path:
-            return 200, json.dumps({"items": []}).encode()
-        if path == "/api/v1/nodes":
+        if base == "/api/v1/nodes":
             return 200, json.dumps(
                 {"items": [{"metadata": {"name": "n1"}}]}).encode()
-        if path.endswith("/binding"):
+        if base.endswith("/binding"):
             self.bound.append(body)
             return 201, b"{}"
-        if "/leases/" in path or path.endswith("/leases"):
-            return self._lease(method, path, body)
+        if "/leases/" in base or base.endswith("/leases"):
+            return self._lease(method, base, body)
         if method == "PATCH":
             return 200, b"{}"
         if method == "DELETE":
@@ -86,10 +86,13 @@ def test_list_metrics_roundtrip(client):
     assert metrics[0].node == "n1" and metrics[0].chip_count == 4
 
 
-def test_list_pending_pods_filters_scheduler_name(client, api):
-    pods = client.list_pending_pods("yoda-scheduler")
-    assert [p.name for p in pods] == ["p1"]
-    assert client.list_pending_pods("other-sched") == []
+def test_pending_pods_visible_after_resync(client):
+    store = TelemetryStore()
+    cluster = KubeCluster(client, store)
+    cluster.resync()
+    pending = cluster.pending_pods()
+    assert [p.name for p in pending] == ["p1"]
+    assert pending[0].scheduler_name == "yoda-scheduler"
 
 
 def test_bind_posts_binding_and_patches_chips(client, api):
@@ -160,7 +163,7 @@ def test_list_bound_pods_includes_containercreating(client, api):
          "spec": {"nodeName": "n1"}, "status": {"phase": "Succeeded"}},
     ]
     def transport(method, path, body, timeout):
-        if path == "/api/v1/pods":
+        if path.partition("?")[0] == "/api/v1/pods":
             return 200, json.dumps({"items": api_items}).encode()
         return api.transport(method, path, body, timeout)
     c = KubeClient("https://fake", transport=transport)

@@ -1,21 +1,26 @@
-"""Minimal Kubernetes API client + cluster adapter (stdlib only, gated).
+"""Kubernetes API client + watch-cache cluster adapter (stdlib only, gated).
 
-The reference talks to the API server through client-go/controller-runtime
-(reference pkg/yoda/scheduler.go:53-72). This environment has no kubernetes
-Python package and no cluster, so the real-cluster path is a small REST
-client over urllib that implements exactly the verbs the scheduler needs:
+The reference talks to the API server through client-go/controller-runtime:
+its hot path reads an informer-backed in-memory cache fed by WATCH streams
+(reference pkg/yoda/scheduler.go:53-68), never a per-decision API roundtrip.
+This module reproduces that architecture over urllib:
 
-- list/watch TpuNodeMetrics CRs  -> feed the TelemetryStore (watch cache)
-- list/watch pending Pods with our schedulerName -> feed the queue
-- POST pods/<name>/binding        -> bind (with the chip-assignment
-  annotation the in-memory binder writes as a label)
-- DELETE pod (eviction) for preemption
-- Lease get/update for leader election (leaderelect.py)
+- `KubeClient` — the REST verbs with bounded retry/backoff on transient
+  errors, 409-aware bind, and paginated lists (limit/continue).
+- `watch()` — a streaming `watch=true` GET yielding newline-delimited
+  events, with resourceVersion bookmarks.
+- `Reflector` — the list+watch loop: one paginated LIST to seed the cache,
+  then incremental WATCH events; a 410 Gone (compacted resourceVersion)
+  triggers an immediate re-list, exactly the client-go reflector contract.
+- `KubeCluster` — the cluster interface (scheduler/cluster.py contract)
+  over three reflectors (nodes, pods, TpuNodeMetrics CRs). Falls back to
+  periodic poll re-lists when the transport cannot stream (injected fake
+  transports without a stream side).
 
-Everything is injectable (the `transport` callable) so the full path is
-unit-testable against a fake transport without a cluster; `from_env`
-returns None when no API server is reachable (the CLI then tells the user
-to use `simulate`).
+Everything is injectable (`transport` + `stream_transport` callables) so
+the full path is unit-testable without a cluster; `from_env` returns None
+when no API server is reachable (the CLI then tells the user to use
+`simulate`).
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ import ssl
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from ..telemetry.schema import CRD_GROUP, CRD_PLURAL, CRD_VERSION, TpuNodeMetrics
@@ -35,25 +41,54 @@ from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chi
 
 log = logging.getLogger("yoda-tpu.k8s")
 
+METRICS_PATH = f"/apis/{CRD_GROUP}/{CRD_VERSION}/{CRD_PLURAL}"
+
+# transient statuses worth retrying: throttled, server hiccups, gateway
+_RETRYABLE = {429, 500, 502, 503, 504}
+
+
+class ApiError(RuntimeError):
+    """Non-2xx API response, carrying the status code for callers that
+    branch on it (409 conflict, 410 gone, 404 absent)."""
+
+    def __init__(self, method: str, path: str, status: int, body: bytes = b""):
+        self.status = status
+        super().__init__(f"{method} {path} -> {status}: {body[:200]!r}")
+
+
+class WatchExpired(Exception):
+    """The watch resourceVersion was compacted away (410 Gone): the caller
+    must re-list and start a fresh watch."""
+
 
 class KubeClient:
     def __init__(self, base_url: str, token: str | None = None,
-                 ca_file: str | None = None, transport=None) -> None:
+                 ca_file: str | None = None, transport=None,
+                 stream_transport=None, max_retries: int = 4,
+                 retry_backoff_s: float = 0.25) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
         self._ctx = None
         if transport is not None:
             self._transport = transport
+            # injected fakes stream only if they provide the stream side
+            self._stream = stream_transport
         else:
             if ca_file and os.path.exists(ca_file):
                 self._ctx = ssl.create_default_context(cafile=ca_file)
             elif base_url.startswith("https"):
                 self._ctx = ssl._create_unverified_context()  # lab clusters
             self._transport = self._urllib_transport
+            self._stream = stream_transport or self._urllib_stream
+
+    @property
+    def can_stream(self) -> bool:
+        return self._stream is not None
 
     # ------------------------------------------------------------- transport
-    def _urllib_transport(self, method: str, path: str, body: dict | None,
-                          timeout: float):
+    def _mk_request(self, method: str, path: str, body: dict | None):
         req = urllib.request.Request(
             self.base_url + path, method=method,
             data=json.dumps(body).encode() if body is not None else None,
@@ -67,15 +102,64 @@ class KubeClient:
             req.add_header("Content-Type", ctype)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        with urllib.request.urlopen(req, timeout=timeout, context=self._ctx) as r:
-            return r.status, r.read()
+        return req
+
+    def _urllib_transport(self, method: str, path: str, body: dict | None,
+                          timeout: float):
+        req = self._mk_request(method, path, body)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout,
+                                        context=self._ctx) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:  # non-2xx WITH a response
+            return e.code, e.read()
+
+    def _urllib_stream(self, method: str, path: str, timeout: float):
+        """Yield response lines from a streaming (watch) request. The HTTP
+        status is checked before the first yield; non-2xx raises ApiError."""
+        req = self._mk_request(method, path, None)
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout,
+                                          context=self._ctx)
+        except urllib.error.HTTPError as e:
+            raise ApiError(method, path, e.code, e.read()) from None
+        try:
+            while True:
+                line = resp.readline()
+                if not line:
+                    break  # server closed the stream (timeoutSeconds)
+                yield line
+        finally:
+            resp.close()
 
     def request(self, method: str, path: str, body: dict | None = None,
-                timeout: float = 10.0) -> dict:
-        status, raw = self._transport(method, path, body, timeout)
-        if status >= 300:
-            raise RuntimeError(f"{method} {path} -> {status}: {raw[:200]}")
-        return json.loads(raw) if raw else {}
+                timeout: float = 10.0, retries: int | None = None) -> dict:
+        """One API call with bounded retry/backoff on transient failures
+        (connection errors, 429, 5xx). Non-retryable statuses raise
+        ApiError immediately. Mutating verbs are retried too — Kubernetes
+        writes are level-based (bind/PUT conflicts surface as 409, which is
+        NOT retried here; see `bind` for the 409 recovery protocol)."""
+        retries = self.max_retries if retries is None else retries
+        backoff = self.retry_backoff_s
+        attempt = 0
+        while True:
+            try:
+                status, raw = self._transport(method, path, body, timeout)
+            except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+                if attempt >= retries:
+                    raise ApiError(method, path, 0, str(e).encode()) from e
+                attempt += 1
+                time.sleep(backoff)
+                backoff *= 2
+                continue
+            if status >= 300:
+                if status in _RETRYABLE and attempt < retries:
+                    attempt += 1
+                    time.sleep(backoff)
+                    backoff *= 2
+                    continue
+                raise ApiError(method, path, status, raw)
+            return json.loads(raw) if raw else {}
 
     # ------------------------------------------------------------ finding us
     @classmethod
@@ -109,41 +193,103 @@ class KubeClient:
                 pass
         for c in candidates:
             try:
-                c.request("GET", "/version", timeout=3.0)
+                c.request("GET", "/version", timeout=3.0, retries=0)
                 return c
             except Exception as e:
                 log.debug("api server %s unreachable: %s", c.base_url, e)
         return None
 
+    # ------------------------------------------------------------ list/watch
+    def list_all(self, path: str, limit: int = 500,
+                 timeout: float = 30.0) -> dict:
+        """Paginated LIST (limit + continue tokens): items merged, the final
+        page's resourceVersion kept — large clusters must not be fetched as
+        one giant response."""
+        items: list[dict] = []
+        cont = None
+        while True:
+            sep = "&" if "?" in path else "?"
+            q = f"{path}{sep}limit={limit}"
+            if cont:
+                q += "&continue=" + urllib.parse.quote(cont)
+            doc = self.request("GET", q, timeout=timeout)
+            items.extend(doc.get("items", []))
+            cont = doc.get("metadata", {}).get("continue")
+            if not cont:
+                doc["items"] = items
+                return doc
+
+    def watch(self, path: str, resource_version: str | None = None,
+              timeout_s: float = 120.0):
+        """Yield watch events ({"type": ..., "object": ...}) from a
+        streaming GET. Returns normally when the server ends the stream
+        (timeoutSeconds rotation — caller re-watches from its last seen
+        resourceVersion); raises WatchExpired on 410 Gone."""
+        if self._stream is None:
+            raise RuntimeError("transport cannot stream; use poll resync")
+        sep = "&" if "?" in path else "?"
+        q = (f"{path}{sep}watch=true&allowWatchBookmarks=true"
+             f"&timeoutSeconds={int(timeout_s)}")
+        if resource_version is not None:
+            q += f"&resourceVersion={urllib.parse.quote(str(resource_version))}"
+        try:
+            lines = self._stream("GET", q, timeout_s + 10.0)
+            for line in lines:
+                if not line.strip():
+                    continue
+                ev = json.loads(line)
+                if ev.get("type") == "ERROR":
+                    code = ev.get("object", {}).get("code")
+                    if code == 410:
+                        raise WatchExpired(path)
+                    raise ApiError("WATCH", path, code or 0,
+                                   json.dumps(ev.get("object", {})).encode())
+                yield ev
+        except ApiError as e:
+            if e.status == 410:
+                raise WatchExpired(path) from None
+            raise
+
     # ----------------------------------------------------------------- verbs
     def list_metrics(self) -> list[TpuNodeMetrics]:
-        doc = self.request(
-            "GET", f"/apis/{CRD_GROUP}/{CRD_VERSION}/{CRD_PLURAL}")
+        doc = self.list_all(METRICS_PATH)
         return [TpuNodeMetrics.from_cr(item) for item in doc.get("items", [])]
 
-    def list_pending_pods(self, scheduler_name: str) -> list[Pod]:
-        doc = self.request(
-            "GET",
-            "/api/v1/pods?fieldSelector=spec.nodeName%3D,status.phase%3DPending")
-        pods = []
-        for item in doc.get("items", []):
-            p = Pod.from_manifest(item)
-            if p.scheduler_name == scheduler_name and p.node is None:
-                pods.append(p)
-        return pods
+    def get_pod(self, namespace: str, name: str) -> dict | None:
+        try:
+            return self.request(
+                "GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
 
     def bind(self, pod: Pod, node: str,
              assigned_chips: list | None = None) -> None:
+        """POST the binding subresource. A 409 means the pod is already
+        assigned — possibly by OUR earlier attempt whose response was lost
+        (the retry path re-POSTs). Recover by reading the pod back: bound to
+        our target = success; bound elsewhere = genuine conflict, raised."""
         body = {
             "apiVersion": "v1",
             "kind": "Binding",
             "metadata": {"name": pod.name, "namespace": pod.namespace},
             "target": {"apiVersion": "v1", "kind": "Node", "name": node},
         }
-        self.request(
-            "POST",
-            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
-            body)
+        try:
+            self.request(
+                "POST",
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
+                body)
+        except ApiError as e:
+            if e.status != 409:
+                raise
+            live = self.get_pod(pod.namespace, pod.name)
+            bound_to = (live or {}).get("spec", {}).get("nodeName")
+            if bound_to != node:
+                raise ApiError("POST", "binding(conflict)", 409,
+                               f"pod bound to {bound_to!r}".encode()) from e
+            log.info("bind %s -> %s: 409 but already ours", pod.key, node)
         if assigned_chips:
             patch = {"metadata": {"annotations": {
                 ASSIGNED_CHIPS_LABEL: format_assigned_chips(assigned_chips)}}}
@@ -157,59 +303,273 @@ class KubeClient:
                             pod.key, e)
 
     def evict(self, pod: Pod) -> None:
-        self.request(
-            "DELETE",
-            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}")
+        try:
+            self.request(
+                "DELETE",
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}")
+        except ApiError as e:
+            if e.status != 404:  # already gone = evicted
+                raise
 
     def list_bound_pods(self) -> dict[str, list[Pod]]:
         """Every pod holding a node — any phase except terminal. Filtering on
         phase=Running would make bound-but-ContainerCreating pods invisible
         for a resync window and their chips would be double-allocated."""
-        doc = self.request("GET", "/api/v1/pods")
+        doc = self.list_all("/api/v1/pods")
         by_node: dict[str, list[Pod]] = {}
         for item in doc.get("items", []):
-            phase = item.get("status", {}).get("phase", "")
-            if phase in ("Succeeded", "Failed"):
-                continue
-            p = Pod.from_manifest(item)
-            # chip assignment travels as an annotation on real clusters
-            ann = item.get("metadata", {}).get("annotations", {})
-            if ASSIGNED_CHIPS_LABEL in ann:
-                p.labels[ASSIGNED_CHIPS_LABEL] = ann[ASSIGNED_CHIPS_LABEL]
-            if p.node:
+            p = _pod_from_api(item)
+            if p is not None and p.node:
                 by_node.setdefault(p.node, []).append(p)
         return by_node
 
     def list_nodes(self) -> list[str]:
-        doc = self.request("GET", "/api/v1/nodes")
+        doc = self.list_all("/api/v1/nodes")
         return [i["metadata"]["name"] for i in doc.get("items", [])]
 
 
+def _pod_from_api(item: dict) -> Pod | None:
+    """API pod object -> Pod, or None for terminal phases. Chip assignment
+    travels as an annotation on real clusters; surface it as the label the
+    allocator reads."""
+    phase = item.get("status", {}).get("phase", "Pending")
+    if phase in ("Succeeded", "Failed"):
+        return None
+    p = Pod.from_manifest(item)
+    ann = item.get("metadata", {}).get("annotations", {})
+    if ASSIGNED_CHIPS_LABEL in ann:
+        p.labels[ASSIGNED_CHIPS_LABEL] = ann[ASSIGNED_CHIPS_LABEL]
+    if p.node:
+        p.phase = PodPhase.BOUND
+    return p
+
+
+def _rv_of(obj: dict) -> str | None:
+    return obj.get("metadata", {}).get("resourceVersion")
+
+
+class Reflector:
+    """client-go reflector semantics: LIST once (paginated) to replace the
+    cache, then WATCH from the list's resourceVersion applying incremental
+    events; on 410 Gone re-list immediately; on transport errors reconnect
+    with bounded backoff; a full re-list every `relist_s` as a safety net
+    against missed events (informer periodic resync)."""
+
+    def __init__(self, client: KubeClient, path: str, on_replace, on_event,
+                 relist_s: float = 300.0, watch_timeout_s: float = 60.0,
+                 backoff_s: float = 0.5, max_backoff_s: float = 15.0) -> None:
+        self.client = client
+        self.path = path
+        self.on_replace = on_replace
+        self.on_event = on_event
+        self.relist_s = relist_s
+        self.watch_timeout_s = watch_timeout_s
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.last_list_at = 0.0
+
+    def list_once(self) -> str | None:
+        doc = self.client.list_all(self.path)
+        self.on_replace(doc.get("items", []))
+        self.last_list_at = time.monotonic()
+        return _rv_of(doc)
+
+    def run(self, stop: threading.Event) -> None:
+        backoff = self.backoff_s
+        while not stop.is_set():
+            try:
+                rv = self.list_once()
+                backoff = self.backoff_s
+                while not stop.is_set():
+                    if time.monotonic() - self.last_list_at > self.relist_s:
+                        break  # periodic full resync
+                    got_any = False
+                    for ev in self.client.watch(
+                            self.path, rv, timeout_s=self.watch_timeout_s):
+                        got_any = True
+                        obj = ev.get("object", {})
+                        new_rv = _rv_of(obj)
+                        if new_rv is not None:
+                            rv = new_rv
+                        if ev.get("type") == "BOOKMARK":
+                            continue
+                        self.on_event(ev.get("type", ""), obj)
+                    if stop.is_set():
+                        break
+                    if not got_any:
+                        # stream closed without events: normal rotation;
+                        # tiny pause avoids hot-spinning a broken server
+                        stop.wait(0.05)
+            except WatchExpired:
+                log.info("watch %s expired (410): re-listing", self.path)
+                continue  # immediate re-list
+            except Exception as e:
+                log.warning("watch %s failed: %s; retrying in %.1fs",
+                            self.path, e, backoff)
+                stop.wait(backoff)
+                backoff = min(backoff * 2, self.max_backoff_s)
+
+
 class KubeCluster:
-    """Cluster interface (scheduler/cluster.py contract) over a KubeClient,
-    with a periodic re-list loop standing in for watch streams."""
+    """Cluster interface (scheduler/cluster.py contract) over a KubeClient:
+    an informer-style watch cache over nodes, pods, and TpuNodeMetrics CRs.
+
+    Watch mode (streaming transport): three Reflector threads feed the
+    cache incrementally — scheduling decisions read memory, the API server
+    sees O(changes) traffic, and staleness is bounded by event latency
+    rather than a poll interval. Poll mode (non-streaming fakes): periodic
+    full re-lists every `resync_s`, the round-1 behaviour.
+    """
 
     def __init__(self, client: KubeClient, telemetry: TelemetryStore,
-                 resync_s: float = 2.0) -> None:
+                 resync_s: float = 2.0, watch: bool | None = None,
+                 relist_s: float = 300.0) -> None:
         self.client = client
         self.telemetry = telemetry
         self.resync_s = resync_s
+        self.watch_mode = client.can_stream if watch is None else watch
         self._lock = threading.RLock()
-        self._nodes: list[str] = []
-        self._bound: dict[str, list[Pod]] = {}
+        self._nodes: set[str] = set()
+        self._pods: dict[str, Pod] = {}          # key -> non-terminal pod
+        self._by_node: dict[str, dict[str, Pod]] = {}  # node -> key -> pod
+        self._pods_ver: dict[str, int] = {}      # node -> change counter
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
+        self._reflectors: list[Reflector] = []
+        if self.watch_mode:
+            self._reflectors = [
+                Reflector(client, "/api/v1/nodes",
+                          self._replace_nodes, self._node_event,
+                          relist_s=relist_s),
+                Reflector(client, "/api/v1/pods",
+                          self._replace_pods, self._pod_event,
+                          relist_s=relist_s),
+                Reflector(client, METRICS_PATH,
+                          self._replace_metrics, self._metrics_event,
+                          relist_s=relist_s),
+            ]
 
+    # ----------------------------------------------------- watch-cache apply
+    def _bump(self, node: str | None) -> None:
+        if node:
+            self._pods_ver[node] = self._pods_ver.get(node, 0) + 1
+
+    def _replace_nodes(self, items: list[dict]) -> None:
+        names = {i["metadata"]["name"] for i in items}
+        with self._lock:
+            self._nodes = names
+
+    def _node_event(self, typ: str, obj: dict) -> None:
+        name = obj.get("metadata", {}).get("name")
+        if not name:
+            return
+        with self._lock:
+            if typ == "DELETED":
+                self._nodes.discard(name)
+                self._bump(name)
+            else:
+                self._nodes.add(name)
+
+    def _set_pod(self, key: str, p: Pod) -> None:
+        """Install/replace a pod record, maintaining the node index and
+        per-node versions. Caller holds the lock."""
+        old = self._pods.get(key)
+        self._pods[key] = p
+        if old is not None and old.node and old.node != p.node:
+            self._by_node.get(old.node, {}).pop(key, None)
+            self._bump(old.node)
+        if p.node:
+            self._by_node.setdefault(p.node, {})[key] = p
+        self._bump(p.node)
+
+    def _drop_pod(self, key: str) -> None:
+        old = self._pods.pop(key, None)
+        if old is not None:
+            if old.node:
+                self._by_node.get(old.node, {}).pop(key, None)
+            self._bump(old.node)
+
+    def _replace_pods(self, items: list[dict]) -> None:
+        fresh: dict[str, Pod] = {}
+        for item in items:
+            p = _pod_from_api(item)
+            if p is not None:
+                fresh[p.key] = p
+        with self._lock:
+            # same guard as _pod_event: a relist snapshot served just before
+            # our own bind landed must not resurrect the pod as unbound (its
+            # chips would look free until the bind's watch event arrives)
+            for key, old in self._pods.items():
+                new = fresh.get(key)
+                if new is not None and _stale_event(old, new):
+                    fresh[key] = old
+            touched = {p.node for p in self._pods.values() if p.node}
+            touched |= {p.node for p in fresh.values() if p.node}
+            self._pods = fresh
+            self._by_node = {}
+            for key, p in fresh.items():
+                if p.node:
+                    self._by_node.setdefault(p.node, {})[key] = p
+            for n in touched:
+                self._bump(n)
+
+    def _pod_event(self, typ: str, obj: dict) -> None:
+        meta = obj.get("metadata", {})
+        key = f"{meta.get('namespace', 'default')}/{meta.get('name')}"
+        with self._lock:
+            old = self._pods.get(key)
+            if typ == "DELETED":
+                self._drop_pod(key)
+                return
+            p = _pod_from_api(obj)
+            if p is None:  # went terminal: drop from cache
+                self._drop_pod(key)
+                return
+            # events can arrive out of order with our own write-through bind
+            # (we update the cache at bind time, the ADDED/MODIFIED event for
+            # the pre-bind pod may still be in flight); keep the newer.
+            if old is not None and _stale_event(old, p):
+                return
+            self._set_pod(key, p)
+
+    def _replace_metrics(self, items: list[dict]) -> None:
+        seen = set()
+        for item in items:
+            m = TpuNodeMetrics.from_cr(item)
+            seen.add(m.node)
+            self.telemetry.put(m)
+        for node in set(self.telemetry.nodes()) - seen:
+            self.telemetry.delete(node)
+
+    def _metrics_event(self, typ: str, obj: dict) -> None:
+        m = TpuNodeMetrics.from_cr(obj)
+        if typ == "DELETED":
+            self.telemetry.delete(m.node)
+        else:
+            self.telemetry.put(m)
+
+    # ------------------------------------------------------------ lifecycle
     def resync(self) -> None:
+        """One full re-list of everything (poll mode / initial seed)."""
         nodes = self.client.list_nodes()
-        bound = self.client.list_bound_pods()
+        pod_doc = self.client.list_all("/api/v1/pods")
+        with self._lock:
+            self._nodes = set(nodes)
+        self._replace_pods(pod_doc.get("items", []))
         for m in self.client.list_metrics():
             self.telemetry.put(m)
-        with self._lock:
-            self._nodes = nodes
-            self._bound = bound
 
     def start(self) -> None:
+        if self.watch_mode:
+            # seed synchronously so the caller sees a populated cache, then
+            # stream updates
+            for r in self._reflectors:
+                t = threading.Thread(target=r.run, args=(self._stop,),
+                                     daemon=True,
+                                     name=f"reflector:{r.path}")
+                self._threads.append(t)
+                t.start()
+            return
         self.resync()
 
         def loop():
@@ -219,8 +579,22 @@ class KubeCluster:
                 except Exception as e:
                     log.warning("resync failed: %s", e)
 
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+        t = threading.Thread(target=loop, daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def wait_synced(self, timeout_s: float = 10.0) -> bool:
+        """Block until the watch cache has completed its initial lists
+        (controller-runtime WaitForCacheSync analogue)."""
+        if not self.watch_mode:
+            return True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(r.last_list_at > 0 for r in self._reflectors):
+                return True
+            if self._stop.wait(0.02):
+                return False
+        return False
 
     def stop(self) -> None:
         self._stop.set()
@@ -228,11 +602,23 @@ class KubeCluster:
     # ---------------------------------------------------- cluster interface
     def node_names(self) -> list[str]:
         with self._lock:
-            return list(self._nodes)
+            return sorted(self._nodes)
+
+    def pods_version(self, node: str) -> int:
+        with self._lock:
+            return self._pods_ver.get(node, 0)
 
     def pods_on(self, node: str) -> list[Pod]:
+        # node-keyed index: snapshot() asks for every node every cycle, so
+        # this must not scan the whole pod cache per node
         with self._lock:
-            return list(self._bound.get(node, []))
+            return list(self._by_node.get(node, {}).values())
+
+    def pending_pods(self) -> list[Pod]:
+        """Unbound, non-terminal pods from the watch cache — the serve
+        loop's intake, replacing a per-poll LIST to the API server."""
+        with self._lock:
+            return [p for p in self._pods.values() if p.node is None]
 
     def bind(self, pod: Pod, node: str, assigned_chips=None) -> None:
         self.client.bind(pod, node, assigned_chips)
@@ -241,20 +627,28 @@ class KubeCluster:
         if assigned_chips:
             pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(assigned_chips)
         with self._lock:
-            self._bound.setdefault(node, []).append(pod)
+            # write-through so the next cycle sees the bind without waiting
+            # for the watch event (which will confirm it)
+            self._set_pod(pod.key, pod)
 
     def evict(self, pod: Pod) -> None:
         self.client.evict(pod)
         with self._lock:
-            if pod.node and pod.node in self._bound:
-                self._bound[pod.node] = [
-                    p for p in self._bound[pod.node] if p.key != pod.key]
+            self._drop_pod(pod.key)
         # match FakeCluster.evict's contract for the in-memory object: the
         # deletion ends this incarnation's chip claim, so the stale label
         # must not ride into any later spec/accounting of this Pod object
         pod.node = None
         pod.phase = PodPhase.PENDING
         pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+
+
+def _stale_event(old: Pod, new: Pod) -> bool:
+    """True when the incoming event is older than what we hold: our
+    write-through bound version beats an in-flight pre-bind event for the
+    same incarnation."""
+    return (old.k8s_uid == new.k8s_uid and old.node is not None
+            and new.node is None)
 
 
 def run_scheduler_against_cluster(client: KubeClient, profiles,
@@ -280,6 +674,7 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
     telemetry = TelemetryStore()
     cluster = KubeCluster(client, telemetry)
     cluster.start()
+    cluster.wait_synced()
     sched = MultiProfileScheduler(cluster, profiles)
 
     if metrics_port is not None:
@@ -304,9 +699,8 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
              list(sched.engines), client.base_url)
     while not stop.is_set():
         try:
-            pending = []
-            for name in sched.engines:
-                pending += client.list_pending_pods(name)
+            pending = [p for p in cluster.pending_pods()
+                       if p.scheduler_name in sched.engines]
             pending_keys = {p.key for p in pending}
             for pod in pending:
                 if sched.tracks(pod.key):

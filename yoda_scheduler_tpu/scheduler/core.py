@@ -459,7 +459,10 @@ class Scheduler:
         if self.profile.bind is not None:
             self.profile.bind.bind(CycleState(), pod, node)
         else:
-            self.cluster.bind(pod, node, None)
+            # pass coords through: real-API backends publish them as the
+            # chip-assignment annotation so the claim survives a scheduler
+            # restart (the label above only lives on the in-memory object)
+            self.cluster.bind(pod, node, coords)
         e2e_ms = (self.clock.time() - info.enqueued) * 1e3
         self.metrics.observe("schedule_latency_ms", e2e_ms)
         self.metrics.inc("pods_scheduled_total")
