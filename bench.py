@@ -115,9 +115,15 @@ def run_burst(profile_kind: str):
                if p.labels.get("tpu/gang-name") == f"gang{g}")
     )
     h = sched.metrics.histogram("schedule_latency_ms")
+    hc = sched.metrics.histogram("cycle_latency_ms")
     return {
         "p50_ms": h.quantile(0.5),
         "p99_ms": h.quantile(0.99),
+        # pure per-cycle scheduling compute (one schedule_one call), free of
+        # queue wait/backoff — p50_ms compounds queue time, so this is the
+        # number that can't be gamed by backoff tuning
+        "cycle_compute_p50_ms": round(hc.quantile(0.5), 4),
+        "cycle_compute_p99_ms": round(hc.quantile(0.99), 4),
         "bound": bound,
         "failed": sum(1 for p in pods if p.phase == PodPhase.FAILED),
         "gangs_complete": gang_ok,

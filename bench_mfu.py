@@ -19,10 +19,15 @@ Output: ONE JSON line, same contract as bench.py.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench_mfu] {msg}", file=sys.stderr, flush=True)
 
 
 # bf16 peak FLOP/s per chip by device_kind substring (public spec sheets:
@@ -92,6 +97,8 @@ def llama_train_bench(on_tpu: bool) -> dict:
     mesh = make_mesh(mesh_shape_for(1), devices=jax.devices()[:1])
     last_err = None
     for config, batch, seq in candidates:
+        _progress(f"train candidate dim={config.dim} L={config.n_layers} "
+                  f"B={batch} S={seq}")
         try:
             init_fn, step_fn, batch_sh = build_llama_train_step(
                 config, mesh, remat=True)
@@ -109,6 +116,7 @@ def llama_train_bench(on_tpu: bool) -> dict:
             # warmup/compile, then fence with a real device round trip
             params, opt_state, loss = run(params, opt_state)
             _sync(loss)
+            _progress("train step compiled + warm; timing")
             iters = 10 if on_tpu else 3
             t0 = time.perf_counter()
             for _ in range(iters):
@@ -184,6 +192,7 @@ def attention_bench(on_tpu: bool) -> dict:
         # batch must shrink with S for it to fit HBM at all. (CPU fallback:
         # tiny batch — the Pallas kernel runs in interpret mode there.)
         b = max(1, (8192 if on_tpu else 512) // s)
+        _progress(f"attention S={s} B={b}")
         key = jax.random.PRNGKey(s)
         kq, kk, kv = jax.random.split(key, 3)
         q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)

@@ -37,6 +37,14 @@ class RefFilter(FilterPlugin):
         m = node.metrics
         if m is None:
             return Status.unschedulable(f"{node.name}: scv is not exist")
+        # nodeSelector stand-in, NOT a reference plugin capability: on a
+        # mixed cluster a reference deployment pins GPU jobs to GPU nodes
+        # with ordinary k8s nodeSelectors (upstream NodeAffinity runs before
+        # the yoda plugin). Without this the baseline scatters TPU jobs onto
+        # GPU nodes and the bin-pack comparison measures mis-placement, not
+        # packing quality.
+        if spec.accelerator is not None and m.accelerator != spec.accelerator:
+            return Status.unschedulable(f"{node.name}: nodeSelector mismatch")
         if m.chip_count < max(spec.chips, 1):
             return Status.unschedulable(f"{node.name}: not enough cards")
         fits_mem = sum(
@@ -125,7 +133,12 @@ class RefScore(ScorePlugin):
 
 class TelemetryDecrementingCluster:
     """Wraps a FakeCluster: on bind, immediately debits the node's live
-    telemetry (the ideal-sniffer assumption that favours the baseline)."""
+    telemetry (the ideal-sniffer assumption that favours the baseline), and
+    assigns concrete chips the way a topology-blind device plugin would —
+    any free qualifying coords, arbitrary order, no contiguity. The
+    reference never chooses chips (SURVEY §2.2: that was the GPU device
+    plugin's job), so without this the baseline's bin-pack utilisation
+    measures 0 by construction instead of measuring its placement quality."""
 
     def __init__(self, inner) -> None:
         self._inner = inner
@@ -133,7 +146,28 @@ class TelemetryDecrementingCluster:
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
+    def _naive_chips(self, pod, node):
+        m = self._inner.telemetry.get(node)
+        if m is None:
+            return None
+        try:
+            spec = spec_for(pod)
+        except Exception:
+            return None
+        used = set()
+        for p in self._inner.pods_on(node):
+            used |= p.assigned_chips()
+        free = sorted(
+            c.coords for c in m.chips
+            if c.healthy and c.coords not in used
+            and c.hbm_free_mb >= spec.min_free_mb)
+        if len(free) < spec.chips:
+            return None  # overcommitted (reference has no allocation view)
+        return free[:spec.chips]
+
     def bind(self, pod, node, assigned_chips=None):
+        if assigned_chips is None:
+            assigned_chips = self._naive_chips(pod, node)
         self._inner.bind(pod, node, assigned_chips)
         m = self._inner.telemetry.get(node)
         if m is None:
