@@ -43,6 +43,11 @@ class FakeApiState:
         # fault injection: list of [path_substring, status, remaining_count]
         self.faults: list[list] = []
         self.uid_seq = 0
+        # graceful deletion: DELETE sets metadata.deletionTimestamp and
+        # emits MODIFIED (the pod keeps running with its nodeName, as a real
+        # kubelet does for terminationGracePeriodSeconds); the test then
+        # calls finish_termination() to emit the final DELETED
+        self.graceful_deletion = False
 
     # ------------------------------------------------------------- mutation
     def _stamp(self, kind: str, obj: dict, typ: str) -> dict:
@@ -99,6 +104,11 @@ class FakeApiState:
     def pod(self, name: str, namespace: str = "default") -> dict | None:
         with self.cond:
             return self.objects["pods"].get(f"{namespace}/{name}")
+
+    def finish_termination(self, key: str) -> dict | None:
+        """Complete a graceful deletion: the kubelet finished tearing the
+        pod down, so the object actually disappears (DELETED event)."""
+        return self.remove("pods", key)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -260,6 +270,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(404, {"kind": "Status", "code": 404})
             return self._json(200, pod)
         if method == "DELETE":
+            with s.cond:
+                pod = s.objects["pods"].get(key)
+                graceful = (s.graceful_deletion and pod is not None
+                            and not pod["metadata"].get("deletionTimestamp"))
+            if graceful:
+                pod["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+                s.upsert("pods", pod, "MODIFIED")
+                return self._json(200, {"kind": "Status", "code": 200})
             gone = s.remove("pods", key)
             code = 200 if gone is not None else 404
             return self._json(code, {"kind": "Status", "code": code})

@@ -113,6 +113,11 @@ def test_kube_cluster_adapter(client):
     cluster.bind(pod, "n1", [(0, 0, 0)])
     assert [p.key for p in cluster.pods_on("n1")] == ["default/x"]
     cluster.evict(pod)
+    # graceful-deletion semantics: the write-through marks the pod
+    # terminating (it still holds its chips until it actually goes away)
+    assert cluster.pods_on("n1")[0].terminating
+    # the API no longer lists it -> the next resync drops it
+    cluster.resync()
     assert cluster.pods_on("n1") == []
 
 

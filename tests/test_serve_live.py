@@ -164,8 +164,11 @@ class TestWatchCacheLive:
             assert server.state.pod("p")["spec"]["nodeName"] == "n1"
             assert [p.name for p in cluster.pods_on("n1")] == ["p"]
             cluster.evict(pod)
+            # write-through marks the pod terminating (graceful-deletion
+            # semantics); it leaves the node when the DELETED event lands
+            assert pod.terminating
             assert wait_for(lambda: server.state.pod("p") is None)
-            assert cluster.pods_on("n1") == []
+            assert wait_for(lambda: cluster.pods_on("n1") == [])
         finally:
             cluster.stop()
 

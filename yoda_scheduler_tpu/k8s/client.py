@@ -71,6 +71,10 @@ class KubeClient:
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
         self._ctx = None
+        # open streaming responses; close_streams() unblocks reflector
+        # threads parked in readline() so stop() doesn't wait on a socket
+        # timeout (set add/discard are atomic under the GIL)
+        self._live_streams: set = set()
         if transport is not None:
             self._transport = transport
             # injected fakes stream only if they provide the stream side
@@ -123,6 +127,7 @@ class KubeClient:
                                           context=self._ctx)
         except urllib.error.HTTPError as e:
             raise ApiError(method, path, e.code, e.read()) from None
+        self._live_streams.add(resp)
         try:
             while True:
                 line = resp.readline()
@@ -130,7 +135,27 @@ class KubeClient:
                     break  # server closed the stream (timeoutSeconds)
                 yield line
         finally:
+            self._live_streams.discard(resp)
             resp.close()
+
+    def close_streams(self) -> None:
+        """Force-close every live watch stream (shutdown path). A plain
+        close() of the fd does NOT unblock a reader parked in recv() on
+        Linux — shut the socket down first."""
+        import socket as _socket
+
+        for resp in list(self._live_streams):
+            try:
+                raw = getattr(getattr(resp, "fp", None), "raw", None)
+                sock = getattr(raw, "_sock", None)
+                if sock is not None:
+                    sock.shutdown(_socket.SHUT_RDWR)
+            except Exception:
+                pass
+            try:
+                resp.close()
+            except Exception:
+                pass
 
     def request(self, method: str, path: str, body: dict | None = None,
                 timeout: float = 10.0, retries: int | None = None) -> dict:
@@ -376,6 +401,7 @@ class Reflector:
 
     def run(self, stop: threading.Event) -> None:
         backoff = self.backoff_s
+        expired_streak = 0  # consecutive 410s since the last clean watch
         while not stop.is_set():
             try:
                 rv = self.list_once()
@@ -384,6 +410,7 @@ class Reflector:
                     if time.monotonic() - self.last_list_at > self.relist_s:
                         break  # periodic full resync
                     got_any = False
+                    relist_due = False
                     for ev in self.client.watch(
                             self.path, rv, timeout_s=self.watch_timeout_s):
                         got_any = True
@@ -394,16 +421,34 @@ class Reflector:
                         if ev.get("type") == "BOOKMARK":
                             continue
                         self.on_event(ev.get("type", ""), obj)
-                    if stop.is_set():
+                        # a stream that always yields within its rotation
+                        # must not defer the safety-net re-list forever:
+                        # check the deadline per event, not per stream
+                        if (time.monotonic() - self.last_list_at
+                                > self.relist_s):
+                            relist_due = True
+                            break
+                    expired_streak = 0  # full watch round without a 410
+                    if relist_due or stop.is_set():
                         break
                     if not got_any:
                         # stream closed without events: normal rotation;
                         # tiny pause avoids hot-spinning a broken server
                         stop.wait(0.05)
             except WatchExpired:
+                # re-list, but back off on a persistent 410 pathology so a
+                # misbehaving server doesn't eat back-to-back full LISTs
+                # (client-go rate-limits this path the same way)
+                expired_streak += 1
                 log.info("watch %s expired (410): re-listing", self.path)
-                continue  # immediate re-list
+                if expired_streak > 1:
+                    stop.wait(min(
+                        self.backoff_s * (2 ** min(expired_streak - 2, 32)),
+                        self.max_backoff_s))
+                continue
             except Exception as e:
+                if stop.is_set():
+                    return  # shutdown closed our stream: not an error
                 log.warning("watch %s failed: %s; retrying in %.1fs",
                             self.path, e, backoff)
                 stop.wait(backoff)
@@ -532,14 +577,19 @@ class KubeCluster:
                 return
             self._set_pod(key, p)
 
-    def _replace_metrics(self, items: list[dict]) -> None:
+    def _apply_metrics(self, metrics: list[TpuNodeMetrics]) -> None:
+        """Install a full metrics listing, pruning vanished nodes — shared
+        by the watch path's replace and poll-mode resync so the two modes
+        can't diverge on staleness behaviour."""
         seen = set()
-        for item in items:
-            m = TpuNodeMetrics.from_cr(item)
+        for m in metrics:
             seen.add(m.node)
             self.telemetry.put(m)
         for node in set(self.telemetry.nodes()) - seen:
             self.telemetry.delete(node)
+
+    def _replace_metrics(self, items: list[dict]) -> None:
+        self._apply_metrics([TpuNodeMetrics.from_cr(i) for i in items])
 
     def _metrics_event(self, typ: str, obj: dict) -> None:
         m = TpuNodeMetrics.from_cr(obj)
@@ -553,16 +603,17 @@ class KubeCluster:
         """One full re-list of everything (poll mode / initial seed)."""
         nodes = self.client.list_nodes()
         pod_doc = self.client.list_all("/api/v1/pods")
+        metrics = self.client.list_metrics()
         with self._lock:
             self._nodes = set(nodes)
         self._replace_pods(pod_doc.get("items", []))
-        for m in self.client.list_metrics():
-            self.telemetry.put(m)
+        self._apply_metrics(metrics)
 
     def start(self) -> None:
         if self.watch_mode:
-            # seed synchronously so the caller sees a populated cache, then
-            # stream updates
+            # seeding is asynchronous (each reflector's first LIST runs on
+            # its own thread); callers that need a populated cache block on
+            # wait_synced()
             for r in self._reflectors:
                 t = threading.Thread(target=r.run, args=(self._stop,),
                                      daemon=True,
@@ -598,6 +649,13 @@ class KubeCluster:
 
     def stop(self) -> None:
         self._stop.set()
+        # unblock reflectors parked in readline() so they observe the stop
+        # event now rather than at their socket timeout
+        close = getattr(self.client, "close_streams", None)
+        if close is not None:
+            close()
+        for t in self._threads:
+            t.join(timeout=2.0)
 
     # ---------------------------------------------------- cluster interface
     def node_names(self) -> list[str]:
@@ -615,10 +673,26 @@ class KubeCluster:
             return list(self._by_node.get(node, {}).values())
 
     def pending_pods(self) -> list[Pod]:
-        """Unbound, non-terminal pods from the watch cache — the serve
-        loop's intake, replacing a per-poll LIST to the API server."""
+        """Unbound, non-terminal, non-terminating pods from the watch cache
+        — the serve loop's intake, replacing a per-poll LIST to the API
+        server."""
         with self._lock:
-            return [p for p in self._pods.values() if p.node is None]
+            return [p for p in self._pods.values()
+                    if p.node is None and not p.terminating]
+
+    def known_pod_keys(self) -> set[str]:
+        """Every pod key in the cache (any phase) — the serve loop checks
+        tracked pods against this to notice external deletions."""
+        with self._lock:
+            return set(self._pods)
+
+    def doomed_pod_keys(self) -> set[str]:
+        """Keys of pods in graceful termination. A tracked (queued) pod
+        that turns terminating was deleted externally mid-queue: the serve
+        loop must forget it BEFORE the final DELETED event, or the engine
+        binds a deleting pod from its stale queued object."""
+        with self._lock:
+            return {k for k, p in self._pods.items() if p.terminating}
 
     def bind(self, pod: Pod, node: str, assigned_chips=None) -> None:
         self.client.bind(pod, node, assigned_chips)
@@ -633,22 +707,35 @@ class KubeCluster:
 
     def evict(self, pod: Pod) -> None:
         self.client.evict(pod)
+        # Write-through: mark this incarnation terminating rather than
+        # dropping it. A real DELETE starts GRACEFUL termination — the pod
+        # keeps running (and holding its chips) for up to
+        # terminationGracePeriodSeconds, and its next MODIFIED event (now
+        # carrying deletionTimestamp) would resurrect a dropped entry
+        # anyway. Capacity frees when the DELETED event lands; meanwhile
+        # the terminating flag blocks re-scheduling/re-eviction and keeps
+        # a preemptor's nomination hold alive while its victims drain.
+        pod.terminating = True
         with self._lock:
-            self._drop_pod(pod.key)
-        # match FakeCluster.evict's contract for the in-memory object: the
-        # deletion ends this incarnation's chip claim, so the stale label
-        # must not ride into any later spec/accounting of this Pod object
-        pod.node = None
-        pod.phase = PodPhase.PENDING
-        pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+            cur = self._pods.get(pod.key)
+            # uid guard: if the watch thread already applied DELETED(old) +
+            # ADDED(new incarnation) before we got here, the cache entry is
+            # a DIFFERENT pod that must not inherit the terminating mark
+            # (_stale_event would then pin it terminating forever)
+            if cur is not None and cur.k8s_uid == pod.k8s_uid:
+                cur.terminating = True
+                self._bump(cur.node)
 
 
 def _stale_event(old: Pod, new: Pod) -> bool:
     """True when the incoming event is older than what we hold: our
-    write-through bound version beats an in-flight pre-bind event for the
-    same incarnation."""
-    return (old.k8s_uid == new.k8s_uid and old.node is not None
-            and new.node is None)
+    write-through bound (or terminating) version beats an in-flight
+    pre-bind (or pre-delete) event for the same incarnation."""
+    if old.k8s_uid != new.k8s_uid:
+        return False
+    if old.node is not None and new.node is None:
+        return True
+    return old.terminating and not new.terminating
 
 
 def run_scheduler_against_cluster(client: KubeClient, profiles,
@@ -660,8 +747,6 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
     EVERY configured profile, run scheduling cycles, bind through the API
     server. `profiles` is a list of (SchedulerConfig, enablement) pairs
     (cli.load_profiles)."""
-    from ..scheduler.multi import MultiProfileScheduler
-
     stop = stop_event or threading.Event()
     if leader_elect:
         from .leaderelect import LeaderElector
@@ -674,6 +759,16 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
     telemetry = TelemetryStore()
     cluster = KubeCluster(client, telemetry)
     cluster.start()
+    try:
+        return _serve(client, cluster, profiles, metrics_port, poll_s, stop)
+    finally:
+        cluster.stop()  # join reflector threads; no orphaned watchers
+
+
+def _serve(client: KubeClient, cluster: KubeCluster, profiles,
+           metrics_port, poll_s: float, stop: threading.Event) -> int:
+    from ..scheduler.multi import MultiProfileScheduler
+
     cluster.wait_synced()
     sched = MultiProfileScheduler(cluster, profiles)
 
@@ -714,11 +809,22 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
                     e.failed.pop(pod.key, None)  # new incarnation resets
                 seen[pod.key] = pod.k8s_uid
                 sched.submit(pod)
+            known = cluster.known_pod_keys()
+            doomed = cluster.doomed_pod_keys()
             for key in list(seen):
                 if key not in pending_keys and not sched.tracks(key):
                     seen.pop(key, None)
                     for e in sched.engines.values():
                         e.failed.pop(key, None)
+                elif (key not in known or key in doomed) and sched.tracks(key):
+                    # the incarnation we handled vanished (external DELETE
+                    # while queued/parked at Permit) or entered graceful
+                    # termination: release its queue entry, reservation,
+                    # and nomination hold — otherwise the hold subtracts
+                    # capacity forever, or the engine binds a deleting pod
+                    # from its stale queued object
+                    sched.forget(key)
+                    seen.pop(key, None)
             for d, interval, last in deschedulers:
                 now = time.time()
                 if now - last[0] >= interval:

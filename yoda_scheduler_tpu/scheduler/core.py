@@ -355,6 +355,16 @@ class Scheduler:
         self._filter_start = (self._filter_start + checked) % max(len(nodes), 1)
 
         if not feasible:
+            # a nominated preemptor whose victims are still in graceful
+            # termination is just waiting for capacity it is already
+            # entitled to — don't plan a second preemption round (`nom`
+            # from the filter-ordering step above; the cycle lock means it
+            # cannot have changed since)
+            if nom is not None and any(
+                    p.terminating for p in self.cluster.pods_on(nom[0])):
+                return self._unschedulable(
+                    info, trace,
+                    f"waiting for victims on {nom[0]} to terminate")
             # PostFilter: preemption — the plugin plans, the engine evicts
             for p in self.profile.post_filter:
                 nominated, victims, st = p.post_filter(state, pod, snapshot, trace.filter_verdicts)
@@ -438,7 +448,16 @@ class Scheduler:
                 return self._unschedulable(info, trace, f"permit: {st.message}")
 
         # Bind this pod, then any gang peers its admission released
-        self._bind(info, chosen, trace)
+        if not self._bind(info, chosen, trace):
+            # anchor bind failed: the gang (if any) must not half-bind —
+            # reject the waiting peers now instead of letting them park
+            # until the Permit deadline with reservations held
+            if self.gang_permit is not None:
+                gang = self.gang_permit.gang_of(pod)
+                if gang:
+                    for key in self.gang_permit.fail_gang(gang):
+                        self._rollback_waiting(key)
+            return "bind-error"
         if self.gang_permit is not None:
             for peer_key in self.gang_permit.peers_to_approve(pod):
                 w = self.waiting.pop(peer_key, None)
@@ -447,37 +466,59 @@ class Scheduler:
         return "bound"
 
     # ------------------------------------------------------------ sub-steps
-    def _bind(self, info: QueuedPodInfo, node: str, trace: CycleTrace) -> None:
+    def _bind(self, info: QueuedPodInfo, node: str, trace: CycleTrace) -> bool:
+        """Bind through the configured binder. On failure (API outage
+        outlasting the client's retry budget, pod deleted, bound elsewhere)
+        the reservation is rolled back and the pod requeued with backoff —
+        an escaped exception here used to strand the pod Pending forever."""
         pod = info.pod
-        coords = self.allocator.complete(pod) if self.allocator is not None else None
+        entry = self.allocator.assignment_of(pod) if self.allocator is not None else None
+        coords = entry[1] if entry is not None else None
+        try:
+            if self.profile.bind is not None:
+                self.profile.bind.bind(CycleState(), pod, node)
+            else:
+                # pass coords through: real-API backends publish them as the
+                # chip-assignment annotation so the claim survives a
+                # scheduler restart
+                self.cluster.bind(pod, node, coords)
+        except Exception as e:
+            if self.allocator is not None:
+                # release the pending reservation; keep any nomination (a
+                # preemptor's entitlement survives a transient bind failure)
+                self.allocator.unreserve(CycleState(), pod, node)
+            self.metrics.inc("bind_errors_total")
+            self._unschedulable(info, trace, f"bind failed: {e}",
+                                outcome="bind-error")
+            return False
         if self.allocator is not None:
+            self.allocator.complete(pod)  # reservation consumed
             self.allocator.unnominate(pod.key)  # entitlement consumed
         if coords is not None:
             # publish the chip assignment on the pod regardless of binder, so
             # allocation accounting sees it next cycle
             pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(coords)
-        if self.profile.bind is not None:
-            self.profile.bind.bind(CycleState(), pod, node)
-        else:
-            # pass coords through: real-API backends publish them as the
-            # chip-assignment annotation so the claim survives a scheduler
-            # restart (the label above only lives on the in-memory object)
-            self.cluster.bind(pod, node, coords)
         e2e_ms = (self.clock.time() - info.enqueued) * 1e3
         self.metrics.observe("schedule_latency_ms", e2e_ms)
         self.metrics.inc("pods_scheduled_total")
         self._finish(trace, "bound", node=node)
+        return True
 
     def _unschedulable(self, info: QueuedPodInfo, trace: CycleTrace, reason: str,
                        outcome: str = "unschedulable") -> str:
         info.last_failure = reason
         if self.allocator is not None:
             nom = self.allocator.nomination_of(info.pod.key)
-            if nom is not None and trace.filter_verdicts.get(nom[0]) != "ok":
+            if (nom is not None and trace.filter_verdicts.get(nom[0]) != "ok"
+                    and not any(p.terminating
+                                for p in self.cluster.pods_on(nom[0]))):
                 # the nominated node no longer fits this pod (chips went
                 # unhealthy, telemetry stale, node gone): release the hold so
                 # it doesn't block the node's capacity forever — upstream
-                # clears nominatedNodeName the same way
+                # clears nominatedNodeName the same way. While victims are
+                # still draining (terminating pods present) the node is
+                # EXPECTED to fail the filter, so the hold survives — this
+                # is the whole point of nominatedNodeName semantics.
                 self.allocator.unnominate(info.pod.key)
         if self.config.max_attempts and info.attempts + 1 >= self.config.max_attempts:
             info.pod.phase = PodPhase.FAILED
@@ -521,10 +562,7 @@ class Scheduler:
             for key in members:
                 self._rollback_waiting(key)
 
-    def _rollback_waiting(self, key: str) -> None:
-        w = self.waiting.pop(key, None)
-        if w is None:
-            return
+    def _unreserve_waiting(self, w: _WaitingPod) -> None:
         state = CycleState()
         try:
             state.write("workload_spec", spec_for(w.info.pod))
@@ -532,7 +570,32 @@ class Scheduler:
             pass
         for p in reversed(self.profile.reserve):
             p.unreserve(state, w.info.pod, w.node)
+
+    def _rollback_waiting(self, key: str) -> None:
+        w = self.waiting.pop(key, None)
+        if w is None:
+            return
+        self._unreserve_waiting(w)
         self.queue.requeue_backoff(w.info, now=self.clock.time())
+
+    def forget(self, pod_key: str) -> None:
+        """The pod vanished from the cluster (external DELETE while queued
+        or parked at Permit): drop every trace so its reservation and
+        nomination hold don't leak. A parked gang member takes its whole
+        gang down — the gang can never complete without it, and its key
+        left in the coordinator's waiting set would otherwise let a
+        re-formed gang 'complete' with a phantom member."""
+        w = self.waiting.pop(pod_key, None)
+        if w is not None:
+            self._unreserve_waiting(w)
+            gang = self.gang_permit.gang_of(w.info.pod) if self.gang_permit else None
+            if gang:
+                for key in self.gang_permit.fail_gang(gang):
+                    self._rollback_waiting(key)  # surviving peers requeue
+        self.queue.remove(pod_key)
+        if self.allocator is not None:
+            self.allocator.unnominate(pod_key)
+        self.failed.pop(pod_key, None)
 
     # -------------------------------------------------------------- main loop
     def run_one(self) -> str | None:

@@ -144,6 +144,8 @@ class Descheduler:
         return plan
 
     def _movable(self, pod: Pod) -> bool:
+        if pod.terminating:
+            return False  # already draining; nothing to gain by re-evicting
         if pod.scheduler_name != self.sched.config.scheduler_name:
             # another profile's pod: evicting it here would strand it
             # (our submit() rejects foreign schedulerNames)

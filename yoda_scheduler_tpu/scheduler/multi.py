@@ -83,6 +83,11 @@ class MultiProfileScheduler:
     def tracks(self, pod_key: str) -> bool:
         return any(e.tracks(pod_key) for e in self.engines.values())
 
+    def forget(self, pod_key: str) -> None:
+        """Drop a vanished pod from every engine (see Scheduler.forget)."""
+        for e in self.engines.values():
+            e.forget(pod_key)
+
     # ------------------------------------------------------------------- drive
     def run_until_idle(self, max_cycles: int = 10_000) -> int:
         """Drain all engines round-robin, one scheduling cycle per turn;

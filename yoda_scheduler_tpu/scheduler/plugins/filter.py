@@ -26,7 +26,7 @@ from ..framework import CycleState, FilterPlugin, NodeInfo, Status
 from ...topology.torus import fits_shape, parse_topology, best_fit_block
 from ...utils.labels import WorkloadSpec
 from .allocator import ChipAllocator, _node_shape
-from .gang import GangCoordinator
+from .gang import GangCoordinator, bound_gang_members
 
 
 class TelemetryFilter(FilterPlugin):
@@ -68,6 +68,11 @@ class TelemetryFilter(FilterPlugin):
                 )
             if self.gangs is not None:
                 chosen = self.gangs.chosen_slice(spec.gang_name)
+                if chosen is None:
+                    # partially-bound gang (peer bind failure / scheduler
+                    # restart): members already on a slice pin the choice
+                    # even though the coordinator's state is gone
+                    _, chosen = bound_gang_members(state, spec.gang_name)
                 if chosen is not None and chosen != m.slice_id:
                     return Status.unschedulable(
                         f"{node.name}: gang {spec.gang_name} is placing on slice {chosen}"

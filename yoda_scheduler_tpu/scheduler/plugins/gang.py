@@ -18,8 +18,41 @@ from __future__ import annotations
 import threading
 
 from ..framework import CycleState, PermitPlugin, ReservePlugin, Status
-from ...utils.labels import WorkloadSpec, spec_for
+from ...utils.labels import GANG_NAME_LABEL, WorkloadSpec, spec_for
 from ...utils.pod import Pod
+
+
+def bound_gang_members(state: CycleState, gang: str) -> tuple[set[str], str | None]:
+    """(pod keys, slice id) of gang members ALREADY BOUND in the cluster,
+    from this cycle's snapshot — cluster truth, not coordinator state.
+
+    This is what lets a gang survive partial binds: if a peer's bind fails
+    after the anchor bound (API outage mid-gang), or the scheduler restarts
+    mid-assembly, the coordinator's waiting set is gone but the bound
+    members are still visible on their nodes. A retrying member counts them
+    toward gang completeness and sticks to their slice. Cached per cycle in
+    CycleState (one snapshot scan per gang per cycle).
+
+    Caveat: gang names must be unique per job — reusing a name while an
+    older gang's pods are still bound would let the new gang 'complete'
+    against them."""
+    key = "gang_bound:" + gang
+    cached = state.read_or(key)
+    if cached is not None:
+        return cached
+    keys: set[str] = set()
+    slice_id: str | None = None
+    snapshot = state.read_or("snapshot")
+    if snapshot is not None:
+        for ni in snapshot.list():
+            for p in ni.pods:
+                if (p.labels.get(GANG_NAME_LABEL) == gang
+                        and not p.terminating):
+                    keys.add(p.key)
+                    if ni.metrics is not None and ni.metrics.slice_id:
+                        slice_id = ni.metrics.slice_id
+    state.write(key, (keys, slice_id))
+    return keys, slice_id
 
 
 class GangCoordinator:
@@ -80,11 +113,17 @@ class GangPermit(PermitPlugin, ReservePlugin):
         if not spec.is_gang:
             return Status.success(), 0.0
         n_waiting = self.gangs.add_waiting(spec.gang_name, pod.key)
-        if n_waiting >= spec.gang_size:
+        # members already bound in the cluster count toward completeness:
+        # this re-admits stragglers of a partially-bound gang (peer bind
+        # failure, scheduler restart mid-assembly) instead of parking them
+        # at 1/N forever
+        bound, _ = bound_gang_members(state, spec.gang_name)
+        n = n_waiting + len(bound - {pod.key})
+        if n >= spec.gang_size:
             # gang complete: this pod proceeds; the engine approves the rest
             return Status.success(), 0.0
         return Status.wait(
-            f"gang {spec.gang_name}: {n_waiting}/{spec.gang_size} members placed"
+            f"gang {spec.gang_name}: {n}/{spec.gang_size} members placed"
         ), self.timeout_s
 
     # ------------------------------------------------------------ engine hooks

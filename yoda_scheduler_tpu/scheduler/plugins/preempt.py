@@ -29,7 +29,11 @@ def _evictable(pod: Pod) -> bool:
     """Gang members are never preemption victims: evicting one strands its
     peers bound and holding chips — exactly the partial-gang deadlock
     GangCoordinator's all-or-nothing admission exists to prevent. (The
-    descheduler applies the same exclusion in its _movable check.)"""
+    descheduler applies the same exclusion in its _movable check.)
+    Already-terminating pods are excluded too: their chips free on their
+    own shortly, and re-evicting them frees nothing extra."""
+    if pod.terminating:
+        return False
     try:
         return not spec_for(pod).is_gang
     except LabelError:

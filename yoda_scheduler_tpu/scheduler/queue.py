@@ -95,7 +95,11 @@ class SchedulingQueue:
         """Return an unschedulable pod with exponential backoff 1s -> 10s."""
         now = time.time() if now is None else now
         info.attempts += 1
-        delay = min(self._initial * (2 ** (info.attempts - 1)), self._max)
+        # cap the exponent: a permanently-unschedulable pod with
+        # max_attempts=0 retries forever, and 2**attempts overflows float
+        # past ~1024 attempts
+        delay = min(self._initial * (2 ** min(info.attempts - 1, 32)),
+                    self._max)
         info.not_before = now + delay
         self._backoff.append(info)
 
@@ -105,6 +109,18 @@ class SchedulingQueue:
         next pop (the nominated-node fast-retry analogue)."""
         info.not_before = 0.0
         self._push_active(info)
+
+    def remove(self, pod_key: str) -> bool:
+        """Drop a pod from the active queue and backoff lot (external
+        deletion while queued). Returns True if anything was removed."""
+        n0 = len(self)
+        if self._key is not None:
+            self._active = [e for e in self._active if e[2].pod.key != pod_key]
+            heapq.heapify(self._active)
+        else:
+            self._active = [q for q in self._active if q.pod.key != pod_key]
+        self._backoff = [q for q in self._backoff if q.pod.key != pod_key]
+        return len(self) < n0
 
     def contains(self, pod_key: str) -> bool:
         return any(q.pod.key == pod_key for q in self._active_infos()) or any(

@@ -45,6 +45,12 @@ class Pod:
     # (Deployment/Job/...); bare pods have none and are NOT recreated after
     # an API DELETE — eviction-based flows must refuse them on real clusters
     has_controller: bool = False
+    # metadata.deletionTimestamp set: the pod is in graceful termination
+    # (DELETE issued, still holding its node/chips for up to
+    # terminationGracePeriodSeconds). Terminating pods keep occupying
+    # capacity in the cache but are never scheduled or re-evicted, and a
+    # preemptor's nomination hold survives while its victims drain.
+    terminating: bool = False
     created: float = field(default_factory=time.time)
 
     @property
@@ -83,4 +89,5 @@ class Pod:
                 ref.get("controller")
                 for ref in meta.get("ownerReferences", []) or []
             ),
+            terminating=bool(meta.get("deletionTimestamp")),
         )
