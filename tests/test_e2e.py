@@ -33,9 +33,12 @@ def pub_publish_keepalive(pub, nodes, clock):
 
 def refresh(sched):
     """Re-stamp heartbeats against the fake clock (stand-in for the sniffer
-    daemon publishing on its interval)."""
+    daemon publishing on its interval). Publishes through put() — the
+    store's version counter is what invalidates scheduler caches, exactly
+    as a real sniffer's publication would."""
     for m in sched.cluster.telemetry.list():
         m.heartbeat = sched.clock.time()
+        sched.cluster.telemetry.put(m)
 
 
 class TestScenario1:

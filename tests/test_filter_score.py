@@ -109,9 +109,12 @@ class TestFilterPredicates:
         alloc = ChipAllocator()
         f = TelemetryFilter(alloc, GangCoordinator())
         m = make_tpu_node("n", chips=4)
+        from yoda_scheduler_tpu.scheduler.framework import Snapshot
+
         state = mk_state({"scv/number": "3"})
-        state.write("node_info:n", node_info(m))
-        assert f.filter(state, POD, node_info(m)).ok
+        ni = node_info(m)
+        state.write("snapshot", Snapshot({"n": ni}))
+        assert f.filter(state, POD, ni).ok
         assert alloc.reserve(state, Pod("r"), "n").ok
         # the next pod's cycle gets a fresh CycleState (free_coords is
         # memoised per cycle), exactly as the engine does

@@ -37,6 +37,7 @@ import urllib.request
 
 from ..telemetry.schema import CRD_GROUP, CRD_PLURAL, CRD_VERSION, TpuNodeMetrics
 from ..telemetry.store import TelemetryStore
+from ..utils.changelog import ChangeLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
 log = logging.getLogger("yoda-tpu.k8s")
@@ -478,6 +479,10 @@ class KubeCluster:
         self._pods: dict[str, Pod] = {}          # key -> non-terminal pod
         self._by_node: dict[str, dict[str, Pod]] = {}  # node -> key -> pod
         self._pods_ver: dict[str, int] = {}      # node -> change counter
+        # global change log + membership version for incremental snapshots
+        # (same contract as FakeCluster/TelemetryStore)
+        self._changes = ChangeLog()
+        self._nodes_ver = 0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._reflectors: list[Reflector] = []
@@ -498,10 +503,29 @@ class KubeCluster:
     def _bump(self, node: str | None) -> None:
         if node:
             self._pods_ver[node] = self._pods_ver.get(node, 0) + 1
+            self._changes.record(node)
+
+    @property
+    def nodes_version(self) -> int:
+        return self._nodes_ver
+
+    @property
+    def pods_global_version(self) -> int:
+        return self._changes.version
+
+    def changes_since(self, version: int) -> tuple[int, set[str] | None]:
+        """(current version, nodes whose pod set changed after `version`);
+        None when the log was trimmed past it (full rebuild)."""
+        with self._lock:
+            return self._changes.changes_since(version)
 
     def _replace_nodes(self, items: list[dict]) -> None:
         names = {i["metadata"]["name"] for i in items}
         with self._lock:
+            if names != self._nodes:
+                self._nodes_ver += 1
+                for n in names ^ self._nodes:
+                    self._bump(n)
             self._nodes = names
 
     def _node_event(self, typ: str, obj: dict) -> None:
@@ -510,9 +534,14 @@ class KubeCluster:
             return
         with self._lock:
             if typ == "DELETED":
+                if name in self._nodes:
+                    self._nodes_ver += 1
                 self._nodes.discard(name)
                 self._bump(name)
             else:
+                if name not in self._nodes:
+                    self._nodes_ver += 1
+                    self._bump(name)
                 self._nodes.add(name)
 
     def _set_pod(self, key: str, p: Pod) -> None:

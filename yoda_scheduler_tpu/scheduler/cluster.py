@@ -17,6 +17,7 @@ import threading
 from typing import Protocol
 
 from ..telemetry.store import TelemetryStore
+from ..utils.changelog import ChangeLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
 
@@ -45,15 +46,39 @@ class FakeCluster:
         # scheduler reuse per-node snapshot state across cycles — a bind
         # invalidates one node, not the whole cluster
         self._pods_ver: dict[str, int] = {}
+        # global change log + node-membership version for incremental
+        # snapshots and the unschedulable-class memo
+        self._changes = ChangeLog()
+        self._nodes_ver = 0
 
     def _bump(self, node: str) -> None:
         # callers hold self._lock; every mutation of a node's bound-pod set
         # MUST bump, or cross-cycle snapshot reuse serves stale NodeInfos
         self._pods_ver[node] = self._pods_ver.get(node, 0) + 1
+        self._changes.record(node)
+
+    @property
+    def nodes_version(self) -> int:
+        """Bumped whenever node MEMBERSHIP changes (add/remove)."""
+        return self._nodes_ver
+
+    @property
+    def pods_global_version(self) -> int:
+        """Bumped on any bound-pod mutation anywhere (cheap read)."""
+        return self._changes.version
+
+    def changes_since(self, version: int) -> tuple[int, set[str] | None]:
+        """(current version, nodes whose bound-pod set changed after
+        `version`); None when the log was trimmed past it (full rebuild).
+        Mirrors TelemetryStore.changes_since."""
+        with self._lock:
+            return self._changes.changes_since(version)
 
     # ------------------------------------------------------------- node admin
     def add_node(self, name: str) -> None:
         with self._lock:
+            if name not in self._nodes:
+                self._nodes_ver += 1
             self._nodes.add(name)
             self._bound.setdefault(name, [])
 
@@ -68,6 +93,8 @@ class FakeCluster:
     def remove_node(self, name: str) -> list[Pod]:
         """Node goes away; its pods return to the caller for requeueing."""
         with self._lock:
+            if name in self._nodes:
+                self._nodes_ver += 1
             self._nodes.discard(name)
             orphans = self._bound.pop(name, [])
             self._bump(name)

@@ -58,7 +58,7 @@ from .plugins import (
     TelemetryScore,
     TopologyScore,
 )
-from ..utils.labels import LabelError, spec_for
+from ..utils.labels import LabelError, spec_for, workload_class
 from ..utils.obs import CycleTrace, Metrics, TraceLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
@@ -208,6 +208,16 @@ class Scheduler:
         # snapshot() for the cross-cycle reuse contract
         self._ni_cache: dict[str, tuple[tuple, NodeInfo]] = {}
         self._known_nodes: set[str] = set()
+        # incremental-snapshot state: (Snapshot, cluster gver, telemetry
+        # ver, nodes membership ver) from the previous cycle
+        self._snap: tuple[Snapshot, int, int, int] | None = None
+        # unschedulable-CLASS memo: spec -> (cluster versions, reason). A
+        # pod whose identical-spec classmate just failed, with NOTHING
+        # changed since (no bind/evict/telemetry/reservation/nomination/
+        # membership event), fails in O(1) instead of rescanning every
+        # node — the native analogue of upstream kube-scheduler parking
+        # unschedulable pods until a relevant cluster event.
+        self._unsched_memo: dict = {}
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
@@ -243,18 +253,77 @@ class Scheduler:
             return num_nodes
         return max(num_nodes * pct // 100, 100)
 
+    def _cluster_versions(self) -> tuple | None:
+        """Version vector over everything a filter verdict can depend on:
+        bound pods, telemetry, node membership, reservations+nominations.
+        None when the backend doesn't expose the counters."""
+        pg = getattr(self.cluster, "pods_global_version", None)
+        if pg is None:
+            return None
+        return (pg,
+                self.cluster.telemetry.resource_version,
+                getattr(self.cluster, "nodes_version", 0),
+                self.allocator.version if self.allocator is not None else 0)
+
     # --------------------------------------------------------------- snapshot
     def snapshot(self) -> Snapshot:
-        """Per-cycle view. NodeInfo objects (and their claimed/assigned
-        memos) are reused across cycles while the node's telemetry
-        generation and bound-pod version are unchanged — a bind touches one
-        node, so the other N-1 infos carry over untouched. Falls back to
-        full rebuilds on backends without pods_version."""
-        pods_version = getattr(self.cluster, "pods_version", None)
+        """Per-cycle view. Incremental: backends exposing change logs
+        (changes_since on the cluster and telemetry store, plus a node
+        membership version) let a cycle rebuild ONLY the nodes that
+        changed since the previous cycle — a bind touches one node, so at
+        1000 nodes the per-cycle cost is O(dirty), not O(cluster). Node
+        membership changes or an out-of-range log fall back to the full
+        walk, which itself reuses per-node NodeInfos via _ni_cache."""
+        cluster = self.cluster
+        csince = getattr(cluster, "changes_since", None)
+        tsince = getattr(cluster.telemetry, "changes_since", None)
+        nver = getattr(cluster, "nodes_version", None)
+        if csince is not None and tsince is not None and self._snap is not None:
+            snap, pv0, tv0, nv0 = self._snap
+            if nver == nv0:  # membership unchanged
+                pv, pdirty = csince(pv0)
+                tv, tdirty = tsince(tv0)
+                if pdirty is not None and tdirty is not None:
+                    dirty = pdirty | tdirty
+                    if not dirty:
+                        self._snap = (snap, pv, tv, nv0)
+                        return snap
+                    infos = dict(snap._node_infos)
+                    pods_version = getattr(cluster, "pods_version", None)
+                    for name in dirty:
+                        if name not in infos:
+                            continue  # telemetry for a non-member node
+                        ni = NodeInfo(name=name,
+                                      metrics=cluster.telemetry.get(name),
+                                      pods=cluster.pods_on(name))
+                        infos[name] = ni
+                        if pods_version is not None:
+                            key = (getattr(ni.metrics, "generation", None),
+                                   pods_version(name))
+                            self._ni_cache[name] = (key, ni)
+                    fresh = Snapshot(infos)
+                    self._snap = (fresh, pv, tv, nv0)
+                    return fresh
+        return self._full_snapshot()
+
+    def _full_snapshot(self) -> Snapshot:
+        cluster = self.cluster
+        # sample the version vector BEFORE reading any node data: a
+        # concurrent mutation during the walk then just re-flags its node
+        # dirty next cycle. Sampling after would absorb the event — the
+        # stored version covers a change the snapshot never saw, and
+        # changes_since would never report it again.
+        csince = getattr(cluster, "changes_since", None)
+        tsince = getattr(cluster.telemetry, "changes_since", None)
+        pre = None
+        if csince is not None and tsince is not None:
+            pre = (csince(1 << 62)[0], tsince(1 << 62)[0],
+                   getattr(cluster, "nodes_version", 0))
+        pods_version = getattr(cluster, "pods_version", None)
         infos: dict[str, NodeInfo] = {}
-        names = self.cluster.node_names()
+        names = cluster.node_names()
         for name in names:
-            metrics = self.cluster.telemetry.get(name)
+            metrics = cluster.telemetry.get(name)
             if pods_version is not None:
                 key = (getattr(metrics, "generation", None), pods_version(name))
                 cached = self._ni_cache.get(name)
@@ -262,11 +331,11 @@ class Scheduler:
                     infos[name] = cached[1]
                     continue
                 ni = NodeInfo(name=name, metrics=metrics,
-                              pods=self.cluster.pods_on(name))
+                              pods=cluster.pods_on(name))
                 self._ni_cache[name] = (key, ni)
             else:
                 ni = NodeInfo(name=name, metrics=metrics,
-                              pods=self.cluster.pods_on(name))
+                              pods=cluster.pods_on(name))
             infos[name] = ni
         # prune per-node caches for departed nodes on EVERY backend — the
         # allocator's free-set cache fills from free_coords() regardless of
@@ -277,8 +346,19 @@ class Scheduler:
                 self._ni_cache.pop(n, None)
             if self.allocator is not None:
                 self.allocator.forget_nodes(gone)
+            # plugin-local per-node caches (filter verdicts, score terms)
+            # prune through the same hook
+            for plugins in (self.profile.filter, self.profile.pre_score,
+                            self.profile.score):
+                for p in plugins:
+                    forget = getattr(p, "forget_nodes", None)
+                    if forget is not None:
+                        forget(gone)
         self._known_nodes = set(infos)
-        return Snapshot(infos)
+        snap = Snapshot(infos)
+        if pre is not None:
+            self._snap = (snap, pre[0], pre[1], pre[2])
+        return snap
 
     # ------------------------------------------------------------- the cycle
     def schedule_one(self, info: QueuedPodInfo) -> str:
@@ -308,10 +388,19 @@ class Scheduler:
             return "failed"
         state.write("workload_spec", spec)
 
+        # unschedulable-class fast path (see _unsched_memo). Gang pods and
+        # nominated preemptors carry state outside the version vector.
+        memo_ok = (not spec.is_gang
+                   and (self.allocator is None
+                        or self.allocator.nomination_of(pod.key) is None))
+        vers = self._cluster_versions()
+        if memo_ok and vers is not None:
+            hit = self._unsched_memo.get(spec)
+            if hit is not None and hit[0] == vers:
+                return self._unschedulable(info, trace, hit[1])
+
         snapshot = self.snapshot()
         state.write("snapshot", snapshot)
-        for ni in snapshot.list():
-            state.write("node_info:" + ni.name, ni)
 
         # PreFilter
         for p in self.profile.pre_filter:
@@ -392,12 +481,25 @@ class Scheduler:
                     self.queue.requeue_immediate(info)
                     self._finish(trace, "preempting", reason=info.last_failure)
                     return "preempting"
-            return self._unschedulable(
-                info, trace,
-                "no feasible node: " + "; ".join(
-                    f"{n}: {v}" for n, v in sorted(trace.filter_verdicts.items()) if v != "ok"
-                )[:500],
-            )
+            # build the diagnostic bounded: at 1000 nodes a full join of
+            # every failure verdict costs more than the whole cycle
+            parts: list[str] = []
+            size = 0
+            for n, v in sorted(trace.filter_verdicts.items()):
+                if v == "ok":
+                    continue
+                parts.append(f"{n}: {v}")
+                size += len(parts[-1])
+                if size > 500:
+                    parts.append("...")
+                    break
+            reason = "no feasible node: " + "; ".join(parts)[:500]
+            if memo_ok and vers is not None:
+                # classmates fail in O(1) until any cluster event
+                if len(self._unsched_memo) > 256:
+                    self._unsched_memo.clear()
+                self._unsched_memo[spec] = (vers, reason)
+            return self._unschedulable(info, trace, reason)
 
         # PreScore
         for p in self.profile.pre_score:
@@ -500,6 +602,10 @@ class Scheduler:
             pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(coords)
         e2e_ms = (self.clock.time() - info.enqueued) * 1e3
         self.metrics.observe("schedule_latency_ms", e2e_ms)
+        # per-class decomposition (gang / multi-chip / gpu / unlabeled ...):
+        # aggregate p50 hides class-level regressions behind class mix
+        self.metrics.observe(
+            "schedule_latency_ms_class_" + workload_class(pod), e2e_ms)
         self.metrics.inc("pods_scheduled_total")
         self._finish(trace, "bound", node=node)
         return True

@@ -22,7 +22,6 @@ Two deliberate departures from the reference, per SURVEY.md §3.2:
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from enum import IntEnum
@@ -51,7 +50,9 @@ class Status:
 
     @classmethod
     def success(cls) -> "Status":
-        return cls(Code.SUCCESS)
+        # shared singleton: success statuses are created per (pod, node)
+        # on the hot path and nobody mutates them
+        return _SUCCESS
 
     @classmethod
     def unschedulable(cls, message: str) -> "Status":
@@ -77,35 +78,36 @@ class Status:
         raise TypeError("use status.ok / status.code, not truthiness")
 
 
+_SUCCESS = Status(Code.SUCCESS)
+
+
 class CycleState:
     """Per-scheduling-cycle scratch space shared between plugins.
 
     The reference used framework.CycleState with manual Lock/Write/Unlock
-    (reference pkg/yoda/collection/collection.go:53-55); same contract here,
-    with the lock managed internally so plugins cannot forget it."""
+    (reference pkg/yoda/collection/collection.go:53-55) because upstream
+    runs Filter/Score over nodes in parallel goroutines. Here a cycle runs
+    single-threaded under the engine's cycle lock, and single dict ops are
+    atomic under the GIL — so the state is a plain dict (read/write are the
+    hot path: several accesses per (pod, node) filter/score call)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
         self._data: dict[str, Any] = {}
 
     def write(self, key: str, value: Any) -> None:
-        with self._lock:
-            self._data[key] = value
+        self._data[key] = value
 
     def read(self, key: str) -> Any:
-        with self._lock:
-            if key not in self._data:
-                raise KeyError(f"cycle state has no key {key!r}")
-            return self._data[key]
+        if key not in self._data:
+            raise KeyError(f"cycle state has no key {key!r}")
+        return self._data[key]
 
     def read_or(self, key: str, default: Any = None) -> Any:
-        with self._lock:
-            return self._data.get(key, default)
+        return self._data.get(key, default)
 
     def clone(self) -> "CycleState":
         c = CycleState()
-        with self._lock:
-            c._data = dict(self._data)
+        c._data = dict(self._data)
         return c
 
 

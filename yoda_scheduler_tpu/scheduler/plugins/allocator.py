@@ -20,6 +20,30 @@ from ...utils.labels import WorkloadSpec
 from ...utils.pod import Pod
 
 
+class ClassStats:
+    """Aggregates over one node's QUALIFYING chips — healthy, unclaimed,
+    and meeting a workload class's (min free HBM, min clock). Computed once
+    per (node state, class) instead of once per (pod, node) by each of
+    Filter / PreScore / Score: bursts are dominated by pods sharing a few
+    label classes, and a bind changes ONE node, so nearly every per-chip
+    scan a cycle would do is a repeat of the previous cycle's.
+
+    maxima/sums attribute order: (ici_bandwidth_gbps, clock_mhz, core_count,
+    hbm_free_mb, power_w, hbm_total_mb)."""
+
+    __slots__ = ("count", "qcoords", "maxima", "sums")
+
+    def __init__(self, count: int, qcoords: frozenset,
+                 maxima: tuple, sums: tuple) -> None:
+        self.count = count
+        self.qcoords = qcoords
+        self.maxima = maxima
+        self.sums = sums
+
+
+_ZERO6 = (0, 0, 0, 0, 0, 0)
+
+
 class ChipAllocator(ReservePlugin):
     name = "chip-allocator"
 
@@ -36,6 +60,15 @@ class ChipAllocator(ReservePlugin):
         self._pending_ver: dict[str, int] = {}
         self._free_cache: dict[str, dict[tuple[int, int], set[Coord]]] = {}
         self._free_cache_slots = 4
+        # per-node ClassStats cache, keyed by (NodeInfo serial, pending
+        # version, min_free_mb, min_clock_mhz) — a few slots per node since
+        # a burst usually carries a handful of label classes
+        self._class_cache: dict[str, dict[tuple, ClassStats]] = {}
+        self._class_cache_slots = 8
+        # contiguity-score memo (TopologyScore's per-(pod, node) term is a
+        # block search — the single most expensive scoring computation at
+        # 1000-node scale), keyed by (serial, pending version, k)
+        self._contig_cache: dict[str, dict[tuple, float]] = {}
         # nominated capacity claims (upstream nominatedNodeName semantics):
         # a successful preemption entitles the preemptor to the freed chips
         # on its nominated node until it binds or fails permanently. Claims
@@ -44,9 +77,17 @@ class ChipAllocator(ReservePlugin):
         # them first (or co-hosted profiles rebind victims into the hole
         # and the preemptor livelocks).
         self._nominated: dict[str, tuple[str, int, int]] = {}  # pod.key -> (node, chips, priority)
+        # global version over reservations + nominations (cheap read) — the
+        # engine's unschedulable-class memo keys on it
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
 
     def _bump(self, node: str) -> None:
         self._pending_ver[node] = self._pending_ver.get(node, 0) + 1
+        self._version += 1
 
     def forget_nodes(self, gone: set[str]) -> None:
         """Drop cached per-node state for nodes that left the cluster
@@ -55,6 +96,8 @@ class ChipAllocator(ReservePlugin):
         with self._lock:
             for n in gone:
                 self._free_cache.pop(n, None)
+                self._class_cache.pop(n, None)
+                self._contig_cache.pop(n, None)
                 self._pending_ver.pop(n, None)
 
     # ----------------------------------------------------------------- views
@@ -65,6 +108,12 @@ class ChipAllocator(ReservePlugin):
     def pending_chip_count(self, node: str) -> int:
         return len(self.pending_on(node))
 
+    def pending_version(self, node: str) -> int:
+        """Per-node reservation version — cache-key component for anything
+        derived from free_coords (which subtracts pending reservations, a
+        dimension NodeInfo.serial does not see)."""
+        return self._pending_ver.get(node, 0)
+
     def free_coords(self, node_info: NodeInfo) -> set[Coord]:
         """Healthy chips not claimed by bound pods nor pending reservations.
 
@@ -73,11 +122,15 @@ class ChipAllocator(ReservePlugin):
         this allocator's per-node pending version. Every plugin asks for the
         same node's free set several times per cycle, and most nodes are
         untouched between cycles."""
-        with self._lock:
-            key = (node_info.serial, self._pending_ver.get(node_info.name, 0))
-            slot = self._free_cache.get(node_info.name)
-            if slot is not None and key in slot:
-                return slot[key]
+        # lock-free read path: slot dicts are only ever replaced/extended
+        # under the lock, and single dict reads are GIL-atomic; a stale
+        # miss just recomputes
+        key = (node_info.serial, self._pending_ver.get(node_info.name, 0))
+        slot = self._free_cache.get(node_info.name)
+        if slot is not None:
+            hit = slot.get(key)
+            if hit is not None:
+                return hit
         m = node_info.metrics
         if m is None:
             return set()
@@ -94,14 +147,87 @@ class ChipAllocator(ReservePlugin):
         with self._lock:
             return self._pending.get(pod.key)
 
+    def class_stats(self, node_info: NodeInfo, min_free_mb: int,
+                    min_clock_mhz: int) -> ClassStats:
+        """Qualifying-chip aggregates for one workload class on one node,
+        memoised while the node's telemetry, bound pods, and pending
+        reservations are unchanged (see ClassStats)."""
+        name = node_info.name
+        key = (node_info.serial, self._pending_ver.get(name, 0),
+               min_free_mb, min_clock_mhz)
+        # lock-free read path (see free_coords)
+        slot = self._class_cache.get(name)
+        if slot is not None:
+            hit = slot.get(key)
+            if hit is not None:
+                return hit
+        m = node_info.metrics
+        if m is None:
+            stats = ClassStats(0, frozenset(), _ZERO6, _ZERO6)
+        else:
+            free = self.free_coords(node_info)
+            qcoords = set()
+            mbw = mck = mco = mfm = mpw = mtm = 0
+            sbw = sck = sco = sfm = spw = stm = 0
+            for c in m.healthy_chips():
+                if (c.coords in free and c.hbm_free_mb >= min_free_mb
+                        and c.clock_mhz >= min_clock_mhz):
+                    qcoords.add(c.coords)
+                    bw, ck, co, fm, pw, tm = (
+                        c.ici_bandwidth_gbps, c.clock_mhz, c.core_count,
+                        c.hbm_free_mb, c.power_w, c.hbm_total_mb)
+                    if bw > mbw: mbw = bw
+                    if ck > mck: mck = ck
+                    if co > mco: mco = co
+                    if fm > mfm: mfm = fm
+                    if pw > mpw: mpw = pw
+                    if tm > mtm: mtm = tm
+                    sbw += bw; sck += ck; sco += co
+                    sfm += fm; spw += pw; stm += tm
+            stats = ClassStats(len(qcoords), frozenset(qcoords),
+                               (mbw, mck, mco, mfm, mpw, mtm),
+                               (sbw, sck, sco, sfm, spw, stm))
+        with self._lock:
+            slot = self._class_cache.setdefault(name, {})
+            slot[key] = stats
+            while len(slot) > self._class_cache_slots:
+                slot.pop(next(iter(slot)))  # evict oldest (insertion order)
+        return stats
+
+    def contiguity(self, node_info: NodeInfo, k: int) -> float:
+        """Memoised torus.contiguity_score over the node's free set (see
+        _contig_cache)."""
+        from ...topology.torus import contiguity_score
+
+        name = node_info.name
+        key = (node_info.serial, self._pending_ver.get(name, 0), k)
+        slot = self._contig_cache.get(name)  # lock-free read (free_coords)
+        if slot is not None:
+            hit = slot.get(key)
+            if hit is not None:
+                return hit
+        m = node_info.metrics
+        if m is None:
+            return 0.0
+        free = self.free_coords(node_info)
+        score = contiguity_score(_node_shape(m), free, min(k, len(free)))
+        with self._lock:
+            slot = self._contig_cache.setdefault(name, {})
+            slot[key] = score
+            while len(slot) > self._class_cache_slots:
+                slot.pop(next(iter(slot)))
+        return score
+
     # ---------------------------------------------------------- nominations
     def nominate(self, pod_key: str, node: str, chips: int, priority: int) -> None:
         with self._lock:
             self._nominated[pod_key] = (node, chips, priority)
+            self._version += 1
 
     def unnominate(self, pod_key: str) -> None:
         with self._lock:
-            self._nominated.pop(pod_key, None)
+            if self._nominated.pop(pod_key, None) is not None:
+                self._version += 1
 
     def nomination_of(self, pod_key: str) -> tuple[str, int, int] | None:
         """(node, chips, priority) this pod is entitled to, if any."""
@@ -113,6 +239,8 @@ class ChipAllocator(ReservePlugin):
         """Chips on `node` held for nominated preemptors that outrank (or
         tie) `priority` — capacity the asking pod must treat as taken. A
         pod never blocks on its own nomination."""
+        if not self._nominated:
+            return 0  # fast path: nominations are rare (GIL-atomic read)
         with self._lock:
             return sum(
                 chips for key, (n, chips, prio) in self._nominated.items()
@@ -129,16 +257,11 @@ class ChipAllocator(ReservePlugin):
         m = node_info.metrics
         if m is None:
             return None
-        free = self.free_coords(node_info)
-        qualifying = {
-            c.coords
-            for c in m.healthy_chips()
-            if c.coords in free
-            and c.hbm_free_mb >= spec.min_free_mb
-            and c.clock_mhz >= spec.min_clock_mhz
-        }
+        stats = self.class_stats(node_info, spec.min_free_mb,
+                                 spec.min_clock_mhz)
+        qualifying = stats.qcoords
         hold = self.nominated_hold(node_info.name, spec.priority, pod_key)
-        if len(qualifying) - hold < spec.chips:
+        if stats.count - hold < spec.chips:
             return None
         shape = _node_shape(m)
         if spec.topology is not None:
@@ -153,7 +276,8 @@ class ChipAllocator(ReservePlugin):
 
     # ---------------------------------------------------------- reserve hook
     def reserve(self, state: CycleState, pod: Pod, node: str) -> Status:
-        node_info = state.read_or("node_info:" + node)
+        snapshot = state.read_or("snapshot")
+        node_info = snapshot.get(node) if snapshot is not None else None
         spec = state.read_or("workload_spec")
         if node_info is None or spec is None:
             return Status.error("allocator: cycle state missing node_info/spec")

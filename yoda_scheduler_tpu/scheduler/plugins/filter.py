@@ -38,6 +38,18 @@ class TelemetryFilter(FilterPlugin):
         self.gangs = gangs
         self.max_age = telemetry_max_age_s
         self.require_contiguous = require_contiguous
+        # verdict cache: the full capacity verdict (including its message
+        # string — f-string builds dominate failing full-scan cycles at
+        # 1000 nodes) per (spec, node serial, pending version, hold).
+        # WorkloadSpec is a frozen dataclass, so it hashes by value and
+        # identical label classes share entries. Time-dependent (staleness)
+        # and externally-stateful (gang) checks stay OUTSIDE the cache.
+        self._verdict_cache: dict[str, dict[tuple, Status]] = {}
+        self._verdict_slots = 8
+
+    def forget_nodes(self, gone: set[str]) -> None:
+        for n in gone:
+            self._verdict_cache.pop(n, None)
 
     def filter(self, state: CycleState, pod, node: NodeInfo) -> Status:
         spec: WorkloadSpec = state.read("workload_spec")
@@ -48,6 +60,25 @@ class TelemetryFilter(FilterPlugin):
             return Status.unschedulable(f"{node.name}: no accelerator telemetry")
         if m.stale(now=state.read_or("now", time.time()), max_age_s=self.max_age):
             return Status.unschedulable(f"{node.name}: telemetry stale")
+        if spec.is_gang:
+            return self._filter_checked(state, spec, pod, node, m)
+        hold = self.allocator.nominated_hold(node.name, spec.priority, pod.key)
+        key = (spec, node.serial,
+               self.allocator.pending_version(node.name), hold)
+        slot = self._verdict_cache.get(node.name)
+        if slot is not None:
+            hit = slot.get(key)
+            if hit is not None:
+                return hit
+        st = self._filter_checked(state, spec, pod, node, m, hold)
+        slot = self._verdict_cache.setdefault(node.name, {})
+        slot[key] = st
+        while len(slot) > self._verdict_slots:
+            slot.pop(next(iter(slot)))
+        return st
+
+    def _filter_checked(self, state: CycleState, spec: WorkloadSpec, pod,
+                        node: NodeInfo, m, hold: int | None = None) -> Status:
         if spec.accelerator is not None and m.accelerator != spec.accelerator:
             return Status.unschedulable(
                 f"{node.name}: accelerator {m.accelerator} != requested {spec.accelerator}"
@@ -83,7 +114,9 @@ class TelemetryFilter(FilterPlugin):
         # nominated-pod semantics: don't schedule into a freshly-preempted
         # hole that a higher-priority pod is entitled to)
         free = self.allocator.free_coords(node)
-        hold = self.allocator.nominated_hold(node.name, spec.priority, pod.key)
+        if hold is None:
+            hold = self.allocator.nominated_hold(node.name, spec.priority,
+                                                 pod.key)
         if len(free) - hold < spec.chips:
             return Status.unschedulable(
                 f"{node.name}: {len(free)} unclaimed healthy chips"
@@ -92,29 +125,26 @@ class TelemetryFilter(FilterPlugin):
             )
 
         # per-chip memory + clock predicates over unclaimed healthy chips
-        qualifying = [
-            c for c in m.healthy_chips()
-            if c.coords in free
-            and c.hbm_free_mb >= spec.min_free_mb
-            and c.clock_mhz >= spec.min_clock_mhz
-        ]
-        if len(qualifying) - hold < spec.chips:
+        # (aggregates memoised per (node state, label class) — see
+        # allocator.ClassStats)
+        stats = self.allocator.class_stats(node, spec.min_free_mb,
+                                           spec.min_clock_mhz)
+        if stats.count - hold < spec.chips:
             return Status.unschedulable(
-                f"{node.name}: only {len(qualifying)} chips satisfy "
+                f"{node.name}: only {stats.count} chips satisfy "
                 f"hbm>={spec.min_free_mb}MB clock>={spec.min_clock_mhz}MHz "
                 f"(need {spec.chips})"
             )
 
         # exact topology request must fit contiguously
         if spec.topology is not None:
-            qcoords = {c.coords for c in qualifying}
-            if fits_shape(_node_shape(m), qcoords, parse_topology(spec.topology)) is None:
+            if fits_shape(_node_shape(m), stats.qcoords,
+                          parse_topology(spec.topology)) is None:
                 return Status.unschedulable(
                     f"{node.name}: no free contiguous {spec.topology} block"
                 )
         elif self.require_contiguous and spec.chips > 1:
-            qcoords = {c.coords for c in qualifying}
-            if best_fit_block(_node_shape(m), qcoords, spec.chips) is None:
+            if best_fit_block(_node_shape(m), stats.qcoords, spec.chips) is None:
                 return Status.unschedulable(
                     f"{node.name}: no contiguous block of {spec.chips} chips"
                 )

@@ -100,7 +100,8 @@ class GangPermit(PermitPlugin, ReservePlugin):
     def reserve(self, state: CycleState, pod: Pod, node: str) -> Status:
         spec: WorkloadSpec = state.read("workload_spec")
         if spec.is_gang:
-            node_info = state.read_or("node_info:" + node)
+            snapshot = state.read_or("snapshot")
+            node_info = snapshot.get(node) if snapshot is not None else None
             if node_info is not None and node_info.metrics is not None:
                 self.gangs.choose_slice(spec.gang_name, node_info.metrics.slice_id)
         return Status.success()

@@ -44,20 +44,21 @@ class MaxCollection(PreScorePlugin):
     def pre_score(self, state: CycleState, pod, feasible: list[NodeInfo]) -> Status:
         spec: WorkloadSpec = state.read(SPEC_KEY)
         mv = MaxValue()
+        # fold per-node qualifying-chip maxima (memoised per node state +
+        # label class; allocator.ClassStats) instead of rescanning chips
         for node in feasible:
-            m = node.metrics
-            if m is None:
+            if node.metrics is None:
                 continue
-            free = self.allocator.free_coords(node)
-            for c in m.healthy_chips():
-                if (c.coords in free
-                        and c.hbm_free_mb >= spec.min_free_mb
-                        and c.clock_mhz >= spec.min_clock_mhz):
-                    mv.bandwidth = max(mv.bandwidth, c.ici_bandwidth_gbps)
-                    mv.clock = max(mv.clock, c.clock_mhz)
-                    mv.core = max(mv.core, c.core_count)
-                    mv.free_memory = max(mv.free_memory, c.hbm_free_mb)
-                    mv.power = max(mv.power, c.power_w)
-                    mv.total_memory = max(mv.total_memory, c.hbm_total_mb)
+            st = self.allocator.class_stats(node, spec.min_free_mb,
+                                            spec.min_clock_mhz)
+            if st.count == 0:
+                continue
+            bw, ck, co, fm, pw, tm = st.maxima
+            mv.bandwidth = max(mv.bandwidth, bw)
+            mv.clock = max(mv.clock, ck)
+            mv.core = max(mv.core, co)
+            mv.free_memory = max(mv.free_memory, fm)
+            mv.power = max(mv.power, pw)
+            mv.total_memory = max(mv.total_memory, tm)
         state.write(MAX_KEY, mv)
         return Status.success()

@@ -131,6 +131,10 @@ class RefScore(ScorePlugin):
         min_max_normalize(scores)
 
 
+class OvercommitError(RuntimeError):
+    """The naive device-plugin emulation found no free chips at bind time."""
+
+
 class TelemetryDecrementingCluster:
     """Wraps a FakeCluster: on bind, immediately debits the node's live
     telemetry (the ideal-sniffer assumption that favours the baseline), and
@@ -138,15 +142,26 @@ class TelemetryDecrementingCluster:
     any free qualifying coords, arbitrary order, no contiguity. The
     reference never chooses chips (SURVEY §2.2: that was the GPU device
     plugin's job), so without this the baseline's bin-pack utilisation
-    measures 0 by construction instead of measuring its placement quality."""
+    measures 0 by construction instead of measuring its placement quality.
+
+    Overcommit honesty (VERDICT r2 weak #1): when the reference's
+    allocation-blind filter picks a node whose chips are actually all
+    claimed, the real-world outcome is a device-plugin admission failure
+    and a pod retry — NOT a successful placement. The emulation therefore
+    raises OvercommitError (the engine's bind-failure path requeues the
+    pod with backoff) and counts it, instead of crediting the baseline
+    with a latency win for a pod that got no chips."""
 
     def __init__(self, inner) -> None:
         self._inner = inner
+        self.overcommitted_binds = 0
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
     def _naive_chips(self, pod, node):
+        """Free qualifying coords, or "overcommit" when the node has fewer
+        than requested (distinct from None = not assessable)."""
         m = self._inner.telemetry.get(node)
         if m is None:
             return None
@@ -162,12 +177,16 @@ class TelemetryDecrementingCluster:
             if c.healthy and c.coords not in used
             and c.hbm_free_mb >= spec.min_free_mb)
         if len(free) < spec.chips:
-            return None  # overcommitted (reference has no allocation view)
+            return "overcommit"  # reference has no allocation view
         return free[:spec.chips]
 
     def bind(self, pod, node, assigned_chips=None):
         if assigned_chips is None:
             assigned_chips = self._naive_chips(pod, node)
+            if assigned_chips == "overcommit":
+                self.overcommitted_binds += 1
+                raise OvercommitError(
+                    f"{node}: all chips claimed; device plugin rejects")
         self._inner.bind(pod, node, assigned_chips)
         m = self._inner.telemetry.get(node)
         if m is None:

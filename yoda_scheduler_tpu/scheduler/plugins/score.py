@@ -38,6 +38,14 @@ class TelemetryScore(ScorePlugin):
         self.allocator = allocator
         self.weights = weights or ScoreWeights()
         self.weight = weight
+        # allocate+actual are spec-independent: cache per node keyed by the
+        # NodeInfo serial (new serial whenever telemetry or bound pods
+        # change) — at 1000 nodes these two terms dominate scoring cost
+        self._aa_cache: dict[str, tuple[int, float]] = {}
+
+    def forget_nodes(self, gone: set[str]) -> None:
+        for n in gone:
+            self._aa_cache.pop(n, None)
 
     # ------------------------------------------------------------ components
     def basic_score(self, mv: MaxValue, spec: WorkloadSpec, node: NodeInfo,
@@ -46,21 +54,22 @@ class TelemetryScore(ScorePlugin):
         if m is None:
             return 0.0
         w = self.weights
-        free = self.allocator.free_coords(node)
-        total = 0.0
-        for c in m.healthy_chips():
-            if (c.coords in free
-                    and c.hbm_free_mb >= spec.min_free_mb
-                    and c.clock_mhz >= spec.min_clock_mhz):
-                total += (
-                    100.0 * c.ici_bandwidth_gbps / mv.bandwidth * w.bandwidth
-                    + 100.0 * c.clock_mhz / mv.clock * w.clock
-                    + 100.0 * c.core_count / mv.core * w.core
-                    + 100.0 * c.power_w / mv.power * w.power
-                    + 100.0 * c.hbm_free_mb / mv.free_memory * w.free_memory
-                    + 100.0 * c.hbm_total_mb / mv.total_memory * w.total_memory
-                )
-        return total
+        # Σ over qualifying chips distributes over the per-attribute sums
+        # (allocator.ClassStats, memoised per node state + label class):
+        # Σ_c Σ_a 100·a(c)/mv_a·w_a == Σ_a (100·w_a/mv_a)·Σ_c a(c)
+        st = self.allocator.class_stats(node, spec.min_free_mb,
+                                        spec.min_clock_mhz)
+        if st.count == 0:
+            return 0.0
+        sbw, sck, sco, sfm, spw, stm = st.sums
+        return (
+            100.0 * sbw / mv.bandwidth * w.bandwidth
+            + 100.0 * sck / mv.clock * w.clock
+            + 100.0 * sco / mv.core * w.core
+            + 100.0 * spw / mv.power * w.power
+            + 100.0 * sfm / mv.free_memory * w.free_memory
+            + 100.0 * stm / mv.total_memory * w.total_memory
+        )
 
     def allocate_score(self, node: NodeInfo) -> float:
         """Label-claimed headroom, clamped at 0 when oversubscribed
@@ -88,9 +97,13 @@ class TelemetryScore(ScorePlugin):
             # keep the guard as an internal error, not a scheduling failure
             return 0.0, Status.error("PreScore never wrote Max")
         spec: WorkloadSpec = state.read(SPEC_KEY)
-        s = (self.basic_score(mv, spec, node, state)
-             + self.allocate_score(node) + self.actual_score(node))
-        return s, Status.success()
+        hit = self._aa_cache.get(node.name)
+        if hit is not None and hit[0] == node.serial:
+            aa = hit[1]
+        else:
+            aa = self.allocate_score(node) + self.actual_score(node)
+            self._aa_cache[node.name] = (node.serial, aa)
+        return self.basic_score(mv, spec, node, state) + aa, Status.success()
 
     def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
         min_max_normalize(scores)

@@ -18,7 +18,6 @@ sets its strength against the telemetry score.
 from __future__ import annotations
 
 from ..framework import CycleState, NodeInfo, PreScorePlugin, ScorePlugin, Status, min_max_normalize
-from ...topology.torus import contiguity_score
 from ...utils.labels import WorkloadSpec
 from .allocator import ChipAllocator, _node_shape
 from .prescore import SPEC_KEY
@@ -34,6 +33,17 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
         self.allocator = allocator
         self.weight = weight
         self.contiguity_frac = contiguity_frac
+        # packing-term cache per node: keyed by (serial, slice usage
+        # entry, is_gang) — all of its inputs (contiguity is memoised
+        # separately in the allocator)
+        self._pack_cache: dict[str, tuple[tuple, float]] = {}
+        # per-node used-chip count for the slice-usage map
+        self._used_cache: dict[str, tuple] = {}
+
+    def forget_nodes(self, gone: set[str]) -> None:
+        for n in gone:
+            self._pack_cache.pop(n, None)
+            self._used_cache.pop(n, None)
 
     def pre_score(self, state: CycleState, pod, feasible: list[NodeInfo]) -> Status:
         """Compute per-slice usage over the WHOLE snapshot — a slice's full
@@ -42,11 +52,18 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
         snapshot = state.read_or("snapshot")
         nodes = snapshot.list() if snapshot is not None else feasible
         usage: dict[str, tuple[int, int]] = {}  # slice -> (used, total)
+        used_cache = self._used_cache
         for node in nodes:
             m = node.metrics
             if m is None or not m.slice_id:
                 continue
-            used_here = m.chip_count - len(self.allocator.free_coords(node))
+            ukey = (node.serial, self.allocator.pending_version(node.name))
+            hit = used_cache.get(node.name)
+            if hit is not None and hit[0] == ukey:
+                used_here = hit[1]
+            else:
+                used_here = m.chip_count - len(self.allocator.free_coords(node))
+                used_cache[node.name] = (ukey, used_here)
             u, t = usage.get(m.slice_id, (0, 0))
             usage[m.slice_id] = (u + used_here, t + m.chip_count)
         state.write(SLICE_USE_KEY, usage)
@@ -57,31 +74,42 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
         if m is None:
             return 0.0, Status.success()
         spec: WorkloadSpec = state.read(SPEC_KEY)
+        cont = self.allocator.contiguity(node, spec.chips)
+        usage = state.read_or(SLICE_USE_KEY, {}).get(m.slice_id, (0, 0)) \
+            if m.slice_id else (0, 0)
+        pkey = (node.serial, self.allocator.pending_version(node.name),
+                usage, spec.is_gang)
+        hit = self._pack_cache.get(node.name)
+        if hit is not None and hit[0] == pkey:
+            packing = hit[1]
+        else:
+            packing = self._packing(m, node, usage, spec.is_gang)
+            self._pack_cache[node.name] = (pkey, packing)
+        s = self.contiguity_frac * cont + (1.0 - self.contiguity_frac) * packing
+        return s, Status.success()
+
+    def _packing(self, m, node: NodeInfo, usage: tuple[int, int],
+                 is_gang: bool) -> float:
         free = self.allocator.free_coords(node)
-        cont = contiguity_score(_node_shape(m), free, min(spec.chips, len(free)))
         if not m.slice_id or m.num_hosts <= 1:
             # standalone node: always preferable to denting a pristine slice
             # for non-gang work (base 50), and among standalone nodes prefer
             # the already-dented one (intra-node bin-pack) so whole boards
             # survive for block-shaped requests
             node_used = 1.0 - len(free) / m.chip_count if m.chip_count else 0.0
-            packing = 50.0 + 50.0 * node_used
-        else:
-            used, total = state.read_or(SLICE_USE_KEY, {}).get(m.slice_id, (0, 0))
-            if spec.is_gang:
-                # a gang consumes hosts wholesale; pristine slices are ideal
-                packing = 100.0 * (total - used) / total if total else 0.0
-            else:
-                # single-node job on a multi-host slice: prefer dented slices
-                # (concentrate fragmentation) and, within a slice, dented
-                # hosts — a leftover lone chip is "contiguous" by the frag
-                # metric but useless to block-shaped requests, so host-level
-                # consolidation must be rewarded explicitly
-                slice_used = used / total if total else 0.0
-                node_used = 1.0 - len(free) / m.chip_count if m.chip_count else 0.0
-                packing = 100.0 * (0.5 * slice_used + 0.5 * node_used)
-        s = self.contiguity_frac * cont + (1.0 - self.contiguity_frac) * packing
-        return s, Status.success()
+            return 50.0 + 50.0 * node_used
+        used, total = usage
+        if is_gang:
+            # a gang consumes hosts wholesale; pristine slices are ideal
+            return 100.0 * (total - used) / total if total else 0.0
+        # single-node job on a multi-host slice: prefer dented slices
+        # (concentrate fragmentation) and, within a slice, dented hosts — a
+        # leftover lone chip is "contiguous" by the frag metric but useless
+        # to block-shaped requests, so host-level consolidation must be
+        # rewarded explicitly
+        slice_used = used / total if total else 0.0
+        node_used = 1.0 - len(free) / m.chip_count if m.chip_count else 0.0
+        return 100.0 * (0.5 * slice_used + 0.5 * node_used)
 
     def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
         # already on a 0..100 scale by construction; min-max would erase the

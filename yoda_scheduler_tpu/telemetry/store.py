@@ -19,6 +19,7 @@ import threading
 from typing import Callable, Iterable
 
 from .schema import TpuNodeMetrics
+from ..utils.changelog import ChangeLog
 
 WatchCallback = Callable[[str, TpuNodeMetrics | None], None]
 
@@ -30,13 +31,12 @@ class TelemetryStore:
         self._lock = threading.RLock()
         self._by_node: dict[str, TpuNodeMetrics] = {}
         self._watchers: list[WatchCallback] = []
-        self._resource_version = 0
+        self._changes = ChangeLog()
 
     # ------------------------------------------------------------- publisher
     def put(self, metrics: TpuNodeMetrics) -> None:
         with self._lock:
-            self._resource_version += 1
-            metrics.generation = self._resource_version
+            metrics.generation = self._changes.record(metrics.node)
             self._by_node[metrics.node] = metrics
             watchers = list(self._watchers)
         for cb in watchers:
@@ -45,10 +45,18 @@ class TelemetryStore:
     def delete(self, node: str) -> None:
         with self._lock:
             self._by_node.pop(node, None)
-            self._resource_version += 1
+            self._changes.record(node)
             watchers = list(self._watchers)
         for cb in watchers:
             cb(node, None)
+
+    def changes_since(self, version: int) -> tuple[int, set[str] | None]:
+        """(current version, nodes changed after `version`) — None for the
+        node set when the change log no longer reaches back that far (the
+        caller must do a full rebuild). Lets per-cycle consumers refresh
+        only dirty nodes instead of scanning every node every cycle."""
+        with self._lock:
+            return self._changes.changes_since(version)
 
     # -------------------------------------------------------------- consumer
     def get(self, node: str) -> TpuNodeMetrics | None:
@@ -65,8 +73,7 @@ class TelemetryStore:
 
     @property
     def resource_version(self) -> int:
-        with self._lock:
-            return self._resource_version
+        return self._changes.version  # single int read: GIL-atomic
 
     def watch(self, cb: WatchCallback) -> Callable[[], None]:
         """Register a change callback; returns an unsubscribe function."""

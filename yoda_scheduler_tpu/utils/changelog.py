@@ -1,0 +1,43 @@
+"""Bounded change log: version counter + recent-changes ring.
+
+Shared by the cluster backends and the telemetry store so per-cycle
+consumers (incremental snapshots, the unschedulable-class memo) can ask
+"what changed since version V" instead of rescanning everything. One
+implementation because the boundary condition in changes_since (`log[0]
+version > V+1` = trimmed past the caller, full rebuild required) is easy
+to get subtly wrong in copies.
+
+Thread-safety: record() must be called under the owner's lock; version
+reads are single-int reads (GIL-atomic).
+"""
+
+from __future__ import annotations
+
+
+class ChangeLog:
+    __slots__ = ("version", "_log", "_cap")
+
+    def __init__(self, cap: int = 8192) -> None:
+        self.version = 0
+        self._log: list[tuple[int, str]] = []  # (version, key)
+        self._cap = cap
+
+    def record(self, key: str) -> int:
+        """Bump the version, attributing the change to `key`. Returns the
+        new version. Caller holds the owner's lock."""
+        self.version += 1
+        self._log.append((self.version, key))
+        if len(self._log) > self._cap:
+            del self._log[: len(self._log) - self._cap]
+        return self.version
+
+    def changes_since(self, version: int) -> tuple[int, set[str] | None]:
+        """(current version, keys changed after `version`) — None for the
+        key set when the log no longer reaches back that far (the caller
+        must rebuild from scratch)."""
+        cur = self.version
+        if version >= cur:
+            return cur, set()
+        if not self._log or self._log[0][0] > version + 1:
+            return cur, None
+        return cur, {k for v, k in self._log if v > version}
