@@ -19,15 +19,64 @@ Output: ONE JSON line, same contract as bench.py.
 from __future__ import annotations
 
 import json
+import os
 import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
+_T0 = time.time()
 
 
 def _progress(msg: str) -> None:
-    print(f"[bench_mfu] {msg}", file=sys.stderr, flush=True)
+    print(f"[bench_mfu] +{time.time() - _T0:.1f}s {msg}", file=sys.stderr,
+          flush=True)
+
+
+# wall-clock budget for the WHOLE bench: candidates stop escalating and
+# attention sequence lengths stop growing once it is spent (the driver
+# gives the bench a bounded slot; a partial artifact beats a timeout)
+BUDGET_S = float(os.environ.get("BENCH_MFU_BUDGET_S", "480"))
+
+
+def _remaining() -> float:
+    return BUDGET_S - (time.time() - _T0)
+
+
+# persistent compilation cache: first run pays XLA compile (~20-40s per
+# shape on TPU), reruns are seconds
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/jax_comp_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+_progress("importing jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+_progress("jax imported")
+
+
+def _probe_devices(timeout_s: float = 90.0):
+    """Enumerate devices under a watchdog: device init over a TPU tunnel
+    has been observed to hang indefinitely — fail fast with a diagnostic
+    instead of eating the whole bench budget (VERDICT r2 weak #2)."""
+    result: list = []
+
+    def go():
+        result.append(jax.devices())
+
+    t = threading.Thread(target=go, daemon=True)
+    _progress("enumerating devices (watchdog %ds)" % int(timeout_s))
+    t.start()
+    t.join(timeout=timeout_s)
+    if not result:
+        print(json.dumps({
+            "metric": "llama_train_mfu", "value": None, "unit": "%",
+            "vs_baseline": None,
+            "error": f"device enumeration hung > {timeout_s}s",
+        }))
+        sys.exit(0)
+    _progress(f"devices: {result[0]}")
+    return result[0]
 
 
 # bf16 peak FLOP/s per chip by device_kind substring (public spec sheets:
@@ -82,21 +131,31 @@ def llama_train_bench(on_tpu: bool) -> dict:
     from yoda_scheduler_tpu.parallel.train import build_llama_train_step
 
     if on_tpu:
-        # ~950M-param shape: the largest round Llama-style config that fits
-        # one v5e chip (16 GB HBM) with AdamW fp32 moments + remat; batch
-        # sized so B*S fills the MXU. Falls back a size if HBM is smaller.
+        # ASCENDING sizes: the smallest produces a committed number within
+        # a couple of minutes even if everything after it OOMs or the
+        # budget runs out; each success is kept and the next size attempted
+        # (VERDICT r2: "put the tiny candidate first"). The largest is a
+        # ~950M-param shape sized for one v5e chip (16 GB HBM) with AdamW
+        # fp32 moments + remat.
         candidates = [
-            (LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
-                         n_kv_heads=16, ffn_dim=5632, max_seq_len=2048), 4, 2048),
+            (LlamaConfig(vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
+                         n_kv_heads=16, ffn_dim=4096, max_seq_len=2048), 8, 2048),
             (LlamaConfig(vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
                          n_kv_heads=16, ffn_dim=4096, max_seq_len=2048), 8, 2048),
+            (LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                         n_kv_heads=16, ffn_dim=5632, max_seq_len=2048), 4, 2048),
         ]
     else:
         candidates = [(LlamaConfig.tiny(), 2, 256)]
 
     mesh = make_mesh(mesh_shape_for(1), devices=jax.devices()[:1])
-    last_err = None
+    best = None
+    attempts = []
     for config, batch, seq in candidates:
+        if best is not None and _remaining() < 120:
+            attempts.append({"dim": config.dim, "layers": config.n_layers,
+                             "skipped": "budget"})
+            break
         _progress(f"train candidate dim={config.dim} L={config.n_layers} "
                   f"B={batch} S={seq}")
         try:
@@ -133,7 +192,7 @@ def llama_train_bench(on_tpu: bool) -> dict:
             flops_per_sec = flops_per_token * tokens_per_step / dt
             kind = jax.devices()[0].device_kind
             peak = peak_flops(kind)
-            return {
+            best = {
                 "model_params": n_params,
                 "batch": batch,
                 "seq": seq,
@@ -145,10 +204,20 @@ def llama_train_bench(on_tpu: bool) -> dict:
                 "mfu_pct": round(100 * flops_per_sec / peak, 2) if peak else None,
                 "final_loss": float(loss),
             }
-        except Exception as e:  # OOM on smaller-HBM chips: try next size
-            last_err = e
-            continue
-    raise RuntimeError(f"no train config fit the device: {last_err}")
+            attempts.append({"dim": config.dim, "layers": config.n_layers,
+                             "mfu_pct": best["mfu_pct"],
+                             "tokens_per_sec": best["tokens_per_sec"]})
+            _progress(f"candidate ok: mfu={best['mfu_pct']}% "
+                      f"tok/s={best['tokens_per_sec']}")
+        except Exception as e:  # OOM: keep the last success, stop escalating
+            _progress(f"candidate failed: {type(e).__name__}: {str(e)[:200]}")
+            attempts.append({"dim": config.dim, "layers": config.n_layers,
+                             "error": f"{type(e).__name__}"})
+            break
+    if best is None:
+        raise RuntimeError(f"no train config completed: {attempts}")
+    best["attempts"] = attempts
+    return best
 
 
 # --------------------------------------------------- flash attention bench
@@ -187,6 +256,9 @@ def attention_bench(on_tpu: bool) -> dict:
     n1, n2 = (4, 24) if on_tpu else (1, 3)
     out = {}
     for s in seqs:
+        if out and _remaining() < 90:
+            _progress(f"budget spent; skipping S>={s}")
+            break
         # keep total tokens constant so the comparison is iso-work; the
         # plain-XLA baseline materialises the [S,S] fp32 score matrix, so
         # batch must shrink with S for it to fit HBM at all. (CPU fallback:
@@ -234,7 +306,10 @@ def attention_bench(on_tpu: bool) -> dict:
 
 
 def main() -> None:
-    on_tpu = jax.default_backend() == "tpu"
+    devices = _probe_devices()
+    on_tpu = devices[0].platform == "tpu"
+    _progress(f"backend={jax.default_backend()} on_tpu={on_tpu} "
+              f"budget={BUDGET_S}s")
     train = llama_train_bench(on_tpu)
     attn = attention_bench(on_tpu)
     # largest sequence where the XLA baseline still runs (above that, the

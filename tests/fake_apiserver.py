@@ -84,9 +84,12 @@ class FakeApiState:
             self.events[kind].clear()
             self.cond.notify_all()
 
-    def fail(self, path_substring: str, status: int, times: int = 1) -> None:
+    def fail(self, path_substring: str, status: int, times: int = 1,
+             method: str | None = None) -> None:
+        """Inject `status` for the next `times` requests whose path contains
+        `path_substring` (optionally only for one HTTP method)."""
         with self.cond:
-            self.faults.append([path_substring, status, times])
+            self.faults.append([path_substring, status, times, method])
 
     # ------------------------------------------------------------- helpers
     def add_node(self, name: str) -> None:
@@ -131,10 +134,11 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0) or 0)
         return json.loads(self.rfile.read(n)) if n else {}
 
-    def _injected_fault(self, path: str) -> int | None:
+    def _injected_fault(self, path: str, method: str) -> int | None:
         with self.state.cond:
             for f in self.state.faults:
-                if f[0] in path and f[2] > 0:
+                if (f[0] in path and f[2] > 0
+                        and (len(f) < 4 or f[3] is None or f[3] == method)):
                     f[2] -= 1
                     return f[1]
         return None
@@ -144,7 +148,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path
         with s.cond:
             s.requests.append((method, path))
-        fault = self._injected_fault(path)
+        fault = self._injected_fault(path, method)
         if fault is not None:
             return self._json(fault, {"kind": "Status", "code": fault})
         base, _, query = path.partition("?")
@@ -178,6 +182,11 @@ class _Handler(BaseHTTPRequestHandler):
             if q.get("watch", ["false"])[0] == "true":
                 return self._watch(kind, q)
             return self._list(kind, q)
+        # TpuNodeMetrics item verbs + collection POST (the sniffer
+        # publisher's create-or-update path, with the same optimistic
+        # concurrency a real API server enforces)
+        if "/tpunodemetrics" in base:
+            return self._metrics_verb(method, base, kind)
 
         if base.startswith("/api/v1/namespaces/"):
             parts = base.split("/")  # '', api, v1, namespaces, ns, pods, name[, sub]
@@ -292,6 +301,54 @@ class _Handler(BaseHTTPRequestHandler):
                     "annotations", {}).update(ann)
             s.upsert("pods", pod, "MODIFIED")
             return self._json(200, pod)
+        self._json(405, {"kind": "Status", "code": 405})
+
+    # -------------------------------------------------------- metrics verbs
+    def _metrics_verb(self, method: str, base: str, collection_kind) -> None:
+        s = self.state
+        if collection_kind == "metrics" and method == "POST":
+            body = self._body()
+            if body.get("metadata", {}).get("resourceVersion"):
+                # real API servers reject creates carrying a resourceVersion
+                return self._json(400, {
+                    "kind": "Status", "code": 400,
+                    "message": "resourceVersion should not be set on "
+                               "objects to be created"})
+            key = _key(body)
+            with s.cond:
+                if key in s.objects["metrics"]:
+                    return self._json(409, {"kind": "Status", "code": 409,
+                                            "message": "already exists"})
+            s.upsert("metrics", body, "ADDED")
+            return self._json(201, body)
+        name = base.rsplit("/", 1)[-1]
+        if method == "GET":
+            with s.cond:
+                cr = s.objects["metrics"].get(name)
+            if cr is None:
+                return self._json(404, {"kind": "Status", "code": 404})
+            return self._json(200, cr)
+        if method == "PUT":
+            body = self._body()
+            with s.cond:
+                cur = s.objects["metrics"].get(name)
+                if cur is None:
+                    return self._json(404, {"kind": "Status", "code": 404})
+                sent = body.get("metadata", {}).get("resourceVersion")
+                if not sent:
+                    return self._json(422, {
+                        "kind": "Status", "code": 422,
+                        "message": "resourceVersion: must be specified for "
+                                   "an update"})
+                if sent != cur["metadata"]["resourceVersion"]:
+                    return self._json(409, {"kind": "Status", "code": 409,
+                                            "message": "rv conflict"})
+            s.upsert("metrics", body, "MODIFIED")
+            return self._json(200, body)
+        if method == "DELETE":
+            gone = s.remove("metrics", name)
+            code = 200 if gone is not None else 404
+            return self._json(code, {"kind": "Status", "code": code})
         self._json(405, {"kind": "Status", "code": 405})
 
     # ---------------------------------------------------------- lease verbs

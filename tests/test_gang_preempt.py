@@ -1,0 +1,223 @@
+"""Gang preemption (VERDICT r2 item 4b): a high-priority multi-host gang
+evicts lower-priority pods across the hosts of ONE slice, all-or-nothing,
+holds the slice while assembling (gang-level nomination), and gang members
+themselves stay protected from eviction.
+
+Before this feature the engine bailed out ("gangs don't preempt in v1"):
+under contention a v4-32 Llama gang — the workload the blueprint cares
+most about — could neither evict the singles denting its slice nor go
+anywhere else.
+"""
+
+from __future__ import annotations
+
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node, make_v4_slice
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk_cluster(*, slices=1, standalone=0):
+    store = TelemetryStore()
+    now = time.time()
+    for i in range(slices):
+        for m in make_v4_slice(f"s{i}", "2x2x4"):
+            m.heartbeat = now + 1e8
+            store.put(m)
+    for i in range(standalone):
+        m = make_tpu_node(f"t{i}", chips=4)
+        m.heartbeat = now + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return cluster
+
+
+def mk_sched(cluster, **cfg):
+    clock = FakeClock(start=time.time())
+    sched = Scheduler(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=1e9, gang_timeout_s=30.0, **cfg),
+        clock=clock)
+    return sched, clock
+
+
+def gang_pods(name, size, chips="4", prio="8"):
+    return [Pod(f"{name}-{i}", labels={
+        "tpu/gang-name": name, "tpu/gang-size": str(size),
+        "scv/number": chips, "scv/priority": prio,
+        "tpu/accelerator": "tpu"}) for i in range(size)]
+
+
+def dent_slice(sched, clock, n_hosts=4, chips="2", prio="0"):
+    """Bind one low-priority single per slice host so no host has 4 free."""
+    singles = [Pod(f"low-{i}", labels={
+        "scv/number": chips, "scv/priority": prio, "tpu/accelerator": "tpu"})
+        for i in range(n_hosts)]
+    for p in singles:
+        sched.submit(p)
+    sched.run_until_idle()
+    assert all(p.phase == PodPhase.BOUND for p in singles)
+    # the topology scorer concentrates; force one per host if needed
+    assert len({p.node for p in singles}) == n_hosts, \
+        {p.node for p in singles}
+    return singles
+
+
+class TestGangPreemption:
+    def test_gang_evicts_singles_across_slice_hosts(self):
+        cluster = mk_cluster(slices=1)
+        sched, clock = mk_sched(cluster)
+        singles = dent_slice(sched, clock)
+
+        gang = gang_pods("llama", 4)
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang), \
+            [(p.name, p.phase) for p in gang]
+        # all four low-priority singles were evicted (each held 2 of the 4
+        # chips its host needed to free)
+        assert all(p.node is None for p in singles)
+        assert sched.metrics.counters.get("preemptions_total", 0) >= 1
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 4
+        # entitlement consumed on completion
+        assert sched.allocator.gang_nomination_of("llama") is None
+
+    def test_gang_prefers_slice_with_fewest_victims(self):
+        cluster = mk_cluster(slices=2)
+        sched, clock = mk_sched(cluster)
+        # dent slice s0 on all 4 hosts, s1 on only... occupy s1 fully with
+        # a rival gang so only s0 is evictable: simpler — dent s0 with 4
+        # singles and s1 with 8 (two per host): fewest-victims picks s0
+        for i in range(4):
+            p = Pod(f"a{i}", labels={"scv/number": "2", "scv/priority": "0",
+                                     "tpu/accelerator": "tpu"})
+            coords = sorted(cluster.telemetry.get(f"s0-host-{i}").healthy_coords())[:2]
+            cluster.bind(p, f"s0-host-{i}", coords)
+        for i in range(4):
+            m = cluster.telemetry.get(f"s1-host-{i}")
+            cs = sorted(m.healthy_coords())
+            p1 = Pod(f"b{i}", labels={"scv/number": "2", "scv/priority": "0",
+                                      "tpu/accelerator": "tpu"})
+            p2 = Pod(f"c{i}", labels={"scv/number": "1", "scv/priority": "0",
+                                      "tpu/accelerator": "tpu"})
+            cluster.bind(p1, f"s1-host-{i}", cs[:2])
+            cluster.bind(p2, f"s1-host-{i}", cs[2:3])
+        gang = gang_pods("g", 4)
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang)
+        assert {p.node for p in gang} == {f"s0-host-{i}" for i in range(4)}
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 4
+
+    def test_slice_hold_blocks_lower_priority_thief(self):
+        """Between the evictions and gang completion, a lower-priority pod
+        must not bind into the freed slice capacity."""
+        cluster = mk_cluster(slices=1)
+        sched, clock = mk_sched(cluster, max_attempts=6)
+        dent_slice(sched, clock)
+        gang = gang_pods("g", 4)
+        # submit only ONE member first: it preempts, takes the slice hold,
+        # and parks; the thief then tries to slip in
+        sched.submit(gang[0])
+        out = sched.run_one()
+        assert out == "preempting"
+        assert sched.allocator.gang_nomination_of("g") is not None
+        thief = Pod("thief", labels={"scv/number": "4", "scv/priority": "1",
+                                     "tpu/accelerator": "tpu"})
+        sched.submit(thief)
+        # the thief outranks nothing: every slice host holds 4 chips for g
+        for _ in range(4):
+            sched.run_one()
+            clock.advance(1.0)
+        assert thief.phase != PodPhase.BOUND
+        # remaining members arrive; gang completes on its entitlement
+        for p in gang[1:]:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang)
+        assert thief.phase != PodPhase.BOUND
+
+    def test_gang_members_are_protected_victims(self):
+        """A higher-priority gang must not evict a BOUND gang's members
+        (partial-gang deadlock protection holds even against gangs)."""
+        cluster = mk_cluster(slices=1)
+        sched, clock = mk_sched(cluster, max_attempts=4)
+        g1 = gang_pods("first", 4, prio="2")
+        for p in g1:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in g1)
+        g2 = gang_pods("second", 4, prio="9")
+        for p in g2:
+            sched.submit(p)
+        sched.run_until_idle()
+        # no capacity anywhere and g1 is untouchable: g2 fails, g1 intact
+        assert all(p.phase == PodPhase.BOUND for p in g1)
+        assert not any(p.phase == PodPhase.BOUND for p in g2)
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 0
+
+    def test_expired_gang_hold_frees_the_slice(self):
+        """An abandoned gang's slice entitlement must not block the slice
+        forever: gang_hold prunes expired entries."""
+        cluster = mk_cluster(slices=1)
+        sched, clock = mk_sched(cluster)
+        dent_slice(sched, clock)
+        gang = gang_pods("g", 4)
+        sched.submit(gang[0])
+        assert sched.run_one() == "preempting"
+        alloc = sched.allocator
+        assert alloc.gang_nomination_of("g") is not None
+        t_exp = alloc.gang_nomination_of("g")[3]
+        assert alloc.gang_hold("s0", priority=0, now=t_exp - 1.0) == 4
+        # past the expiry the hold evaporates and the entry is pruned
+        assert alloc.gang_hold("s0", priority=0, now=t_exp + 1.0) == 0
+        assert alloc.gang_nomination_of("g") is None
+
+    def test_planning_is_pinned_to_the_parked_members_slice(self):
+        """Members already parked on slice A pin the gang there; a member
+        that then needs preemption must plan evictions on A — never on
+        another slice the gang's own filter would refuse to use."""
+        cluster = mk_cluster(slices=2)
+        sched, clock = mk_sched(cluster)
+        # slice s1 fully free EXCEPT we want the gang pinned to s0 first:
+        # park two members by keeping s1 out of reach (dent every s1 host
+        # so a 4-chip member can't fit there, and keep 2 free hosts on s0)
+        for i in range(4):
+            m = cluster.telemetry.get(f"s1-host-{i}")
+            cs = sorted(m.healthy_coords())
+            cluster.bind(Pod(f"s1pod{i}", labels={
+                "scv/number": "2", "scv/priority": "9",
+                "tpu/accelerator": "tpu"}), f"s1-host-{i}", cs[:2])
+        # dent two of s0's hosts with EVICTABLE low-prio singles
+        for i in (2, 3):
+            m = cluster.telemetry.get(f"s0-host-{i}")
+            cs = sorted(m.healthy_coords())
+            cluster.bind(Pod(f"low{i}", labels={
+                "scv/number": "2", "scv/priority": "0",
+                "tpu/accelerator": "tpu"}), f"s0-host-{i}", cs[:2])
+        gang = gang_pods("g", 4)
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang)
+        assert {p.node for p in gang} == {f"s0-host-{i}" for i in range(4)}
+        # only s0's two low-prio singles were evicted; s1's high-prio pods
+        # (which outrank nothing here but live on the wrong slice) intact
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 2
+        assert len(cluster.pods_on("s1-host-0")) == 1
+
+    def test_external_deletion_of_preempting_member_releases_hold(self):
+        cluster = mk_cluster(slices=1)
+        sched, clock = mk_sched(cluster)
+        dent_slice(sched, clock)
+        gang = gang_pods("g", 4)
+        sched.submit(gang[0])
+        assert sched.run_one() == "preempting"
+        assert sched.allocator.gang_nomination_of("g") is not None
+        sched.forget(gang[0].key)  # external DELETE observed by serve loop
+        assert sched.allocator.gang_nomination_of("g") is None

@@ -114,6 +114,77 @@ class TestServeLoop:
             t.join(timeout=5.0)
 
 
+class TestGangLive:
+    def test_gang_assembles_over_real_http_with_midway_relist(self, server):
+        """A 4-member gang assembling over the REAL transport, with an etcd
+        compaction (410 -> full re-list) injected while the gang is half
+        submitted: the parked members' reservations and the gang
+        coordinator state must survive the relist, and all 4 members must
+        bind onto the 4 hosts of one slice (VERDICT r2 item 4a)."""
+        from yoda_scheduler_tpu.telemetry import make_v4_slice
+
+        server.state.add_node("other")
+        server.state.put_metrics(make_tpu_node("other", chips=4).to_cr())
+        for m in make_v4_slice("s1", "2x2x4"):
+            server.state.add_node(m.node)
+            server.state.put_metrics(m.to_cr())
+
+        def gang_pod(name):
+            return {
+                "metadata": {"name": name, "namespace": "default",
+                             "labels": {"tpu/gang-name": "llama",
+                                        "tpu/gang-size": "4",
+                                        "scv/number": "4",
+                                        "tpu/accelerator": "tpu"},
+                             "ownerReferences": [{"kind": "Job", "name": "j",
+                                                  "controller": True}]},
+                "spec": {"schedulerName": "yoda-scheduler"},
+                "status": {"phase": "Pending"},
+            }
+
+        client = KubeClient(server.url)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=run_scheduler_against_cluster,
+            args=(client, [(SchedulerConfig(pod_initial_backoff_s=0.05,
+                                            pod_max_backoff_s=0.2,
+                                            gang_timeout_s=20.0), None)]),
+            kwargs={"metrics_port": None, "poll_s": 0.05,
+                    "stop_event": stop},
+            daemon=True)
+        t.start()
+        try:
+            server.state.add_pod(gang_pod("w0"))
+            server.state.add_pod(gang_pod("w1"))
+            time.sleep(0.4)  # two members park at Permit
+            # nothing binds yet (all-or-nothing admission)
+            for n in ("w0", "w1"):
+                assert not (server.state.pod(n) or {}).get(
+                    "spec", {}).get("nodeName")
+            # etcd compaction mid-assembly: watch history gone, reflector
+            # must re-list; parked members must NOT be double-submitted or
+            # their reservations dropped
+            server.state.compact("pods")
+            server.state.add_pod(gang_pod("w2"))
+            server.state.add_pod(gang_pod("w3"))
+            ok = wait_for(lambda: all(
+                (server.state.pod(f"w{i}") or {}).get("spec", {}).get(
+                    "nodeName") for i in range(4)), timeout=20.0)
+            assert ok, "gang never fully bound after the relist"
+            nodes = {(server.state.pod(f"w{i}") or {})["spec"]["nodeName"]
+                     for i in range(4)}
+            assert nodes == {"s1-host-0", "s1-host-1", "s1-host-2",
+                             "s1-host-3"}, nodes
+            # every member carries a chip assignment annotation
+            for i in range(4):
+                ann = server.state.pod(f"w{i}")["metadata"].get(
+                    "annotations", {})
+                assert "tpu/assigned-chips" in ann
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+
 class TestWatchCacheLive:
     def _start(self, server):
         client = KubeClient(server.url)

@@ -139,8 +139,20 @@ def cmd_simulate(args) -> int:
 def cmd_sniff(args) -> int:
     from .telemetry.sniffer import local_node_metrics
 
-    print(json.dumps(local_node_metrics(args.node_name).to_cr(), indent=2))
-    return 0
+    if not args.publish:
+        print(json.dumps(local_node_metrics(args.node_name).to_cr(), indent=2))
+        return 0
+    # daemon mode: publish this node's CR to the API server on an interval
+    # (what deploy/sniffer-daemonset.yaml runs)
+    from .k8s.client import KubeClient
+    from .telemetry.publisher import run_publisher
+
+    client = KubeClient.from_env(args.kubeconfig, args.apiserver)
+    if client is None:
+        log.error("no reachable Kubernetes API server to publish to")
+        return 2
+    return run_publisher(client, node_name=args.node_name,
+                         interval_s=args.interval, once=args.once)
 
 
 def cmd_serve(args) -> int:
@@ -173,8 +185,18 @@ def main(argv=None) -> int:
     sim.add_argument("--serve-forever", action="store_true")
     sim.set_defaults(fn=cmd_simulate)
 
-    sn = sub.add_parser("sniff", help="print this host's telemetry CR")
+    sn = sub.add_parser(
+        "sniff", help="print this host's telemetry CR, or publish it to "
+                      "the API server on an interval (--publish)")
     sn.add_argument("--node-name", default=None)
+    sn.add_argument("--publish", action="store_true",
+                    help="publish the CR to the API server instead of printing")
+    sn.add_argument("--interval", type=float, default=5.0,
+                    help="publish interval seconds (with --publish)")
+    sn.add_argument("--once", action="store_true",
+                    help="publish a single snapshot and exit (with --publish)")
+    sn.add_argument("--kubeconfig", default=None)
+    sn.add_argument("--apiserver", default=None)
     sn.set_defaults(fn=cmd_sniff)
 
     srv = sub.add_parser("serve", help="run against a real API server")

@@ -148,7 +148,7 @@ def default_profile(config: SchedulerConfig,
     profile = Profile(
         queue_sort=PrioritySort(),
         filter=[TelemetryFilter(allocator, gangs, config.telemetry_max_age_s)],
-        post_filter=[PriorityPreemption(allocator)] if config.preemption else [],
+        post_filter=[PriorityPreemption(allocator, gangs)] if config.preemption else [],
         # TopologyScore is both a PreScore (slice-usage map) and a Score plugin
         pre_score=[MaxCollection(allocator)] + ([topo] if config.topology_weight > 0 else []),
         score=[
@@ -454,6 +454,19 @@ class Scheduler:
                 return self._unschedulable(
                     info, trace,
                     f"waiting for victims on {nom[0]} to terminate")
+            # same for a gang holding a slice-level entitlement: while its
+            # victims drain anywhere on the nominated slice, wait
+            if spec.is_gang and self.allocator is not None:
+                gnom = self.allocator.gang_nomination_of(spec.gang_name)
+                if gnom is not None and any(
+                        p.terminating
+                        for ni in snapshot.list()
+                        if ni.metrics is not None
+                        and ni.metrics.slice_id == gnom[0]
+                        for p in ni.pods):
+                    return self._unschedulable(
+                        info, trace,
+                        f"waiting for victims on slice {gnom[0]} to terminate")
             # PostFilter: preemption — the plugin plans, the engine evicts
             for p in self.profile.post_filter:
                 nominated, victims, st = p.post_filter(state, pod, snapshot, trace.filter_verdicts)
@@ -471,11 +484,24 @@ class Scheduler:
                             if not router(victim):
                                 self.metrics.inc("preempt_victims_unrouted_total")
                     if self.allocator is not None:
-                        # hold the freed capacity for this pod until it binds
+                        # hold the freed capacity until the preemptor binds
                         # or fails — otherwise requeued victims (or co-hosted
-                        # profiles) refill the hole and the preemptor livelocks
-                        self.allocator.nominate(pod.key, nominated,
-                                                spec.chips, spec.priority)
+                        # profiles) refill the hole and the preemptor
+                        # livelocks. A gang holds its whole SLICE (per-host
+                        # chips, bounded by an expiry so an abandoned gang
+                        # can't block the slice forever).
+                        if spec.is_gang:
+                            ni = snapshot.get(nominated)
+                            slice_id = (ni.metrics.slice_id
+                                        if ni is not None and ni.metrics
+                                        else "")
+                            self.allocator.nominate_gang(
+                                spec.gang_name, slice_id, spec.chips,
+                                spec.priority,
+                                expires_at=now + 2 * self.config.gang_timeout_s)
+                        else:
+                            self.allocator.nominate(pod.key, nominated,
+                                                    spec.chips, spec.priority)
                     self.metrics.inc("preemptions_total")
                     info.last_failure = f"preempting on {nominated}"
                     self.queue.requeue_immediate(info)
@@ -557,14 +583,23 @@ class Scheduler:
             if self.gang_permit is not None:
                 gang = self.gang_permit.gang_of(pod)
                 if gang:
-                    for key in self.gang_permit.fail_gang(gang):
-                        self._rollback_waiting(key)
+                    self._fail_gang(gang)
             return "bind-error"
         if self.gang_permit is not None:
+            peers_ok = True
             for peer_key in self.gang_permit.peers_to_approve(pod):
                 w = self.waiting.pop(peer_key, None)
-                if w is not None:
-                    self._bind(w.info, w.node, CycleTrace(pod=peer_key, started=w.info.enqueued))
+                if w is not None and not self._bind(
+                        w.info, w.node,
+                        CycleTrace(pod=peer_key, started=w.info.enqueued)):
+                    peers_ok = False
+            if spec.is_gang and self.allocator is not None and peers_ok:
+                # gang FULLY bound: its slice entitlement (if it preempted
+                # its way in) is consumed. A failed peer bind keeps the
+                # hold — the straggler needs its refill window protected
+                # until it re-binds (the entitlement expiry bounds the
+                # worst case).
+                self.allocator.unnominate_gang(spec.gang_name)
         return "bound"
 
     # ------------------------------------------------------------ sub-steps
@@ -631,6 +666,14 @@ class Scheduler:
             self.failed[info.pod.key] = reason
             if self.allocator is not None:
                 self.allocator.unnominate(info.pod.key)  # give the hole back
+                try:
+                    spec = spec_for(info.pod)
+                    if spec.is_gang:
+                        # a permanently-failed member dooms the gang: give
+                        # its slice entitlement back too
+                        self.allocator.unnominate_gang(spec.gang_name)
+                except LabelError:
+                    pass
             self.metrics.inc("pods_failed_total")
             self._finish(trace, "failed", reason=reason)
             return "failed"
@@ -663,10 +706,17 @@ class Scheduler:
                 else:
                     self._rollback_waiting(key)
         for gang in expired_gangs:
-            members = self.gang_permit.fail_gang(gang)
             self.metrics.inc("gang_timeouts_total")
-            for key in members:
-                self._rollback_waiting(key)
+            self._fail_gang(gang)
+
+    def _fail_gang(self, gang: str) -> None:
+        """Tear a gang down: reject its parked members (reservations roll
+        back, pods requeue with backoff) and release any slice entitlement
+        it won by preemption."""
+        for key in self.gang_permit.fail_gang(gang):
+            self._rollback_waiting(key)
+        if self.allocator is not None:
+            self.allocator.unnominate_gang(gang)
 
     def _unreserve_waiting(self, w: _WaitingPod) -> None:
         state = CycleState()
@@ -696,9 +746,13 @@ class Scheduler:
             self._unreserve_waiting(w)
             gang = self.gang_permit.gang_of(w.info.pod) if self.gang_permit else None
             if gang:
-                for key in self.gang_permit.fail_gang(gang):
-                    self._rollback_waiting(key)  # surviving peers requeue
-        self.queue.remove(pod_key)
+                self._fail_gang(gang)  # surviving peers requeue
+        for q in self.queue.remove(pod_key):
+            # a QUEUED gang member (e.g. mid-preemption, before parking)
+            # also takes its gang's state and slice entitlement with it
+            gang = self.gang_permit.gang_of(q.pod) if self.gang_permit else None
+            if gang:
+                self._fail_gang(gang)
         if self.allocator is not None:
             self.allocator.unnominate(pod_key)
         self.failed.pop(pod_key, None)

@@ -77,6 +77,13 @@ class ChipAllocator(ReservePlugin):
         # them first (or co-hosted profiles rebind victims into the hole
         # and the preemptor livelocks).
         self._nominated: dict[str, tuple[str, int, int]] = {}  # pod.key -> (node, chips, priority)
+        # gang-level nominations: a gang that preempted is entitled to
+        # `chips_per_host` on EVERY host of its chosen slice until it
+        # completes, fails, or the entitlement expires — victims free
+        # capacity on several hosts at once and single-pod holds can't
+        # cover hosts whose member hasn't cycled yet.
+        # gang -> (slice_id, chips_per_host, priority, expires_at)
+        self._gang_nominated: dict[str, tuple[str, int, int, float]] = {}
         # global version over reservations + nominations (cheap read) — the
         # engine's unschedulable-class memo keys on it
         self._version = 0
@@ -146,6 +153,12 @@ class ChipAllocator(ReservePlugin):
     def assignment_of(self, pod: Pod) -> tuple[str, list[Coord]] | None:
         with self._lock:
             return self._pending.get(pod.key)
+
+    def pending_node_of(self, pod_key: str) -> str | None:
+        """Node a pending reservation (by key) sits on, if any."""
+        with self._lock:
+            entry = self._pending.get(pod_key)
+            return entry[0] if entry else None
 
     def class_stats(self, node_info: NodeInfo, min_free_mb: int,
                     min_clock_mhz: int) -> ClassStats:
@@ -234,6 +247,44 @@ class ChipAllocator(ReservePlugin):
         with self._lock:
             return self._nominated.get(pod_key)
 
+    def nominate_gang(self, gang: str, slice_id: str, chips_per_host: int,
+                      priority: int, expires_at: float) -> None:
+        with self._lock:
+            self._gang_nominated[gang] = (slice_id, chips_per_host, priority,
+                                          expires_at)
+            self._version += 1
+
+    def unnominate_gang(self, gang: str) -> None:
+        with self._lock:
+            if self._gang_nominated.pop(gang, None) is not None:
+                self._version += 1
+
+    def gang_nomination_of(self, gang: str) -> tuple[str, int, int, float] | None:
+        with self._lock:
+            return self._gang_nominated.get(gang)
+
+    def gang_hold(self, slice_id: str, priority: int,
+                  exclude_gang: str | None = None,
+                  now: float | None = None) -> int:
+        """Chips per host on `slice_id` held for nominated gangs that
+        outrank (or tie) `priority`. Expired entitlements are pruned lazily
+        (a gang that never completed must not block the slice forever).
+        Held on every host of the slice — coarser than the gang strictly
+        needs when the slice has more hosts than the gang, by design:
+        which hosts the members land on is decided at Reserve time."""
+        if not self._gang_nominated:
+            return 0  # fast path (GIL-atomic read)
+        with self._lock:
+            hold = 0
+            for gang, (sid, chips, prio, exp) in list(self._gang_nominated.items()):
+                if now is not None and exp < now:
+                    del self._gang_nominated[gang]
+                    self._version += 1
+                    continue
+                if sid == slice_id and prio >= priority and gang != exclude_gang:
+                    hold += chips
+            return hold
+
     def nominated_hold(self, node: str, priority: int,
                        exclude_key: str | None = None) -> int:
         """Chips on `node` held for nominated preemptors that outrank (or
@@ -247,9 +298,21 @@ class ChipAllocator(ReservePlugin):
                 if n == node and prio >= priority and key != exclude_key
             )
 
+    def holds_for(self, spec: WorkloadSpec, node_info: NodeInfo,
+                  pod_key: str | None, now: float | None = None) -> int:
+        """Combined per-node + gang-slice nominated capacity this pod must
+        treat as taken on this node."""
+        hold = self.nominated_hold(node_info.name, spec.priority, pod_key)
+        m = node_info.metrics
+        if m is not None and m.slice_id:
+            hold += self.gang_hold(m.slice_id, spec.priority,
+                                   exclude_gang=spec.gang_name, now=now)
+        return hold
+
     # ------------------------------------------------------------ placement
     def pick_chips(self, spec: WorkloadSpec, node_info: NodeInfo,
-                   pod_key: str | None = None) -> list[Coord] | None:
+                   pod_key: str | None = None,
+                   now: float | None = None) -> list[Coord] | None:
         """Choose concrete chips for the spec on this node, best-fit
         contiguous. Falls back to any qualifying chips when the node's free
         space has no contiguous block (still schedulable, just lower quality —
@@ -260,7 +323,7 @@ class ChipAllocator(ReservePlugin):
         stats = self.class_stats(node_info, spec.min_free_mb,
                                  spec.min_clock_mhz)
         qualifying = stats.qcoords
-        hold = self.nominated_hold(node_info.name, spec.priority, pod_key)
+        hold = self.holds_for(spec, node_info, pod_key, now=now)
         if stats.count - hold < spec.chips:
             return None
         shape = _node_shape(m)
@@ -281,7 +344,8 @@ class ChipAllocator(ReservePlugin):
         spec = state.read_or("workload_spec")
         if node_info is None or spec is None:
             return Status.error("allocator: cycle state missing node_info/spec")
-        coords = self.pick_chips(spec, node_info, pod_key=pod.key)
+        coords = self.pick_chips(spec, node_info, pod_key=pod.key,
+                                 now=state.read_or("now"))
         if coords is None:
             return Status.unschedulable(f"{node}: chips vanished before reserve")
         with self._lock:

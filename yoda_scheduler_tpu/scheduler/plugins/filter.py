@@ -62,7 +62,8 @@ class TelemetryFilter(FilterPlugin):
             return Status.unschedulable(f"{node.name}: telemetry stale")
         if spec.is_gang:
             return self._filter_checked(state, spec, pod, node, m)
-        hold = self.allocator.nominated_hold(node.name, spec.priority, pod.key)
+        hold = self.allocator.holds_for(spec, node, pod.key,
+                                        now=state.read_or("now"))
         key = (spec, node.serial,
                self.allocator.pending_version(node.name), hold)
         slot = self._verdict_cache.get(node.name)
@@ -115,8 +116,8 @@ class TelemetryFilter(FilterPlugin):
         # hole that a higher-priority pod is entitled to)
         free = self.allocator.free_coords(node)
         if hold is None:
-            hold = self.allocator.nominated_hold(node.name, spec.priority,
-                                                 pod.key)
+            hold = self.allocator.holds_for(spec, node, pod.key,
+                                            now=state.read_or("now"))
         if len(free) - hold < spec.chips:
             return Status.unschedulable(
                 f"{node.name}: {len(free)} unclaimed healthy chips"

@@ -15,7 +15,7 @@ because their memory is only released once they actually terminate.
 from __future__ import annotations
 
 from ..framework import CycleState, NodeInfo, PostFilterPlugin, Snapshot, Status
-from ...utils.labels import LabelError, WorkloadSpec, spec_for
+from ...utils.labels import GANG_NAME_LABEL, LabelError, WorkloadSpec, spec_for
 from ...utils.pod import Pod
 from .allocator import ChipAllocator
 
@@ -49,14 +49,18 @@ def _evictable(pod: Pod) -> bool:
 class PriorityPreemption(PostFilterPlugin):
     name = "priority-preemption"
 
-    def __init__(self, allocator: ChipAllocator) -> None:
+    def __init__(self, allocator: ChipAllocator, gangs=None) -> None:
         self.allocator = allocator
+        self.gangs = gangs  # GangCoordinator: chosen-slice pin for gangs
 
     def post_filter(self, state: CycleState, pod: Pod, snapshot: Snapshot,
                     failures: dict[str, str]) -> tuple[str | None, list[Pod], Status]:
         spec: WorkloadSpec = state.read("workload_spec")
         now = state.read_or("now")
         my_prio = _priority(pod)
+        if spec.is_gang:
+            return self._gang_post_filter(state, spec, my_prio, pod,
+                                          snapshot, now)
         # minimal disruption: fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
         for node in snapshot.list():
@@ -74,6 +78,91 @@ class PriorityPreemption(PostFilterPlugin):
             )
         return best[1], best[2], Status.success()
 
+    def _gang_post_filter(self, state: CycleState, spec: WorkloadSpec,
+                          my_prio: int, pod: Pod, snapshot: Snapshot,
+                          now) -> tuple[str | None, list[Pod], Status]:
+        """All-or-nothing slice eviction for a gang (VERDICT r2 item 4b —
+        the workload MOST likely to find its slice dented by low-priority
+        singles is the one that previously could neither evict them nor go
+        elsewhere). Plan: for each big-enough slice, a per-host victim set
+        freeing `spec.chips` qualifying chips on `gang_size` hosts; choose
+        the slice with the fewest total victims. The engine then evicts
+        the whole plan and takes a GANG nomination (chips held on every
+        host of the slice until the gang completes or the hold expires)."""
+        # honour the slice pin: members already parked (coordinator) or
+        # bound (cluster truth) tie the whole gang to ONE slice — evicting
+        # pods on any other slice would free capacity the gang's filter
+        # refuses to use
+        pinned = self.gangs.chosen_slice(spec.gang_name) \
+            if self.gangs is not None else None
+        if pinned is None:
+            from .gang import bound_gang_members
+
+            _, pinned = bound_gang_members(state, spec.gang_name)
+        by_slice: dict[str, list[NodeInfo]] = {}
+        for node in snapshot.list():
+            m = node.metrics
+            if m is None or not m.slice_id:
+                continue
+            if pinned is not None and m.slice_id != pinned:
+                continue
+            if now is not None and m.stale(now=now):
+                continue
+            if spec.accelerator is not None and m.accelerator != spec.accelerator:
+                continue
+            if m.num_hosts < spec.gang_size:
+                continue
+            by_slice.setdefault(m.slice_id, []).append(node)
+        # hosts already serving this gang's own members — parked peers'
+        # pending reservations and bound members — are covered: they need
+        # no planning (their chips look taken, but by US), and only
+        # gang_size - covered more hosts must be freed
+        covered: set[str] = set()
+        if self.gangs is not None:
+            for key in self.gangs.waiting_members(spec.gang_name):
+                n = self.allocator.pending_node_of(key)
+                if n is not None:
+                    covered.add(n)
+        for ni in snapshot.list():
+            for p in ni.pods:
+                if (p.labels.get(GANG_NAME_LABEL) == spec.gang_name
+                        and not p.terminating):
+                    covered.add(ni.name)
+        need = max(spec.gang_size - len(covered), 1)
+        best: tuple[tuple, str, list[Pod]] | None = None
+        for sid, hosts in by_slice.items():
+            if len(hosts) < spec.gang_size:
+                continue
+            plans: list[tuple[int, int, str, list[Pod]]] = []
+            for host in hosts:
+                if host.name in covered:
+                    continue
+                victims = self._plan_node(spec, my_prio, host, pod_key=pod.key)
+                if victims is None:
+                    continue  # this host can't reach spec.chips at all
+                plans.append((len(victims),
+                              max((_priority(v) for v in victims), default=-1),
+                              host.name, victims))
+            if len(plans) < need:
+                continue  # not enough viable hosts even with evictions
+            plans.sort()
+            chosen = plans[:need]
+            victims = [v for _, _, _, vs in chosen for v in vs]
+            if not victims:
+                # every chosen host already fits without evicting: the
+                # gang's infeasibility has a non-capacity cause preemption
+                # cannot cure
+                continue
+            key = (len(victims), max(_priority(v) for v in victims), sid)
+            if best is None or key < best[0]:
+                best = (key, chosen[0][2], victims)
+        if best is None:
+            return None, [], Status.unschedulable(
+                f"preemption: no slice can host gang {spec.gang_name} even "
+                f"after evicting lower-priority pods"
+            )
+        return best[1], best[2], Status.success()
+
     def _plan_eviction(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
                        now: float | None = None,
                        pod_key: str | None = None) -> list[Pod] | None:
@@ -81,7 +170,7 @@ class PriorityPreemption(PostFilterPlugin):
         qualifying chips; victims chosen lowest-priority-first. None if
         impossible — or if no eviction is needed at all, in which case the
         pod's infeasibility has a non-capacity cause preemption cannot cure
-        (stale telemetry, accelerator mismatch, gang constraints)."""
+        (stale telemetry, accelerator mismatch)."""
         m = node.metrics
         if m is None:
             return None
@@ -89,31 +178,40 @@ class PriorityPreemption(PostFilterPlugin):
             return None
         if spec.accelerator is not None and m.accelerator != spec.accelerator:
             return None
-        if spec.is_gang:
-            return None  # gangs don't preempt in v1: cross-node all-or-nothing eviction
-        # fast reject before any chip scan: with no evictable lower-priority
-        # pod this function can only ever return None (either the node fits
-        # without evictions — "no eviction needed", also None — or it can't
-        # fit at all). This is the common case for every node during an
-        # unschedulable burst.
-        pool = [p for p in node.pods
-                if _priority(p) < my_prio and _evictable(p)]
-        if not pool:
-            return None
+        victims = self._plan_node(spec, my_prio, node, pod_key=pod_key)
+        return victims or None
+
+    def _plan_node(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
+                   pod_key: str | None = None) -> list[Pod] | None:
+        """Victims on this node that free `spec.chips` qualifying chips:
+        [] when the node already fits without evicting, None when it cannot
+        reach the target at all. Shared by the single-pod path and the
+        per-host step of gang slice planning."""
+        m = node.metrics
+        free = self.allocator.free_coords(node)
+        # capacity already held for OTHER nominated preemptors (pod-level
+        # and gang-level) of >= priority counts as taken, exactly as in
+        # TelemetryFilter — otherwise two preemptors can be "proven" to fit
+        # in the same freshly-freed hole, nominate overlapping chips, and
+        # deadlock each other's holds
+        hold = self.allocator.holds_for(spec, node, pod_key)
         # capacity check against chip HBM totals (see module docstring)
         ok_coords = {
             c.coords for c in m.healthy_chips()
             if c.hbm_total_mb >= spec.min_free_mb and c.clock_mhz >= spec.min_clock_mhz
         }
-        # capacity already held for OTHER nominated preemptors of >= priority
-        # counts as taken, exactly as in TelemetryFilter — otherwise two
-        # preemptors can be "proven" to fit in the same freshly-freed hole,
-        # nominate overlapping chips, and deadlock each other's holds
-        hold = self.allocator.nominated_hold(node.name, spec.priority, pod_key)
+        if len(free & ok_coords) - hold >= spec.chips:
+            return []  # fits as-is; nothing to evict here
+        # fast reject before sorting: with no evictable lower-priority pod
+        # the target is unreachable. This is the common case for every node
+        # during an unschedulable burst.
+        pool = [p for p in node.pods
+                if _priority(p) < my_prio and _evictable(p)]
+        if not pool:
+            return None
         if len(ok_coords) - hold < spec.chips:
             return None
         pool.sort(key=_priority)
-        free = self.allocator.free_coords(node)
         victims: list[Pod] = []
         while len(free & ok_coords) - hold < spec.chips:
             if not pool:
@@ -121,4 +219,4 @@ class PriorityPreemption(PostFilterPlugin):
             v = pool.pop(0)
             victims.append(v)
             free = free | v.assigned_chips()
-        return victims or None
+        return victims

@@ -110,17 +110,29 @@ class SchedulingQueue:
         info.not_before = 0.0
         self._push_active(info)
 
-    def remove(self, pod_key: str) -> bool:
+    def remove(self, pod_key: str) -> list[QueuedPodInfo]:
         """Drop a pod from the active queue and backoff lot (external
-        deletion while queued). Returns True if anything was removed."""
-        n0 = len(self)
+        deletion while queued). Returns the removed entries (callers
+        inspect them to release gang state; truthy iff anything was
+        removed)."""
+        removed: list[QueuedPodInfo] = []
         if self._key is not None:
-            self._active = [e for e in self._active if e[2].pod.key != pod_key]
+            keep = []
+            for e in self._active:
+                (removed if e[2].pod.key == pod_key else keep).append(e)
+            self._active = keep
             heapq.heapify(self._active)
+            removed = [e[2] for e in removed]
         else:
-            self._active = [q for q in self._active if q.pod.key != pod_key]
+            keep = []
+            for q in self._active:
+                (removed if q.pod.key == pod_key else keep).append(q)
+            self._active = keep
+        for q in self._backoff:
+            if q.pod.key == pod_key:
+                removed.append(q)
         self._backoff = [q for q in self._backoff if q.pod.key != pod_key]
-        return len(self) < n0
+        return removed
 
     def contains(self, pod_key: str) -> bool:
         return any(q.pod.key == pod_key for q in self._active_infos()) or any(

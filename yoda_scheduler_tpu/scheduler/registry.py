@@ -53,7 +53,7 @@ register("topology-score",
          lambda cfg, alloc, gangs: TopologyScore(alloc, weight=cfg.topology_weight))
 register("gang-permit",
          lambda cfg, alloc, gangs: GangPermit(gangs, timeout_s=cfg.gang_timeout_s))
-register("priority-preemption", lambda cfg, alloc, gangs: PriorityPreemption(alloc))
+register("priority-preemption", lambda cfg, alloc, gangs: PriorityPreemption(alloc, gangs))
 
 
 # the default enablement per extension point (mirrors default_profile);
