@@ -32,6 +32,7 @@ from yoda_scheduler_tpu.scheduler.plugins.reference_emulation import (
 from yoda_scheduler_tpu.telemetry import (
     TelemetryStore,
     make_gpu_node,
+    make_slice,
     make_tpu_node,
     make_v4_slice,
 )
@@ -42,6 +43,8 @@ def build_nodes():
     nodes = []
     for i in range(8):
         nodes += make_v4_slice(f"v4-32-{i}", "2x2x4")          # 8 x 16 chips
+    # one 2-D v5e slice so the burst exercises the non-v4 path end-to-end
+    nodes += make_slice("v5e-32", "8x4x1", generation="v5e")   # 4 x 8 chips
     for i in range(8):
         nodes.append(make_tpu_node(f"v4-8-{i}", chips=4))      # 8 x 4 chips
     for i in range(20):
@@ -50,7 +53,8 @@ def build_nodes():
 
 
 def build_burst():
-    """200 pods: 5 gangs x 4 workers, 45 TPU jobs, 85 GPU jobs, 50 unlabeled."""
+    """200 pods: 5 gangs x 4 workers, 49 TPU jobs (25 single + 15 double +
+    5 2x2-topology + 4 v5e-pinned 2x4 blocks), 85 GPU jobs, 46 unlabeled."""
     pods = []
     for g in range(5):
         for w in range(4):
@@ -60,6 +64,7 @@ def build_burst():
                     "tpu/gang-name": f"gang{g}", "tpu/gang-size": "4",
                     "scv/number": "4", "scv/memory": "16000",
                     "scv/priority": "5", "tpu/accelerator": "tpu",
+                    "tpu/generation": "v4",  # BASELINE #4: a v4-32 job
                 },
             ))
     for i in range(25):
@@ -72,10 +77,19 @@ def build_burst():
     for i in range(5):
         pods.append(Pod(f"tpu-topo-{i}", labels={
             "scv/number": "4", "tpu/topology": "2x2", "tpu/accelerator": "tpu"}))
+    # v5e-pinned block jobs: exercise generation routing + 2-D placement
+    # (the v5e-32 slice has 4 hosts = room for exactly 4 full 2x4 blocks).
+    # Priority 3: reserved block capacity schedules ahead of the unpinned
+    # flood — identical labels feed both profiles, so the comparison stays
+    # fair
+    for i in range(4):
+        pods.append(Pod(f"v5e-blk-{i}", labels={
+            "scv/number": "8", "tpu/topology": "2x4", "scv/priority": "3",
+            "tpu/generation": "v5e", "tpu/accelerator": "tpu"}))
     for i in range(85):
         pods.append(Pod(f"gpu-job-{i}", labels={
             "scv/number": "1", "scv/memory": "10000", "tpu/accelerator": "gpu"}))
-    for i in range(50):
+    for i in range(46):
         pods.append(Pod(f"any-{i}", labels={"scv/memory": "1000"}))
     assert len(pods) == 200
     return pods

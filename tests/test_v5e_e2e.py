@@ -1,0 +1,138 @@
+"""v5e end-to-end (VERDICT r2 item 6): the non-v4 path travelled all the
+way — generation-true telemetry (2-D torus, 2x4 host blocks, v5e clocks),
+an 8-member gang and a topology-pinned block job on an 8x8 v5e slice, the
+example manifest through `cli simulate`, and generation routing in a
+heterogeneous v4+v5e fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from yoda_scheduler_tpu.cli import main as cli_main
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_slice, make_v4_slice
+from yoda_scheduler_tpu.topology.generations import generation
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk_fleet():
+    """One 8x8 v5e slice (8 hosts x 8 chips) + one v4-32 slice."""
+    store = TelemetryStore()
+    now = time.time()
+    for m in make_slice("v5e-64", "8x8x1", generation="v5e"):
+        m.heartbeat = now + 1e8
+        store.put(m)
+    for m in make_v4_slice("v4-32", "2x2x4"):
+        m.heartbeat = now + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9,
+                                               gang_timeout_s=30.0),
+                      clock=FakeClock(start=time.time()))
+    return cluster, sched
+
+
+def test_v5e_telemetry_is_generation_true():
+    m = make_slice("v5e-64", "8x8x1", generation="v5e")[0]
+    gen = generation("v5e")
+    assert m.tpu_generation == "v5e"
+    assert m.num_hosts == 8 and len(m.chips) == 8  # 2x4 host block
+    chip = m.chips[0]
+    assert chip.clock_mhz == gen.clock_mhz
+    assert chip.ici_bandwidth_gbps == gen.ici_gbps
+    assert chip.hbm_total_mb == gen.hbm_mb
+    # 2-D torus: all coords flat in z
+    assert all(c.coords[2] == 0 for c in m.chips)
+
+
+def test_v5e_gang_and_topology_block_end_to_end():
+    cluster, sched = mk_fleet()
+    gang = [Pod(f"mx-{i}", labels={
+        "tpu/gang-name": "mx", "tpu/gang-size": "8", "scv/number": "8",
+        "tpu/accelerator": "tpu", "tpu/generation": "v5e"})
+        for i in range(8)]
+    blk = Pod("blk", labels={"scv/number": "8", "tpu/topology": "2x4",
+                             "tpu/accelerator": "tpu",
+                             "tpu/generation": "v5e"})
+    for p in gang:
+        sched.submit(p)
+    sched.submit(blk)
+    sched.run_until_idle()
+    # the gang fills the whole 8-host slice; the block job then has no v5e
+    # room left — submit order guarantees the gang goes first (priority 0
+    # FIFO), so assert gang success and block pinned AWAY from v4
+    assert all(p.phase == PodPhase.BOUND for p in gang), \
+        [(p.name, p.phase) for p in gang]
+    assert {p.node.rsplit("-host-", 1)[0] for p in gang} == {"v5e-64"}
+    for p in gang:
+        assert len(p.assigned_chips()) == 8  # a full 2x4 host block
+    # generation pin respected: never placed on the v4 slice
+    assert blk.phase != PodPhase.BOUND
+
+
+def test_v5e_topology_block_lands_contiguous():
+    cluster, sched = mk_fleet()
+    blk = Pod("blk", labels={"scv/number": "8", "tpu/topology": "2x4",
+                             "tpu/accelerator": "tpu",
+                             "tpu/generation": "v5e"})
+    sched.submit(blk)
+    sched.run_until_idle()
+    assert blk.phase == PodPhase.BOUND
+    assert blk.node.startswith("v5e-64-host-")
+    coords = blk.assigned_chips()
+    xs = sorted({c[0] for c in coords})
+    ys = sorted({c[1] for c in coords})
+    # an axis-aligned 2x4 (or 4x2) block
+    assert len(coords) == 8
+    assert {(x, y, 0) for x in xs for y in ys} == coords
+
+
+def test_generation_routing_in_mixed_fleet():
+    """A v4-pinned pod must never land on v5e and vice versa, even when
+    the other generation has more room."""
+    cluster, sched = mk_fleet()
+    v4 = Pod("v4job", labels={"scv/number": "4", "tpu/accelerator": "tpu",
+                              "tpu/generation": "v4"})
+    v5e = Pod("v5ejob", labels={"scv/number": "8", "tpu/accelerator": "tpu",
+                                "tpu/generation": "v5e"})
+    sched.submit(v4)
+    sched.submit(v5e)
+    sched.run_until_idle()
+    assert v4.node.startswith("v4-32-host-")
+    assert v5e.node.startswith("v5e-64-host-")
+
+
+def test_v5e_example_manifest_through_simulate(capsys):
+    """`cli simulate` with the shipped v5e manifest on a v5e fleet: the
+    8-member gang and the 2x4 block job all bind."""
+    rc = cli_main([
+        "simulate", "example/mixtral-v5e-64.yaml",
+        "--tpu-slices", "0", "--v5e-slices", "2",
+        "--tpu-nodes", "0", "--gpu-nodes", "0",
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["bound"] == 9  # 8 gang workers + the block pod
+    gang_nodes = {v["node"] for k, v in out["pods"].items()
+                  if "mixtral" in k}
+    assert len(gang_nodes) == 8
+    assert len({n.rsplit("-host-", 1)[0] for n in gang_nodes}) == 1
+
+
+def test_multislice_example_manifest_through_simulate(capsys):
+    """The multi-slice gang example: 8 workers across two 4-host v4-32
+    slices via `cli simulate`."""
+    rc = cli_main([
+        "simulate", "example/llama-multislice-gang.yaml",
+        "--tpu-slices", "2", "--tpu-nodes", "0", "--gpu-nodes", "0",
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["bound"] == 8
+    slices = {v["node"].rsplit("-host-", 1)[0]
+              for v in out["pods"].values()}
+    assert len(slices) == 2

@@ -64,9 +64,13 @@ def cmd_simulate(args) -> int:
     pub = FakePublisher(store)
 
     # cluster topology from flags
+    from .telemetry import make_slice
+
     nodes = []
     for i in range(args.tpu_slices):
         nodes += make_v4_slice(f"v4-32-{i}", "2x2x4")
+    for i in range(args.v5e_slices):
+        nodes += make_slice(f"v5e-64-{i}", "8x8x1", generation="v5e")
     for i in range(args.tpu_nodes):
         nodes.append(make_tpu_node(f"v4-8-{i}", chips=4))
     for i in range(args.gpu_nodes):
@@ -177,7 +181,10 @@ def main(argv=None) -> int:
     sim = sub.add_parser("simulate", help="schedule manifests on a fake cluster")
     sim.add_argument("manifests", nargs="*", help="Pod/Deployment YAML files")
     sim.add_argument("--config", default=None)
-    sim.add_argument("--tpu-slices", type=int, default=2)
+    sim.add_argument("--tpu-slices", type=int, default=2,
+                     help="multi-host v4-32 slices (3-D torus)")
+    sim.add_argument("--v5e-slices", type=int, default=0,
+                     help="multi-host 8x8 v5e slices (2-D torus)")
     sim.add_argument("--tpu-nodes", type=int, default=2)
     sim.add_argument("--gpu-nodes", type=int, default=2)
     sim.add_argument("--metrics-port", type=int, default=None)

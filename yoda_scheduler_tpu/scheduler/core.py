@@ -143,10 +143,14 @@ def default_profile(config: SchedulerConfig,
     double-book chips between Reserve and Bind."""
     allocator = allocator or ChipAllocator()
     gangs = gangs or GangCoordinator()
-    gang_permit = GangPermit(gangs, timeout_s=config.gang_timeout_s)
+    gang_permit = GangPermit(gangs, timeout_s=config.gang_timeout_s,
+                             allocator=allocator)
     topo = TopologyScore(allocator, weight=config.topology_weight)
     profile = Profile(
         queue_sort=PrioritySort(),
+        # GangPermit.pre_filter computes multi-slice plans for gangs no
+        # single slice can host
+        pre_filter=[gang_permit],
         filter=[TelemetryFilter(allocator, gangs, config.telemetry_max_age_s)],
         post_filter=[PriorityPreemption(allocator, gangs)] if config.preemption else [],
         # TopologyScore is both a PreScore (slice-usage map) and a Score plugin

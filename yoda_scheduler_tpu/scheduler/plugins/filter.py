@@ -90,25 +90,36 @@ class TelemetryFilter(FilterPlugin):
                 f" != requested {spec.tpu_generation}"
             )
 
-        # gang constraints: whole gang must fit one slice; follow the chosen slice
+        # gang constraints: the gang fits one slice (and sticks to the
+        # chosen one) — or, when no single slice can host it, follows its
+        # multi-slice plan within per-slice quotas (GangPermit.pre_filter)
         if spec.is_gang:
             if not m.slice_id:
                 return Status.unschedulable(f"{node.name}: gang pod needs a pod-slice node")
-            if m.num_hosts < spec.gang_size:
-                return Status.unschedulable(
-                    f"{node.name}: slice {m.slice_id} has {m.num_hosts} hosts < gang size {spec.gang_size}"
-                )
-            if self.gangs is not None:
-                chosen = self.gangs.chosen_slice(spec.gang_name)
-                if chosen is None:
-                    # partially-bound gang (peer bind failure / scheduler
-                    # restart): members already on a slice pin the choice
-                    # even though the coordinator's state is gone
-                    _, chosen = bound_gang_members(state, spec.gang_name)
-                if chosen is not None and chosen != m.slice_id:
+            plan_quota = (self.gangs.quota_left(spec.gang_name, m.slice_id)
+                          if self.gangs is not None else None)
+            if plan_quota is not None:
+                if plan_quota <= 0:
                     return Status.unschedulable(
-                        f"{node.name}: gang {spec.gang_name} is placing on slice {chosen}"
+                        f"{node.name}: slice {m.slice_id} quota filled for "
+                        f"gang {spec.gang_name}"
                     )
+            else:
+                if m.num_hosts < spec.gang_size:
+                    return Status.unschedulable(
+                        f"{node.name}: slice {m.slice_id} has {m.num_hosts} hosts < gang size {spec.gang_size}"
+                    )
+                if self.gangs is not None:
+                    chosen = self.gangs.chosen_slice(spec.gang_name)
+                    if chosen is None:
+                        # partially-bound gang (peer bind failure / scheduler
+                        # restart): members already on a slice pin the choice
+                        # even though the coordinator's state is gone
+                        _, chosen, _ = bound_gang_members(state, spec.gang_name)
+                    if chosen is not None and chosen != m.slice_id:
+                        return Status.unschedulable(
+                            f"{node.name}: gang {spec.gang_name} is placing on slice {chosen}"
+                        )
 
         # chips-count predicate over *unclaimed* healthy chips, minus
         # capacity held for nominated preemptors of >= priority (upstream

@@ -98,7 +98,7 @@ class PriorityPreemption(PostFilterPlugin):
         if pinned is None:
             from .gang import bound_gang_members
 
-            _, pinned = bound_gang_members(state, spec.gang_name)
+            _, pinned, _ = bound_gang_members(state, spec.gang_name)
         by_slice: dict[str, list[NodeInfo]] = {}
         for node in snapshot.list():
             m = node.metrics

@@ -45,8 +45,22 @@ class RefFilter(FilterPlugin):
         # packing quality.
         if spec.accelerator is not None and m.accelerator != spec.accelerator:
             return Status.unschedulable(f"{node.name}: nodeSelector mismatch")
+        if (spec.tpu_generation is not None
+                and m.tpu_generation != spec.tpu_generation):
+            # same stand-in rationale: a reference deployment pins TPU
+            # generations with ordinary nodeSelectors, not plugin logic
+            return Status.unschedulable(f"{node.name}: nodeSelector mismatch")
         if m.chip_count < max(spec.chips, 1):
             return Status.unschedulable(f"{node.name}: not enough cards")
+        # device-plugin resource stand-in, NOT a reference plugin capability:
+        # real reference deployments request cards through the device-plugin
+        # resource, and the DEFAULT NodeResourcesFit plugin (running
+        # alongside yoda in the same framework) prevents handing the same
+        # device out twice. Without this the baseline thrashes forever
+        # re-offering claimed cards whose telemetry still shows free HBM —
+        # a deployment artifact, not the scheduling behaviour under test.
+        if m.chip_count - len(node.assigned_coords()) < max(spec.chips, 1):
+            return Status.unschedulable(f"{node.name}: devices exhausted")
         fits_mem = sum(
             1 for c in m.chips
             if c.healthy and c.hbm_free_mb >= spec.min_free_mb
@@ -195,11 +209,18 @@ class TelemetryDecrementingCluster:
             spec = spec_for(pod)
         except Exception:
             return
+        # debit the chips that were ACTUALLY assigned — debiting different
+        # chips than the device plugin handed out would desynchronise the
+        # HBM view from the coordinate view and manufacture phantom
+        # overcommits the real reference never caused
+        taken = set(assigned_chips or ())
         need = spec.chips
-        for c in sorted(m.chips, key=lambda c: -c.hbm_free_mb):
+        for c in sorted(m.chips,
+                        key=lambda c: (c.coords not in taken, -c.hbm_free_mb)):
             if need == 0:
                 break
-            if c.healthy and c.hbm_free_mb >= spec.min_free_mb:
+            if c.healthy and (c.coords in taken
+                              or c.hbm_free_mb >= spec.min_free_mb):
                 c.hbm_free_mb = max(
                     0, c.hbm_free_mb - max(spec.min_free_mb, c.hbm_total_mb // max(m.chip_count, 1)))
                 need -= 1

@@ -52,7 +52,8 @@ register("telemetry-score",
 register("topology-score",
          lambda cfg, alloc, gangs: TopologyScore(alloc, weight=cfg.topology_weight))
 register("gang-permit",
-         lambda cfg, alloc, gangs: GangPermit(gangs, timeout_s=cfg.gang_timeout_s))
+         lambda cfg, alloc, gangs: GangPermit(gangs, timeout_s=cfg.gang_timeout_s,
+                                              allocator=alloc))
 register("priority-preemption", lambda cfg, alloc, gangs: PriorityPreemption(alloc, gangs))
 
 
@@ -114,11 +115,12 @@ def build_profile(config: SchedulerConfig,
             built[name] = _REGISTRY[name](config, alloc, gangs)
         return built[name]
 
-    from .framework import PreScorePlugin, ReservePlugin
+    from .framework import PreFilterPlugin, PreScorePlugin, ReservePlugin
 
     qs = enabled.get("queueSort", ["priority-sort"])
     queue_sort = get(qs[0]) if qs else PrioritySort()
     filters = [get(n) for n in enabled.get("filter", [])]
+    pre_filters = [get(n) for n in enabled.get("preFilter", [])]
     post_filters = [get(n) for n in enabled.get("postFilter", [])]
     pre_scores = [get(n) for n in enabled.get("preScore", [])]
     scores = [get(n) for n in enabled.get("score", [])]
@@ -135,8 +137,14 @@ def build_profile(config: SchedulerConfig,
     for p in list(built.values()) + explicit_reserves:
         if isinstance(p, ReservePlugin) and p not in reserves:
             reserves.append(p)
+    # any enabled plugin that also implements PreFilter (gang-permit's
+    # multi-slice planning pass) hooks in automatically
+    for p in built.values():
+        if isinstance(p, PreFilterPlugin) and p not in pre_filters:
+            pre_filters.append(p)
     return Profile(
         queue_sort=queue_sort,
+        pre_filter=pre_filters,
         filter=filters,
         post_filter=post_filters,
         pre_score=pre_scores,
