@@ -68,7 +68,7 @@ def _parse_int(labels: dict[str, str], key: str, default: int) -> int:
         raise LabelError(key, raw, "must be an integer") from None
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=True)
 class WorkloadSpec:
     """The parsed resource request of one pod.
 
@@ -135,6 +135,18 @@ class WorkloadSpec:
     def is_gang(self) -> bool:
         return self.gang_name is not None
 
+    def __hash__(self) -> int:
+        # cached: specs key the filter-verdict and unschedulable-class
+        # caches, so they are hashed once per (pod, node) on the hot path;
+        # frozen dataclasses rebuild the field tuple on every hash call
+        h = self.__dict__.get("_hash_memo")
+        if h is None:
+            h = hash((self.chips, self.min_free_mb, self.min_clock_mhz,
+                      self.priority, self.accelerator, self.tpu_generation,
+                      self.topology, self.gang_name, self.gang_size))
+            object.__setattr__(self, "_hash_memo", h)
+        return h
+
 
 _SPEC_LABELS = (
     NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
@@ -163,6 +175,22 @@ def workload_class(pod) -> str:
     return "unlabeled"
 
 
+_SPEC_INTERN: dict[WorkloadSpec, WorkloadSpec] = {}
+
+
+def _intern_spec(spec: WorkloadSpec) -> WorkloadSpec:
+    """One canonical object per spec VALUE: pods sharing a label class then
+    share the spec object, so dict lookups in the spec-keyed caches
+    short-circuit on identity instead of comparing nine fields."""
+    got = _SPEC_INTERN.get(spec)
+    if got is not None:
+        return got
+    if len(_SPEC_INTERN) > 4096:  # churn guard; classes are few in practice
+        _SPEC_INTERN.clear()
+    _SPEC_INTERN[spec] = spec
+    return spec
+
+
 def spec_for(pod) -> WorkloadSpec:
     """Parse-once spec cache for a pod-like object (anything with ``labels``).
 
@@ -174,4 +202,5 @@ def spec_for(pod) -> WorkloadSpec:
     malformed pod fails its cycle permanently anyway)."""
     labels = pod.labels
     key = tuple(labels.get(k) for k in _SPEC_LABELS)
-    return memo(pod, "_spec_cache", key, lambda: WorkloadSpec.from_labels(labels))
+    return memo(pod, "_spec_cache", key,
+                lambda: _intern_spec(WorkloadSpec.from_labels(labels)))
