@@ -26,6 +26,10 @@ simulate:
 	$(PY) -m yoda_scheduler_tpu.cli simulate example/test-pod.yaml \
 		example/test-deployment.yaml example/resnet-v4-8.yaml \
 		example/llama-v4-32-gang.yaml
+	$(PY) -m yoda_scheduler_tpu.cli simulate example/llama-multislice-gang.yaml \
+		--tpu-slices 2 --tpu-nodes 0 --gpu-nodes 0
+	$(PY) -m yoda_scheduler_tpu.cli simulate example/mixtral-v5e-64.yaml \
+		--tpu-slices 0 --v5e-slices 2 --tpu-nodes 0 --gpu-nodes 0
 
 graft:
 	$(PY) __graft_entry__.py

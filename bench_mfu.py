@@ -306,6 +306,25 @@ def attention_bench(on_tpu: bool) -> dict:
 
 
 def main() -> None:
+    # hard ceiling: a wedged device tunnel mid-compile would otherwise hang
+    # forever inside XLA where the cooperative budget checks never run —
+    # emit a diagnostic JSON instead of eating the driver's whole slot.
+    # A THREAD timer, not SIGALRM: Python signal handlers only run between
+    # bytecodes on the main thread, so a hang inside a single native XLA
+    # call would defer SIGALRM forever; a daemon thread fires regardless.
+    def _on_deadline():
+        print(json.dumps({
+            "metric": "llama_train_mfu", "value": None, "unit": "%",
+            "vs_baseline": None,
+            "error": f"hard budget exceeded ({BUDGET_S + 120:.0f}s): device "
+                     "hung mid-run",
+        }), flush=True)
+        os._exit(0)
+
+    watchdog = threading.Timer(BUDGET_S + 120, _on_deadline)
+    watchdog.daemon = True
+    watchdog.start()
+
     devices = _probe_devices()
     on_tpu = devices[0].platform == "tpu"
     _progress(f"backend={jax.default_backend()} on_tpu={on_tpu} "
@@ -317,6 +336,7 @@ def main() -> None:
     numeric = {k: v for k, v in attn.items()
                if isinstance(v["fwd_speedup"], (int, float))}
     top_s = max(numeric or attn, key=lambda k: int(k[1:]))
+    watchdog.cancel()  # completed in time
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": train["mfu_pct"] if train["mfu_pct"] is not None

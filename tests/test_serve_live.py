@@ -185,6 +185,87 @@ class TestGangLive:
             t.join(timeout=5.0)
 
 
+class TestGangPreemptionLive:
+    def test_gang_preempts_singles_with_graceful_drain_over_http(self, server):
+        """Round-3 integration: a high-priority gang preempts low-priority
+        singles denting its slice, over the REAL transport with GRACEFUL
+        victim termination — evictions are DELETEs, victims keep their
+        chips until the kubelet finishes, the slice-level entitlement
+        holds the capacity through the drain, and the gang binds after
+        finish_termination."""
+        from yoda_scheduler_tpu.telemetry import make_v4_slice
+
+        server.state.graceful_deletion = True
+        for m in make_v4_slice("s1", "2x2x4"):
+            server.state.add_node(m.node)
+            server.state.put_metrics(m.to_cr())
+
+        def pod_manifest(name, labels):
+            return {
+                "metadata": {"name": name, "namespace": "default",
+                             "labels": labels,
+                             "ownerReferences": [{"kind": "Job", "name": "j",
+                                                  "controller": True}]},
+                "spec": {"schedulerName": "yoda-scheduler"},
+                "status": {"phase": "Pending"},
+            }
+
+        client = KubeClient(server.url)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=run_scheduler_against_cluster,
+            args=(client, [(SchedulerConfig(pod_initial_backoff_s=0.05,
+                                            pod_max_backoff_s=0.2,
+                                            gang_timeout_s=20.0), None)]),
+            kwargs={"metrics_port": None, "poll_s": 0.05,
+                    "stop_event": stop},
+            daemon=True)
+        t.start()
+        try:
+            for i in range(4):
+                server.state.add_pod(pod_manifest(f"low-{i}", {
+                    "scv/number": "2", "scv/priority": "0",
+                    "tpu/accelerator": "tpu"}))
+            assert wait_for(lambda: all(
+                (server.state.pod(f"low-{i}") or {}).get("spec", {}).get(
+                    "nodeName") for i in range(4)))
+            # the scenario needs one single per host; current scoring
+            # spreads them (headroom), but if a future packing strategy
+            # concentrates them this test degrades to a skip — the
+            # per-host case stays covered by the engine-level tests
+            nodes = {(server.state.pod(f"low-{i}") or {})["spec"]["nodeName"]
+                     for i in range(4)}
+            if len(nodes) < 4:
+                pytest.skip("packing concentrated the singles onto fewer "
+                            "hosts; engine-level tests cover this case")
+            for i in range(4):
+                server.state.add_pod(pod_manifest(f"g-{i}", {
+                    "tpu/gang-name": "g", "tpu/gang-size": "4",
+                    "scv/number": "4", "scv/priority": "9",
+                    "tpu/accelerator": "tpu"}))
+            # victims get graceful DELETEs (deletionTimestamp set)
+            assert wait_for(lambda: all(
+                (server.state.pod(f"low-{i}") or {"metadata": {
+                    "deletionTimestamp": "x"}})["metadata"].get(
+                        "deletionTimestamp") for i in range(4)), timeout=15.0)
+            # while draining, the gang must NOT be bound yet
+            assert not any((server.state.pod(f"g-{i}") or {}).get(
+                "spec", {}).get("nodeName") for i in range(4))
+            for i in range(4):
+                if server.state.pod(f"low-{i}") is not None:
+                    server.state.finish_termination(f"default/low-{i}")
+            assert wait_for(lambda: all(
+                (server.state.pod(f"g-{i}") or {}).get("spec", {}).get(
+                    "nodeName") for i in range(4)), timeout=20.0), \
+                "gang never bound after victims drained"
+            gang_nodes = {(server.state.pod(f"g-{i}"))["spec"]["nodeName"]
+                          for i in range(4)}
+            assert gang_nodes == {f"s1-host-{i}" for i in range(4)}
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+
 class TestWatchCacheLive:
     def _start(self, server):
         client = KubeClient(server.url)
