@@ -10,6 +10,8 @@ from __future__ import annotations
 import json
 import time
 
+import pytest
+
 from yoda_scheduler_tpu.cli import main as cli_main
 from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
 from yoda_scheduler_tpu.scheduler.core import FakeClock
@@ -36,10 +38,11 @@ def mk_fleet():
     return cluster, sched
 
 
-def test_v5e_telemetry_is_generation_true():
-    m = make_slice("v5e-64", "8x8x1", generation="v5e")[0]
-    gen = generation("v5e")
-    assert m.tpu_generation == "v5e"
+@pytest.mark.parametrize("gen_name", ["v5e", "v6e"])
+def test_2d_generation_telemetry_is_generation_true(gen_name):
+    m = make_slice(f"{gen_name}-64", "8x8x1", generation=gen_name)[0]
+    gen = generation(gen_name)
+    assert m.tpu_generation == gen_name
     assert m.num_hosts == 8 and len(m.chips) == 8  # 2x4 host block
     chip = m.chips[0]
     assert chip.clock_mhz == gen.clock_mhz
@@ -47,6 +50,31 @@ def test_v5e_telemetry_is_generation_true():
     assert chip.hbm_total_mb == gen.hbm_mb
     # 2-D torus: all coords flat in z
     assert all(c.coords[2] == 0 for c in m.chips)
+
+
+def test_v6e_block_job_end_to_end():
+    """Same placement machinery, third generation: a 2x4 block on a v6e
+    slice in a fleet that also carries v4 — routing + contiguity hold."""
+    store = TelemetryStore()
+    now = time.time()
+    for m in make_slice("v6e-64", "8x8x1", generation="v6e"):
+        m.heartbeat = now + 1e8
+        store.put(m)
+    for m in make_v4_slice("v4-32", "2x2x4"):
+        m.heartbeat = now + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+                      clock=FakeClock(start=time.time()))
+    blk = Pod("blk", labels={"scv/number": "8", "tpu/topology": "2x4",
+                             "tpu/accelerator": "tpu",
+                             "tpu/generation": "v6e"})
+    sched.submit(blk)
+    sched.run_until_idle()
+    assert blk.phase == PodPhase.BOUND
+    assert blk.node.startswith("v6e-64-host-")
+    assert len(blk.assigned_chips()) == 8
 
 
 def test_v5e_gang_and_topology_block_end_to_end():

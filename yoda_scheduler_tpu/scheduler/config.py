@@ -29,6 +29,14 @@ class ScoreWeights:
     total_memory: int = 1
     actual: int = 2
     allocate: int = 3
+    # TPU-only, default OFF (reference parity): prefer nodes whose
+    # qualifying chips report LOW measured MXU duty cycle — live
+    # utilisation the reference's clock-as-performance proxy cannot see
+    # (telemetry/schema.py Chip.duty_cycle_pct). NOTE: the first-party
+    # sniffer cannot measure duty through JAX's public API and reports 0;
+    # this weight only means something with a telemetry publisher that
+    # fills the field (e.g. from libtpu profiler counters).
+    duty_cycle: int = 0
 
 
 @dataclass(frozen=True)

@@ -29,16 +29,18 @@ class ClassStats:
     scan a cycle would do is a repeat of the previous cycle's.
 
     maxima/sums attribute order: (ici_bandwidth_gbps, clock_mhz, core_count,
-    hbm_free_mb, power_w, hbm_total_mb)."""
+    hbm_free_mb, power_w, hbm_total_mb). duty_sum is the qualifying chips'
+    summed measured MXU duty cycle (utilisation-aware scoring)."""
 
-    __slots__ = ("count", "qcoords", "maxima", "sums")
+    __slots__ = ("count", "qcoords", "maxima", "sums", "duty_sum")
 
     def __init__(self, count: int, qcoords: frozenset,
-                 maxima: tuple, sums: tuple) -> None:
+                 maxima: tuple, sums: tuple, duty_sum: float = 0.0) -> None:
         self.count = count
         self.qcoords = qcoords
         self.maxima = maxima
         self.sums = sums
+        self.duty_sum = duty_sum
 
 
 _ZERO6 = (0, 0, 0, 0, 0, 0)
@@ -182,6 +184,7 @@ class ChipAllocator(ReservePlugin):
             qcoords = set()
             mbw = mck = mco = mfm = mpw = mtm = 0
             sbw = sck = sco = sfm = spw = stm = 0
+            duty = 0.0
             for c in m.healthy_chips():
                 if (c.coords in free and c.hbm_free_mb >= min_free_mb
                         and c.clock_mhz >= min_clock_mhz):
@@ -197,9 +200,10 @@ class ChipAllocator(ReservePlugin):
                     if tm > mtm: mtm = tm
                     sbw += bw; sck += ck; sco += co
                     sfm += fm; spw += pw; stm += tm
+                    duty += c.duty_cycle_pct
             stats = ClassStats(len(qcoords), frozenset(qcoords),
                                (mbw, mck, mco, mfm, mpw, mtm),
-                               (sbw, sck, sco, sfm, spw, stm))
+                               (sbw, sck, sco, sfm, spw, stm), duty)
         with self._lock:
             slot = self._class_cache.setdefault(name, {})
             slot[key] = stats

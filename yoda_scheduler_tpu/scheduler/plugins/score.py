@@ -62,7 +62,7 @@ class TelemetryScore(ScorePlugin):
         if st.count == 0:
             return 0.0
         sbw, sck, sco, sfm, spw, stm = st.sums
-        return (
+        total = (
             100.0 * sbw / mv.bandwidth * w.bandwidth
             + 100.0 * sck / mv.clock * w.clock
             + 100.0 * sco / mv.core * w.core
@@ -70,6 +70,16 @@ class TelemetryScore(ScorePlugin):
             + 100.0 * sfm / mv.free_memory * w.free_memory
             + 100.0 * stm / mv.total_memory * w.total_memory
         )
+        if w.duty_cycle:
+            # utilisation-aware term (TPU-only, default off): prefer chips
+            # whose MXUs are measured IDLE — live duty cycle sees noisy
+            # neighbours the clock-as-performance proxy cannot. AVERAGE per
+            # qualifying chip, deliberately not count-scaled: on a fleet
+            # whose publisher reports no duty at all (everything 0) the
+            # term is a constant offset that min-max normalisation washes
+            # out, instead of a hidden chip-count amplifier.
+            total += (100.0 - st.duty_sum / st.count) * w.duty_cycle
+        return total
 
     def allocate_score(self, node: NodeInfo) -> float:
         """Label-claimed headroom, clamped at 0 when oversubscribed

@@ -176,6 +176,17 @@ class FakePublisher:
     def unfreeze(self, node: str) -> None:
         self._frozen.discard(node)
 
+    def set_duty(self, node: str, pct: float) -> None:
+        """Report a measured MXU duty cycle on every chip of a node (a
+        noisy-neighbour / busy-chip scenario for utilisation-aware scoring)."""
+        m = self.store.get(node)
+        if m is None:
+            raise KeyError(node)
+        m = copy.deepcopy(m)
+        for c in m.chips:
+            c.duty_cycle_pct = pct
+        self.publish(m)
+
     def fail_chip(self, node: str, chip_index: int, health: str = "Unhealthy") -> None:
         m = self.store.get(node)
         if m is None:
