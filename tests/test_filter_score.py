@@ -319,3 +319,35 @@ class TestDutyCycleScoring:
         assert sched.run_one() == "bound"
         t = sched.traces.recent(1)[0]
         assert t.scores["idle"] == t.scores["busy"]
+
+    def test_unmeasured_nodes_are_not_preferred(self):
+        """Penalty semantics: a node REPORTING zero duty (unmeasured, e.g.
+        a GPU node or the zero-reporting sniffer) must tie with a measured
+        -idle node, not outrank a slightly-busy measured one by a constant
+        bonus — only measured busyness moves a ranking."""
+        import time as _t
+
+        from yoda_scheduler_tpu.scheduler import (
+            FakeCluster, Scheduler, SchedulerConfig)
+        from yoda_scheduler_tpu.scheduler.core import FakeClock
+        from yoda_scheduler_tpu.telemetry import FakePublisher, TelemetryStore
+
+        store = TelemetryStore()
+        pub = FakePublisher(store)
+        pub.publish(make_tpu_node("unmeasured", chips=4),
+                    make_tpu_node("measured-idle", chips=4))
+        pub.set_duty("measured-idle", 0.0)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        clock = FakeClock(start=_t.time())
+        for m in store.list():
+            m.heartbeat = clock.time()
+            store.put(m)
+        sched = Scheduler(cluster, SchedulerConfig(
+            telemetry_max_age_s=1e9, topology_weight=0,
+            weights=ScoreWeights(duty_cycle=5)), clock=clock)
+        p = Pod("p", labels={"scv/number": "2", "tpu/accelerator": "tpu"})
+        sched.submit(p)
+        assert sched.run_one() == "bound"
+        t = sched.traces.recent(1)[0]
+        assert t.scores["unmeasured"] == t.scores["measured-idle"]

@@ -29,13 +29,13 @@ class ScoreWeights:
     total_memory: int = 1
     actual: int = 2
     allocate: int = 3
-    # TPU-only, default OFF (reference parity): prefer nodes whose
-    # qualifying chips report LOW measured MXU duty cycle — live
-    # utilisation the reference's clock-as-performance proxy cannot see
-    # (telemetry/schema.py Chip.duty_cycle_pct). NOTE: the first-party
-    # sniffer cannot measure duty through JAX's public API and reports 0;
-    # this weight only means something with a telemetry publisher that
-    # fills the field (e.g. from libtpu profiler counters).
+    # Default OFF (reference parity): PENALISE nodes whose qualifying
+    # chips report a high measured MXU duty cycle — live utilisation the
+    # reference's clock-as-performance proxy cannot see (telemetry/
+    # schema.py Chip.duty_cycle_pct). Nodes reporting no duty (GPU nodes;
+    # the first-party sniffer, which cannot measure duty through JAX's
+    # public API) contribute zero — no data means no penalty, never a
+    # bonus, so mixed fleets aren't steered toward unmeasured capacity.
     duty_cycle: int = 0
 
 

@@ -71,14 +71,15 @@ class TelemetryScore(ScorePlugin):
             + 100.0 * stm / mv.total_memory * w.total_memory
         )
         if w.duty_cycle:
-            # utilisation-aware term (TPU-only, default off): prefer chips
-            # whose MXUs are measured IDLE — live duty cycle sees noisy
-            # neighbours the clock-as-performance proxy cannot. AVERAGE per
-            # qualifying chip, deliberately not count-scaled: on a fleet
-            # whose publisher reports no duty at all (everything 0) the
-            # term is a constant offset that min-max normalisation washes
-            # out, instead of a hidden chip-count amplifier.
-            total += (100.0 - st.duty_sum / st.count) * w.duty_cycle
+            # utilisation-aware term (default off): sink nodes whose chips
+            # are MEASURED busy — live MXU duty cycle sees noisy neighbours
+            # the clock-as-performance proxy cannot. A PENALTY (average per
+            # qualifying chip), never a bonus: a node whose publisher
+            # reports no duty at all contributes exactly 0, so unmeasured
+            # fleets (GPU nodes, the zero-reporting first-party sniffer)
+            # neither gain nor lose against measured ones — only measured
+            # busyness moves a ranking.
+            total -= (st.duty_sum / st.count) * w.duty_cycle
         return total
 
     def allocate_score(self, node: NodeInfo) -> float:
