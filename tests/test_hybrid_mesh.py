@@ -1,0 +1,76 @@
+"""Hybrid ICI x DCN meshes (parallel/mesh.py make_hybrid_mesh): the
+multi-host tier split — communication-heavy axes inside a slice (ICI),
+pp/dp across slices (DCN) — exercised on the virtual 8-device CPU mesh
+(all devices are one process there, so the DCN tier is simulated by
+checking the grouping/validation contract; a real multi-host run groups
+by Device.process_index)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from yoda_scheduler_tpu.parallel import make_hybrid_mesh
+
+
+def test_single_process_all_ici():
+    mesh = make_hybrid_mesh({"fsdp": 2, "sp": 2, "tp": 2})
+    assert dict(mesh.shape)["tp"] == 2
+    assert math.prod(mesh.shape.values()) == 8
+    # shardings over the mesh actually distribute data
+    x = jax.device_put(
+        jnp.arange(64.0).reshape(8, 8),
+        NamedSharding(mesh, P(("fsdp", "sp"), "tp")))
+    assert len(x.addressable_shards) == 8
+
+
+def test_dcn_axes_require_granules():
+    # one process/slice (CPU tests) -> any dcn axis > 1 must be rejected
+    # loudly (granule count != prod(dcn_shape))
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"tp": 4}, {"pp": 2})
+
+
+def test_unknown_axis_rejected_at_the_boundary():
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        make_hybrid_mesh({"tp": 2, "seq": 2})  # typo for 'sp'
+
+
+def test_overlapping_axes_rejected():
+    with pytest.raises(ValueError, match="both tiers"):
+        make_hybrid_mesh({"tp": 2}, {"tp": 2})
+
+
+class _FakeDev:
+    """Stand-in device carrying the attributes mesh_utils consults:
+    slice_index (the DCN granule), device_kind, coords. Grouping-contract
+    tests only — no jit runs over these."""
+
+    def __init__(self, sid, i):
+        self.slice_index = sid
+        self.process_index = sid
+        self.id = sid * 100 + i
+        self.device_kind = "fake-tpu"
+        self.coords = (i, 0, 0)
+        self.core_on_chip = 0
+        self.platform = "tpu"
+
+    def __repr__(self):
+        return f"dev({self.slice_index},{self.id})"
+
+
+def test_multislice_grouping_contract():
+    # 4 fake slices x 4 devices: pp=2 x dp=2 over DCN, tp=4 inside
+    devs = [_FakeDev(s, i) for s in range(4) for i in range(4)]
+    mesh = make_hybrid_mesh({"tp": 4}, {"pp": 2, "dp": 2}, devices=devs)
+    grid = mesh.devices
+    shape = dict(mesh.shape)
+    assert shape["pp"] == 2 and shape["dp"] == 2 and shape["tp"] == 4
+    # every tp row must live entirely on ONE slice (ICI), and distinct
+    # (pp, dp) coordinates on distinct slices (DCN)
+    rows = grid.reshape(4, 4)
+    sids = [{d.slice_index for d in row} for row in rows]
+    assert all(len(s) == 1 for s in sids)
+    assert len({next(iter(s)) for s in sids}) == 4

@@ -1,4 +1,4 @@
-from .mesh import make_mesh, mesh_shape_for
+from .mesh import make_hybrid_mesh, make_mesh, mesh_shape_for
 from .sharding import llama_param_specs, llama_shardings, batch_spec
 from .ring import ring_attention, make_ring_attn
 from .ulysses import ulysses_attention, make_ulysses_attn
@@ -12,6 +12,7 @@ from .pipeline import (
 )
 
 __all__ = [
+    "make_hybrid_mesh",
     "make_mesh",
     "mesh_shape_for",
     "llama_param_specs",

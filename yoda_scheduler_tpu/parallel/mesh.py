@@ -60,3 +60,42 @@ def make_mesh(shape: dict[str, int] | None = None, devices=None, **axes) -> Mesh
         raise ValueError(f"mesh {shape} wants {want} devices, have {len(devices)}")
     grid = np.asarray(devices[:want]).reshape(sizes)
     return Mesh(grid, AXIS_ORDER)
+
+
+def make_hybrid_mesh(ici_shape: dict[str, int],
+                     dcn_shape: dict[str, int] | None = None,
+                     devices=None) -> Mesh:
+    """Multi-host/multi-slice mesh split across the two interconnect
+    tiers: `ici_shape` axes stay INSIDE one slice (all-reduce-heavy:
+    tp/sp/fsdp ride ICI torus links), `dcn_shape` axes cross slices
+    (pp/dp tolerate the slower data-centre network) — the scaling-book
+    recipe for multi-pod training, and the workload-side mirror of the
+    scheduler's multi-slice gang placement, which hands one contiguous
+    ICI block per slice and leaves only the dcn axes' traffic to cross
+    the cut.
+
+    Delegates the device grid to jax's mesh_utils.create_hybrid_device_
+    mesh: granule = a SLICE (`Device.slice_index`; falls back to
+    process-as-granule where the platform doesn't set it, so multi-host
+    v4/v5p slices keep hosting ICI axes larger than one host), exact
+    per-granule device counts enforced, and topology-aware device
+    ordering inside each granule. Every granule must hold exactly
+    prod(ici_shape) devices and the granule count must equal
+    prod(dcn_shape)."""
+    dcn_shape = dcn_shape or {}
+    overlap = set(ici_shape) & set(dcn_shape)
+    if overlap:
+        raise ValueError(f"axes {sorted(overlap)} listed in both tiers")
+    unknown = (set(ici_shape) | set(dcn_shape)) - set(AXIS_ORDER)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
+    devices = list(devices if devices is not None else jax.devices())
+    from jax.experimental import mesh_utils
+
+    ici_sizes = [ici_shape.get(a, 1) for a in AXIS_ORDER]
+    dcn_sizes = [dcn_shape.get(a, 1) for a in AXIS_ORDER]
+    grid = mesh_utils.create_hybrid_device_mesh(
+        ici_sizes, dcn_sizes, devices=devices,
+        process_is_granule=not hasattr(devices[0], "slice_index"))
+    return Mesh(grid, AXIS_ORDER)
