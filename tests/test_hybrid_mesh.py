@@ -74,3 +74,10 @@ def test_multislice_grouping_contract():
     sids = [{d.slice_index for d in row} for row in rows]
     assert all(len(s) == 1 for s in sids)
     assert len({next(iter(s)) for s in sids}) == 4
+
+
+def test_make_mesh_also_rejects_unknown_axes():
+    from yoda_scheduler_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        make_mesh({"tp": 2, "seq": 2})

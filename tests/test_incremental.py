@@ -126,6 +126,7 @@ class TestUnschedulableClassMemo:
         assert t2.outcome == "unschedulable"
         assert t2.reason == t1.reason
         assert not t2.filter_verdicts  # memo fast path: no per-node work
+        assert sched.metrics.counters.get("unsched_memo_hits_total") == 1
 
     def test_any_cluster_event_invalidates(self):
         cluster, store, sched = mk_sched(chips=2, nodes=("n1",),

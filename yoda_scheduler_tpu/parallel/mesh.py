@@ -46,6 +46,13 @@ def mesh_shape_for(n_devices: int, tp: int = 1, sp: int = 1, fsdp: int | None = 
     return {"pp": pp, "dp": dp, "fsdp": fsdp, "ep": ep, "sp": sp, "tp": tp}
 
 
+def _check_axes(shape: dict[str, int]) -> None:
+    unknown = set(shape) - set(AXIS_ORDER)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
+
+
 def make_mesh(shape: dict[str, int] | None = None, devices=None, **axes) -> Mesh:
     """Build a Mesh. `shape` maps axis name -> size in AXIS_ORDER; axes not
     named get size 1 (kept in the mesh so PartitionSpecs always resolve)."""
@@ -54,6 +61,7 @@ def make_mesh(shape: dict[str, int] | None = None, devices=None, **axes) -> Mesh
     devices = devices if devices is not None else jax.devices()
     if shape is None:
         shape = mesh_shape_for(len(devices))
+    _check_axes(shape)
     sizes = [shape.get(a, 1) for a in AXIS_ORDER]
     want = math.prod(sizes)
     if want > len(devices):
@@ -86,10 +94,7 @@ def make_hybrid_mesh(ici_shape: dict[str, int],
     overlap = set(ici_shape) & set(dcn_shape)
     if overlap:
         raise ValueError(f"axes {sorted(overlap)} listed in both tiers")
-    unknown = (set(ici_shape) | set(dcn_shape)) - set(AXIS_ORDER)
-    if unknown:
-        raise ValueError(
-            f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
+    _check_axes({**ici_shape, **dcn_shape})
     devices = list(devices if devices is not None else jax.devices())
     from jax.experimental import mesh_utils
 

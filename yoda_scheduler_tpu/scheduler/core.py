@@ -401,6 +401,7 @@ class Scheduler:
         if memo_ok and vers is not None:
             hit = self._unsched_memo.get(spec)
             if hit is not None and hit[0] == vers:
+                self.metrics.inc("unsched_memo_hits_total")
                 return self._unschedulable(info, trace, hit[1])
 
         snapshot = self.snapshot()
