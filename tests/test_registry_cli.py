@@ -150,3 +150,83 @@ def test_config_defaults_single_source_of_truth():
     cfg = SchedulerConfig.from_profile({"pluginConfig": [{"name": "yoda-tpu", "args": {}}]})
     assert cfg.topology_weight == SchedulerConfig().topology_weight
     assert cfg.telemetry_max_age_s == SchedulerConfig().telemetry_max_age_s
+
+
+class TestValidate:
+    def _run(self, tmp_path, content):
+        from yoda_scheduler_tpu.cli import main
+
+        p = tmp_path / "m.yaml"
+        p.write_text(content)
+        return main(["validate", str(p)])
+
+    def test_good_manifests_pass(self, capsys):
+        from yoda_scheduler_tpu.cli import main
+
+        rc = main(["validate", "example/test-pod.yaml",
+                   "example/llama-v4-32-gang.yaml",
+                   "example/mixtral-v5e-64.yaml",
+                   "example/llama-multislice-gang.yaml"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "OK" in out
+
+    def test_malformed_label_reported(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: bad
+  labels: {scv/number: "-3"}
+spec: {schedulerName: yoda-scheduler}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1 and "scv/number" in out
+
+    def test_unknown_label_flagged_as_typo(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: typo
+  labels: {tpu/topologyy: 2x2}
+spec: {schedulerName: yoda-scheduler}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1 and "tpu/topologyy" in out and "typo" in out
+
+    def test_gang_member_count_mismatch(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: w0
+  labels: {tpu/gang-name: g, tpu/gang-size: "4", scv/number: "4"}
+spec: {schedulerName: yoda-scheduler}
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: w1
+  labels: {tpu/gang-name: g, tpu/gang-size: "4", scv/number: "4"}
+spec: {schedulerName: yoda-scheduler}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1 and "2 member pods" in out and "park at Permit" in out
+
+    def test_null_labels_and_non_mapping_docs_reported_not_crashed(
+            self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: nolabels
+  labels:
+spec: {schedulerName: yoda-scheduler}
+---
+- not
+- a
+- k8s-object
+""")
+        out = capsys.readouterr().out
+        assert rc == 1 and "not a mapping" in out
+        assert "Traceback" not in out

@@ -159,6 +159,79 @@ def cmd_sniff(args) -> int:
                          interval_s=args.interval, once=args.once)
 
 
+def cmd_validate(args) -> int:
+    """Lint workload manifests against the label contract before they hit
+    the cluster: malformed scv/tpu labels (strict parse), unknown labels in
+    the scv/ and tpu/ namespaces (typos silently change scheduling), and
+    gang-size consistency across a file's members."""
+    import yaml
+
+    from .utils.labels import KNOWN_LABELS, LabelError, WorkloadSpec
+
+    problems: list[str] = []
+    gang_sizes: dict[str, set[int]] = {}
+    gang_members: dict[str, int] = {}
+
+    def check(name: str, labels: dict, where: str, count: int = 1) -> None:
+        """Validate one workload's labels; `count` = how many member pods
+        this manifest contributes (a Deployment's replicas)."""
+        try:
+            spec = WorkloadSpec.from_labels(labels)
+        except LabelError as e:
+            problems.append(f"{where}: {name}: {e}")
+            return
+        for k in labels:
+            ns = k.split("/", 1)[0]
+            if ns in ("scv", "tpu") and k not in KNOWN_LABELS:
+                problems.append(
+                    f"{where}: {name}: unknown label {k!r} (typo? known: "
+                    f"{sorted(KNOWN_LABELS)})")
+        if spec.is_gang:
+            gang_sizes.setdefault(spec.gang_name, set()).add(spec.gang_size)
+            gang_members[spec.gang_name] = (
+                gang_members.get(spec.gang_name, 0) + count)
+
+    for path in args.manifests:
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if not doc:
+                    continue
+                if not isinstance(doc, dict):
+                    problems.append(
+                        f"{path}: document is not a mapping "
+                        f"({type(doc).__name__}) — not a k8s object")
+                    continue
+                kind = doc.get("kind")
+                meta = doc.get("metadata") or {}
+                if kind == "Pod":
+                    check(meta.get("name", "pod"),
+                          dict(meta.get("labels") or {}), path)
+                elif kind == "Deployment":
+                    tmpl = (doc.get("spec") or {}).get("template") or {}
+                    labels = dict((tmpl.get("metadata") or {}).get("labels")
+                                  or {})
+                    replicas = (doc.get("spec") or {}).get("replicas", 1)
+                    check(meta.get("name", "deploy"), labels, path,
+                          count=replicas)
+    for gang, sizes in gang_sizes.items():
+        if len(sizes) > 1:
+            problems.append(
+                f"gang {gang!r}: members disagree on tpu/gang-size {sorted(sizes)}")
+        else:
+            size = next(iter(sizes))
+            n = gang_members.get(gang, 0)
+            if n != size:
+                problems.append(
+                    f"gang {gang!r}: {n} member pods in these manifests but "
+                    f"tpu/gang-size={size} (the gang would park at Permit "
+                    f"until timeout)")
+    for p in problems:
+        print(f"ERROR: {p}")
+    if not problems:
+        print("OK: all manifests satisfy the label contract")
+    return 1 if problems else 0
+
+
 def cmd_serve(args) -> int:
     profiles = load_profiles(args.config)
     from .k8s.client import KubeClient, run_scheduler_against_cluster
@@ -205,6 +278,11 @@ def main(argv=None) -> int:
     sn.add_argument("--kubeconfig", default=None)
     sn.add_argument("--apiserver", default=None)
     sn.set_defaults(fn=cmd_sniff)
+
+    val = sub.add_parser(
+        "validate", help="lint manifests against the scv/tpu label contract")
+    val.add_argument("manifests", nargs="+", help="Pod/Deployment YAML files")
+    val.set_defaults(fn=cmd_validate)
 
     srv = sub.add_parser("serve", help="run against a real API server")
     srv.add_argument("--config", default=None)

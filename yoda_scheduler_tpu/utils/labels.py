@@ -154,6 +154,13 @@ _SPEC_LABELS = (
     GANG_NAME_LABEL, GANG_SIZE_LABEL,
 )
 
+# the complete public label surface (spec inputs + the bind-time chip
+# assignment the scheduler itself publishes) — `cli validate` flags any
+# other scv/* or tpu/* label as a probable typo
+from .pod import ASSIGNED_CHIPS_LABEL as _ASSIGNED  # no cycle: pod imports only .memo
+
+KNOWN_LABELS = frozenset(_SPEC_LABELS) | {_ASSIGNED}
+
 
 def workload_class(pod) -> str:
     """Coarse pod classification for per-class latency metrics (the bench
