@@ -230,3 +230,15 @@ spec: {schedulerName: yoda-scheduler}
         out = capsys.readouterr().out
         assert rc == 1 and "not a mapping" in out
         assert "Traceback" not in out
+
+    def test_topology_rank_vs_generation(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: impossible
+  labels: {tpu/topology: 2x2x2, tpu/generation: v5e, scv/number: "8"}
+spec: {schedulerName: yoda-scheduler}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1 and "2-D tori" in out

@@ -186,6 +186,17 @@ def cmd_validate(args) -> int:
                 problems.append(
                     f"{where}: {name}: unknown label {k!r} (typo? known: "
                     f"{sorted(KNOWN_LABELS)})")
+        if spec.topology is not None and spec.tpu_generation is not None:
+            from .topology.generations import generation
+            from .topology.torus import parse_topology
+
+            shape = parse_topology(spec.topology)
+            gen = generation(spec.tpu_generation)
+            if gen.torus_rank == 2 and shape[2] > 1:
+                problems.append(
+                    f"{where}: {name}: tpu/topology {spec.topology} is 3-D "
+                    f"but {gen.name} slices are 2-D tori — this pod can "
+                    f"never place")
         if spec.is_gang:
             gang_sizes.setdefault(spec.gang_name, set()).add(spec.gang_size)
             gang_members[spec.gang_name] = (
