@@ -92,8 +92,14 @@ class FakeApiState:
             self.faults.append([path_substring, status, times, method])
 
     # ------------------------------------------------------------- helpers
-    def add_node(self, name: str) -> None:
-        self.upsert("nodes", {"metadata": {"name": name}})
+    def add_node(self, name: str, labels: dict | None = None,
+                 taints: list | None = None) -> None:
+        obj: dict = {"metadata": {"name": name}}
+        if labels:
+            obj["metadata"]["labels"] = dict(labels)
+        if taints:
+            obj["spec"] = {"taints": list(taints)}
+        self.upsert("nodes", obj)
 
     def add_pod(self, manifest: dict) -> dict:
         manifest.setdefault("metadata", {}).setdefault("namespace", "default")

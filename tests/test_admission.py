@@ -1,0 +1,317 @@
+"""Node admission (nodeSelector + taints/tolerations) tests.
+
+The reference delegated these checks to the kube-scheduler it embedded
+(upstream NodeAffinity/TaintToleration run beside the yoda plugin —
+reference pkg/register/register.go:10-12); the standalone engine provides
+them via plugins/admission.py. Unit layer: toleration matching semantics.
+Integration layer: end-to-end routing through Scheduler + FakeCluster and
+through the watch cache over live HTTP (node objects carry the meta).
+"""
+
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.framework import Code, CycleState, NodeInfo
+from yoda_scheduler_tpu.scheduler.plugins import NodeAdmission
+from yoda_scheduler_tpu.scheduler.plugins.admission import tolerates, untolerated
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def mk_pod(name="p", selector=None, tolerations=(), labels=None):
+    return Pod(name, labels=dict(labels or {"scv/number": "1"}),
+               node_selector=dict(selector or {}),
+               tolerations=tuple(tolerations))
+
+
+def ni(name="n", labels=None, taints=(), metrics=None):
+    return NodeInfo(name=name, metrics=metrics, labels=dict(labels or {}),
+                    taints=tuple(taints))
+
+
+TAINT_NS = {"key": "dedicated", "value": "ml", "effect": "NoSchedule"}
+TAINT_NE = {"key": "out", "value": "", "effect": "NoExecute"}
+TAINT_PREFER = {"key": "aging", "value": "", "effect": "PreferNoSchedule"}
+
+
+class TestTolerationMatching:
+    def test_equal_match(self):
+        assert tolerates({"key": "dedicated", "operator": "Equal",
+                          "value": "ml", "effect": "NoSchedule"}, TAINT_NS)
+
+    def test_equal_value_mismatch(self):
+        assert not tolerates({"key": "dedicated", "operator": "Equal",
+                              "value": "web", "effect": "NoSchedule"}, TAINT_NS)
+
+    def test_exists_ignores_value(self):
+        assert tolerates({"key": "dedicated", "operator": "Exists",
+                          "effect": "NoSchedule"}, TAINT_NS)
+
+    def test_empty_effect_matches_all_effects(self):
+        assert tolerates({"key": "dedicated", "operator": "Equal",
+                          "value": "ml", "effect": ""}, TAINT_NS)
+
+    def test_effect_mismatch(self):
+        assert not tolerates({"key": "dedicated", "operator": "Equal",
+                              "value": "ml", "effect": "NoExecute"}, TAINT_NS)
+
+    def test_tolerate_everything(self):
+        # empty key + Exists is the universal toleration
+        assert tolerates({"key": "", "operator": "Exists", "effect": ""},
+                         TAINT_NS)
+        assert tolerates({"key": "", "operator": "Exists", "effect": ""},
+                         TAINT_NE)
+
+    def test_empty_key_equal_is_invalid_no_match(self):
+        assert not tolerates({"key": "", "operator": "Equal", "value": ""},
+                             TAINT_NS)
+
+    def test_untolerated_filters_by_effect(self):
+        pod = mk_pod(tolerations=[{"key": "dedicated", "operator": "Exists",
+                                   "effect": "", "value": ""}])
+        bad = untolerated(pod, (TAINT_NS, TAINT_NE, TAINT_PREFER),
+                          ("NoSchedule", "NoExecute"))
+        assert bad == [TAINT_NE]
+
+
+class TestAdmissionPlugin:
+    def test_selector_subset_required(self):
+        p = NodeAdmission()
+        pod = mk_pod(selector={"pool": "tpu", "zone": "a"})
+        ok = ni(labels={"pool": "tpu", "zone": "a", "extra": "x"})
+        miss = ni(labels={"pool": "tpu"})
+        wrong = ni(labels={"pool": "tpu", "zone": "b"})
+        assert p.filter(CycleState(), pod, ok).ok
+        assert p.filter(CycleState(), pod, miss).code == Code.UNSCHEDULABLE
+        assert p.filter(CycleState(), pod, wrong).code == Code.UNSCHEDULABLE
+
+    def test_no_selector_no_taints_passes(self):
+        assert NodeAdmission().filter(CycleState(), mk_pod(), ni()).ok
+
+    def test_noschedule_taint_blocks_without_toleration(self):
+        p = NodeAdmission()
+        st = p.filter(CycleState(), mk_pod(), ni(taints=[TAINT_NS]))
+        assert st.code == Code.UNSCHEDULABLE and "dedicated" in st.message
+
+    def test_toleration_admits(self):
+        p = NodeAdmission()
+        pod = mk_pod(tolerations=[{"key": "dedicated", "operator": "Equal",
+                                   "value": "ml", "effect": "NoSchedule"}])
+        assert p.filter(CycleState(), pod, ni(taints=[TAINT_NS])).ok
+
+    def test_prefer_noschedule_never_blocks_but_scores_lower(self):
+        p = NodeAdmission()
+        pod = mk_pod()
+        tainted = ni(taints=[TAINT_PREFER])
+        assert p.filter(CycleState(), pod, tainted).ok
+        s_tainted, _ = p.score(CycleState(), pod, tainted)
+        s_clean, _ = p.score(CycleState(), pod, ni())
+        assert s_tainted < s_clean
+
+
+def _cluster(names):
+    store = TelemetryStore()
+    now = time.time()
+    for n in names:
+        m = make_tpu_node(n, chips=4)
+        m.heartbeat = now + 1e8
+        store.put(m)
+    c = FakeCluster(store)
+    c.add_nodes_from_telemetry()
+    return c
+
+
+class TestSchedulerIntegration:
+    def test_selector_routes_to_labeled_node(self):
+        c = _cluster(["a", "b", "c"])
+        c.set_node_meta("b", labels={"pool": "gold"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = mk_pod("want-gold", selector={"pool": "gold"})
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "b"
+
+    def test_taint_excludes_node(self):
+        c = _cluster(["a", "b"])
+        c.set_node_meta("a", taints=[TAINT_NS])
+        c.set_node_meta("b", taints=[TAINT_NS])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        blocked = mk_pod("no-tol")
+        tolerant = mk_pod("tol", tolerations=[
+            {"key": "dedicated", "operator": "Exists", "effect": "",
+             "value": ""}])
+        sched.submit(blocked)
+        sched.submit(tolerant)
+        sched.run_until_idle()
+        assert blocked.phase == PodPhase.FAILED
+        assert tolerant.phase == PodPhase.BOUND
+
+    def test_meta_change_invalidates_cached_verdicts(self):
+        # a node labeled AFTER a pod went unschedulable must be re-offered:
+        # set_node_meta bumps the node's change counter, so cached NodeInfos
+        # and the unschedulable-class memo can't serve the stale verdict
+        c = _cluster(["a"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=0))
+        pod = mk_pod("waits", selector={"pool": "gold"})
+        sched.submit(pod)
+        for _ in range(3):
+            sched.run_one()
+        assert pod.phase == PodPhase.PENDING
+        c.set_node_meta("a", labels={"pool": "gold"})
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "a"
+
+    def test_prefer_noschedule_is_last_resort(self):
+        c = _cluster(["t1", "clean"])
+        c.set_node_meta("t1", taints=[TAINT_PREFER])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = mk_pod("picky")
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "clean"
+
+
+class TestPreemptionRespectsAdmission:
+    def test_no_evictions_on_inadmissible_nodes(self):
+        """A high-priority pod that cannot pass admission anywhere (all
+        nodes tainted, no toleration) must NOT trigger preemption: evicting
+        victims on a node the preemptor can never land on would disrupt
+        workloads every cycle while the pod stays Pending."""
+        c = _cluster(["a", "b"])
+        for n in ("a", "b"):
+            c.set_node_meta(n, taints=[TAINT_NS])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        # fill both nodes with low-priority TOLERATING pods
+        fillers = []
+        for n in ("a", "b"):
+            f = mk_pod(f"fill-{n}", labels={"scv/number": "4"},
+                       tolerations=[{"key": "dedicated", "operator": "Exists",
+                                     "effect": "", "value": ""}])
+            fillers.append(f)
+            sched.submit(f)
+        sched.run_until_idle()
+        assert all(f.phase == PodPhase.BOUND for f in fillers)
+        # high-priority pod without a toleration: unschedulable, NO victims
+        hp = mk_pod("hp", labels={"scv/number": "1", "scv/priority": "9"})
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.FAILED
+        assert all(f.phase == PodPhase.BOUND for f in fillers), \
+            "preemption must not evict for an inadmissible preemptor"
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 0
+
+    def test_preemption_targets_only_admissible_nodes(self):
+        """With one selectable node and one not, preemption plans victims
+        only on the node matching the preemptor's nodeSelector."""
+        from yoda_scheduler_tpu.scheduler.core import HybridClock
+
+        c = _cluster(["sel", "other"])
+        c.set_node_meta("sel", labels={"pool": "gold"})
+        # the evicted victim can never re-fit (both nodes full): bound
+        # attempts + virtual backoff clock keep run_until_idle finite
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3),
+                          clock=HybridClock())
+        f_sel = mk_pod("f-sel", labels={"scv/number": "4"})
+        f_other = mk_pod("f-other", labels={"scv/number": "4"})
+        sched.submit(f_sel)
+        sched.submit(f_other)
+        sched.run_until_idle()
+        by_node = {f_sel.node: f_sel, f_other.node: f_other}
+        hp = mk_pod("hp", labels={"scv/number": "1", "scv/priority": "9"},
+                    selector={"pool": "gold"})
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND and hp.node == "sel"
+        assert by_node["other"].phase == PodPhase.BOUND, \
+            "victim must come from the admissible node only"
+
+
+class TestLiveTransport:
+    def test_meta_flows_through_watch_cache_and_gates_binds(self):
+        """Node labels/taints travel API server -> watch cache -> NodeInfo:
+        a nodeSelector pod lands on the labeled node and an untolerated
+        NoSchedule taint keeps the other node off-limits, over real HTTP."""
+        import threading
+
+        from fake_apiserver import FakeApiServer
+        from yoda_scheduler_tpu.k8s.client import (
+            KubeClient, run_scheduler_against_cluster)
+
+        def wait_for(cond, timeout=10.0, step=0.02):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if cond():
+                    return True
+                time.sleep(step)
+            return False
+
+        with FakeApiServer() as server:
+            server.state.add_node("gold", labels={"pool": "gold"})
+            server.state.add_node(
+                "fenced", taints=[{"key": "dedicated", "value": "ml",
+                                   "effect": "NoSchedule"}])
+            for n in ("gold", "fenced"):
+                server.state.put_metrics(make_tpu_node(n, chips=4).to_cr())
+            server.state.add_pod({
+                "metadata": {"name": "sel", "namespace": "default",
+                             "labels": {"scv/number": "1"},
+                             "ownerReferences": [{"kind": "ReplicaSet",
+                                                  "name": "rs",
+                                                  "controller": True}]},
+                "spec": {"schedulerName": "yoda-scheduler",
+                         "nodeSelector": {"pool": "gold"}},
+                "status": {"phase": "Pending"},
+            })
+            # no toleration: of the two nodes only "gold" is admissible
+            server.state.add_pod({
+                "metadata": {"name": "plain", "namespace": "default",
+                             "labels": {"scv/number": "1"},
+                             "ownerReferences": [{"kind": "ReplicaSet",
+                                                  "name": "rs",
+                                                  "controller": True}]},
+                "spec": {"schedulerName": "yoda-scheduler"},
+                "status": {"phase": "Pending"},
+            })
+            client = KubeClient(server.url)
+            stop = threading.Event()
+            t = threading.Thread(
+                target=run_scheduler_against_cluster,
+                args=(client, [(SchedulerConfig(), None)]),
+                kwargs={"metrics_port": None, "poll_s": 0.05,
+                        "stop_event": stop},
+                daemon=True)
+            t.start()
+            try:
+                assert wait_for(lambda: all(
+                    (server.state.pod(n) or {}).get("spec", {}).get("nodeName")
+                    for n in ("sel", "plain"))), "pods never bound"
+                assert server.state.pod("sel")["spec"]["nodeName"] == "gold"
+                assert server.state.pod("plain")["spec"]["nodeName"] == "gold"
+            finally:
+                stop.set()
+                t.join(timeout=5.0)
+
+
+class TestManifestParsing:
+    def test_from_manifest_selector_and_tolerations(self):
+        pod = Pod.from_manifest({
+            "metadata": {"name": "x", "labels": {"scv/number": "1"}},
+            "spec": {
+                "schedulerName": "yoda-scheduler",
+                "nodeSelector": {"pool": "gold"},
+                "tolerations": [
+                    {"key": "dedicated", "operator": "Equal", "value": "ml",
+                     "effect": "NoSchedule"},
+                    {"operator": "Exists"},
+                ],
+            },
+        })
+        assert pod.node_selector == {"pool": "gold"}
+        assert pod.tolerations[0]["key"] == "dedicated"
+        # defaults fill in: operator Equal, empty effect matches everything
+        assert pod.tolerations[1] == {"key": "", "operator": "Exists",
+                                      "value": "", "effect": ""}

@@ -71,7 +71,7 @@ profiles:
     assert cfg.percentage_of_nodes_to_score == 25
     assert cfg.topology_weight == 9
     assert cfg.weights.free_memory == 7
-    assert enabled["filter"] == ["telemetry-filter"]
+    assert enabled["filter"] == ["node-admission", "telemetry-filter"]
 
 
 def test_cli_simulate_end_to_end(capsys):
@@ -94,6 +94,30 @@ def test_cli_simulate_end_to_end(capsys):
     assert len(gang_nodes) == 4
     slices = {n.rsplit("-host-", 1)[0] for n in gang_nodes}
     assert len(slices) == 1  # whole gang on one slice
+
+
+def test_cli_simulate_unplaceable_terminates_promptly(capsys):
+    """A manifest that can NEVER place (v5e gang, zero v5e slices) must
+    report Pending pods with exit 1 in bounded time — the virtual clock
+    turns retry backoffs into simulated time instead of wall sleeps
+    (previously this hung for max_cycles x backoff real seconds)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    rc = main(["simulate", "example/mixtral-v5e-64.yaml",
+               "--max-cycles", "500"])
+    assert rc == 1
+    assert _time.monotonic() - t0 < 30.0
+    out = json.loads(capsys.readouterr().out)
+    assert out["bound"] == 0
+
+
+def test_cli_simulate_v5e_manifest_places(capsys):
+    rc = main(["simulate", "example/mixtral-v5e-64.yaml",
+               "--v5e-slices", "2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["bound"] == 9
 
 
 def test_cli_sniff(capsys):
@@ -134,7 +158,7 @@ def test_merge_enablement_keeps_defaults():
 
     # listing only `score:` must not disable filtering/permit (k8s semantics)
     merged = merge_enablement({"score": {"enabled": [{"name": "telemetry-score"}]}})
-    assert merged["filter"] == ["telemetry-filter"]
+    assert merged["filter"] == ["node-admission", "telemetry-filter"]
     assert merged["permit"] == ["gang-permit"]
     assert "telemetry-score" in merged["score"]
     # explicit disable-all clears a point
@@ -142,7 +166,7 @@ def test_merge_enablement_keeps_defaults():
     assert merged["permit"] == []
     # targeted disable
     merged = merge_enablement({"score": {"disabled": [{"name": "topology-score"}]}})
-    assert merged["score"] == ["telemetry-score"]
+    assert merged["score"] == ["telemetry-score", "node-admission"]
 
 
 def test_config_defaults_single_source_of_truth():

@@ -370,6 +370,21 @@ def _pod_from_api(item: dict) -> Pod | None:
     return p
 
 
+def _node_meta_from_api(item: dict) -> tuple[dict, tuple]:
+    """Node object -> (metadata.labels, spec.taints) for the admission
+    plugin (plugins/admission.py). Taints normalised to plain dicts."""
+    labels = dict(item.get("metadata", {}).get("labels", {}) or {})
+    taints = tuple(
+        {
+            "key": t.get("key", ""),
+            "value": t.get("value", ""),
+            "effect": t.get("effect", ""),
+        }
+        for t in item.get("spec", {}).get("taints", []) or []
+    )
+    return labels, taints
+
+
 def _rv_of(obj: dict) -> str | None:
     return obj.get("metadata", {}).get("resourceVersion")
 
@@ -476,6 +491,7 @@ class KubeCluster:
         self.watch_mode = client.can_stream if watch is None else watch
         self._lock = threading.RLock()
         self._nodes: set[str] = set()
+        self._node_meta: dict[str, tuple[dict, tuple]] = {}  # name -> (labels, taints)
         self._pods: dict[str, Pod] = {}          # key -> non-terminal pod
         self._by_node: dict[str, dict[str, Pod]] = {}  # node -> key -> pod
         self._pods_ver: dict[str, int] = {}      # node -> change counter
@@ -521,12 +537,19 @@ class KubeCluster:
 
     def _replace_nodes(self, items: list[dict]) -> None:
         names = {i["metadata"]["name"] for i in items}
+        metas = {i["metadata"]["name"]: _node_meta_from_api(i) for i in items}
         with self._lock:
             if names != self._nodes:
                 self._nodes_ver += 1
                 for n in names ^ self._nodes:
                     self._bump(n)
+            # a label/taint edit must invalidate the node's cached NodeInfo
+            # and filter verdicts even though membership is unchanged
+            for n, meta in metas.items():
+                if self._node_meta.get(n, ({}, ())) != meta:
+                    self._bump(n)
             self._nodes = names
+            self._node_meta = metas
 
     def _node_event(self, typ: str, obj: dict) -> None:
         name = obj.get("metadata", {}).get("name")
@@ -537,12 +560,17 @@ class KubeCluster:
                 if name in self._nodes:
                     self._nodes_ver += 1
                 self._nodes.discard(name)
+                self._node_meta.pop(name, None)
                 self._bump(name)
             else:
                 if name not in self._nodes:
                     self._nodes_ver += 1
                     self._bump(name)
                 self._nodes.add(name)
+                meta = _node_meta_from_api(obj)
+                if self._node_meta.get(name, ({}, ())) != meta:
+                    self._node_meta[name] = meta
+                    self._bump(name)
 
     def _set_pod(self, key: str, p: Pod) -> None:
         """Install/replace a pod record, maintaining the node index and
@@ -630,11 +658,12 @@ class KubeCluster:
     # ------------------------------------------------------------ lifecycle
     def resync(self) -> None:
         """One full re-list of everything (poll mode / initial seed)."""
-        nodes = self.client.list_nodes()
+        node_doc = self.client.list_all("/api/v1/nodes")
         pod_doc = self.client.list_all("/api/v1/pods")
         metrics = self.client.list_metrics()
-        with self._lock:
-            self._nodes = set(nodes)
+        # same replace path as the watch reflector: names + labels/taints,
+        # with change-counter bumps on meta edits
+        self._replace_nodes(node_doc.get("items", []))
         self._replace_pods(pod_doc.get("items", []))
         self._apply_metrics(metrics)
 
@@ -690,6 +719,12 @@ class KubeCluster:
     def node_names(self) -> list[str]:
         with self._lock:
             return sorted(self._nodes)
+
+    def node_meta(self, name: str) -> tuple[dict[str, str], tuple]:
+        """Node-object (metadata.labels, spec.taints) for the admission
+        plugin; empty for unknown nodes."""
+        with self._lock:
+            return self._node_meta.get(name, ({}, ()))
 
     def pods_version(self, node: str) -> int:
         with self._lock:

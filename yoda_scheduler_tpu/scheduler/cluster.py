@@ -42,6 +42,7 @@ class FakeCluster:
         self._lock = threading.RLock()
         self._nodes: set[str] = set()
         self._bound: dict[str, list[Pod]] = {}  # node -> pods
+        self._meta: dict[str, tuple[dict, tuple]] = {}  # node -> (labels, taints)
         # monotonic per-node change counter (bind/evict/removal): lets the
         # scheduler reuse per-node snapshot state across cycles — a bind
         # invalidates one node, not the whole cluster
@@ -96,12 +97,27 @@ class FakeCluster:
             if name in self._nodes:
                 self._nodes_ver += 1
             self._nodes.discard(name)
+            self._meta.pop(name, None)
             orphans = self._bound.pop(name, [])
             self._bump(name)
         for p in orphans:
             p.node = None
             p.phase = PodPhase.PENDING
         return orphans
+
+    def set_node_meta(self, name: str, labels: dict[str, str] | None = None,
+                      taints: list[dict] | tuple = ()) -> None:
+        """Node-object metadata.labels / spec.taints (admission plugin
+        inputs). Bumps the node's change counter: a label or taint edit
+        must invalidate cached NodeInfos and filter verdicts."""
+        with self._lock:
+            self.add_node(name)
+            self._meta[name] = (dict(labels or {}), tuple(taints))
+            self._bump(name)
+
+    def node_meta(self, name: str) -> tuple[dict[str, str], tuple]:
+        with self._lock:
+            return self._meta.get(name, ({}, ()))
 
     # ---------------------------------------------------------------- reading
     def node_names(self) -> list[str]:

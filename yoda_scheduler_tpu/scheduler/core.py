@@ -52,6 +52,7 @@ from .plugins import (
     GangCoordinator,
     GangPermit,
     MaxCollection,
+    NodeAdmission,
     PriorityPreemption,
     PrioritySort,
     TelemetryFilter,
@@ -61,6 +62,9 @@ from .plugins import (
 from ..utils.labels import LabelError, spec_for, workload_class
 from ..utils.obs import CycleTrace, Metrics, TraceLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
+
+# distinguishes "caller supplied no metrics" from "telemetry is None"
+_UNSET = object()
 
 
 class Clock:
@@ -146,18 +150,23 @@ def default_profile(config: SchedulerConfig,
     gang_permit = GangPermit(gangs, timeout_s=config.gang_timeout_s,
                              allocator=allocator)
     topo = TopologyScore(allocator, weight=config.topology_weight)
+    admission = NodeAdmission()
     profile = Profile(
         queue_sort=PrioritySort(),
         # GangPermit.pre_filter computes multi-slice plans for gangs no
         # single slice can host
         pre_filter=[gang_permit],
-        filter=[TelemetryFilter(allocator, gangs, config.telemetry_max_age_s)],
+        # admission first: nodeSelector/taint rejections are cheap and spare
+        # the telemetry filter's capacity math on excluded nodes
+        filter=[admission,
+                TelemetryFilter(allocator, gangs, config.telemetry_max_age_s)],
         post_filter=[PriorityPreemption(allocator, gangs)] if config.preemption else [],
         # TopologyScore is both a PreScore (slice-usage map) and a Score plugin
         pre_score=[MaxCollection(allocator)] + ([topo] if config.topology_weight > 0 else []),
         score=[
             TelemetryScore(allocator, config.weights, weight=1),
             *([topo] if config.topology_weight > 0 else []),
+            admission,
         ],
         reserve=[allocator, gang_permit],
         permit=[gang_permit],
@@ -297,18 +306,37 @@ class Scheduler:
                     for name in dirty:
                         if name not in infos:
                             continue  # telemetry for a non-member node
-                        ni = NodeInfo(name=name,
-                                      metrics=cluster.telemetry.get(name),
-                                      pods=cluster.pods_on(name))
+                        ni = self._make_node_info(name)
                         infos[name] = ni
                         if pods_version is not None:
                             key = (getattr(ni.metrics, "generation", None),
                                    pods_version(name))
                             self._ni_cache[name] = (key, ni)
                     fresh = Snapshot(infos)
+                    # carry the any-taints fact: only dirty nodes can have
+                    # introduced a taint (a removal leaves the conservative
+                    # True, costing nothing but the skipped optimization)
+                    if snap._any_taints is not None:
+                        fresh._any_taints = snap._any_taints or any(
+                            infos[n].taints for n in dirty if n in infos)
                     self._snap = (fresh, pv, tv, nv0)
                     return fresh
         return self._full_snapshot()
+
+    def _make_node_info(self, name: str, metrics=_UNSET) -> NodeInfo:
+        """One coherent NodeInfo: telemetry + bound pods + node-object meta
+        (labels/taints for the admission plugin; backends without node
+        metadata — plain fakes — yield empty meta). Callers that already
+        fetched the node's telemetry (cache-key computation) pass it in to
+        avoid a second store lookup per node."""
+        cluster = self.cluster
+        meta_fn = getattr(cluster, "node_meta", None)
+        labels, taints = meta_fn(name) if meta_fn is not None else ({}, ())
+        if metrics is _UNSET:
+            metrics = cluster.telemetry.get(name)
+        return NodeInfo(name=name, metrics=metrics,
+                        pods=cluster.pods_on(name), labels=labels,
+                        taints=taints)
 
     def _full_snapshot(self) -> Snapshot:
         cluster = self.cluster
@@ -334,12 +362,10 @@ class Scheduler:
                 if cached is not None and cached[0] == key:
                     infos[name] = cached[1]
                     continue
-                ni = NodeInfo(name=name, metrics=metrics,
-                              pods=cluster.pods_on(name))
+                ni = self._make_node_info(name, metrics)
                 self._ni_cache[name] = (key, ni)
             else:
-                ni = NodeInfo(name=name, metrics=metrics,
-                              pods=cluster.pods_on(name))
+                ni = self._make_node_info(name, metrics)
             infos[name] = ni
         # prune per-node caches for departed nodes on EVERY backend — the
         # allocator's free-set cache fills from free_coords() regardless of
@@ -394,12 +420,23 @@ class Scheduler:
 
         # unschedulable-class fast path (see _unsched_memo). Gang pods and
         # nominated preemptors carry state outside the version vector.
+        # Admission inputs (nodeSelector/tolerations) are part of the class:
+        # two pods with identical labels but different tolerations must not
+        # share a verdict. The common no-admission case keys on the interned
+        # spec alone (a tuple never equals a WorkloadSpec, so no collision).
         memo_ok = (not spec.is_gang
                    and (self.allocator is None
                         or self.allocator.nomination_of(pod.key) is None))
+        if pod.node_selector or pod.tolerations:
+            memo_key = (spec, frozenset(pod.node_selector.items()),
+                        tuple((t.get("key", ""), t.get("operator", "Equal"),
+                               t.get("value", ""), t.get("effect", ""))
+                              for t in pod.tolerations))
+        else:
+            memo_key = spec
         vers = self._cluster_versions()
         if memo_ok and vers is not None:
-            hit = self._unsched_memo.get(spec)
+            hit = self._unsched_memo.get(memo_key)
             if hit is not None and hit[0] == vers:
                 self.metrics.inc("unsched_memo_hits_total")
                 return self._unschedulable(info, trace, hit[1])
@@ -429,13 +466,20 @@ class Scheduler:
             if ni is not None:
                 order.remove(ni)
                 order.insert(0, ni)
+        # per-cycle relevance gating: plugins exposing `relevant(pod,
+        # snapshot)` drop out of the per-node loops when they cannot affect
+        # this pod (e.g. admission on an untainted cluster) — the gate runs
+        # once per cycle, not once per node
+        filters = [p for p in self.profile.filter
+                   if getattr(p, "relevant", None) is None
+                   or p.relevant(pod, snapshot)]
         feasible: list[NodeInfo] = []
         checked = 0
         for i in order:
             node = nodes[i]
             checked += 1
             st = Status.success()
-            for p in self.profile.filter:
+            for p in filters:
                 st = p.filter(state, pod, node)
                 if not st.ok:
                     break
@@ -529,7 +573,7 @@ class Scheduler:
                 # classmates fail in O(1) until any cluster event
                 if len(self._unsched_memo) > 256:
                     self._unsched_memo.clear()
-                self._unsched_memo[spec] = (vers, reason)
+                self._unsched_memo[memo_key] = (vers, reason)
             return self._unschedulable(info, trace, reason)
 
         # PreScore
@@ -538,9 +582,13 @@ class Scheduler:
             if st.code == Code.ERROR:
                 return self._cycle_error(info, trace, st.message)
 
-        # Score + per-plugin normalize + weighted sum
+        # Score + per-plugin normalize + weighted sum (same relevance gate
+        # as the filter loop)
         totals: dict[str, float] = {n.name: 0.0 for n in feasible}
-        for p in self.profile.score:
+        scorers = [p for p in self.profile.score
+                   if getattr(p, "relevant", None) is None
+                   or p.relevant(pod, snapshot)]
+        for p in scorers:
             raw: dict[str, float] = {}
             for node in feasible:
                 s, st = p.score(state, pod, node)

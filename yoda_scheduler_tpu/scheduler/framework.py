@@ -122,6 +122,12 @@ class NodeInfo:
     name: str
     metrics: TpuNodeMetrics | None
     pods: list[Pod] = field(default_factory=list)
+    # Node-object metadata.labels and spec.taints (upstream NodeAffinity /
+    # TaintToleration contract — plugins/admission.py). The reference got
+    # these checks from the kube-scheduler it embedded; telemetry CRs don't
+    # carry them, the Node objects do.
+    labels: dict[str, str] = field(default_factory=dict)
+    taints: tuple = ()
     # process-unique identity for version-keyed caches (id() can be reused
     # after GC; the serial never is). A NodeInfo is immutable once built, so
     # serial equality == same telemetry + same bound-pod set.
@@ -177,12 +183,26 @@ class Snapshot:
 
     def __init__(self, node_infos: dict[str, NodeInfo]) -> None:
         self._node_infos = node_infos
+        # lazily-computed cluster facts used for plugin relevance gating
+        # (core.py builds the per-cycle active-plugin lists from them);
+        # incremental snapshots inherit the value from their parent when
+        # the dirty set cannot have changed it
+        self._any_taints: bool | None = None
 
     def get(self, name: str) -> NodeInfo | None:
         return self._node_infos.get(name)
 
     def list(self) -> list[NodeInfo]:
         return list(self._node_infos.values())
+
+    def any_taints(self) -> bool:
+        """True when at least one node carries a taint. On an untainted
+        cluster (the common case) the admission plugin drops out of the
+        per-(pod, node) filter/score hot loops entirely."""
+        if self._any_taints is None:
+            self._any_taints = any(
+                ni.taints for ni in self._node_infos.values())
+        return self._any_taints
 
     def __len__(self) -> int:
         return len(self._node_infos)

@@ -1,4 +1,5 @@
 from .sort import PrioritySort
+from .admission import NodeAdmission
 from .filter import TelemetryFilter
 from .prescore import MaxCollection, MAX_KEY, SPEC_KEY
 from .score import TelemetryScore
@@ -9,6 +10,7 @@ from .preempt import PriorityPreemption
 
 __all__ = [
     "PrioritySort",
+    "NodeAdmission",
     "TelemetryFilter",
     "MaxCollection",
     "TelemetryScore",

@@ -1,0 +1,113 @@
+"""Node admission: nodeSelector + taints/tolerations (upstream parity).
+
+The reference never implemented these checks itself — it registered one
+plugin INTO full kube-scheduler (reference pkg/register/register.go:10-12),
+so every pod it placed also passed upstream's NodeAffinity and
+TaintToleration plugins (enabled by default in the embedded framework).
+A standalone engine that dropped them would bind pods onto cordoned or
+dedicated nodes that the reference deployment would have refused, so this
+plugin restores the same contract:
+
+- Filter: ``spec.nodeSelector`` must be a subset of the node's labels
+  (upstream NodeAffinity's required term for plain selectors), and every
+  node taint with effect NoSchedule/NoExecute must be tolerated
+  (upstream TaintToleration filter semantics).
+- Score: nodes with untolerated PreferNoSchedule taints score lower
+  (upstream TaintToleration scoring), so tainted-but-admissible nodes are
+  a last resort rather than a coin flip.
+
+Toleration matching follows the Kubernetes spec: operator Exists matches
+any value (an empty key with Exists tolerates everything); operator Equal
+(the default) requires the values to match; an empty toleration effect
+matches every effect.
+"""
+
+from __future__ import annotations
+
+from ..framework import CycleState, FilterPlugin, NodeInfo, ScorePlugin, Status
+from ...utils.pod import Pod
+
+NO_SCHEDULE = "NoSchedule"
+NO_EXECUTE = "NoExecute"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+
+
+def tolerates(toleration: dict, taint: dict) -> bool:
+    """One toleration vs one taint, k8s semantics."""
+    effect = toleration.get("effect", "")
+    if effect and effect != taint.get("effect", ""):
+        return False
+    key = toleration.get("key", "")
+    op = toleration.get("operator", "Equal")
+    if not key:
+        # empty key + Exists tolerates all taints; empty key + Equal is
+        # invalid per the API (apiserver rejects it) — treat as no match
+        return op == "Exists"
+    if key != taint.get("key", ""):
+        return False
+    if op == "Exists":
+        return True
+    return toleration.get("value", "") == taint.get("value", "")
+
+
+def untolerated(pod: Pod, taints: tuple, effects: tuple[str, ...]) -> list[dict]:
+    """Taints with an effect in `effects` that no pod toleration covers."""
+    tols = pod.tolerations
+    return [
+        t for t in taints
+        if t.get("effect") in effects
+        and not any(tolerates(tol, t) for tol in tols)
+    ]
+
+
+def admissible(pod: Pod, node: NodeInfo) -> bool:
+    """Would NodeAdmission.filter pass this (pod, node)? Used by the
+    preemption planner: evicting victims on a node the preemptor's
+    nodeSelector/tolerations can never accept would disrupt workloads for
+    a pod that stays Pending (upstream preemption re-filters candidate
+    nodes the same way)."""
+    if pod.node_selector:
+        labels = node.labels
+        for k, v in pod.node_selector.items():
+            if labels.get(k) != v:
+                return False
+    if node.taints and untolerated(pod, node.taints,
+                                   (NO_SCHEDULE, NO_EXECUTE)):
+        return False
+    return True
+
+
+class NodeAdmission(FilterPlugin, ScorePlugin):
+    name = "node-admission"
+    weight = 1
+
+    def relevant(self, pod: Pod, snapshot) -> bool:
+        """Hot-loop gate (core.py): on an untainted cluster a pod without a
+        nodeSelector cannot be affected by this plugin, so the engine drops
+        it from the per-(pod, node) filter/score loops. Tolerations alone
+        never change a verdict — they only permit what taints would block."""
+        return bool(pod.node_selector) or snapshot.any_taints()
+
+    def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
+        sel = pod.node_selector
+        if sel:
+            labels = node.labels
+            for k, v in sel.items():
+                if labels.get(k) != v:
+                    return Status.unschedulable(
+                        f"{node.name}: nodeSelector {k}={v} not satisfied")
+        if node.taints:
+            bad = untolerated(pod, node.taints, (NO_SCHEDULE, NO_EXECUTE))
+            if bad:
+                t = bad[0]
+                return Status.unschedulable(
+                    f"{node.name}: untolerated taint "
+                    f"{t.get('key')}={t.get('value')}:{t.get('effect')}")
+        return Status.success()
+
+    def score(self, state: CycleState, pod: Pod, node: NodeInfo
+              ) -> tuple[float, Status]:
+        if not node.taints:  # hot path: almost all nodes are untainted
+            return 0.0, Status.success()
+        n = len(untolerated(pod, node.taints, (PREFER_NO_SCHEDULE,)))
+        return -100.0 * n, Status.success()

@@ -17,6 +17,7 @@ from __future__ import annotations
 from ..framework import CycleState, NodeInfo, PostFilterPlugin, Snapshot, Status
 from ...utils.labels import GANG_NAME_LABEL, LabelError, WorkloadSpec, spec_for
 from ...utils.pod import Pod
+from .admission import admissible
 from .allocator import ChipAllocator
 
 
@@ -64,6 +65,11 @@ class PriorityPreemption(PostFilterPlugin):
         # minimal disruption: fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
         for node in snapshot.list():
+            # never plan evictions on a node the preemptor itself cannot
+            # pass admission on (nodeSelector/taints) — the evictions would
+            # repeat every cycle while the pod stays Pending
+            if not admissible(pod, node):
+                continue
             plan = self._plan_eviction(spec, my_prio, node, now=now,
                                        pod_key=pod.key)
             if plan is None:
@@ -109,6 +115,10 @@ class PriorityPreemption(PostFilterPlugin):
             if now is not None and m.stale(now=now):
                 continue
             if spec.accelerator is not None and m.accelerator != spec.accelerator:
+                continue
+            # a host the gang member can't pass admission on disqualifies
+            # it from the per-slice plan the same way capacity would
+            if not admissible(pod, node):
                 continue
             if m.num_hosts < spec.gang_size:
                 continue

@@ -19,6 +19,7 @@ from .plugins import (
     GangCoordinator,
     GangPermit,
     MaxCollection,
+    NodeAdmission,
     PriorityPreemption,
     PrioritySort,
     TelemetryFilter,
@@ -44,6 +45,7 @@ def registered() -> list[str]:
 
 
 register("priority-sort", lambda cfg, alloc, gangs: PrioritySort())
+register("node-admission", lambda cfg, alloc, gangs: NodeAdmission())
 register("telemetry-filter",
          lambda cfg, alloc, gangs: TelemetryFilter(alloc, gangs, cfg.telemetry_max_age_s))
 register("max-collection", lambda cfg, alloc, gangs: MaxCollection(alloc))
@@ -64,10 +66,10 @@ register("priority-preemption", lambda cfg, alloc, gangs: PriorityPreemption(all
 # explicitly disabled
 DEFAULT_ENABLED: dict[str, list[str]] = {
     "queueSort": ["priority-sort"],
-    "filter": ["telemetry-filter"],
+    "filter": ["node-admission", "telemetry-filter"],
     "postFilter": ["priority-preemption"],
     "preScore": ["max-collection"],
-    "score": ["telemetry-score", "topology-score"],
+    "score": ["telemetry-score", "topology-score", "node-admission"],
     "permit": ["gang-permit"],
 }
 

@@ -51,6 +51,12 @@ class Pod:
     # capacity in the cache but are never scheduled or re-evicted, and a
     # preemptor's nomination hold survives while its victims drain.
     terminating: bool = False
+    # spec.nodeSelector / spec.tolerations: the reference ran inside full
+    # kube-scheduler, so its users got upstream NodeAffinity/TaintToleration
+    # admission for free alongside the yoda plugin; the standalone engine
+    # must provide the same contract (plugins/admission.py)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: tuple = ()
     created: float = field(default_factory=time.time)
 
     @property
@@ -90,4 +96,14 @@ class Pod:
                 for ref in meta.get("ownerReferences", []) or []
             ),
             terminating=bool(meta.get("deletionTimestamp")),
+            node_selector=dict(spec.get("nodeSelector", {}) or {}),
+            tolerations=tuple(
+                {
+                    "key": t.get("key", ""),
+                    "operator": t.get("operator", "Equal"),
+                    "value": t.get("value", ""),
+                    "effect": t.get("effect", ""),
+                }
+                for t in spec.get("tolerations", []) or []
+            ),
         )
