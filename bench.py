@@ -238,16 +238,27 @@ def main():
     except Exception:
         pass
     # warm both paths once (imports, dict/bytecode caches) so neither profile
-    # pays process cold-start, then take the median of 3 measured runs each —
-    # p50 latency compounds queue wait, so single runs are noisy
+    # pays process cold-start, then take the median of 5 measured runs each.
+    # Runs ALTERNATE between the two profiles: host-state drift (frequency
+    # scaling, page cache, co-tenant load) then lands on both sides equally
+    # instead of biasing whichever group ran later. GC is paused inside each
+    # measured burst (collected between bursts) — a mid-burst major
+    # collection otherwise lands on a random pod's latency.
+    import gc
+
     run_burst("yoda-tpu")
     run_burst("reference")
-    ours_runs = sorted((run_burst("yoda-tpu") for _ in range(3)),
-                       key=lambda r: r["p50_ms"])
-    ref_runs = sorted((run_burst("reference") for _ in range(3)),
-                      key=lambda r: r["p50_ms"])
-    ours = ours_runs[1]
-    ref = ref_runs[1]
+    ours_all, ref_all = [], []
+    for _ in range(5):
+        for kind, dest in (("yoda-tpu", ours_all), ("reference", ref_all)):
+            gc.collect()
+            gc.disable()
+            try:
+                dest.append(run_burst(kind))
+            finally:
+                gc.enable()
+    ours = sorted(ours_all, key=lambda r: r["p50_ms"])[2]
+    ref = sorted(ref_all, key=lambda r: r["p50_ms"])[2]
     vs_baseline = (ref["p50_ms"] / ours["p50_ms"]) if ours["p50_ms"] > 0 else 1.0
     # scale stress (opt out with YODA_BENCH_NO_SCALE=1 for quick local
     # runs; a soft deadline keeps the whole bench inside the driver's

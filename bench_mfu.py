@@ -52,6 +52,13 @@ _progress("importing jax")
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+# honor JAX_PLATFORMS=cpu through jax.config: this environment's TPU
+# plugin (sitecustomize) force-selects its platform regardless of the env
+# var, so the documented CPU fallback would otherwise still dial the TPU
+# tunnel — and hang the whole bench when the tunnel is wedged
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 _progress("jax imported")
 
 
@@ -225,19 +232,27 @@ def _kernel_time_s(fn, q, k, v, n1: int, n2: int) -> float | None:
     """Per-call seconds of `fn(q, k, v) -> q-shaped array`, measured as a
     device-side fori_loop with the output carried into the next iteration's
     q (a serial dependency XLA cannot hoist), one dispatch per measurement.
-    Two loop lengths cancel the constant dispatch + tunnel round-trip
-    overhead: t = (T(n2) - T(n1)) / (n2 - n1). Returns None on OOM."""
+    The two-length slope (T(n2)-T(n1))/(n2-n1) cancels the constant
+    dispatch + tunnel round-trip overhead, but a single jittered endpoint
+    poisons it — one earlier artifact carried a physically impossible
+    >peak throughput that way. Guard: each length is measured three times
+    and the per-length MEDIAN feeds the slope (three collinear lengths
+    would NOT help: the median of their pairwise slopes is algebraically
+    just the endpoint slope again). Returns None on OOM."""
     @jax.jit
     def run(q, k, v, n):
         return jax.lax.fori_loop(
             0, n, lambda i, x: fn(x, k, v).astype(q.dtype), q)
 
-    def measure(n):
+    def measure(n, reps=3):
         na = jnp.int32(n)
         _sync(run(q, k, v, na))  # warm (first call compiles)
-        t0 = time.perf_counter()
-        _sync(run(q, k, v, na))
-        return time.perf_counter() - t0
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _sync(run(q, k, v, na))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
 
     try:
         t1 = measure(n1)
@@ -247,7 +262,7 @@ def _kernel_time_s(fn, q, k, v, n1: int, n2: int) -> float | None:
         return None  # OOM: the impl cannot run this shape at all
 
 
-def attention_bench(on_tpu: bool) -> dict:
+def attention_bench(on_tpu: bool, peak: float | None = None) -> dict:
     from yoda_scheduler_tpu.ops.attention import (
         flash_attention, reference_attention)
 
@@ -290,17 +305,40 @@ def attention_bench(on_tpu: bool) -> dict:
             lambda q, k, v: reference_attention(q, k, v, causal=True)),
             q, k, v, n1, n2)
 
-        ms = lambda t: round(t * 1e3, 3) if t is not None else "oom"
+        # ENFORCED self-check: useful causal FLOPs over the measured time
+        # cannot exceed the chip's peak — if they do, the measurement (not
+        # the kernel) is wrong; re-measure once, and if still impossible,
+        # null the sample rather than commit it (the artifact then shows
+        # "unmeasurable" instead of a fantasy speedup)
+        useful_flops = 4 * s * s * d * 0.5 * b * h
+
+        def plausible(t):
+            return t is None or peak is None or useful_flops / t <= peak
+
+        if not plausible(t_flash):
+            _progress(f"S={s} flash fwd {t_flash * 1e3:.3f}ms implies "
+                      ">peak; re-measuring")
+            t_flash = _kernel_time_s(
+                lambda q, k, v: flash_attention(q, k, v, causal=True),
+                q, k, v, n1, n2)
+            if not plausible(t_flash):
+                t_flash = None
+
+        # "unmeasured" = OOM or an implausible sample the guard nulled;
+        # a speedup is only reported when BOTH sides measured cleanly
+        ms = lambda t: round(t * 1e3, 3) if t is not None else "unmeasured"
+        speedup = (lambda ref, fl: round(ref / fl, 3) if fl and ref
+                   else ("flash_unmeasured" if ref else "xla_unmeasured"))
         out[f"S{s}"] = {
             "batch": b,
+            "flash_fwd_tflops": (round(useful_flops / t_flash / 1e12, 1)
+                                 if t_flash else None),
             "flash_fwd_ms": ms(t_flash),
             "xla_fwd_ms": ms(t_ref),
-            "fwd_speedup": (round(t_ref / t_flash, 3)
-                            if t_flash and t_ref else "xla_oom"),
+            "fwd_speedup": speedup(t_ref, t_flash),
             "flash_fwdbwd_ms": ms(t_flash_g),
             "xla_fwdbwd_ms": ms(t_ref_g),
-            "fwdbwd_speedup": (round(t_ref_g / t_flash_g, 3)
-                               if t_flash_g and t_ref_g else "xla_oom"),
+            "fwdbwd_speedup": speedup(t_ref_g, t_flash_g),
         }
     return out
 
@@ -330,7 +368,8 @@ def main() -> None:
     _progress(f"backend={jax.default_backend()} on_tpu={on_tpu} "
               f"budget={BUDGET_S}s")
     train = llama_train_bench(on_tpu)
-    attn = attention_bench(on_tpu)
+    attn = attention_bench(
+        on_tpu, peak=peak_flops(devices[0].device_kind) if on_tpu else None)
     # largest sequence where the XLA baseline still runs (above that, the
     # baseline OOMs and the "speedup" is infinite)
     numeric = {k: v for k, v in attn.items()

@@ -126,3 +126,43 @@ def test_flash_causal_cross_length():
     out = flash_attention(q, k, v, block_q=64, block_k=64)
     ref = reference_attention(q, k, v)
     assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_kernel_path_selection(monkeypatch):
+    """flash_attention must route to the Pallas kernel whenever the blocks
+    tile the sequence — including EXPLICIT sub-128 blocks (a VMEM-pressure
+    escape hatch) and auto-selected blocks — and fall back to the XLA path
+    only for untileable (ragged) lengths."""
+    import yoda_scheduler_tpu.ops.attention as attn
+
+    def boom(*a, **kw):
+        raise AssertionError("fell back to reference_attention")
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 128, 32))
+    monkeypatch.setattr(attn, "reference_attention", boom)
+    attn.flash_attention(q, q, q, block_q=64, block_k=64)  # explicit small
+    attn.flash_attention(q, q, q)                          # auto
+    # sub-128 sequences run as one whole-sequence block (pre-auto
+    # behavior tiled them too, as min(block, seq))
+    attn.flash_attention(q[:, :, :96], q[:, :, :96], q[:, :, :96])
+    monkeypatch.undo()
+    # long ragged length: no power-of-two divisor >= 128 -> XLA path
+    called = {}
+    monkeypatch.setattr(attn, "reference_attention",
+                        lambda *a, **kw: called.setdefault("yes", True) or a[0])
+    r = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 1000, 32))
+    attn.flash_attention(r, r, r)
+    assert called.get("yes")
+
+
+def test_auto_block_selection():
+    from yoda_scheduler_tpu.ops.attention import _auto_block
+
+    assert _auto_block(2048) == 512
+    assert _auto_block(8192) == 512
+    assert _auto_block(384) == 128     # 128 <= S <= 512: pow2 divisor only
+    assert _auto_block(96) == 96       # sub-128: whole-sequence block
+    assert 300 % _auto_block(300) != 0  # ragged short: caller falls back
+    assert 129 % _auto_block(129) != 0  # ragged short: caller falls back
+    assert _auto_block(12288) == 512
+    assert 1000 % _auto_block(1000) != 0  # untileable: caller falls back

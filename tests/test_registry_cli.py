@@ -105,11 +105,16 @@ def test_cli_simulate_unplaceable_terminates_promptly(capsys):
 
     t0 = _time.monotonic()
     rc = main(["simulate", "example/mixtral-v5e-64.yaml",
-               "--max-cycles", "500"])
+               "example/test-pod.yaml", "--max-cycles", "2000"])
     assert rc == 1
-    assert _time.monotonic() - t0 < 30.0
+    assert _time.monotonic() - t0 < 60.0
     out = json.loads(capsys.readouterr().out)
-    assert out["bound"] == 0
+    # the v5e pods stay Pending (no v5e slice exists)...
+    assert out["bound"] == 1
+    # ...but the placeable pod binds even though the unplaceable gang's
+    # virtual backoff races simulated time far past the 60s staleness
+    # gate — heartbeats are pinned so the fleet never ages out mid-run
+    assert out["pods"]["default/test-pod"]["phase"] == "Bound"
 
 
 def test_cli_simulate_v5e_manifest_places(capsys):

@@ -76,12 +76,25 @@ def cmd_simulate(args) -> int:
     for i in range(args.gpu_nodes):
         nodes.append(make_gpu_node(f"gpu-{i}", cards=8))
     pub.publish(*nodes)
+    # the one-shot publish stands in for a continuously-publishing sniffer;
+    # re-pin heartbeats far in the future (publish stamps them `now`, and
+    # the store holds these same objects) so the virtual clock's backoff
+    # sleeps — which race simulated time past the 60s staleness gate in
+    # seconds of wall time — never age the fleet out mid-simulation (same
+    # hazard bench.py guards against)
+    for m in nodes:
+        m.heartbeat = time.time() + 1e9
 
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
+    from .scheduler.core import HybridClock
     from .scheduler.multi import MultiProfileScheduler
 
-    sched = MultiProfileScheduler(cluster, profiles)
+    # virtual clock: retry backoffs and gang timeouts advance simulated
+    # time instead of wall-sleeping — a manifest that can never place
+    # (e.g. a v5e gang with --v5e-slices 0) previously made simulate hang
+    # for max_cycles x backoff REAL seconds before reporting Pending
+    sched = MultiProfileScheduler(cluster, profiles, clock=HybridClock())
 
     if args.metrics_port is not None:
         from .utils.httpserv import serve
