@@ -196,6 +196,49 @@ class TestKubeClusterWatch:
         cluster._pod_event("DELETED", pod_obj("p1", rv="6"))
         assert [p.name for p in cluster.pods_on("n1")] == ["p2"]
 
+    def test_node_meta_from_events_and_replace(self):
+        """Node labels/taints (admission inputs) flow through watch events
+        AND full re-lists, bumping the node's change counter on every edit
+        so cached filter verdicts can't outlive a label change."""
+        api = ScriptedApi()
+        cluster, _ = self._cluster(api)
+        cluster._node_event("ADDED", {
+            "metadata": {"name": "n1", "resourceVersion": "1",
+                         "labels": {"pool": "gold"}},
+            "spec": {"taints": [{"key": "dedicated", "value": "ml",
+                                 "effect": "NoSchedule"}]}})
+        labels, taints = cluster.node_meta("n1")
+        assert labels == {"pool": "gold"}
+        assert taints == ({"key": "dedicated", "value": "ml",
+                           "effect": "NoSchedule"},)
+        # MODIFIED with a label edit bumps the node's version
+        v0 = cluster.pods_version("n1")
+        cluster._node_event("MODIFIED", {
+            "metadata": {"name": "n1", "resourceVersion": "2",
+                         "labels": {"pool": "silver"}},
+            "spec": {}})
+        assert cluster.pods_version("n1") > v0
+        assert cluster.node_meta("n1") == ({"pool": "silver"}, ())
+        # an unchanged MODIFIED does NOT bump (no spurious invalidation)
+        v1 = cluster.pods_version("n1")
+        cluster._node_event("MODIFIED", {
+            "metadata": {"name": "n1", "resourceVersion": "3",
+                         "labels": {"pool": "silver"}},
+            "spec": {}})
+        assert cluster.pods_version("n1") == v1
+        # full re-list replaces meta and bumps changed nodes only
+        cluster._replace_nodes([
+            {"metadata": {"name": "n1", "resourceVersion": "4",
+                          "labels": {"pool": "silver"}}, "spec": {}},
+            {"metadata": {"name": "n2", "resourceVersion": "4",
+                          "labels": {"a": "b"}}, "spec": {}},
+        ])
+        assert cluster.pods_version("n1") == v1  # unchanged
+        assert cluster.node_meta("n2") == ({"a": "b"}, ())
+        # DELETED clears meta
+        cluster._node_event("DELETED", {"metadata": {"name": "n2"}})
+        assert cluster.node_meta("n2") == ({}, ())
+
     def test_pods_version_bumps_on_node_changes(self):
         api = ScriptedApi()
         cluster, _ = self._cluster(api)
