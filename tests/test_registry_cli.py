@@ -271,3 +271,89 @@ spec: {schedulerName: yoda-scheduler}
 """)
         out = capsys.readouterr().out
         assert rc == 1 and "2-D tori" in out
+
+    def test_toleration_lint(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badtol
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  tolerations:
+    - {key: dedicated, operator: Equals, value: ml, effect: NoSchedule}
+    - {key: dedicated, operator: Equal, value: ml, effect: NoSched}
+    - {operator: Equal, value: x}
+    - {key: dedicated, operator: Exists, value: ml}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "operator 'Equals'" in out
+        assert "effect 'NoSched'" in out
+        assert "empty key requires" in out
+        assert "must not set a value" in out
+
+    def test_nodeselector_non_string_value(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Deployment
+metadata: {name: d}
+spec:
+  replicas: 1
+  template:
+    metadata:
+      labels: {scv/number: "1"}
+    spec:
+      schedulerName: yoda-scheduler
+      nodeSelector: {pool: 3}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1 and "not a string" in out
+
+    def test_valid_admission_spec_passes(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: ok
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  nodeSelector: {pool: gold}
+  tolerations:
+    - {key: dedicated, operator: Exists, effect: NoSchedule}
+    - {operator: Exists}
+""")
+        out = capsys.readouterr().out
+        assert rc == 0 and "OK" in out
+
+    def test_malformed_spec_shapes_reported_not_crashed(self, tmp_path,
+                                                        capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badshape
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  tolerations: notalist
+  nodeSelector: [a, b]
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badtolentry
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  tolerations:
+    - just-a-string
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "tolerations is str, not a list" in out
+        assert "nodeSelector is list, not a mapping" in out
+        assert "tolerations[0] is str, not a mapping" in out
+        assert "Traceback" not in out

@@ -215,6 +215,60 @@ def cmd_validate(args) -> int:
             gang_members[spec.gang_name] = (
                 gang_members.get(spec.gang_name, 0) + count)
 
+    def check_spec(name: str, spec_doc, where: str) -> None:
+        """Admission fields (same contract a real apiserver validates, plus
+        the combinations that pass validation but can never match): a typo'd
+        toleration silently stops tolerating and the pod goes Pending.
+        Malformed shapes are reported as lint errors, never tracebacks."""
+        if not isinstance(spec_doc, dict):
+            problems.append(
+                f"{where}: {name}: spec is {type(spec_doc).__name__}, "
+                f"not a mapping")
+            return
+        tols = spec_doc.get("tolerations") or []
+        if not isinstance(tols, list):
+            problems.append(
+                f"{where}: {name}: tolerations is "
+                f"{type(tols).__name__}, not a list")
+            tols = []
+        for i, t in enumerate(tols):
+            if not isinstance(t, dict):
+                problems.append(
+                    f"{where}: {name}: tolerations[{i}] is "
+                    f"{type(t).__name__}, not a mapping")
+                continue
+            op = t.get("operator", "Equal")
+            if op not in ("Equal", "Exists"):
+                problems.append(
+                    f"{where}: {name}: toleration operator {op!r} "
+                    f"(must be Equal or Exists)")
+            eff = t.get("effect", "")
+            if eff not in ("", "NoSchedule", "PreferNoSchedule", "NoExecute"):
+                problems.append(
+                    f"{where}: {name}: toleration effect {eff!r} (must be "
+                    f"NoSchedule, PreferNoSchedule, NoExecute, or empty)")
+            if not t.get("key") and op == "Equal":
+                problems.append(
+                    f"{where}: {name}: toleration with empty key requires "
+                    f"operator Exists (tolerate-everything); with Equal it "
+                    f"matches nothing")
+            if op == "Exists" and t.get("value"):
+                problems.append(
+                    f"{where}: {name}: toleration with operator Exists must "
+                    f"not set a value (apiserver rejects it)")
+        sel = spec_doc.get("nodeSelector") or {}
+        if not isinstance(sel, dict):
+            problems.append(
+                f"{where}: {name}: nodeSelector is "
+                f"{type(sel).__name__}, not a mapping")
+            sel = {}
+        for k, v in sel.items():
+            if not isinstance(v, str):
+                problems.append(
+                    f"{where}: {name}: nodeSelector {k!r} value "
+                    f"{v!r} is {type(v).__name__}, not a string — node "
+                    f"labels are strings, this can never match")
+
     for path in args.manifests:
         with open(path) as f:
             for doc in yaml.safe_load_all(f):
@@ -230,6 +284,8 @@ def cmd_validate(args) -> int:
                 if kind == "Pod":
                     check(meta.get("name", "pod"),
                           dict(meta.get("labels") or {}), path)
+                    check_spec(meta.get("name", "pod"),
+                               doc.get("spec") or {}, path)
                 elif kind == "Deployment":
                     tmpl = (doc.get("spec") or {}).get("template") or {}
                     labels = dict((tmpl.get("metadata") or {}).get("labels")
@@ -237,6 +293,8 @@ def cmd_validate(args) -> int:
                     replicas = (doc.get("spec") or {}).get("replicas", 1)
                     check(meta.get("name", "deploy"), labels, path,
                           count=replicas)
+                    check_spec(meta.get("name", "deploy"),
+                               tmpl.get("spec") or {}, path)
     for gang, sizes in gang_sizes.items():
         if len(sizes) > 1:
             problems.append(
