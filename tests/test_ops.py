@@ -166,3 +166,25 @@ def test_auto_block_selection():
     assert 129 % _auto_block(129) != 0  # ragged short: caller falls back
     assert _auto_block(12288) == 512
     assert 1000 % _auto_block(1000) != 0  # untileable: caller falls back
+
+
+def test_flash_backward_with_divergent_bwd_blocks():
+    """Backward kernels may run at different block sizes than the forward;
+    gradients must match the reference regardless."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (1, 2, 256, 32))
+    k = jax.random.normal(ks[1], (1, 2, 256, 32))
+    v = jax.random.normal(ks[2], (1, 2, 256, 32))
+    gf = _grads(lambda q, k, v: flash_attention(
+        q, k, v, block_q=128, block_k=128, block_q_bwd=64, block_k_bwd=256),
+        q, k, v)
+    gr = _grads(lambda q, k, v: reference_attention(q, k, v), q, k, v)
+    for a, b in zip(gf, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+
+def test_flash_untileable_explicit_bwd_blocks_raise():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 256, 32))
+    import pytest
+    with pytest.raises(ValueError, match="backward blocks"):
+        flash_attention(q, q, q, block_q=128, block_k=128, block_k_bwd=96)

@@ -1,0 +1,104 @@
+"""Flash-attention block-size sweep on the real chip.
+
+Produces the PERFORMANCE.md sweep table: wall time and useful-causal-FLOP
+throughput per (block_q, block_k) at several sequence lengths, forward and
+(with --bwd) forward+backward, against the plain-XLA baseline. Run on TPU
+hardware (no JAX_PLATFORMS=cpu); the timing harness matches
+bench_mfu._kernel_time_s (chained device-side loop, overhead cancelled by
+loop-length differencing, median-of-3 per length).
+
+    python tools/tune_attention.py [--bwd] [--seqs 2048,4096,8192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/jax_comp_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from yoda_scheduler_tpu.ops.attention import (  # noqa: E402
+    flash_attention, reference_attention)
+
+
+def _sync(x) -> None:
+    jax.device_get(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
+
+
+def kernel_time(fn, q, k, v, n1=4, n2=24):
+    @jax.jit
+    def run(q, k, v, n):
+        return jax.lax.fori_loop(
+            0, n, lambda i, x: fn(x, k, v).astype(q.dtype), q)
+
+    def measure(n, reps=3):
+        na = jnp.int32(n)
+        _sync(run(q, k, v, na))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _sync(run(q, k, v, na))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    try:
+        t1, t2 = measure(n1), measure(n2)
+        return max(t2 - t1, 1e-9) / (n2 - n1)
+    except Exception as e:
+        print(f"  err {type(e).__name__}: {str(e)[:120]}", flush=True)
+        return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bwd", action="store_true",
+                    help="sweep fwd+bwd (grad wrt q/k/v) instead of fwd")
+    ap.add_argument("--seqs", default="2048,4096,8192")
+    ap.add_argument("--blocks", default="128:128,256:256,512:256,512:512,1024:512")
+    args = ap.parse_args()
+    h, d = 16, 128
+    for s in (int(x) for x in args.seqs.split(",")):
+        b = max(1, 8192 // s)
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(s), 3)
+        q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, h, s, d), jnp.bfloat16)
+        # useful causal FLOPs (x2.5 more compute in bwd, not counted: the
+        # table compares configurations, not absolute MFU)
+        fl = 4 * s * s * d * 0.5 * b * h
+        for spec in args.blocks.split(","):
+            bq, bk = (int(x) for x in spec.split(":"))
+            if bq > s or bk > s or s % min(bq, s) or s % min(bk, s):
+                continue
+            if args.bwd:
+                fn = jax.grad(lambda q, k, v, bq=bq, bk=bk: jnp.sum(
+                    flash_attention(q, k, v, causal=True, block_q=512,
+                                    block_k=512, block_q_bwd=bq,
+                                    block_k_bwd=bk).astype(jnp.float32)))
+            else:
+                fn = lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk)
+            t = kernel_time(fn, q, k, v)
+            if t:
+                print(f"S={s} bq={bq} bk={bk}{' bwd' if args.bwd else ''}: "
+                      f"{t * 1e3:.3f} ms  {fl / t / 1e12:.1f} TF/s", flush=True)
+        base = (jax.grad(lambda q, k, v: jnp.sum(reference_attention(
+            q, k, v, True).astype(jnp.float32))) if args.bwd
+            else (lambda q, k, v: reference_attention(q, k, v, True)))
+        t = kernel_time(base, q, k, v)
+        if t:
+            print(f"S={s} XLA{' bwd' if args.bwd else ''}: {t * 1e3:.3f} ms  "
+                  f"{fl / t / 1e12:.1f} TF/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -338,23 +338,23 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
             dv.reshape(b, h, sk, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
                             interpret=_use_interpret())
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
+def _flash_fwd(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
                               interpret=_use_interpret())
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, res, g):
+def _flash_bwd(causal, block_q, block_k, block_q_bwd, block_k_bwd, res, g):
     q, k, v, o, lse = res
-    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
-                           interpret=_use_interpret())
+    return _flash_backward(q, k, v, o, lse, g, causal, block_q_bwd,
+                           block_k_bwd, interpret=_use_interpret())
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -382,7 +382,9 @@ def _auto_block(seq: int) -> int:
 
 
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int | None = None, block_k: int | None = None):
+                    block_q: int | None = None, block_k: int | None = None,
+                    block_q_bwd: int | None = None,
+                    block_k_bwd: int | None = None):
     """Fused attention entry point; [B, H, S, D] -> [B, H, S, D].
 
     Compiles to the Pallas kernel on TPU; interpret-mode (same code path)
@@ -392,7 +394,10 @@ def flash_attention(q, k, v, causal: bool = True,
     Default block sizes are auto-selected: 512x512 measured fastest on a
     real v5e across S in {2048, 4096, 8192} (68.7 / 96.9 / 134.0 TF/s vs
     12.4 / 20.7 / 22.1 at the old 128x128 — BENCH_MFU.json), falling to
-    the largest power of two that tiles the sequence.
+    the largest power of two that tiles the sequence. The backward
+    kernels (dq and dk/dv) take their own block sizes, defaulting to the
+    forward's — they have a different arithmetic-intensity profile, so
+    tuning may diverge.
     """
     sq, sk = q.shape[2], k.shape[2]
     if causal and sq > sk:
@@ -407,4 +412,12 @@ def flash_attention(q, k, v, causal: bool = True,
     bk = _auto_block(sk) if block_k is None else min(block_k, sk)
     if sq % bq or sk % bk:
         return reference_attention(q, k, v, causal)
-    return _flash(q, k, v, causal, bq, bk)
+    bq_b = bq if block_q_bwd is None else min(block_q_bwd, sq)
+    bk_b = bk if block_k_bwd is None else min(block_k_bwd, sk)
+    if sq % bq_b or sk % bk_b:
+        # explicit-only path (the defaults are the forward blocks, which
+        # tile by construction here): silently substituting would make a
+        # user benchmark the wrong tile — refuse loudly instead
+        raise ValueError(
+            f"backward blocks ({bq_b},{bk_b}) do not tile seq ({sq},{sk})")
+    return _flash(q, k, v, causal, bq, bk, bq_b, bk_b)
