@@ -80,10 +80,20 @@ def main() -> None:
             if bq > s or bk > s or s % min(bq, s) or s % min(bk, s):
                 continue
             if args.bwd:
-                fn = jax.grad(lambda q, k, v, bq=bq, bk=bk: jnp.sum(
-                    flash_attention(q, k, v, causal=True, block_q=512,
-                                    block_k=512, block_q_bwd=bq,
-                                    block_k_bwd=bk).astype(jnp.float32)))
+                # grad wrt ALL of q,k,v — differentiating only q would let
+                # XLA dead-code-eliminate the dk/dv kernel (the one whose
+                # grid block_k_bwd tiles) and the sweep would time nothing
+                # but dq. Summing the grads yields a q-shaped array that
+                # chains through the timing loop's carry.
+                def fn(q, k, v, bq=bq, bk=bk):
+                    gq, gk, gv = jax.grad(
+                        lambda q, k, v: jnp.sum(
+                            flash_attention(q, k, v, causal=True,
+                                            block_q=512, block_k=512,
+                                            block_q_bwd=bq, block_k_bwd=bk
+                                            ).astype(jnp.float32)),
+                        argnums=(0, 1, 2))(q, k, v)
+                    return gq + gk + gv
             else:
                 fn = lambda q, k, v, bq=bq, bk=bk: flash_attention(
                     q, k, v, causal=True, block_q=bq, block_k=bk)
@@ -91,9 +101,15 @@ def main() -> None:
             if t:
                 print(f"S={s} bq={bq} bk={bk}{' bwd' if args.bwd else ''}: "
                       f"{t * 1e3:.3f} ms  {fl / t / 1e12:.1f} TF/s", flush=True)
-        base = (jax.grad(lambda q, k, v: jnp.sum(reference_attention(
-            q, k, v, True).astype(jnp.float32))) if args.bwd
-            else (lambda q, k, v: reference_attention(q, k, v, True)))
+        if args.bwd:
+            def base(q, k, v):
+                gq, gk, gv = jax.grad(
+                    lambda q, k, v: jnp.sum(reference_attention(
+                        q, k, v, True).astype(jnp.float32)),
+                    argnums=(0, 1, 2))(q, k, v)
+                return gq + gk + gv
+        else:
+            base = lambda q, k, v: reference_attention(q, k, v, True)
         t = kernel_time(base, q, k, v)
         if t:
             print(f"S={s} XLA{' bwd' if args.bwd else ''}: {t * 1e3:.3f} ms  "

@@ -29,6 +29,14 @@ _NEG_INF = -1e30
 def reference_attention(q, k, v, causal: bool = True):
     """Plain-XLA attention; the numerical reference for the kernel and the
     backward-pass recompute. [B, H, S, D] in/out; fp32 softmax accumulation."""
+    out, _ = reference_attention_with_lse(q, k, v, causal)
+    return out
+
+
+def reference_attention_with_lse(q, k, v, causal: bool = True):
+    """reference_attention plus per-row log-sum-exp of the scaled scores
+    ([B, H, S] fp32) — the statistic that lets partial attentions over
+    key/value chunks be merged exactly (parallel/ring.py)."""
     _, _, sq, d = q.shape
     sk = k.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -37,8 +45,9 @@ def reference_attention(q, k, v, causal: bool = True):
         qi = jnp.arange(sq)[:, None] + (sk - sq)  # support kv longer than q
         ki = jnp.arange(sk)[None, :]
         scores = jnp.where(ki <= qi, scores, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v), lse
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
@@ -269,11 +278,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
-                    block_k: int, interpret: bool):
+                    block_k: int, interpret: bool, g_lse=None):
     """Fused FlashAttention backward: two Pallas kernels (dq over q blocks;
     dk/dv over k blocks), re-deriving probabilities from the forward's
     saved log-sum-exp instead of recomputing the online softmax or ever
-    materialising the [S, S] matrix (VERDICT r2 missing #6)."""
+    materialising the [S, S] matrix (VERDICT r2 missing #6).
+
+    `g_lse` ([B, H, S] or None) is the cotangent of the LSE output when the
+    caller consumed it (flash_attention_with_lse). It needs NO kernel
+    change: d lse/d s = p per row, so ds = p*(dp - delta + g_lse)*scale —
+    algebraically the same as shrinking delta by g_lse before streaming it
+    into the unchanged kernels."""
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
@@ -292,6 +307,8 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     delta = jnp.sum(dor.astype(jnp.float32)
                     * o.reshape(bh, sq, d).astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32).reshape(bh, sq, 1)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -339,25 +356,41 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
-                            interpret=_use_interpret())
-    return out
-
-
-def _flash_fwd(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
+def _flash_pair(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
+    """Kernel entry returning (out [B,H,S,D], lse [B,H,S] fp32). The lse
+    output makes chunked/distributed callers (ring attention) mergeable;
+    plain flash_attention discards it (its cotangent is then zero and the
+    backward reduces to the classic one)."""
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
                               interpret=_use_interpret())
-    return out, (q, k, v, out, lse)
+    b, h, sq, _ = q.shape
+    return out, lse.reshape(b, h, sq)
 
 
-def _flash_bwd(causal, block_q, block_k, block_q_bwd, block_k_bwd, res, g):
+def _flash_pair_fwd(q, k, v, causal, block_q, block_k, block_q_bwd,
+                    block_k_bwd):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                              interpret=_use_interpret())
+    b, h, sq, _ = q.shape
+    return (out, lse.reshape(b, h, sq)), (q, k, v, out, lse)
+
+
+def _flash_pair_bwd(causal, block_q, block_k, block_q_bwd, block_k_bwd,
+                    res, g):
     q, k, v, o, lse = res
-    return _flash_backward(q, k, v, o, lse, g, causal, block_q_bwd,
-                           block_k_bwd, interpret=_use_interpret())
+    g_out, g_lse = g
+    return _flash_backward(q, k, v, o, lse, g_out, causal, block_q_bwd,
+                           block_k_bwd, interpret=_use_interpret(),
+                           g_lse=g_lse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_pair.defvjp(_flash_pair_fwd, _flash_pair_bwd)
+
+
+def _flash(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
+    out, _ = _flash_pair(q, k, v, causal, block_q, block_k, block_q_bwd,
+                         block_k_bwd)
+    return out
 
 
 def _use_interpret() -> bool:
@@ -399,6 +432,33 @@ def flash_attention(q, k, v, causal: bool = True,
     forward's — they have a different arithmetic-intensity profile, so
     tuning may diverge.
     """
+    blocks = _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
+                             block_k_bwd)
+    if blocks is None:
+        return reference_attention(q, k, v, causal)
+    return _flash(q, k, v, causal, *blocks)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             block_q: int | None = None,
+                             block_k: int | None = None,
+                             block_q_bwd: int | None = None,
+                             block_k_bwd: int | None = None):
+    """flash_attention plus the per-row log-sum-exp of the scaled scores
+    ([B, H, S] fp32). The LSE lets partial attentions over key/value chunks
+    be merged exactly — the primitive behind ring/context parallelism
+    (parallel/ring.py). Differentiable in both outputs (the LSE cotangent
+    folds into the fused backward at zero extra kernel cost)."""
+    blocks = _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
+                             block_k_bwd)
+    if blocks is None:
+        return reference_attention_with_lse(q, k, v, causal)
+    return _flash_pair(q, k, v, causal, *blocks)
+
+
+def _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
+                    block_k_bwd):
+    """Shared block resolution; None means 'use the XLA reference path'."""
     sq, sk = q.shape[2], k.shape[2]
     if causal and sq > sk:
         # rows beyond the kv horizon would attend to nothing — the math is
@@ -411,7 +471,7 @@ def flash_attention(q, k, v, causal: bool = True,
     bq = _auto_block(sq) if block_q is None else min(block_q, sq)
     bk = _auto_block(sk) if block_k is None else min(block_k, sk)
     if sq % bq or sk % bk:
-        return reference_attention(q, k, v, causal)
+        return None
     bq_b = bq if block_q_bwd is None else min(block_q_bwd, sq)
     bk_b = bk if block_k_bwd is None else min(block_k_bwd, sk)
     if sq % bq_b or sk % bk_b:
@@ -420,4 +480,4 @@ def flash_attention(q, k, v, causal: bool = True,
         # user benchmark the wrong tile — refuse loudly instead
         raise ValueError(
             f"backward blocks ({bq_b},{bk_b}) do not tile seq ({sq},{sk})")
-    return _flash(q, k, v, causal, bq, bk, bq_b, bk_b)
+    return bq, bk, bq_b, bk_b

@@ -3,10 +3,20 @@
 Long-context sequence/context parallelism for the transformer workloads:
 each device of the `sp` mesh axis holds a contiguous sequence chunk of
 Q/K/V. K/V chunks rotate around the ring with `jax.lax.ppermute` (XLA maps
-this onto neighbour ICI links) while each device folds every chunk into its
-local queries' online-softmax state — full causal attention with O(S/n)
+this onto neighbour ICI links) while each device merges every chunk into
+its local queries' attention state — full causal attention with O(S/n)
 activation memory per device, overlap-friendly, never materialising the
 global [S, S] score matrix.
+
+Per-chunk compute routes through the fused Pallas flash kernel on TPU
+(`ops.attention.flash_attention_with_lse` — the LSE output is exactly the
+statistic that makes partial attentions mergeable), with the plain-XLA
+reference used on interpret-mode backends (shard_map's varying-manual-axes
+checker rejects interpret-mode pallas calls there). Causality is exploited
+structurally: a rotation whose source chunk lies entirely in the local
+queries' future contributes nothing and is skipped (`lax.switch` — the
+compute halves versus attending every chunk; the ppermute still runs, the
+ring must keep turning).
 
 Written with shard_map + collectives (not raw RDMA) so the identical code
 runs on a CPU test mesh and a TPU pod slice.
@@ -25,56 +35,73 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from ..ops import attention as _attn
+
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_off, k_off, m, l, acc, scale):
-    """Fold one K/V chunk into the online-softmax state. All [B,H,*,D]."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    sq, sk = q.shape[2], k.shape[2]
-    q_pos = q_off + jnp.arange(sq)[:, None]
-    k_pos = k_off + jnp.arange(sk)[None, :]
-    s = jnp.where(k_pos[None, None] <= q_pos[None, None], s, _NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
-    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_new = acc * alpha + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32)
-    return m_new, l_new, acc_new
+def _chunk_attention(q, k, v, causal: bool):
+    """One q-chunk vs one k/v-chunk: (out, lse) in fp32. Kernel on compiled
+    backends; XLA reference under interpret mode (see module docstring).
+    The dispatch reads through the attention module (not a by-value import)
+    so the TPU-lowering tests' monkeypatch of `_use_interpret` governs this
+    path too."""
+    if _attn._use_interpret():
+        out, lse = _attn.reference_attention_with_lse(q, k, v, causal=causal)
+    else:
+        out, lse = _attn.flash_attention_with_lse(q, k, v, causal=causal)
+    return out.astype(jnp.float32), lse.astype(jnp.float32)[..., None]
 
 
-def _ring_body(q, k, v, axis_name: str, axis_size: int, chunk: int):
-    """Per-shard body under shard_map. q,k,v: [B, H, S/n, D] local chunks."""
+def _ring_body(q, k, v, axis_name: str, axis_size: int):
+    """Per-shard body under shard_map. q,k,v: [B, H, S/n, D] local chunks.
+
+    Merge state over normalized per-chunk partials (out_i, lse_i):
+    the exact combination is out = Σ_i softmax_i(lse_i)·out_i, maintained
+    online as (acc, r, m) with m the running max lse —
+    acc = Σ out_i·exp(lse_i - m), r = Σ exp(lse_i - m)."""
     rank = jax.lax.axis_index(axis_name)
-    scale = 1.0 / (q.shape[-1] ** 0.5)
     qf = q.astype(jnp.float32)
-    # derive the carry from qf so it inherits q's varying-manual-axes type —
-    # literals would be device-invariant and fail the scan carry type check
+    # derive carries from qf so they inherit q's varying-manual-axes type —
+    # literals would be device-invariant and fail the loop carry type check
     m = qf[..., :1] * 0.0 + _NEG_INF
-    l = qf[..., :1] * 0.0
+    r = qf[..., :1] * 0.0
     acc = qf * 0.0
-    q_off = rank * chunk
+
+    def skip(q, k, v):
+        # source chunk entirely in the future: contributes nothing. lse of
+        # -inf makes the merge a no-op (beta = exp(-inf - m) = 0).
+        z = q.astype(jnp.float32)
+        return z * 0.0, z[..., :1] * 0.0 + _NEG_INF
+
+    def full(q, k, v):
+        # source chunk entirely in the past: every (q, k) pair is live
+        return _chunk_attention(q, k, v, causal=False)
+
+    def diag(q, k, v):
+        # the local chunk itself: standard causal attention
+        return _chunk_attention(q, k, v, causal=True)
 
     def step(i, carry):
-        m, l, acc, k, v = carry
+        m, r, acc, k, v = carry
         # after i rotations we hold the chunk originally on rank - i
         src = (rank - i) % axis_size
-        m, l, acc = _block_attend(qf, k.astype(jnp.float32),
-                                  v.astype(jnp.float32),
-                                  q_off, src * chunk, m, l, acc, scale)
-        # rotate kv to the next rank (last rotation is skipped by the loop
-        # bound arithmetic below feeding a dummy — keep it simple: rotate
-        # every step; the final rotated copy is unused)
+        case = jnp.where(src == rank, 2, jnp.where(src < rank, 1, 0))
+        out_i, lse_i = jax.lax.switch(case, (skip, full, diag), q, k, v)
+        m_new = jnp.maximum(m, lse_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_i - m_new)
+        acc_new = acc * alpha + out_i * beta
+        r_new = r * alpha + beta
+        # rotate kv to the next rank (the final rotated copy is unused;
+        # rotating every step keeps the loop body uniform)
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        return m, l, acc, k, v
+        return m_new, r_new, acc_new, k, v
 
-    m, l, acc, _, _ = jax.lax.fori_loop(0, axis_size, step, (m, l, acc, k, v))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    m, r, acc, _, _ = jax.lax.fori_loop(0, axis_size, step, (m, r, acc, k, v))
+    return (acc / jnp.maximum(r, 1e-30)).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "sp"):
@@ -87,10 +114,9 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp"):
     seq = q.shape[2]
     if seq % axis_size:
         raise ValueError(f"seq {seq} not divisible by {axis_name}={axis_size}")
-    chunk = seq // axis_size
     spec = P(("dp", "fsdp"), "tp", axis_name, None)
     body = functools.partial(_ring_body, axis_name=axis_name,
-                             axis_size=axis_size, chunk=chunk)
+                             axis_size=axis_size)
     return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )(q, k, v)
