@@ -315,3 +315,137 @@ class TestManifestParsing:
         # defaults fill in: operator Equal, empty effect matches everything
         assert pod.tolerations[1] == {"key": "", "operator": "Exists",
                                       "value": "", "effect": ""}
+
+
+class TestNodeAffinity:
+    def _pod(self, terms):
+        return Pod.from_manifest({
+            "metadata": {"name": "a", "labels": {"scv/number": "1"}},
+            "spec": {
+                "schedulerName": "yoda-scheduler",
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": terms}}},
+            },
+        })
+
+    def test_expression_operators(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            affinity_matches)
+
+        pod = self._pod([{"matchExpressions": [
+            {"key": "pool", "operator": "In", "values": ["gold", "silver"]},
+            {"key": "cordoned", "operator": "DoesNotExist"},
+            {"key": "gen", "operator": "Gt", "values": ["4"]},
+        ]}])
+        assert affinity_matches(pod, {"pool": "gold", "gen": "5"})
+        assert not affinity_matches(pod, {"pool": "bronze", "gen": "5"})
+        assert not affinity_matches(pod, {"pool": "gold", "gen": "4"})
+        assert not affinity_matches(
+            pod, {"pool": "gold", "gen": "5", "cordoned": "y"})
+
+    def test_terms_or_together(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            affinity_matches)
+
+        pod = self._pod([
+            {"matchExpressions": [
+                {"key": "pool", "operator": "In", "values": ["gold"]}]},
+            {"matchExpressions": [
+                {"key": "zone", "operator": "Exists"}]},
+        ])
+        assert affinity_matches(pod, {"pool": "gold"})
+        assert affinity_matches(pod, {"zone": "a"})
+        assert not affinity_matches(pod, {"pool": "silver"})
+
+    def test_scheduler_routes_by_affinity(self):
+        c = _cluster(["a", "b"])
+        c.set_node_meta("b", labels={"gen": "6"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = self._pod([{"matchExpressions": [
+            {"key": "gen", "operator": "Gt", "values": ["5"]}]}])
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "b"
+
+    def test_unknown_operator_matches_nothing(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            affinity_matches)
+
+        pod = self._pod([{"matchExpressions": [
+            {"key": "pool", "operator": "Inn", "values": ["gold"]}]}])
+        assert not affinity_matches(pod, {"pool": "gold"})
+
+
+class TestSpecPriority:
+    def test_spec_priority_feeds_label(self):
+        pod = Pod.from_manifest({
+            "metadata": {"name": "p"},
+            "spec": {"schedulerName": "yoda-scheduler", "priority": 7}})
+        assert pod.labels["scv/priority"] == "7"
+
+    def test_label_wins_over_spec(self):
+        pod = Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/priority": "2"}},
+            "spec": {"schedulerName": "yoda-scheduler", "priority": 7}})
+        assert pod.labels["scv/priority"] == "2"
+
+    def test_well_known_priority_classes(self):
+        pod = Pod.from_manifest({
+            "metadata": {"name": "p"},
+            "spec": {"schedulerName": "yoda-scheduler",
+                     "priorityClassName": "system-cluster-critical"}})
+        assert pod.labels["scv/priority"] == "2000000000"
+
+    def test_no_priority_no_label(self):
+        pod = Pod.from_manifest({
+            "metadata": {"name": "p"},
+            "spec": {"schedulerName": "yoda-scheduler"}})
+        assert "scv/priority" not in pod.labels
+
+    def test_matchfields_and_empty_terms_match_nothing(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            affinity_matches)
+
+        pinned = Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchFields": [
+                            {"key": "metadata.name", "operator": "In",
+                             "values": ["node-5"]}]}]}}}},
+        })
+        # field selectors aren't modelled: the term must match NOTHING
+        # (match-all would scatter a node-pinned pod across the fleet)
+        assert not affinity_matches(pinned, {"any": "labels"})
+        empty = Pod.from_manifest({
+            "metadata": {"name": "e", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{}]}}}},
+        })
+        assert not affinity_matches(empty, {"any": "labels"})
+
+    def test_malformed_affinity_never_crashes_parse(self):
+        pod = Pod.from_manifest({
+            "metadata": {"name": "m", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler",
+                     "affinity": {"nodeAffinity": ["notadict"]}}})
+        assert pod.node_affinity == ()
+
+    def test_int_values_coerced_to_strings(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            affinity_matches)
+
+        pod = Pod.from_manifest({
+            "metadata": {"name": "i", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [
+                            {"key": "gen", "operator": "In",
+                             "values": [5]}]}]}}}},
+        })
+        assert affinity_matches(pod, {"gen": "5"})

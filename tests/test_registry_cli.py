@@ -357,3 +357,96 @@ spec:
         assert "nodeSelector is list, not a mapping" in out
         assert "tolerations[0] is str, not a mapping" in out
         assert "Traceback" not in out
+
+    def test_node_affinity_lint(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badaff
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        nodeSelectorTerms:
+          - matchExpressions:
+              - {key: pool, operator: Inn, values: [gold]}
+              - {key: pool, operator: In}
+              - {key: gen, operator: Exists, values: [x]}
+              - {key: gen, operator: Gt, values: [a]}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "operator 'Inn'" in out
+        assert "In requires non-empty values" in out
+        assert "Exists must not set values" in out
+        assert "Gt needs exactly one integer" in out
+
+    def test_valid_node_affinity_passes(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: okaff
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        nodeSelectorTerms:
+          - matchExpressions:
+              - {key: pool, operator: In, values: [gold]}
+              - {key: gen, operator: Gt, values: ["5"]}
+""")
+        out = capsys.readouterr().out
+        assert rc == 0 and "OK" in out
+
+    def test_node_affinity_malformed_shapes_and_matchfields(self, tmp_path,
+                                                            capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: shapes
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity: {nodeAffinity: [notadict]}
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: fields
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        nodeSelectorTerms:
+          - matchFields:
+              - {key: metadata.name, operator: In, values: [node-5]}
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: intval
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        nodeSelectorTerms:
+          - matchExpressions:
+              - {key: pool, operator: In, values: [5]}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "nodeAffinity is list, not a mapping" in out
+        assert "matchFields is not supported" in out
+        assert "not a string" in out
+        assert "Traceback" not in out

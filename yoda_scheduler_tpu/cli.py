@@ -268,6 +268,74 @@ def cmd_validate(args) -> int:
                     f"{where}: {name}: nodeSelector {k!r} value "
                     f"{v!r} is {type(v).__name__}, not a string — node "
                     f"labels are strings, this can never match")
+        def as_dict(x, what):
+            if x is None:
+                return {}
+            if not isinstance(x, dict):
+                problems.append(
+                    f"{where}: {name}: {what} is {type(x).__name__}, "
+                    f"not a mapping")
+                return {}
+            return x
+
+        aff = as_dict(spec_doc.get("affinity"), "affinity")
+        node_aff = as_dict(aff.get("nodeAffinity"), "nodeAffinity")
+        req = as_dict(
+            node_aff.get("requiredDuringSchedulingIgnoredDuringExecution"),
+            "requiredDuringSchedulingIgnoredDuringExecution")
+        raw_terms = req.get("nodeSelectorTerms") or []
+        if not isinstance(raw_terms, list):
+            problems.append(
+                f"{where}: {name}: nodeSelectorTerms is "
+                f"{type(raw_terms).__name__}, not a list")
+            raw_terms = []
+        for term in raw_terms:
+            term = as_dict(term, "nodeSelectorTerm")
+            if term.get("matchFields"):
+                problems.append(
+                    f"{where}: {name}: nodeAffinity matchFields is not "
+                    f"supported by this scheduler — the term will match "
+                    f"no node")
+            raw_exprs = term.get("matchExpressions") or []
+            if not isinstance(raw_exprs, list):
+                problems.append(
+                    f"{where}: {name}: matchExpressions is "
+                    f"{type(raw_exprs).__name__}, not a list")
+                raw_exprs = []
+            for e in raw_exprs:
+                if not isinstance(e, dict):
+                    problems.append(
+                        f"{where}: {name}: matchExpression is "
+                        f"{type(e).__name__}, not a mapping")
+                    continue
+                op = e.get("operator", "")
+                vals = e.get("values") or []
+                if op not in ("In", "NotIn", "Exists", "DoesNotExist",
+                              "Gt", "Lt"):
+                    problems.append(
+                        f"{where}: {name}: nodeAffinity operator {op!r} "
+                        f"(must be In/NotIn/Exists/DoesNotExist/Gt/Lt)")
+                elif op in ("In", "NotIn"):
+                    if not vals:
+                        problems.append(
+                            f"{where}: {name}: nodeAffinity {op} requires "
+                            f"non-empty values — matches nothing as written")
+                    for v in vals:
+                        if not isinstance(v, str):
+                            problems.append(
+                                f"{where}: {name}: nodeAffinity {op} value "
+                                f"{v!r} is {type(v).__name__}, not a string "
+                                f"(quote it — the apiserver rejects "
+                                f"non-strings)")
+                elif op in ("Exists", "DoesNotExist") and vals:
+                    problems.append(
+                        f"{where}: {name}: nodeAffinity {op} must not set "
+                        f"values (apiserver rejects it)")
+                elif op in ("Gt", "Lt"):
+                    if len(vals) != 1 or not str(vals[0]).lstrip("-").isdigit():
+                        problems.append(
+                            f"{where}: {name}: nodeAffinity {op} needs "
+                            f"exactly one integer value, got {vals!r}")
 
     for path in args.manifests:
         with open(path) as f:

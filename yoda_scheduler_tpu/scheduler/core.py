@@ -427,11 +427,12 @@ class Scheduler:
         memo_ok = (not spec.is_gang
                    and (self.allocator is None
                         or self.allocator.nomination_of(pod.key) is None))
-        if pod.node_selector or pod.tolerations:
+        if pod.node_selector or pod.tolerations or pod.node_affinity:
             memo_key = (spec, frozenset(pod.node_selector.items()),
                         tuple((t.get("key", ""), t.get("operator", "Equal"),
                                t.get("value", ""), t.get("effect", ""))
-                              for t in pod.tolerations))
+                              for t in pod.tolerations),
+                        pod.node_affinity)
         else:
             memo_key = spec
         vers = self._cluster_versions()

@@ -50,6 +50,41 @@ def tolerates(toleration: dict, taint: dict) -> bool:
     return toleration.get("value", "") == taint.get("value", "")
 
 
+def _match_expression(labels: dict, key: str, op: str, values: tuple) -> bool:
+    """One nodeAffinity matchExpression vs node labels (k8s semantics)."""
+    present = key in labels
+    if op == "In":
+        return present and labels[key] in values
+    if op == "NotIn":
+        return not present or labels[key] not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    if op in ("Gt", "Lt"):
+        if not present or not values:
+            return False
+        try:
+            node_v = int(labels[key])
+            want = int(values[0])
+        except ValueError:
+            return False
+        return node_v > want if op == "Gt" else node_v < want
+    return False  # unknown operator matches nothing (apiserver rejects it)
+
+
+def affinity_matches(pod: Pod, labels: dict) -> bool:
+    """Required nodeAffinity: terms OR together, expressions within a term
+    AND together; no terms = no constraint."""
+    terms = pod.node_affinity
+    if not terms:
+        return True
+    return any(
+        all(_match_expression(labels, k, op, vals) for k, op, vals in term)
+        for term in terms
+    )
+
+
 def untolerated(pod: Pod, taints: tuple, effects: tuple[str, ...]) -> list[dict]:
     """Taints with an effect in `effects` that no pod toleration covers."""
     tols = pod.tolerations
@@ -63,14 +98,16 @@ def untolerated(pod: Pod, taints: tuple, effects: tuple[str, ...]) -> list[dict]
 def admissible(pod: Pod, node: NodeInfo) -> bool:
     """Would NodeAdmission.filter pass this (pod, node)? Used by the
     preemption planner: evicting victims on a node the preemptor's
-    nodeSelector/tolerations can never accept would disrupt workloads for
-    a pod that stays Pending (upstream preemption re-filters candidate
-    nodes the same way)."""
+    nodeSelector/tolerations/affinity can never accept would disrupt
+    workloads for a pod that stays Pending (upstream preemption re-filters
+    candidate nodes the same way)."""
     if pod.node_selector:
         labels = node.labels
         for k, v in pod.node_selector.items():
             if labels.get(k) != v:
                 return False
+    if not affinity_matches(pod, node.labels):
+        return False
     if node.taints and untolerated(pod, node.taints,
                                    (NO_SCHEDULE, NO_EXECUTE)):
         return False
@@ -83,10 +120,12 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
 
     def relevant(self, pod: Pod, snapshot) -> bool:
         """Hot-loop gate (core.py): on an untainted cluster a pod without a
-        nodeSelector cannot be affected by this plugin, so the engine drops
-        it from the per-(pod, node) filter/score loops. Tolerations alone
-        never change a verdict — they only permit what taints would block."""
-        return bool(pod.node_selector) or snapshot.any_taints()
+        nodeSelector or required nodeAffinity cannot be affected by this
+        plugin, so the engine drops it from the per-(pod, node)
+        filter/score loops. Tolerations alone never change a verdict —
+        they only permit what taints would block."""
+        return (bool(pod.node_selector) or bool(pod.node_affinity)
+                or snapshot.any_taints())
 
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
         sel = pod.node_selector
@@ -96,6 +135,9 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                 if labels.get(k) != v:
                     return Status.unschedulable(
                         f"{node.name}: nodeSelector {k}={v} not satisfied")
+        if pod.node_affinity and not affinity_matches(pod, node.labels):
+            return Status.unschedulable(
+                f"{node.name}: required nodeAffinity not satisfied")
         if node.taints:
             bad = untolerated(pod, node.taints, (NO_SCHEDULE, NO_EXECUTE))
             if bad:

@@ -29,6 +29,55 @@ def format_assigned_chips(coords) -> str:
 
 _uid_counter = itertools.count(1)
 
+# upstream's built-in PriorityClass values (scheduling/v1 defaults)
+_WELL_KNOWN_PRIORITY = {
+    "system-cluster-critical": 2_000_000_000,
+    "system-node-critical": 2_000_001_000,
+}
+
+
+# sentinel expression no node can satisfy (_match_expression returns False
+# for unknown operators): represents terms we cannot evaluate — empty
+# terms (match nothing per the API spec) and matchFields terms (field
+# selectors are not modelled; treating them as match-all would schedule a
+# node-pinned pod anywhere)
+_UNMATCHABLE_EXPR = ("", "__unsupported__", ())
+
+
+def _parse_node_affinity(spec) -> tuple:
+    """spec.affinity.nodeAffinity.requiredDuringSchedulingIgnoredDuring
+    Execution -> tuple of terms (OR of terms), each a tuple of
+    (key, operator, values-tuple) matchExpressions (AND within a term).
+    The preferred... variant is scoring-only upstream and not modelled.
+    Malformed shapes never raise (cli validate reports them); terms that
+    cannot be evaluated parse to an unmatchable sentinel."""
+    def as_dict(x):
+        return x if isinstance(x, dict) else {}
+
+    req = as_dict(as_dict(as_dict(as_dict(spec).get("affinity"))
+                          .get("nodeAffinity"))
+                  .get("requiredDuringSchedulingIgnoredDuringExecution"))
+    raw_terms = req.get("nodeSelectorTerms")
+    terms = []
+    for term in (raw_terms if isinstance(raw_terms, list) else []):
+        term = as_dict(term)
+        exprs = []
+        raw_exprs = term.get("matchExpressions")
+        for e in (raw_exprs if isinstance(raw_exprs, list) else []):
+            if not isinstance(e, dict):
+                exprs.append(_UNMATCHABLE_EXPR)
+                continue
+            vals = e.get("values")
+            exprs.append((str(e.get("key", "")), str(e.get("operator", "")),
+                          tuple(str(v) for v in vals)
+                          if isinstance(vals, list) else ()))
+        if term.get("matchFields"):
+            exprs.append(_UNMATCHABLE_EXPR)
+        if not exprs:
+            exprs.append(_UNMATCHABLE_EXPR)  # empty term matches nothing
+        terms.append(tuple(exprs))
+    return tuple(terms)
+
 
 @dataclass
 class Pod:
@@ -51,12 +100,16 @@ class Pod:
     # capacity in the cache but are never scheduled or re-evicted, and a
     # preemptor's nomination hold survives while its victims drain.
     terminating: bool = False
-    # spec.nodeSelector / spec.tolerations: the reference ran inside full
-    # kube-scheduler, so its users got upstream NodeAffinity/TaintToleration
-    # admission for free alongside the yoda plugin; the standalone engine
-    # must provide the same contract (plugins/admission.py)
+    # spec.nodeSelector / spec.tolerations / required nodeAffinity: the
+    # reference ran inside full kube-scheduler, so its users got upstream
+    # NodeAffinity/TaintToleration admission for free alongside the yoda
+    # plugin; the standalone engine must provide the same contract
+    # (plugins/admission.py). node_affinity is the required-during-
+    # scheduling term list: a tuple of terms (OR), each a tuple of
+    # (key, operator, values) expressions (AND).
     node_selector: dict[str, str] = field(default_factory=dict)
     tolerations: tuple = ()
+    node_affinity: tuple = ()
     created: float = field(default_factory=time.time)
 
     @property
@@ -84,10 +137,26 @@ class Pod:
         """Build from a parsed Kubernetes Pod manifest dict."""
         meta = manifest.get("metadata", {})
         spec = manifest.get("spec", {})
+        labels = dict(meta.get("labels", {}))
+        # priority resolution: the scv/priority label (reference contract)
+        # wins; otherwise spec.priority (the integer the apiserver resolves
+        # from priorityClassName) or the two well-known system classes feed
+        # the SAME label so every consumer (queue sort, preemption,
+        # validate) sees one source of truth. Cache-local only — nothing
+        # writes the label back to the API server.
+        from .labels import PRIORITY_LABEL
+
+        if PRIORITY_LABEL not in labels:
+            prio = spec.get("priority")
+            if prio is None:
+                prio = _WELL_KNOWN_PRIORITY.get(
+                    spec.get("priorityClassName", ""))
+            if isinstance(prio, int) and not isinstance(prio, bool):
+                labels[PRIORITY_LABEL] = str(prio)
         return cls(
             name=meta.get("name", "pod"),
             namespace=meta.get("namespace", "default"),
-            labels=dict(meta.get("labels", {})),
+            labels=labels,
             scheduler_name=spec.get("schedulerName", "default-scheduler"),
             node=spec.get("nodeName"),
             k8s_uid=meta.get("uid", ""),
@@ -106,4 +175,5 @@ class Pod:
                 }
                 for t in spec.get("tolerations", []) or []
             ),
+            node_affinity=_parse_node_affinity(spec),
         )
