@@ -28,7 +28,7 @@ def _key(obj: dict) -> str:
 
 
 class FakeApiState:
-    KINDS = ("pods", "nodes", "metrics")
+    KINDS = ("pods", "nodes", "metrics", "poddisruptionbudgets")
 
     def __init__(self):
         self.cond = threading.Condition()
@@ -92,6 +92,14 @@ class FakeApiState:
             self.faults.append([path_substring, status, times, method])
 
     # ------------------------------------------------------------- helpers
+    def add_pdb(self, name: str, match_labels: dict, min_available: int,
+                namespace: str = "default") -> None:
+        self.upsert("poddisruptionbudgets", {
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"selector": {"matchLabels": dict(match_labels)},
+                     "minAvailable": min_available},
+        })
+
     def add_node(self, name: str, labels: dict | None = None,
                  taints: list | None = None) -> None:
         obj: dict = {"metadata": {"name": name}}
@@ -184,6 +192,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif base.startswith("/apis/metrics.yoda.tpu/") and base.endswith(
                 "tpunodemetrics"):
             kind = "metrics"
+        elif base == "/apis/policy/v1/poddisruptionbudgets":
+            kind = "poddisruptionbudgets"
         if kind is not None and method == "GET":
             if q.get("watch", ["false"])[0] == "true":
                 return self._watch(kind, q)

@@ -1,0 +1,278 @@
+"""PodDisruptionBudget-aware preemption and descheduling.
+
+Upstream kube-scheduler minimizes PDB violations when choosing preemption
+victims (best-effort, never an absolute veto); the k8s descheduler refuses
+violating evictions outright because its moves are optional. The reference
+inherited the former by embedding kube-scheduler; this suite locks both
+behaviors into the standalone engine (utils/pdb.py, plugins/preempt.py,
+scheduler/deschedule.py) plus the watch-cache ingestion path.
+"""
+
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.pdb import DisruptionBudget, DisruptionLedger
+
+
+def budget(name="b", labels=None, min_available=None, max_unavailable=None):
+    return DisruptionBudget(
+        name=name,
+        match_labels=frozenset((labels or {"app": "serve"}).items()),
+        min_available=min_available, max_unavailable=max_unavailable)
+
+
+def pod(name, labels=None, prio="0", chips="1"):
+    return Pod(name, labels={"scv/number": chips, "scv/priority": prio,
+                             **(labels or {})})
+
+
+class TestLedger:
+    def test_min_available_allowance(self):
+        pods = [pod(f"p{i}", {"app": "serve"}) for i in range(3)]
+        led = DisruptionLedger([budget(min_available=2)], pods)
+        assert led.violations_for([pods[0]]) == 0
+        assert led.violations_for(pods[:2]) == 1  # 3 - 2 evicted < 2
+
+    def test_max_unavailable_counts_terminating(self):
+        pods = [pod(f"p{i}", {"app": "serve"}) for i in range(3)]
+        pods[0].terminating = True
+        led = DisruptionLedger([budget(max_unavailable=1)], pods)
+        # the terminating pod already consumed the single disruption
+        assert led.violations_for([pods[1]]) == 1
+
+    def test_consume_carries_between_hosts(self):
+        pods = [pod(f"p{i}", {"app": "serve"}) for i in range(4)]
+        led = DisruptionLedger([budget(min_available=2)], pods)
+        assert led.violations_for([pods[0]]) == 0
+        led.consume([pods[0], pods[1]])
+        assert led.violations_for([pods[2]]) == 1
+        assert led.would_violate(pods[2])
+
+    def test_missing_selector_matches_nothing(self):
+        b = DisruptionBudget.from_manifest({
+            "metadata": {"name": "none"}, "spec": {"minAvailable": 1}})
+        assert not b.matches(pod("p", {"app": "serve"}))
+
+    def test_empty_selector_matches_all_in_namespace(self):
+        # policy/v1: selector {} selects EVERY pod in the namespace
+        b = DisruptionBudget.from_manifest({
+            "metadata": {"name": "all"},
+            "spec": {"selector": {}, "minAvailable": 1}})
+        assert b.matches(pod("p", {"app": "serve"}))
+        assert b.matches(pod("q"))
+        assert not b.matches(Pod("other-ns", namespace="prod",
+                                 labels={"scv/number": "1"}))
+
+    def test_match_expressions(self):
+        b = DisruptionBudget.from_manifest({
+            "metadata": {"name": "expr"},
+            "spec": {"selector": {
+                "matchLabels": {"app": "serve"},
+                "matchExpressions": [
+                    {"key": "tier", "operator": "In", "values": ["canary"]},
+                ]}, "minAvailable": 1}})
+        assert b.matches(pod("p", {"app": "serve", "tier": "canary"}))
+        assert not b.matches(pod("q", {"app": "serve"}))
+
+    def test_greedy_victim_choice_avoids_second_violation(self):
+        """Working-allowance ordering: needing 2 victims from
+        {serve-A, serve-B, batch-C} with serve allowance 1 must pick one
+        serve + batch (0 violations), never both serve replicas."""
+        from yoda_scheduler_tpu.utils.pdb import DisruptionLedger
+
+        a = pod("serve-a", {"app": "serve"})
+        bq = pod("serve-b", {"app": "serve"})
+        c_ = pod("batch-c", prio="5")
+        led = DisruptionLedger([budget(min_available=1)], [a, bq, c_])
+        t = led.tracker()
+        picks = []
+        pool = [a, bq, c_]
+        for _ in range(2):
+            v = min(pool, key=lambda p: (t.would_violate(p), 0))
+            pool.remove(v)
+            t.consume_one(v)
+            picks.append(v)
+        assert c_ in picks, "second pick must avoid draining the budget"
+
+    def test_percentage_budget_unevaluable(self):
+        b = DisruptionBudget.from_manifest({
+            "metadata": {"name": "pct"},
+            "spec": {"selector": {"matchLabels": {"app": "serve"}},
+                     "minAvailable": "50%"}})
+        assert b.min_available is None
+        led = DisruptionLedger([b], [pod("p", {"app": "serve"})])
+        assert led.violations_for([pod("q", {"app": "serve"})]) == 0
+
+    def test_from_manifest_integers(self):
+        b = DisruptionBudget.from_manifest({
+            "metadata": {"name": "x", "namespace": "prod"},
+            "spec": {"selector": {"matchLabels": {"app": "s"}},
+                     "maxUnavailable": 1}})
+        assert b.namespace == "prod" and b.max_unavailable == 1
+        assert b.matches(Pod("p", namespace="prod",
+                             labels={"app": "s", "scv/number": "1"}))
+
+
+def _cluster(nodes, chips=4):
+    store = TelemetryStore()
+    now = time.time()
+    for n in nodes:
+        m = make_tpu_node(n, chips=chips)
+        m.heartbeat = now + 1e8
+        store.put(m)
+    c = FakeCluster(store)
+    c.add_nodes_from_telemetry()
+    return c
+
+
+class TestPreemptionWithBudgets:
+    def test_prefers_non_violating_node(self):
+        """Two full nodes; evicting from node 'a' violates the serving
+        budget, evicting from 'b' does not — preemption must pick 'b'
+        even though both plans are equal-size and equal-priority."""
+        c = _cluster(["a", "b"], chips=1)
+        c.set_pdbs([budget(min_available=1)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3))
+        protected = pod("serve-1", {"app": "serve"})  # only replica
+        plain = pod("batch-1")
+        sched.submit(protected)
+        sched.submit(plain)
+        sched.run_until_idle()
+        plain_node = plain.node  # eviction clears the victim's node field
+        assert plain_node is not None and plain_node != protected.node
+        hp = pod("hp", prio="9")
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND
+        assert hp.node == plain_node, \
+            "preemption must choose the non-violating victim's node"
+        assert protected.phase == PodPhase.BOUND
+
+    def test_violates_when_no_alternative(self):
+        """Upstream parity: PDBs are best-effort in preemption — when the
+        ONLY plan violates a budget, the preemptor still places."""
+        c = _cluster(["a"], chips=1)
+        c.set_pdbs([budget(min_available=1)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3))
+        protected = pod("serve-1", {"app": "serve"})
+        sched.submit(protected)
+        sched.run_until_idle()
+        hp = pod("hp", prio="9")
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND and hp.node == "a"
+
+    def test_victim_order_prefers_unprotected(self):
+        """On one node with a protected and an unprotected equal-priority
+        pod, the single-victim plan must evict the unprotected one."""
+        c = _cluster(["a"], chips=2)
+        c.set_pdbs([budget(min_available=1)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3))
+        protected = pod("serve-1", {"app": "serve"})
+        plain = pod("batch-1")
+        sched.submit(protected)
+        sched.submit(plain)
+        sched.run_until_idle()
+        hp = pod("hp", prio="9")
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND
+        assert protected.phase == PodPhase.BOUND, \
+            "the budget-protected pod must not be the chosen victim"
+
+    def test_pdb_change_invalidates_memo(self):
+        """set_pdbs bumps the version vector: a pod memoized unschedulable
+        must be re-evaluated after budgets change."""
+        c = _cluster(["a"], chips=1)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             preemption=False,
+                                             max_attempts=0))
+        filler = pod("filler")
+        sched.submit(filler)
+        sched.run_until_idle()
+        waiter = pod("waiter")
+        sched.submit(waiter)
+        for _ in range(2):
+            sched.run_one()
+        v0 = sched.metrics.counters.get("unsched_memo_hits_total", 0)
+        c.set_pdbs([budget(min_available=1)])
+        sched.run_one()
+        assert sched.metrics.counters.get(
+            "unsched_memo_hits_total", 0) == v0, \
+            "budget change must invalidate the unschedulable-class memo"
+
+
+class TestDeschedulerRespectsBudgets:
+    def test_defrag_never_violates(self):
+        """A stray pod denting a gang slice would normally be moved; with
+        a budget making it the last healthy replica, the move is vetoed."""
+        from yoda_scheduler_tpu.scheduler.deschedule import Descheduler
+        from yoda_scheduler_tpu.telemetry import make_v4_slice
+
+        store = TelemetryStore()
+        now = time.time()
+        for m in make_v4_slice("s", "2x2x4"):
+            m.heartbeat = now + 1e8
+            store.put(m)
+        spare = make_tpu_node("standalone", chips=4)
+        spare.heartbeat = now + 1e8
+        store.put(spare)
+        c = FakeCluster(store)
+        c.add_nodes_from_telemetry()
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        # plant the stray ON the slice host (the scheduler itself would
+        # prefer the standalone node — that avoidance is the very reason
+        # the descheduler wants the stray gone once it's there)
+        stray = pod("stray", {"app": "serve"})
+        c.bind(stray, "s-host-0", [(0, 0, 0)])
+        d = Descheduler(sched)
+        # without a budget the stray moves off the slice
+        c.set_pdbs([budget(min_available=1)])
+        plan = d.plan()
+        assert stray not in plan.victims, \
+            "optional defrag move must not breach the disruption budget"
+        c.set_pdbs([])
+        plan = d.plan()
+        assert stray in plan.victims
+
+
+class TestWatchIngestion:
+    def test_pdbs_flow_through_watch_cache(self):
+        import threading
+
+        from fake_apiserver import FakeApiServer
+        from yoda_scheduler_tpu.k8s.client import KubeClient, KubeCluster
+
+        with FakeApiServer() as server:
+            server.state.add_node("n1")
+            server.state.add_pdb("serve-pdb", {"app": "serve"}, 2)
+            client = KubeClient(server.url)
+            cluster = KubeCluster(client, TelemetryStore())
+            cluster.start()
+            try:
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if cluster.disruption_budgets():
+                        break
+                    time.sleep(0.02)
+                budgets = cluster.disruption_budgets()
+                assert len(budgets) == 1
+                assert budgets[0].name == "serve-pdb"
+                assert budgets[0].min_available == 2
+                v0 = cluster.nodes_version
+                # live update arrives as a watch event and bumps the vector
+                server.state.add_pdb("serve-pdb", {"app": "serve"}, 1)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if cluster.disruption_budgets()[0].min_available == 1:
+                        break
+                    time.sleep(0.02)
+                assert cluster.disruption_budgets()[0].min_available == 1
+                assert cluster.nodes_version > v0
+            finally:
+                cluster.stop()

@@ -450,3 +450,45 @@ spec:
         assert "matchFields is not supported" in out
         assert "not a string" in out
         assert "Traceback" not in out
+
+    def test_pdb_lint(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: policy/v1
+kind: PodDisruptionBudget
+metadata: {name: pct}
+spec:
+  selector: {matchLabels: {app: serve}}
+  minAvailable: 50%
+---
+apiVersion: policy/v1
+kind: PodDisruptionBudget
+metadata: {name: noselector}
+spec:
+  minAvailable: 1
+---
+apiVersion: policy/v1
+kind: PodDisruptionBudget
+metadata: {name: badexpr}
+spec:
+  selector:
+    matchExpressions:
+      - {key: tier, operator: Inn, values: [canary]}
+  minAvailable: 1
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "percentage" in out
+        assert "selects no pods" in out
+        assert "operator 'Inn'" in out
+
+    def test_valid_pdb_passes(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: policy/v1
+kind: PodDisruptionBudget
+metadata: {name: ok}
+spec:
+  selector: {matchLabels: {app: serve}}
+  minAvailable: 2
+""")
+        out = capsys.readouterr().out
+        assert rc == 0 and "OK" in out

@@ -363,6 +363,40 @@ def cmd_validate(args) -> int:
                           count=replicas)
                     check_spec(meta.get("name", "deploy"),
                                tmpl.get("spec") or {}, path)
+                elif kind == "PodDisruptionBudget":
+                    name = meta.get("name", "pdb")
+                    pspec = doc.get("spec") or {}
+                    if not isinstance(pspec, dict):
+                        problems.append(f"{path}: {name}: spec is "
+                                        f"{type(pspec).__name__}, not a mapping")
+                        continue
+                    for fld in ("minAvailable", "maxUnavailable"):
+                        v = pspec.get(fld)
+                        if v is not None and not (
+                                isinstance(v, int) and not isinstance(v, bool)):
+                            problems.append(
+                                f"{path}: {name}: {fld}={v!r} — percentage "
+                                f"budgets need the controller's scale "
+                                f"resolution; this scheduler evaluates only "
+                                f"integer budgets, so this one protects "
+                                f"nothing")
+                    sel = pspec.get("selector")
+                    if sel is None:
+                        # policy/v1: selector {} selects ALL pods in the
+                        # namespace (legal, no lint); a MISSING selector
+                        # selects none
+                        problems.append(
+                            f"{path}: {name}: no selector — selects no pods")
+                    elif isinstance(sel, dict):
+                        for e in (sel.get("matchExpressions") or []):
+                            op = (e or {}).get("operator", "") \
+                                if isinstance(e, dict) else ""
+                            if op not in ("In", "NotIn", "Exists",
+                                          "DoesNotExist"):
+                                problems.append(
+                                    f"{path}: {name}: matchExpressions "
+                                    f"operator {op!r} (must be In/NotIn/"
+                                    f"Exists/DoesNotExist)")
     for gang, sizes in gang_sizes.items():
         if len(sizes) > 1:
             problems.append(

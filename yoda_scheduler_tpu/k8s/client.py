@@ -43,6 +43,7 @@ from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chi
 log = logging.getLogger("yoda-tpu.k8s")
 
 METRICS_PATH = f"/apis/{CRD_GROUP}/{CRD_VERSION}/{CRD_PLURAL}"
+PDB_PATH = "/apis/policy/v1/poddisruptionbudgets"
 
 # transient statuses worth retrying: throttled, server hiccups, gateway
 _RETRYABLE = {429, 500, 502, 503, 504}
@@ -492,6 +493,7 @@ class KubeCluster:
         self._lock = threading.RLock()
         self._nodes: set[str] = set()
         self._node_meta: dict[str, tuple[dict, tuple]] = {}  # name -> (labels, taints)
+        self._pdbs: tuple = ()                   # DisruptionBudget models
         self._pods: dict[str, Pod] = {}          # key -> non-terminal pod
         self._by_node: dict[str, dict[str, Pod]] = {}  # node -> key -> pod
         self._pods_ver: dict[str, int] = {}      # node -> change counter
@@ -512,6 +514,9 @@ class KubeCluster:
                           relist_s=relist_s),
                 Reflector(client, METRICS_PATH,
                           self._replace_metrics, self._metrics_event,
+                          relist_s=relist_s),
+                Reflector(client, PDB_PATH,
+                          self._replace_pdbs, self._pdb_event,
                           relist_s=relist_s),
             ]
 
@@ -645,6 +650,36 @@ class KubeCluster:
         for node in set(self.telemetry.nodes()) - seen:
             self.telemetry.delete(node)
 
+    def _replace_pdbs(self, items: list[dict]) -> None:
+        from ..utils.pdb import DisruptionBudget
+
+        budgets = tuple(DisruptionBudget.from_manifest(i) for i in items)
+        with self._lock:
+            # set comparison: a relist returns API order while the event
+            # path appends — same content must not bump the version
+            if frozenset(budgets) != frozenset(self._pdbs):
+                # allowance changes can unblock pods whose preemption had
+                # no non-violating plan: invalidate via membership version
+                # (same vector the unschedulable memo keys on)
+                self._nodes_ver += 1
+            self._pdbs = budgets
+
+    def _pdb_event(self, typ: str, obj: dict) -> None:
+        from ..utils.pdb import DisruptionBudget
+
+        b = DisruptionBudget.from_manifest(obj)
+        with self._lock:
+            rest = tuple(p for p in self._pdbs
+                         if (p.namespace, p.name) != (b.namespace, b.name))
+            budgets = rest if typ == "DELETED" else rest + (b,)
+            if frozenset(budgets) != frozenset(self._pdbs):
+                self._nodes_ver += 1
+            self._pdbs = budgets
+
+    def disruption_budgets(self) -> tuple:
+        with self._lock:
+            return self._pdbs
+
     def _replace_metrics(self, items: list[dict]) -> None:
         self._apply_metrics([TpuNodeMetrics.from_cr(i) for i in items])
 
@@ -666,6 +701,11 @@ class KubeCluster:
         self._replace_nodes(node_doc.get("items", []))
         self._replace_pods(pod_doc.get("items", []))
         self._apply_metrics(metrics)
+        try:
+            pdb_doc = self.client.list_all(PDB_PATH)
+        except ApiError:
+            pdb_doc = {}  # control planes without the policy API group
+        self._replace_pdbs(pdb_doc.get("items", []))
 
     def start(self) -> None:
         if self.watch_mode:

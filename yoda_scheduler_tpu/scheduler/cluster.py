@@ -43,6 +43,7 @@ class FakeCluster:
         self._nodes: set[str] = set()
         self._bound: dict[str, list[Pod]] = {}  # node -> pods
         self._meta: dict[str, tuple[dict, tuple]] = {}  # node -> (labels, taints)
+        self._pdbs: tuple = ()
         # monotonic per-node change counter (bind/evict/removal): lets the
         # scheduler reuse per-node snapshot state across cycles — a bind
         # invalidates one node, not the whole cluster
@@ -104,6 +105,18 @@ class FakeCluster:
             p.node = None
             p.phase = PodPhase.PENDING
         return orphans
+
+    def set_pdbs(self, budgets) -> None:
+        """Install the cluster's PodDisruptionBudgets (utils/pdb.py model).
+        Bumps the global change log: allowance changes can unblock pods
+        whose preemption previously had no non-violating plan."""
+        with self._lock:
+            self._pdbs = tuple(budgets)
+            self._nodes_ver += 1
+
+    def disruption_budgets(self) -> tuple:
+        with self._lock:
+            return self._pdbs
 
     def set_node_meta(self, name: str, labels: dict[str, str] | None = None,
                       taints: list[dict] | tuple = ()) -> None:

@@ -312,7 +312,8 @@ class Scheduler:
                             key = (getattr(ni.metrics, "generation", None),
                                    pods_version(name))
                             self._ni_cache[name] = (key, ni)
-                    fresh = Snapshot(infos)
+                    # membership version unchanged here, so budgets are too
+                    fresh = Snapshot(infos, budgets=snap.budgets)
                     # carry the any-taints fact: only dirty nodes can have
                     # introduced a taint (a removal leaves the conservative
                     # True, costing nothing but the skipped optimization)
@@ -385,7 +386,9 @@ class Scheduler:
                     if forget is not None:
                         forget(gone)
         self._known_nodes = set(infos)
-        snap = Snapshot(infos)
+        budgets_fn = getattr(cluster, "disruption_budgets", None)
+        snap = Snapshot(infos,
+                        budgets=budgets_fn() if budgets_fn is not None else ())
         if pre is not None:
             self._snap = (snap, pre[0], pre[1], pre[2])
         return snap

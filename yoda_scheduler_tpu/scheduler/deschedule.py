@@ -62,8 +62,19 @@ class Descheduler:
 
     # ------------------------------------------------------------------ plan
     def plan(self) -> DeschedulePlan:
+        from ..utils.pdb import DisruptionLedger
+
         plan = DeschedulePlan()
         snapshot = self.sched.snapshot()
+        # Defrag moves are OPTIONAL work: unlike preemption (which may
+        # violate a budget when nothing else places the pod), a move that
+        # would breach a PodDisruptionBudget is simply not worth making —
+        # hard veto, upstream-descheduler semantics. The ledger is consumed
+        # as the plan grows so a pass can't spend one budget twice.
+        budgets = getattr(snapshot, "budgets", ())
+        ledger = DisruptionLedger(
+            budgets,
+            [p for ni in snapshot.list() for p in ni.pods] if budgets else ())
         # (pod, node, reason, is_defrag): defrag (strategy-2) benefit is
         # computed against the node's CURRENT free set, so at most one
         # defrag victim per node per pass — the first eviction may already
@@ -131,6 +142,8 @@ class Descheduler:
                 continue  # benefit already claimed by this pass's eviction
             if now - self._recent.get(pod.key, -1e18) < self.cooldown_s:
                 continue  # recently moved; don't thrash the workload
+            if ledger.would_violate(pod):
+                continue  # optional move never breaches a disruption budget
             dest = self._fits_elsewhere(pod, node, snapshot, planned)
             if dest is not None:
                 if is_defrag:
@@ -141,6 +154,7 @@ class Descheduler:
                     pass
                 plan.victims.append(pod)
                 plan.reasons[pod.key] = reason
+                ledger.consume([pod])
         return plan
 
     def _movable(self, pod: Pod) -> bool:

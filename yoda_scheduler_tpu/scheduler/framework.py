@@ -181,8 +181,14 @@ class NodeInfo:
 class Snapshot:
     """Immutable-ish view of cluster + telemetry taken at cycle start."""
 
-    def __init__(self, node_infos: dict[str, NodeInfo]) -> None:
+    def __init__(self, node_infos: dict[str, NodeInfo],
+                 budgets: tuple = ()) -> None:
         self._node_infos = node_infos
+        # PodDisruptionBudgets in force this cycle (utils/pdb.py model);
+        # preemption consults them when ranking victim plans. A budget
+        # change bumps the cluster's membership version, so incremental
+        # snapshots never carry stale budgets.
+        self.budgets = budgets
         # lazily-computed cluster facts used for plugin relevance gating
         # (core.py builds the per-cycle active-plugin lists from them);
         # incremental snapshots inherit the value from their parent when

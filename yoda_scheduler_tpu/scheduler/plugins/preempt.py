@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from ..framework import CycleState, NodeInfo, PostFilterPlugin, Snapshot, Status
 from ...utils.labels import GANG_NAME_LABEL, LabelError, WorkloadSpec, spec_for
+from ...utils.pdb import DisruptionLedger
 from ...utils.pod import Pod
 from .admission import admissible
 from .allocator import ChipAllocator
@@ -59,10 +60,19 @@ class PriorityPreemption(PostFilterPlugin):
         spec: WorkloadSpec = state.read("workload_spec")
         now = state.read_or("now")
         my_prio = _priority(pod)
+        # PDB allowance accounting over the whole cluster's bound pods
+        # (upstream parity: violations are minimized, never an absolute
+        # veto — see utils/pdb.py). The cluster-wide pod walk only happens
+        # when budgets actually exist.
+        ledger = DisruptionLedger(
+            snapshot.budgets,
+            [p for ni in snapshot.list() for p in ni.pods]
+            if snapshot.budgets else ())
         if spec.is_gang:
             return self._gang_post_filter(state, spec, my_prio, pod,
-                                          snapshot, now)
-        # minimal disruption: fewest victims, then lowest max victim priority
+                                          snapshot, now, ledger)
+        # minimal disruption: no-PDB-violation plans always win, then
+        # fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
         for node in snapshot.list():
             # never plan evictions on a node the preemptor itself cannot
@@ -71,10 +81,11 @@ class PriorityPreemption(PostFilterPlugin):
             if not admissible(pod, node):
                 continue
             plan = self._plan_eviction(spec, my_prio, node, now=now,
-                                       pod_key=pod.key)
+                                       pod_key=pod.key, ledger=ledger)
             if plan is None:
                 continue
-            key = (len(plan), max(_priority(v) for v in plan), node.name)
+            key = (ledger.violations_for(plan), len(plan),
+                   max(_priority(v) for v in plan), node.name)
             if best is None or key < best[0]:
                 best = (key, node.name, plan)
         if best is None:
@@ -86,7 +97,8 @@ class PriorityPreemption(PostFilterPlugin):
 
     def _gang_post_filter(self, state: CycleState, spec: WorkloadSpec,
                           my_prio: int, pod: Pod, snapshot: Snapshot,
-                          now) -> tuple[str | None, list[Pod], Status]:
+                          now, ledger: DisruptionLedger
+                          ) -> tuple[str | None, list[Pod], Status]:
         """All-or-nothing slice eviction for a gang (VERDICT r2 item 4b —
         the workload MOST likely to find its slice dented by low-priority
         singles is the one that previously could neither evict them nor go
@@ -147,25 +159,32 @@ class PriorityPreemption(PostFilterPlugin):
             for host in hosts:
                 if host.name in covered:
                     continue
-                victims = self._plan_node(spec, my_prio, host, pod_key=pod.key)
+                victims = self._plan_node(spec, my_prio, host, pod_key=pod.key,
+                                          ledger=ledger)
                 if victims is None:
                     continue  # this host can't reach spec.chips at all
-                plans.append((len(victims),
+                # per-host cost leads with this host's own PDB violations
+                # so the `need`-cheapest hosts prefer non-violating ones
+                plans.append((ledger.violations_for(victims), len(victims),
                               max((_priority(v) for v in victims), default=-1),
                               host.name, victims))
             if len(plans) < need:
                 continue  # not enough viable hosts even with evictions
             plans.sort()
             chosen = plans[:need]
-            victims = [v for _, _, _, vs in chosen for v in vs]
+            victims = [v for _, _, _, _, vs in chosen for v in vs]
             if not victims:
                 # every chosen host already fits without evicting: the
                 # gang's infeasibility has a non-capacity cause preemption
                 # cannot cure
                 continue
-            key = (len(victims), max(_priority(v) for v in victims), sid)
+            # slice cost uses the COMBINED victim set: per-budget demand
+            # aggregates across hosts, so two hosts each within allowance
+            # can still violate together
+            key = (ledger.violations_for(victims), len(victims),
+                   max(_priority(v) for v in victims), sid)
             if best is None or key < best[0]:
-                best = (key, chosen[0][2], victims)
+                best = (key, chosen[0][3], victims)
         if best is None:
             return None, [], Status.unschedulable(
                 f"preemption: no slice can host gang {spec.gang_name} even "
@@ -175,7 +194,9 @@ class PriorityPreemption(PostFilterPlugin):
 
     def _plan_eviction(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
                        now: float | None = None,
-                       pod_key: str | None = None) -> list[Pod] | None:
+                       pod_key: str | None = None,
+                       ledger: DisruptionLedger | None = None
+                       ) -> list[Pod] | None:
         """Smallest non-empty victim set on this node that frees enough
         qualifying chips; victims chosen lowest-priority-first. None if
         impossible — or if no eviction is needed at all, in which case the
@@ -188,11 +209,14 @@ class PriorityPreemption(PostFilterPlugin):
             return None
         if spec.accelerator is not None and m.accelerator != spec.accelerator:
             return None
-        victims = self._plan_node(spec, my_prio, node, pod_key=pod_key)
+        victims = self._plan_node(spec, my_prio, node, pod_key=pod_key,
+                                  ledger=ledger)
         return victims or None
 
     def _plan_node(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
-                   pod_key: str | None = None) -> list[Pod] | None:
+                   pod_key: str | None = None,
+                   ledger: DisruptionLedger | None = None
+                   ) -> list[Pod] | None:
         """Victims on this node that free `spec.chips` qualifying chips:
         [] when the node already fits without evicting, None when it cannot
         reach the target at all. Shared by the single-pod path and the
@@ -221,12 +245,26 @@ class PriorityPreemption(PostFilterPlugin):
             return None
         if len(ok_coords) - hold < spec.chips:
             return None
+        # budget-protected victims go LAST (upstream's victim ordering:
+        # prefer evictions that violate no PDB), then lowest priority
+        # first. The protection check runs against a WORKING allowance
+        # copy that each pick consumes — a static snapshot would let two
+        # same-budget picks drain an allowance of one without either
+        # looking protected, taking an avoidable violation.
         pool.sort(key=_priority)
+        tracker = (ledger.tracker()
+                   if ledger is not None and ledger.budgets else None)
         victims: list[Pod] = []
         while len(free & ok_coords) - hold < spec.chips:
             if not pool:
                 return None
-            v = pool.pop(0)
+            if tracker is None:
+                v = pool.pop(0)
+            else:
+                v = min(pool,
+                        key=lambda p: (tracker.would_violate(p), _priority(p)))
+                pool.remove(v)
+                tracker.consume_one(v)
             victims.append(v)
             free = free | v.assigned_chips()
         return victims
