@@ -449,3 +449,57 @@ class TestSpecPriority:
                              "values": [5]}]}]}}}},
         })
         assert affinity_matches(pod, {"gen": "5"})
+
+
+class TestPreferredAffinity:
+    def _pod(self, prefs):
+        return Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution":
+                        prefs}}},
+        })
+
+    def test_weighted_score(self):
+        p = NodeAdmission()
+        pod = self._pod([
+            {"weight": 50, "preference": {"matchExpressions": [
+                {"key": "pool", "operator": "In", "values": ["gold"]}]}},
+            {"weight": 10, "preference": {"matchExpressions": [
+                {"key": "zone", "operator": "Exists"}]}},
+        ])
+        both, _ = p.score(CycleState(), pod,
+                          ni(labels={"pool": "gold", "zone": "a"}))
+        one, _ = p.score(CycleState(), pod, ni(labels={"zone": "a"}))
+        none, _ = p.score(CycleState(), pod, ni())
+        assert (both, one, none) == (60.0, 10.0, 0.0)
+
+    def test_scheduler_prefers_weighted_node(self):
+        c = _cluster(["plain", "preferred"])
+        c.set_node_meta("preferred", labels={"pool": "gold"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = self._pod([{"weight": 100, "preference": {"matchExpressions": [
+            {"key": "pool", "operator": "In", "values": ["gold"]}]}}])
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "preferred"
+
+    def test_preference_never_blocks(self):
+        # no node matches the preference: the pod still binds somewhere
+        c = _cluster(["a"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = self._pod([{"weight": 100, "preference": {"matchExpressions": [
+            {"key": "pool", "operator": "In", "values": ["gold"]}]}}])
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND
+
+    def test_malformed_entries_dropped(self):
+        pod = self._pod([
+            {"weight": "high", "preference": {}},   # non-int weight
+            {"weight": 500, "preference": {}},      # out of API range
+            {"weight": 0, "preference": {}},        # out of API range
+            "notadict",
+        ])
+        assert pod.preferred_affinity == ()

@@ -492,3 +492,46 @@ spec:
 """)
         out = capsys.readouterr().out
         assert rc == 0 and "OK" in out
+
+    def test_preferred_affinity_lint(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badpref
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      preferredDuringSchedulingIgnoredDuringExecution:
+        - weight: 500
+          preference:
+            matchExpressions:
+              - {key: pool, operator: In, values: [gold]}
+        - weight: 10
+          preference:
+            matchExpressions:
+              - {key: pool, operator: Inn, values: [gold]}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "weight 500" in out
+        assert "operator 'Inn'" in out
+
+    def test_preferred_affinity_missing_preference(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: nopref
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      preferredDuringSchedulingIgnoredDuringExecution:
+        - weight: 50
+""")
+        out = capsys.readouterr().out
+        assert rc == 1 and "no preference.matchExpressions" in out

@@ -283,14 +283,8 @@ def cmd_validate(args) -> int:
         req = as_dict(
             node_aff.get("requiredDuringSchedulingIgnoredDuringExecution"),
             "requiredDuringSchedulingIgnoredDuringExecution")
-        raw_terms = req.get("nodeSelectorTerms") or []
-        if not isinstance(raw_terms, list):
-            problems.append(
-                f"{where}: {name}: nodeSelectorTerms is "
-                f"{type(raw_terms).__name__}, not a list")
-            raw_terms = []
-        for term in raw_terms:
-            term = as_dict(term, "nodeSelectorTerm")
+        def lint_term(term, what):
+            term = as_dict(term, what)
             if term.get("matchFields"):
                 problems.append(
                     f"{where}: {name}: nodeAffinity matchFields is not "
@@ -336,6 +330,39 @@ def cmd_validate(args) -> int:
                         problems.append(
                             f"{where}: {name}: nodeAffinity {op} needs "
                             f"exactly one integer value, got {vals!r}")
+
+        raw_terms = req.get("nodeSelectorTerms") or []
+        if not isinstance(raw_terms, list):
+            problems.append(
+                f"{where}: {name}: nodeSelectorTerms is "
+                f"{type(raw_terms).__name__}, not a list")
+            raw_terms = []
+        for term in raw_terms:
+            lint_term(term, "nodeSelectorTerm")
+        raw_prefs = node_aff.get(
+            "preferredDuringSchedulingIgnoredDuringExecution") or []
+        if not isinstance(raw_prefs, list):
+            problems.append(
+                f"{where}: {name}: preferredDuringScheduling... is "
+                f"{type(raw_prefs).__name__}, not a list")
+            raw_prefs = []
+        for pref in raw_prefs:
+            pref = as_dict(pref, "preferred nodeAffinity entry")
+            w = pref.get("weight")
+            if not (isinstance(w, int) and not isinstance(w, bool)
+                    and 1 <= w <= 100):
+                problems.append(
+                    f"{where}: {name}: preferred nodeAffinity weight "
+                    f"{w!r} (must be an integer in 1-100)")
+            preference = pref.get("preference")
+            if not preference or not isinstance(preference, dict) \
+                    or not preference.get("matchExpressions"):
+                problems.append(
+                    f"{where}: {name}: preferred nodeAffinity entry has "
+                    f"no preference.matchExpressions — it can never match "
+                    f"(the apiserver requires a preference)")
+            else:
+                lint_term(preference, "preference")
 
     for path in args.manifests:
         with open(path) as f:

@@ -120,12 +120,12 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
 
     def relevant(self, pod: Pod, snapshot) -> bool:
         """Hot-loop gate (core.py): on an untainted cluster a pod without a
-        nodeSelector or required nodeAffinity cannot be affected by this
-        plugin, so the engine drops it from the per-(pod, node)
-        filter/score loops. Tolerations alone never change a verdict —
-        they only permit what taints would block."""
+        nodeSelector or nodeAffinity (required or preferred) cannot be
+        affected by this plugin, so the engine drops it from the
+        per-(pod, node) filter/score loops. Tolerations alone never change
+        a verdict — they only permit what taints would block."""
         return (bool(pod.node_selector) or bool(pod.node_affinity)
-                or snapshot.any_taints())
+                or bool(pod.preferred_affinity) or snapshot.any_taints())
 
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
         sel = pod.node_selector
@@ -149,7 +149,14 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
 
     def score(self, state: CycleState, pod: Pod, node: NodeInfo
               ) -> tuple[float, Status]:
-        if not node.taints:  # hot path: almost all nodes are untainted
-            return 0.0, Status.success()
-        n = len(untolerated(pod, node.taints, (PREFER_NO_SCHEDULE,)))
-        return -100.0 * n, Status.success()
+        score = 0.0
+        # preferred nodeAffinity: sum of weights of matching preference
+        # terms (upstream NodeAffinity scoring; weights 1-100 per term)
+        for w, term in pod.preferred_affinity:
+            if all(_match_expression(node.labels, k, op, vals)
+                   for k, op, vals in term):
+                score += w
+        if node.taints:
+            n = len(untolerated(pod, node.taints, (PREFER_NO_SCHEDULE,)))
+            score -= 100.0 * n
+        return score, Status.success()

@@ -44,39 +44,69 @@ _WELL_KNOWN_PRIORITY = {
 _UNMATCHABLE_EXPR = ("", "__unsupported__", ())
 
 
+def _as_dict(x):
+    return x if isinstance(x, dict) else {}
+
+
+def _parse_term(term) -> tuple:
+    """One nodeSelectorTerm/preference -> tuple of (key, operator,
+    values-tuple) expressions. Shared by the required and preferred
+    parsers so both evaluate expressions identically. Unevaluable content
+    (non-dict expressions, matchFields, empty terms) yields the
+    unmatchable sentinel; malformed shapes never raise (cli validate
+    reports them)."""
+    term = _as_dict(term)
+    exprs = []
+    raw_exprs = term.get("matchExpressions")
+    for e in (raw_exprs if isinstance(raw_exprs, list) else []):
+        if not isinstance(e, dict):
+            exprs.append(_UNMATCHABLE_EXPR)
+            continue
+        vals = e.get("values")
+        exprs.append((str(e.get("key", "")), str(e.get("operator", "")),
+                      tuple(str(v) for v in vals)
+                      if isinstance(vals, list) else ()))
+    if term.get("matchFields"):
+        exprs.append(_UNMATCHABLE_EXPR)
+    if not exprs:
+        exprs.append(_UNMATCHABLE_EXPR)  # empty term matches nothing
+    return tuple(exprs)
+
+
+def _node_affinity_of(spec):
+    return _as_dict(_as_dict(_as_dict(spec).get("affinity"))
+                    .get("nodeAffinity"))
+
+
 def _parse_node_affinity(spec) -> tuple:
     """spec.affinity.nodeAffinity.requiredDuringSchedulingIgnoredDuring
-    Execution -> tuple of terms (OR of terms), each a tuple of
-    (key, operator, values-tuple) matchExpressions (AND within a term).
-    The preferred... variant is scoring-only upstream and not modelled.
-    Malformed shapes never raise (cli validate reports them); terms that
-    cannot be evaluated parse to an unmatchable sentinel."""
-    def as_dict(x):
-        return x if isinstance(x, dict) else {}
-
-    req = as_dict(as_dict(as_dict(as_dict(spec).get("affinity"))
-                          .get("nodeAffinity"))
-                  .get("requiredDuringSchedulingIgnoredDuringExecution"))
+    Execution -> tuple of terms (OR of terms), each a _parse_term tuple
+    (AND within a term). The preferred... variant (scoring) parses
+    separately via _parse_preferred_affinity."""
+    req = _as_dict(_node_affinity_of(spec)
+                   .get("requiredDuringSchedulingIgnoredDuringExecution"))
     raw_terms = req.get("nodeSelectorTerms")
-    terms = []
-    for term in (raw_terms if isinstance(raw_terms, list) else []):
-        term = as_dict(term)
-        exprs = []
-        raw_exprs = term.get("matchExpressions")
-        for e in (raw_exprs if isinstance(raw_exprs, list) else []):
-            if not isinstance(e, dict):
-                exprs.append(_UNMATCHABLE_EXPR)
-                continue
-            vals = e.get("values")
-            exprs.append((str(e.get("key", "")), str(e.get("operator", "")),
-                          tuple(str(v) for v in vals)
-                          if isinstance(vals, list) else ()))
-        if term.get("matchFields"):
-            exprs.append(_UNMATCHABLE_EXPR)
-        if not exprs:
-            exprs.append(_UNMATCHABLE_EXPR)  # empty term matches nothing
-        terms.append(tuple(exprs))
-    return tuple(terms)
+    return tuple(_parse_term(t)
+                 for t in (raw_terms if isinstance(raw_terms, list) else []))
+
+
+def _parse_preferred_affinity(spec) -> tuple:
+    """spec.affinity.nodeAffinity.preferredDuringSchedulingIgnoredDuring
+    Execution -> tuple of (weight, term); same term shape as the required
+    variant. Malformed entries — including weights outside the API's
+    1-100 range, which a real apiserver rejects — are dropped (cli
+    validate reports them)."""
+    raw = _node_affinity_of(spec).get(
+        "preferredDuringSchedulingIgnoredDuringExecution")
+    out = []
+    for pref in (raw if isinstance(raw, list) else []):
+        pref = _as_dict(pref)
+        w = pref.get("weight")
+        if (not isinstance(w, int) or isinstance(w, bool)
+                or not 1 <= w <= 100):
+            continue
+        out.append((w, _parse_term(pref.get("preference"))))
+    return tuple(out)
 
 
 @dataclass
@@ -110,6 +140,10 @@ class Pod:
     node_selector: dict[str, str] = field(default_factory=dict)
     tolerations: tuple = ()
     node_affinity: tuple = ()
+    # preferredDuringSchedulingIgnoredDuringExecution: tuple of
+    # (weight, term) where term is a tuple of (key, op, values) — scoring
+    # only (admission plugin's Score hook), never feasibility
+    preferred_affinity: tuple = ()
     created: float = field(default_factory=time.time)
 
     @property
@@ -176,4 +210,5 @@ class Pod:
                 for t in spec.get("tolerations", []) or []
             ),
             node_affinity=_parse_node_affinity(spec),
+            preferred_affinity=_parse_preferred_affinity(spec),
         )
