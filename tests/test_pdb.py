@@ -276,3 +276,34 @@ class TestWatchIngestion:
                 assert cluster.nodes_version > v0
             finally:
                 cluster.stop()
+
+
+def test_violating_preemption_counted_in_metrics():
+    """Best-effort violations are legal but observable: the engine counts
+    them in preempt_pdb_violations_total."""
+    c = _cluster(["a"], chips=1)
+    c.set_pdbs([budget(min_available=1)])
+    sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                         max_attempts=3))
+    protected = pod("serve-1", {"app": "serve"})
+    sched.submit(protected)
+    sched.run_until_idle()
+    hp = pod("hp", prio="9")
+    sched.submit(hp)
+    sched.run_until_idle()
+    assert hp.phase == PodPhase.BOUND
+    assert sched.metrics.counters.get("preempt_pdb_violations_total", 0) == 1
+
+
+def test_non_violating_preemption_not_counted():
+    c = _cluster(["a"], chips=1)
+    sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                         max_attempts=3))
+    filler = pod("filler")
+    sched.submit(filler)
+    sched.run_until_idle()
+    hp = pod("hp", prio="9")
+    sched.submit(hp)
+    sched.run_until_idle()
+    assert hp.phase == PodPhase.BOUND
+    assert sched.metrics.counters.get("preempt_pdb_violations_total", 0) == 0

@@ -195,7 +195,8 @@ class TestValidate:
         rc = main(["validate", "example/test-pod.yaml",
                    "example/llama-v4-32-gang.yaml",
                    "example/mixtral-v5e-64.yaml",
-                   "example/llama-multislice-gang.yaml"])
+                   "example/llama-multislice-gang.yaml",
+                   "example/serving-with-admission.yaml"])
         out = capsys.readouterr().out
         assert rc == 0 and "OK" in out
 

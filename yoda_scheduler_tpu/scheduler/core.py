@@ -556,6 +556,11 @@ class Scheduler:
                             self.allocator.nominate(pod.key, nominated,
                                                     spec.chips, spec.priority)
                     self.metrics.inc("preemptions_total")
+                    # budget-violating preemptions are legal (best-effort,
+                    # upstream semantics) but operators need to SEE them
+                    viol = state.read_or("preempt_pdb_violations", 0)
+                    if viol:
+                        self.metrics.inc("preempt_pdb_violations_total", viol)
                     info.last_failure = f"preempting on {nominated}"
                     self.queue.requeue_immediate(info)
                     self._finish(trace, "preempting", reason=info.last_failure)

@@ -93,6 +93,9 @@ class PriorityPreemption(PostFilterPlugin):
                 f"preemption: no node can fit {pod.key} even after evicting "
                 f"lower-priority pods"
             )
+        # surface budget violations to the engine's metrics (key read at
+        # the eviction site in core.py)
+        state.write("preempt_pdb_violations", best[0][0])
         return best[1], best[2], Status.success()
 
     def _gang_post_filter(self, state: CycleState, spec: WorkloadSpec,
@@ -190,6 +193,7 @@ class PriorityPreemption(PostFilterPlugin):
                 f"preemption: no slice can host gang {spec.gang_name} even "
                 f"after evicting lower-priority pods"
             )
+        state.write("preempt_pdb_violations", best[0][0])
         return best[1], best[2], Status.success()
 
     def _plan_eviction(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
