@@ -1,0 +1,278 @@
+"""Inter-pod affinity/anti-affinity (required terms + the symmetry rule).
+
+The reference's embedded kube-scheduler ran the InterPodAffinity plugin by
+default: required podAffinity co-locates by topology domain, required
+podAntiAffinity spreads, and a BOUND pod's anti-affinity symmetrically
+repels incoming matches. This suite locks those semantics into the
+standalone engine (plugins/admission.py `_filter_pod_affinity`).
+"""
+
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def _cluster(zone_of: dict[str, str], chips=4):
+    store = TelemetryStore()
+    now = time.time()
+    c = FakeCluster(store)
+    for n, zone in zone_of.items():
+        m = make_tpu_node(n, chips=chips)
+        m.heartbeat = now + 1e8
+        store.put(m)
+        c.add_node(n)
+        c.set_node_meta(n, labels={"zone": zone, "kubernetes.io/hostname": n})
+    return c
+
+
+def mk_pod(name, labels=None, affinity=None, namespace="default"):
+    return Pod.from_manifest({
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {"scv/number": "1", **(labels or {})}},
+        "spec": {"schedulerName": "yoda-scheduler",
+                 **({"affinity": affinity} if affinity else {})},
+    })
+
+
+def anti(match_labels, key="kubernetes.io/hostname"):
+    return {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": match_labels},
+             "topologyKey": key}]}}
+
+
+def aff(match_labels, key="zone"):
+    return {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": match_labels},
+             "topologyKey": key}]}}
+
+
+class TestAntiAffinity:
+    def test_replicas_spread_across_hosts(self):
+        c = _cluster({"n1": "a", "n2": "a", "n3": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pods = [mk_pod(f"r{i}", {"app": "web"}, anti({"app": "web"}))
+                for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        nodes = {p.node for p in pods}
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        assert len(nodes) == 3, "anti-affinity must spread one per host"
+
+    def test_fourth_replica_unschedulable(self):
+        c = _cluster({"n1": "a", "n2": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        pods = [mk_pod(f"r{i}", {"app": "web"}, anti({"app": "web"}))
+                for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        bound = [p for p in pods if p.phase == PodPhase.BOUND]
+        failed = [p for p in pods if p.phase == PodPhase.FAILED]
+        assert len(bound) == 2 and len(failed) == 1
+
+    def test_zone_level_spreading(self):
+        c = _cluster({"n1": "a", "n2": "a", "n3": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        pods = [mk_pod(f"r{i}", {"app": "db"}, anti({"app": "db"}, "zone"))
+                for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        bound = [p for p in pods if p.phase == PodPhase.BOUND]
+        assert len(bound) == 2  # one per ZONE, not per host
+        assert {c.telemetry.get(p.node) and p.node for p in bound}
+        zones = {"a" if p.node in ("n1", "n2") else "b" for p in bound}
+        assert zones == {"a", "b"}
+
+    def test_symmetry_bound_pod_repels_incoming(self):
+        """A bound pod's anti-affinity term repels an incoming MATCHING
+        pod even though the incoming pod declares no anti-affinity."""
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        guard = mk_pod("guard", {"app": "web"}, anti({"app": "web"}))
+        sched.submit(guard)
+        sched.run_until_idle()
+        assert guard.phase == PodPhase.BOUND
+        intruder = mk_pod("intruder", {"app": "web"})
+        bystander = mk_pod("bystander", {"app": "other"})
+        sched.submit(intruder)
+        sched.submit(bystander)
+        sched.run_until_idle()
+        assert intruder.phase == PodPhase.FAILED
+        assert bystander.phase == PodPhase.BOUND
+
+    def test_namespace_scoping(self):
+        """Terms without explicit namespaces apply only to the owner's
+        namespace: a same-labels pod in another namespace is not repelled."""
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        guard = mk_pod("guard", {"app": "web"}, anti({"app": "web"}))
+        sched.submit(guard)
+        sched.run_until_idle()
+        other_ns = mk_pod("other", {"app": "web"}, namespace="prod")
+        sched.submit(other_ns)
+        sched.run_until_idle()
+        assert other_ns.phase == PodPhase.BOUND
+
+
+class TestAffinity:
+    def test_colocates_in_zone(self):
+        c = _cluster({"n1": "a", "n2": "b", "n3": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        anchor = mk_pod("anchor", {"app": "cache"})
+        sched.submit(anchor)
+        sched.run_until_idle()
+        assert anchor.phase == PodPhase.BOUND
+        anchor_zone = "a" if anchor.node == "n1" else "b"
+        follower = mk_pod("follower", {"app": "web"},
+                          aff({"app": "cache"}))
+        sched.submit(follower)
+        sched.run_until_idle()
+        assert follower.phase == PodPhase.BOUND
+        follower_zone = "a" if follower.node == "n1" else "b"
+        assert follower_zone == anchor_zone
+
+    def test_affinity_with_no_matching_pod_unschedulable(self):
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        lonely = mk_pod("lonely", {"app": "web"}, aff({"app": "nonexistent"}))
+        sched.submit(lonely)
+        sched.run_until_idle()
+        assert lonely.phase == PodPhase.FAILED
+
+    def test_unschedulable_memo_invalidated_by_bind(self):
+        """An affinity pod memoized unschedulable must re-evaluate once a
+        matching anchor binds (bind bumps the version vector)."""
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=0,
+                                             preemption=False))
+        follower = mk_pod("follower", {"app": "web"}, aff({"app": "cache"}))
+        sched.submit(follower)
+        for _ in range(2):
+            sched.run_one()
+        assert follower.phase == PodPhase.PENDING
+        anchor = mk_pod("anchor", {"app": "cache"})
+        sched.submit(anchor)
+        sched.run_until_idle()
+        assert anchor.phase == PodPhase.BOUND
+        assert follower.phase == PodPhase.BOUND
+
+
+class TestParsing:
+    def test_term_shape(self):
+        p = mk_pod("p", {}, {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {
+                        "matchLabels": {"app": "web"},
+                        "matchExpressions": [
+                            {"key": "tier", "operator": "In",
+                             "values": ["a"]}]},
+                     "namespaces": ["prod"],
+                     "topologyKey": "zone"}]}})
+        ((ml, exprs, namespaces, key, match_all),) = p.pod_anti_affinity
+        assert ml == frozenset({("app", "web")})
+        assert exprs == (("tier", "In", ("a",)),)
+        assert namespaces == ("prod",)
+        assert key == "zone"
+        assert match_all is False
+
+    def test_malformed_never_raises(self):
+        p = mk_pod("p", {}, {"podAffinity": "notadict"})
+        assert p.pod_affinity == ()
+        p = mk_pod("p", {}, {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": "nope"}})
+        assert p.pod_anti_affinity == ()
+
+    def test_empty_selector_matches_all_in_namespace(self):
+        """labelSelector: {} (present but empty) matches EVERY pod in the
+        applicable namespaces — upstream LabelSelector semantics."""
+        c = _cluster({"n1": "a", "n2": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        first = mk_pod("first", {"anything": "x"})
+        sched.submit(first)
+        sched.run_until_idle()
+        hermit = mk_pod("hermit", {}, {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {}, "topologyKey": "zone"}]}})
+        sched.submit(hermit)
+        sched.run_until_idle()
+        # every node shares zone "a" with `first`: the hermit cannot land
+        assert hermit.phase == PodPhase.FAILED
+
+
+class TestSelfAffinityBootstrap:
+    def test_first_replica_of_self_affinity_workload_schedules(self):
+        """Upstream special case: when NO pod matches the affinity term
+        but the incoming pod matches its own selector, the term is
+        waived — otherwise the standard co-locate-my-replicas pattern
+        deadlocks on replica 1."""
+        c = _cluster({"n1": "a", "n2": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        replicas = [mk_pod(f"w{i}", {"app": "web"}, aff({"app": "web"}))
+                    for i in range(2)]
+        for p in replicas:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in replicas)
+        zones = {"a" if p.node == "n1" else "b" for p in replicas}
+        assert len(zones) == 1, "replica 2 must co-locate with replica 1"
+
+
+class TestPreemptionInterplay:
+    def test_preemptor_evicts_conflicting_pod(self):
+        """A high-priority pod repelled by a lower-priority bound pod's
+        anti-affinity (symmetry) preempts THAT pod, not a random one."""
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3))
+        guard = mk_pod("guard", {"app": "web"}, anti({"app": "web"}))
+        sched.submit(guard)
+        sched.run_until_idle()
+        assert guard.phase == PodPhase.BOUND
+        hp = Pod.from_manifest({
+            "metadata": {"name": "hp",
+                         "labels": {"scv/number": "1", "app": "web",
+                                    "scv/priority": "9"}},
+            "spec": {"schedulerName": "yoda-scheduler"}})
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND and hp.node == "n1"
+
+    def test_no_eviction_when_affinity_uncurable(self):
+        """Required podAffinity to a pod that exists nowhere: preemption
+        must NOT evict anyone (eviction can never add a matching pod)."""
+        c = _cluster({"n1": "a"}, chips=1)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        filler = mk_pod("filler", {"app": "other"})
+        sched.submit(filler)
+        sched.run_until_idle()
+        hp = Pod.from_manifest({
+            "metadata": {"name": "hp",
+                         "labels": {"scv/number": "1", "scv/priority": "9"}},
+            "spec": {"schedulerName": "yoda-scheduler",
+                     "affinity": aff({"app": "db"})}})
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.FAILED
+        assert filler.phase == PodPhase.BOUND
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 0

@@ -536,3 +536,50 @@ spec:
 """)
         out = capsys.readouterr().out
         assert rc == 1 and "no preference.matchExpressions" in out
+
+    def test_pod_affinity_lint(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badpodaff
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    podAntiAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        - labelSelector: {matchLabels: {app: web}}
+        - topologyKey: zone
+        - labelSelector:
+            matchExpressions:
+              - {key: tier, operator: Inn, values: [a]}
+          topologyKey: zone
+    podAffinity:
+      preferredDuringSchedulingIgnoredDuringExecution:
+        - weight: 10
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "no topologyKey" in out
+        assert "no labelSelector" in out.replace("\n", " ")
+        assert "operator 'Inn'" in out
+        assert "preferred podAffinity is not modelled" in out
+
+    def test_valid_pod_affinity_passes(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: okpodaff
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    podAntiAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        - labelSelector: {matchLabels: {app: web}}
+          topologyKey: kubernetes.io/hostname
+""")
+        out = capsys.readouterr().out
+        assert rc == 0 and "OK" in out

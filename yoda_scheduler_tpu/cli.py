@@ -363,6 +363,44 @@ def cmd_validate(args) -> int:
                     f"(the apiserver requires a preference)")
             else:
                 lint_term(preference, "preference")
+        # inter-pod (anti-)affinity: required terms only; preferred pod
+        # affinity is not modelled (flagged so nobody relies on it)
+        for which in ("podAffinity", "podAntiAffinity"):
+            block = as_dict(aff.get(which), which)
+            if block.get("preferredDuringSchedulingIgnoredDuringExecution"):
+                problems.append(
+                    f"{where}: {name}: preferred {which} is not modelled "
+                    f"by this scheduler — the preference is ignored")
+            raw_pod_terms = block.get(
+                "requiredDuringSchedulingIgnoredDuringExecution") or []
+            if not isinstance(raw_pod_terms, list):
+                problems.append(
+                    f"{where}: {name}: {which} required terms is "
+                    f"{type(raw_pod_terms).__name__}, not a list")
+                raw_pod_terms = []
+            for term in raw_pod_terms:
+                term = as_dict(term, f"{which} term")
+                if not term.get("topologyKey"):
+                    problems.append(
+                        f"{where}: {name}: {which} term has no topologyKey "
+                        f"(the apiserver requires one; without it the term "
+                        f"can never be satisfied)")
+                sel = term.get("labelSelector")
+                if not sel or not isinstance(sel, dict) or not (
+                        sel.get("matchLabels") or sel.get("matchExpressions")):
+                    problems.append(
+                        f"{where}: {name}: {which} term has no "
+                        f"labelSelector — it matches no pods")
+                else:
+                    for e in (sel.get("matchExpressions") or []):
+                        op = (e or {}).get("operator", "") \
+                            if isinstance(e, dict) else ""
+                        if op not in ("In", "NotIn", "Exists",
+                                      "DoesNotExist"):
+                            problems.append(
+                                f"{where}: {name}: {which} matchExpressions "
+                                f"operator {op!r} (must be In/NotIn/Exists/"
+                                f"DoesNotExist)")
 
     for path in args.manifests:
         with open(path) as f:

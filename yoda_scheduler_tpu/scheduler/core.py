@@ -314,12 +314,18 @@ class Scheduler:
                             self._ni_cache[name] = (key, ni)
                     # membership version unchanged here, so budgets are too
                     fresh = Snapshot(infos, budgets=snap.budgets)
-                    # carry the any-taints fact: only dirty nodes can have
-                    # introduced a taint (a removal leaves the conservative
-                    # True, costing nothing but the skipped optimization)
+                    # carry the any-taints / any-anti-affinity facts: only
+                    # dirty nodes can have introduced one (a removal leaves
+                    # the conservative True, costing nothing but the
+                    # skipped optimization)
                     if snap._any_taints is not None:
                         fresh._any_taints = snap._any_taints or any(
                             infos[n].taints for n in dirty if n in infos)
+                    if snap._any_pod_anti is not None:
+                        fresh._any_pod_anti = snap._any_pod_anti or any(
+                            p.pod_anti_affinity
+                            for n in dirty if n in infos
+                            for p in infos[n].pods)
                     self._snap = (fresh, pv, tv, nv0)
                     return fresh
         return self._full_snapshot()
@@ -427,17 +433,30 @@ class Scheduler:
         # two pods with identical labels but different tolerations must not
         # share a verdict. The common no-admission case keys on the interned
         # spec alone (a tuple never equals a WorkloadSpec, so no collision).
+        # the symmetry rule makes verdicts depend on ARBITRARY pod labels
+        # (a bound pod's anti-affinity selector can distinguish pods with
+        # identical WorkloadSpecs), so the class memo is unsound while any
+        # bound pod carries anti-affinity. The previous cycle's snapshot
+        # answers that cheaply; if an anti-affinity pod binds later, the
+        # version vector already invalidates every memo entry.
+        prev = self._snap[0] if self._snap is not None else None
         memo_ok = (not spec.is_gang
+                   and (prev is None or not prev.any_pod_anti_affinity())
                    and (self.allocator is None
                         or self.allocator.nomination_of(pod.key) is None))
-        if pod.node_selector or pod.tolerations or pod.node_affinity:
+        if (pod.node_selector or pod.tolerations or pod.node_affinity
+                or pod.pod_affinity or pod.pod_anti_affinity):
             memo_key = (spec, frozenset(pod.node_selector.items()),
                         tuple((t.get("key", ""), t.get("operator", "Equal"),
                                t.get("value", ""), t.get("effect", ""))
                               for t in pod.tolerations),
-                        pod.node_affinity)
+                        pod.node_affinity, pod.pod_affinity,
+                        pod.pod_anti_affinity, pod.namespace)
         else:
-            memo_key = spec
+            # namespace is part of even the plain class: a bound pod's
+            # anti-affinity (symmetry rule) can repel pods of one
+            # namespace and not another with identical labels
+            memo_key = (spec, pod.namespace)
         vers = self._cluster_versions()
         if memo_ok and vers is not None:
             hit = self._unsched_memo.get(memo_key)
@@ -591,12 +610,18 @@ class Scheduler:
             if st.code == Code.ERROR:
                 return self._cycle_error(info, trace, st.message)
 
-        # Score + per-plugin normalize + weighted sum (same relevance gate
-        # as the filter loop)
+        # Score + per-plugin normalize + weighted sum (relevance-gated
+        # like the filter loop; a plugin may declare a separate
+        # score_relevant when its scoring inputs are narrower than its
+        # filtering inputs)
         totals: dict[str, float] = {n.name: 0.0 for n in feasible}
-        scorers = [p for p in self.profile.score
-                   if getattr(p, "relevant", None) is None
-                   or p.relevant(pod, snapshot)]
+        scorers = []
+        for p in self.profile.score:
+            gate = getattr(p, "score_relevant", None)
+            if gate is None:
+                gate = getattr(p, "relevant", None)
+            if gate is None or gate(pod, snapshot):
+                scorers.append(p)
         for p in scorers:
             raw: dict[str, float] = {}
             for node in feasible:

@@ -193,6 +193,10 @@ class Descheduler:
 
         state = CycleState()
         state.write("now", self.sched.clock.time())
+        # the live filter path reads the snapshot for inter-pod affinity;
+        # omitting it would silently skip those checks in the dry-run and
+        # evict a pod the real cycle then refuses to place
+        state.write("snapshot", snapshot)
         try:
             spec = spec_for(pod)
         except LabelError:

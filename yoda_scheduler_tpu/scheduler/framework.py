@@ -194,6 +194,7 @@ class Snapshot:
         # incremental snapshots inherit the value from their parent when
         # the dirty set cannot have changed it
         self._any_taints: bool | None = None
+        self._any_pod_anti: bool | None = None
 
     def get(self, name: str) -> NodeInfo | None:
         return self._node_infos.get(name)
@@ -209,6 +210,17 @@ class Snapshot:
             self._any_taints = any(
                 ni.taints for ni in self._node_infos.values())
         return self._any_taints
+
+    def any_pod_anti_affinity(self) -> bool:
+        """True when any bound pod carries required podAntiAffinity — the
+        symmetry rule makes such a pod relevant to EVERY incoming pod, so
+        this gates the inter-pod checks the same way any_taints gates the
+        taint checks."""
+        if self._any_pod_anti is None:
+            self._any_pod_anti = any(
+                p.pod_anti_affinity
+                for ni in self._node_infos.values() for p in ni.pods)
+        return self._any_pod_anti
 
     def __len__(self) -> int:
         return len(self._node_infos)

@@ -85,6 +85,100 @@ def affinity_matches(pod: Pod, labels: dict) -> bool:
     )
 
 
+def _pod_term_selects(term: tuple, subject_ns: str, candidate: Pod) -> bool:
+    """Does one PodAffinityTerm's labelSelector select `candidate`?
+    `subject_ns` is the namespace of the pod OWNING the term (terms with
+    no explicit namespaces apply to the owner's namespace). LabelSelector
+    semantics: a NIL (absent) selector matches no pods; a present-but-
+    EMPTY selector matches every pod in the applicable namespaces."""
+    ml, exprs, namespaces, _key, match_all = term
+    if candidate.namespace not in (namespaces or (subject_ns,)):
+        return False
+    if match_all:
+        return True
+    if not ml and not exprs:
+        return False
+    labels = candidate.labels
+    return (
+        all(labels.get(k) == v for k, v in ml)
+        and all(_match_expression(labels, k, op, vals)
+                for k, op, vals in exprs)
+    )
+
+
+_POD_AFFINITY_STATE = "admission/pod-affinity-index"
+
+# affinity term satisfied everywhere: the incoming pod matches its OWN
+# term and no bound pod does — upstream's bootstrap special case, without
+# which the first replica of a self-affinity workload deadlocks forever
+_SELF_SATISFIED = None
+
+
+def _pod_affinity_index(state: CycleState, pod: Pod, snapshot) -> tuple:
+    """Per-cycle index for inter-pod (anti-)affinity, computed once per
+    pod cycle and cached in CycleState:
+
+    - affinity: for each of the pod's podAffinity terms,
+      (term, frozenset of satisfying domain values, or _SELF_SATISFIED)
+    - anti: for each of the pod's podAntiAffinity terms,
+      (term, {domain value: [conflicting bound pods]})
+    - reverse: (term, owner pod, topology_key, domain_value) for every
+      BOUND pod's anti-affinity term in its node's domain — the symmetry
+      rule (an existing pod's anti-affinity also repels incoming matches)
+    """
+    cached = state.read_or(_POD_AFFINITY_STATE)
+    if cached is not None:
+        return cached
+    nodes = snapshot.list()
+
+    affinity = []
+    for term in pod.pod_affinity:
+        key = term[3]
+        found = set()
+        if key:
+            for ni in nodes:
+                dom = ni.labels.get(key)
+                if dom is None:
+                    continue
+                if any(not p.terminating
+                       and _pod_term_selects(term, pod.namespace, p)
+                       for p in ni.pods):
+                    found.add(dom)
+        if not found and _pod_term_selects(term, pod.namespace, pod):
+            affinity.append((term, _SELF_SATISFIED))
+        else:
+            affinity.append((term, frozenset(found)))
+
+    anti = []
+    for term in pod.pod_anti_affinity:
+        key = term[3]
+        by_dom: dict = {}
+        if key:
+            for ni in nodes:
+                dom = ni.labels.get(key)
+                if dom is None:
+                    continue
+                for p in ni.pods:
+                    if not p.terminating and _pod_term_selects(
+                            term, pod.namespace, p):
+                        by_dom.setdefault(dom, []).append(p)
+        anti.append((term, by_dom))
+
+    reverse = []
+    for ni in nodes:
+        for bound in ni.pods:
+            if bound.terminating:
+                continue
+            for term in bound.pod_anti_affinity:
+                key = term[3]
+                dom = ni.labels.get(key) if key else None
+                if dom is not None:
+                    reverse.append((term, bound, key, dom))
+    index = (tuple(affinity), tuple(anti), tuple(reverse))
+    state.write(_POD_AFFINITY_STATE, index)
+    return index
+
+
 def untolerated(pod: Pod, taints: tuple, effects: tuple[str, ...]) -> list[dict]:
     """Taints with an effect in `effects` that no pod toleration covers."""
     tols = pod.tolerations
@@ -114,18 +208,68 @@ def admissible(pod: Pod, node: NodeInfo) -> bool:
     return True
 
 
+def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
+                         snapshot, evictable_fn) -> list[Pod] | None:
+    """Can eviction make this node pass the pod's inter-pod constraints?
+
+    Returns None when it cannot (required podAffinity needs a matching
+    pod PRESENT — eviction only removes; or a conflicting pod is not
+    evictable), else the (possibly empty) list of conflicting pods that
+    must be evicted alongside any capacity victims. Used by the
+    preemption planner so it never churns victims on a node the
+    preemptor still couldn't pass (the same contract admissible() gives
+    it for node-level admission)."""
+    if not (pod.pod_affinity or pod.pod_anti_affinity
+            or snapshot.any_pod_anti_affinity()):
+        return []
+    aff, anti, reverse = _pod_affinity_index(state, pod, snapshot)
+    labels = node.labels
+    for term, domains in aff:
+        if domains is _SELF_SATISFIED:
+            continue
+        key = term[3]
+        dom = labels.get(key) if key else None
+        if dom is None or dom not in domains:
+            return None  # eviction cannot ADD a matching pod
+    must: dict[str, Pod] = {}
+    for term, by_dom in anti:
+        key = term[3]
+        dom = labels.get(key) if key else None
+        for conflict in by_dom.get(dom, ()) if dom is not None else ():
+            if not evictable_fn(conflict):
+                return None
+            must[conflict.key] = conflict
+    for term, owner, key, dom in reverse:
+        if labels.get(key) == dom and _pod_term_selects(
+                term, owner.namespace, pod):
+            if not evictable_fn(owner):
+                return None
+            must[owner.key] = owner
+    return list(must.values())
+
+
 class NodeAdmission(FilterPlugin, ScorePlugin):
     name = "node-admission"
     weight = 1
 
     def relevant(self, pod: Pod, snapshot) -> bool:
-        """Hot-loop gate (core.py): on an untainted cluster a pod without a
-        nodeSelector or nodeAffinity (required or preferred) cannot be
-        affected by this plugin, so the engine drops it from the
-        per-(pod, node) filter/score loops. Tolerations alone never change
-        a verdict — they only permit what taints would block."""
+        """Hot-loop gate (core.py): on an untainted cluster a pod without
+        selectors, affinities, or inter-pod terms — and with no bound pod
+        carrying anti-affinity (the symmetry rule) — cannot be affected by
+        this plugin, so the engine drops it from the per-(pod, node)
+        filter/score loops. Tolerations alone never change a verdict —
+        they only permit what taints would block."""
         return (bool(pod.node_selector) or bool(pod.node_affinity)
-                or bool(pod.preferred_affinity) or snapshot.any_taints())
+                or bool(pod.preferred_affinity) or bool(pod.pod_affinity)
+                or bool(pod.pod_anti_affinity) or snapshot.any_taints()
+                or snapshot.any_pod_anti_affinity())
+
+    def score_relevant(self, pod: Pod, snapshot) -> bool:
+        """Score-side gate: only preferred affinity and PreferNoSchedule
+        taints contribute to scoring — inter-pod terms (which re-enable
+        the FILTER for every pod via the symmetry rule) must not drag the
+        constant-zero score hook back into the hot loop cluster-wide."""
+        return bool(pod.preferred_affinity) or snapshot.any_taints()
 
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
         sel = pod.node_selector
@@ -138,6 +282,13 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
         if pod.node_affinity and not affinity_matches(pod, node.labels):
             return Status.unschedulable(
                 f"{node.name}: required nodeAffinity not satisfied")
+        snapshot = state.read_or("snapshot")
+        if snapshot is not None and (
+                pod.pod_affinity or pod.pod_anti_affinity
+                or snapshot.any_pod_anti_affinity()):
+            st = self._filter_pod_affinity(state, pod, node, snapshot)
+            if not st.ok:
+                return st
         if node.taints:
             bad = untolerated(pod, node.taints, (NO_SCHEDULE, NO_EXECUTE))
             if bad:
@@ -145,6 +296,37 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                 return Status.unschedulable(
                     f"{node.name}: untolerated taint "
                     f"{t.get('key')}={t.get('value')}:{t.get('effect')}")
+        return Status.success()
+
+    def _filter_pod_affinity(self, state: CycleState, pod: Pod,
+                             node: NodeInfo, snapshot) -> Status:
+        """Required inter-pod (anti-)affinity against the candidate node,
+        driven by the per-cycle index (one cluster scan per pod cycle, not
+        per node)."""
+        aff, anti, reverse = _pod_affinity_index(state, pod, snapshot)
+        labels = node.labels
+        for term, domains in aff:
+            if domains is _SELF_SATISFIED:
+                continue  # first replica of a self-affinity workload
+            key = term[3]
+            dom = labels.get(key) if key else None
+            if dom is None or dom not in domains:
+                return Status.unschedulable(
+                    f"{node.name}: required podAffinity "
+                    f"(topologyKey={key or '?'}) not satisfied")
+        for term, by_dom in anti:
+            key = term[3]
+            dom = labels.get(key) if key else None
+            if dom is not None and dom in by_dom:
+                return Status.unschedulable(
+                    f"{node.name}: podAntiAffinity conflict "
+                    f"(topologyKey={key})")
+        for term, owner, key, dom in reverse:
+            if labels.get(key) == dom and _pod_term_selects(
+                    term, owner.namespace, pod):
+                return Status.unschedulable(
+                    f"{node.name}: repelled by a bound pod's "
+                    f"podAntiAffinity (topologyKey={key})")
         return Status.success()
 
     def score(self, state: CycleState, pod: Pod, node: NodeInfo

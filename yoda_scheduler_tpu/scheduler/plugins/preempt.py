@@ -18,7 +18,7 @@ from ..framework import CycleState, NodeInfo, PostFilterPlugin, Snapshot, Status
 from ...utils.labels import GANG_NAME_LABEL, LabelError, WorkloadSpec, spec_for
 from ...utils.pdb import DisruptionLedger
 from ...utils.pod import Pod
-from .admission import admissible
+from .admission import admissible, preemption_obstacles
 from .allocator import ChipAllocator
 
 
@@ -74,20 +74,42 @@ class PriorityPreemption(PostFilterPlugin):
         # minimal disruption: no-PDB-violation plans always win, then
         # fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
+        def evictable_victim(p: Pod) -> bool:
+            return _priority(p) < my_prio and _evictable(p)
+
         for node in snapshot.list():
+            m = node.metrics
+            if m is None or (now is not None and m.stale(now=now)):
+                continue
+            if spec.accelerator is not None and m.accelerator != spec.accelerator:
+                continue
             # never plan evictions on a node the preemptor itself cannot
             # pass admission on (nodeSelector/taints) — the evictions would
             # repeat every cycle while the pod stays Pending
             if not admissible(pod, node):
                 continue
-            plan = self._plan_eviction(spec, my_prio, node, now=now,
-                                       pod_key=pod.key, ledger=ledger)
-            if plan is None:
+            # inter-pod constraints: skip nodes eviction cannot cure
+            # (required podAffinity, or an unevictable conflicting pod);
+            # otherwise the conflicting pods join the victim plan
+            obstacles = preemption_obstacles(state, pod, node, snapshot,
+                                             evictable_victim)
+            if obstacles is None:
                 continue
-            key = (ledger.violations_for(plan), len(plan),
-                   max(_priority(v) for v in plan), node.name)
+            victims = self._plan_node(spec, my_prio, node, pod_key=pod.key,
+                                      ledger=ledger)
+            if victims is None:
+                continue  # capacity unreachable even with evictions
+            seen_keys = {v.key for v in victims}
+            full = victims + [o for o in obstacles
+                              if o.key not in seen_keys]
+            if not full:
+                # fits as-is with no conflicts to clear: the
+                # infeasibility has a cause preemption cannot cure
+                continue
+            key = (ledger.violations_for(full), len(full),
+                   max(_priority(v) for v in full), node.name)
             if best is None or key < best[0]:
-                best = (key, node.name, plan)
+                best = (key, node.name, full)
         if best is None:
             return None, [], Status.unschedulable(
                 f"preemption: no node can fit {pod.key} even after evicting "
@@ -132,8 +154,13 @@ class PriorityPreemption(PostFilterPlugin):
             if spec.accelerator is not None and m.accelerator != spec.accelerator:
                 continue
             # a host the gang member can't pass admission on disqualifies
-            # it from the per-slice plan the same way capacity would
+            # it from the per-slice plan the same way capacity would;
+            # inter-pod obstructions disqualify conservatively (gang plans
+            # don't fold conflicting pods into their per-host victim sets)
             if not admissible(pod, node):
+                continue
+            if preemption_obstacles(state, pod, node, snapshot,
+                                    lambda p: False) != []:
                 continue
             if m.num_hosts < spec.gang_size:
                 continue

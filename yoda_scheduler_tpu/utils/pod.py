@@ -109,6 +109,44 @@ def _parse_preferred_affinity(spec) -> tuple:
     return tuple(out)
 
 
+def _parse_pod_affinity_terms(spec, which: str) -> tuple:
+    """spec.affinity.{podAffinity|podAntiAffinity}.requiredDuringScheduling
+    IgnoredDuringExecution -> tuple of (match_labels frozenset,
+    match_expressions tuple, namespaces tuple, topology_key, match_all).
+    LabelSelector semantics: a NIL (absent) selector matches no pods; a
+    PRESENT-but-empty selector ({}) matches every pod in the applicable
+    namespaces — match_all carries that distinction. An empty topologyKey
+    is invalid upstream and parses to "" (the admission plugin treats it
+    as never satisfiable / never conflicting). Malformed shapes never
+    raise; cli validate reports them."""
+    raw = _as_dict(_as_dict(_as_dict(spec).get("affinity")).get(which)).get(
+        "requiredDuringSchedulingIgnoredDuringExecution")
+    out = []
+    for term in (raw if isinstance(raw, list) else []):
+        term = _as_dict(term)
+        raw_sel = term.get("labelSelector")
+        sel = _as_dict(raw_sel)
+        ml = _as_dict(sel.get("matchLabels"))
+        raw_exprs = sel.get("matchExpressions")
+        exprs = tuple(
+            (str(e.get("key", "")), str(e.get("operator", "")),
+             tuple(str(v) for v in e.get("values") or ())
+             if isinstance(e.get("values"), list) else ())
+            for e in (raw_exprs if isinstance(raw_exprs, list) else [])
+            if isinstance(e, dict)
+        )
+        namespaces = term.get("namespaces")
+        out.append((
+            frozenset((str(k), str(v)) for k, v in ml.items()),
+            exprs,
+            tuple(str(n) for n in namespaces)
+            if isinstance(namespaces, list) else (),
+            str(term.get("topologyKey", "")),
+            isinstance(raw_sel, dict) and not ml and not exprs,
+        ))
+    return tuple(out)
+
+
 @dataclass
 class Pod:
     name: str
@@ -144,6 +182,13 @@ class Pod:
     # (weight, term) where term is a tuple of (key, op, values) — scoring
     # only (admission plugin's Score hook), never feasibility
     preferred_affinity: tuple = ()
+    # required inter-pod (anti-)affinity: tuples of PodAffinityTerm =
+    # (match_labels frozenset, match_expressions tuple, namespaces tuple
+    # or () for the pod's own, topology_key). Anti-affinity is enforced
+    # SYMMETRICALLY: a bound pod's terms also repel incoming matches
+    # (upstream InterPodAffinity semantics).
+    pod_affinity: tuple = ()
+    pod_anti_affinity: tuple = ()
     created: float = field(default_factory=time.time)
 
     @property
@@ -211,4 +256,7 @@ class Pod:
             ),
             node_affinity=_parse_node_affinity(spec),
             preferred_affinity=_parse_preferred_affinity(spec),
+            pod_affinity=_parse_pod_affinity_terms(spec, "podAffinity"),
+            pod_anti_affinity=_parse_pod_affinity_terms(
+                spec, "podAntiAffinity"),
         )
