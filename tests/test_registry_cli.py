@@ -583,3 +583,26 @@ spec:
 """)
         out = capsys.readouterr().out
         assert rc == 0 and "OK" in out
+
+    def test_topology_spread_lint(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badspread
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  topologySpreadConstraints:
+    - {maxSkew: 0, topologyKey: zone, labelSelector: {matchLabels: {a: b}}}
+    - {maxSkew: 1, labelSelector: {matchLabels: {a: b}}}
+    - {maxSkew: 1, topologyKey: zone, whenUnsatisfiable: Maybe,
+       labelSelector: {matchLabels: {a: b}}}
+    - {maxSkew: 1, topologyKey: zone}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "maxSkew=0" in out
+        assert "no topologyKey" in out.replace("\n", " ")
+        assert "whenUnsatisfiable='Maybe'" in out
+        assert "counts no pods" in out

@@ -1,0 +1,181 @@
+"""topologySpreadConstraints: maxSkew filtering and ScheduleAnyway scoring.
+
+Upstream's PodTopologySpread plugin (default-enabled in the kube-scheduler
+the reference embedded) keeps matching pods evenly spread across topology
+domains: DoNotSchedule constraints filter nodes whose placement would
+exceed maxSkew; ScheduleAnyway ones penalize skew in scoring.
+"""
+
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+def _cluster(zone_of: dict[str, str], chips=8):
+    store = TelemetryStore()
+    now = time.time()
+    c = FakeCluster(store)
+    for n, zone in zone_of.items():
+        m = make_tpu_node(n, chips=chips)
+        m.heartbeat = now + 1e8
+        store.put(m)
+        c.add_node(n)
+        c.set_node_meta(n, labels={"zone": zone})
+    return c
+
+
+def spread_pod(name, when="DoNotSchedule", skew=1, labels=None):
+    return Pod.from_manifest({
+        "metadata": {"name": name,
+                     "labels": {"scv/number": "1", "app": "web",
+                                **(labels or {})}},
+        "spec": {
+            "schedulerName": "yoda-scheduler",
+            "topologySpreadConstraints": [{
+                "maxSkew": skew, "topologyKey": "zone",
+                "whenUnsatisfiable": when,
+                "labelSelector": {"matchLabels": {"app": "web"}}}],
+        },
+    })
+
+
+class TestDoNotSchedule:
+    def test_even_spread_across_zones(self):
+        c = _cluster({"n1": "a", "n2": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pods = [spread_pod(f"w{i}") for i in range(4)]
+        for p in pods:
+            sched.submit(p)
+            sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        per_zone = {"a": 0, "b": 0}
+        for p in pods:
+            per_zone["a" if p.node == "n1" else "b"] += 1
+        assert per_zone == {"a": 2, "b": 2}, \
+            f"maxSkew=1 must force 2+2, got {per_zone}"
+
+    def test_skew_blocks_when_zone_full(self):
+        """Zone b has no capacity left: the next matching pod may NOT pile
+        into zone a beyond the skew — it goes Pending instead."""
+        c = _cluster({"n1": "a", "n2": "b"}, chips=2)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        # fill zone b with non-matching pods
+        fillers = [Pod(f"f{i}", labels={"scv/number": "1"}) for i in range(2)]
+        for f in fillers:
+            c.bind(f, "n2", [(i, 0, 0) for i in [fillers.index(f)]])
+        pods = [spread_pod(f"w{i}") for i in range(2)]
+        for p in pods:
+            sched.submit(p)
+            sched.run_until_idle()
+        # first lands in zone a (count 1, min over {a:1, b:0} -> skew 1 ok);
+        # second would make zone a count 2 with zone b stuck at 0 -> skew 2
+        assert pods[0].phase == PodPhase.BOUND and pods[0].node == "n1"
+        assert pods[1].phase == PodPhase.FAILED
+
+    def test_node_without_key_rejected(self):
+        c = _cluster({"n1": "a"})
+        c.set_node_meta("n2", labels={})  # registers n2 with no zone label
+        store = c.telemetry
+        m = make_tpu_node("n2", chips=8)
+        m.heartbeat = time.time() + 1e8
+        store.put(m)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        p = spread_pod("w0")
+        sched.submit(p)
+        sched.run_until_idle()
+        assert p.phase == PodPhase.BOUND and p.node == "n1"
+
+
+class TestScheduleAnyway:
+    def test_prefers_low_skew_but_never_blocks(self):
+        c = _cluster({"n1": "a", "n2": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pods = [spread_pod(f"w{i}", when="ScheduleAnyway") for i in range(4)]
+        for p in pods:
+            sched.submit(p)
+            sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        per_zone = {"a": 0, "b": 0}
+        for p in pods:
+            per_zone["a" if p.node == "n1" else "b"] += 1
+        assert per_zone == {"a": 2, "b": 2}
+
+    def test_still_binds_when_only_skewed_placement_exists(self):
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pods = [spread_pod(f"w{i}", when="ScheduleAnyway") for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+            sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+
+
+class TestParsing:
+    def test_shape_and_dropped_entries(self):
+        p = Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler",
+                     "topologySpreadConstraints": [
+                         {"maxSkew": 2, "topologyKey": "zone",
+                          "whenUnsatisfiable": "ScheduleAnyway",
+                          "labelSelector": {"matchLabels": {"a": "b"}}},
+                         {"maxSkew": 0, "topologyKey": "zone"},   # invalid
+                         {"maxSkew": 1},                          # no key
+                         "notadict",
+                     ]}})
+        assert len(p.topology_spread) == 1
+        skew, key, when, ml, exprs, match_all = p.topology_spread[0]
+        assert (skew, key, when) == (2, "zone", "ScheduleAnyway")
+        assert ml == frozenset({("a", "b")})
+
+
+class TestReviewRegressions:
+    def test_self_match_num(self):
+        """A pod NOT matching its own constraint selector doesn't raise
+        its target domain's count: domain a has 1 web pod, b has 0 and no
+        capacity — an api pod with a web-selector constraint must still
+        land in zone a (upstream selfMatchNum semantics)."""
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        web = Pod("web0", labels={"scv/number": "1", "app": "web"})
+        c.bind(web, "n1", [(0, 0, 0)])
+        api = spread_pod("api0", labels={"app": "api"})
+        # api pod's constraint selects app=web; it is NOT app=web itself
+        api.labels["app"] = "api"
+        sched.submit(api)
+        sched.run_until_idle()
+        assert api.phase == PodPhase.BOUND and api.node == "n1"
+
+    def test_schedule_anyway_avoids_keyless_nodes(self):
+        """Nodes outside the spreading space (no topologyKey label) score
+        WORSE than any in-space domain, never better."""
+        c = _cluster({"n1": "a"})
+        c.set_node_meta("bare", labels={})
+        m = make_tpu_node("bare", chips=8)
+        m.heartbeat = time.time() + 1e8
+        c.telemetry.put(m)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pods = [spread_pod(f"w{i}", when="ScheduleAnyway") for i in range(2)]
+        for p in pods:
+            sched.submit(p)
+            sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        assert all(p.node == "n1" for p in pods), \
+            "spreading pods must prefer in-space nodes over keyless ones"
+
+    def test_empty_selector_lint_ok_and_spreads_everything(self):
+        p = Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler",
+                     "topologySpreadConstraints": [
+                         {"maxSkew": 1, "topologyKey": "zone",
+                          "labelSelector": {}}]}})
+        assert p.topology_spread[0][5] is True  # match_all

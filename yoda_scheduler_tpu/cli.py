@@ -363,6 +363,37 @@ def cmd_validate(args) -> int:
                     f"(the apiserver requires a preference)")
             else:
                 lint_term(preference, "preference")
+        raw_spread = spec_doc.get("topologySpreadConstraints") or []
+        if not isinstance(raw_spread, list):
+            problems.append(
+                f"{where}: {name}: topologySpreadConstraints is "
+                f"{type(raw_spread).__name__}, not a list")
+            raw_spread = []
+        for c in raw_spread:
+            c = as_dict(c, "topologySpreadConstraint")
+            skew = c.get("maxSkew")
+            if not (isinstance(skew, int) and not isinstance(skew, bool)
+                    and skew >= 1):
+                problems.append(
+                    f"{where}: {name}: topologySpreadConstraint "
+                    f"maxSkew={skew!r} (must be an integer >= 1)")
+            if not c.get("topologyKey"):
+                problems.append(
+                    f"{where}: {name}: topologySpreadConstraint has no "
+                    f"topologyKey")
+            when = c.get("whenUnsatisfiable", "DoNotSchedule")
+            if when not in ("DoNotSchedule", "ScheduleAnyway"):
+                problems.append(
+                    f"{where}: {name}: whenUnsatisfiable={when!r} (must "
+                    f"be DoNotSchedule or ScheduleAnyway)")
+            # labelSelector {} (present, empty) is valid match-all; only
+            # an ABSENT or non-mapping selector counts no pods
+            sel = c.get("labelSelector")
+            if sel is None or not isinstance(sel, dict):
+                problems.append(
+                    f"{where}: {name}: topologySpreadConstraint has no "
+                    f"labelSelector — it counts no pods, so the spread "
+                    f"is vacuous")
         # inter-pod (anti-)affinity: required terms only; preferred pod
         # affinity is not modelled (flagged so nobody relies on it)
         for which in ("podAffinity", "podAntiAffinity"):

@@ -445,13 +445,15 @@ class Scheduler:
                    and (self.allocator is None
                         or self.allocator.nomination_of(pod.key) is None))
         if (pod.node_selector or pod.tolerations or pod.node_affinity
-                or pod.pod_affinity or pod.pod_anti_affinity):
+                or pod.pod_affinity or pod.pod_anti_affinity
+                or pod.topology_spread):
             memo_key = (spec, frozenset(pod.node_selector.items()),
                         tuple((t.get("key", ""), t.get("operator", "Equal"),
                                t.get("value", ""), t.get("effect", ""))
                               for t in pod.tolerations),
                         pod.node_affinity, pod.pod_affinity,
-                        pod.pod_anti_affinity, pod.namespace)
+                        pod.pod_anti_affinity, pod.topology_spread,
+                        pod.namespace)
         else:
             # namespace is part of even the plain class: a bound pod's
             # anti-affinity (symmetry rule) can repel pods of one

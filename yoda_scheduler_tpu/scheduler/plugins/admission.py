@@ -208,6 +208,62 @@ def admissible(pod: Pod, node: NodeInfo) -> bool:
     return True
 
 
+_SPREAD_STATE = "admission/topology-spread-index"
+
+
+def _spread_selects(constraint: tuple, pod_ns: str, candidate: Pod) -> bool:
+    """Does a topologySpreadConstraint's labelSelector select `candidate`?
+    Spread selectors are namespace-local to the incoming pod."""
+    _skew, _key, _when, ml, exprs, match_all = constraint
+    if candidate.namespace != pod_ns:
+        return False
+    if match_all:
+        return True
+    if not ml and not exprs:
+        return False
+    labels = candidate.labels
+    return (
+        all(labels.get(k) == v for k, v in ml)
+        and all(_match_expression(labels, k, op, vals)
+                for k, op, vals in exprs)
+    )
+
+
+def _spread_index(state: CycleState, pod: Pod, snapshot) -> tuple:
+    """Per-cycle index: for each of the pod's spread constraints,
+    (constraint, {domain: matching-pod count}, global minimum count).
+    Domains are the distinct values of the constraint's topologyKey over
+    nodes that carry the key; nodes without the key neither host domains
+    nor count toward the minimum (upstream treats them as outside the
+    spreading space; upstream's additional node-inclusion refinement —
+    only nodes passing the pod's own selectors define domains — is not
+    modelled)."""
+    cached = state.read_or(_SPREAD_STATE)
+    if cached is not None:
+        return cached
+    nodes = snapshot.list()
+    out = []
+    for c in pod.topology_spread:
+        key = c[1]
+        counts: dict = {}
+        for ni in nodes:
+            dom = ni.labels.get(key)
+            if dom is None:
+                continue
+            counts[dom] = counts.get(dom, 0) + sum(
+                1 for p in ni.pods
+                if not p.terminating and _spread_selects(c, pod.namespace, p)
+            )
+        # upstream selfMatchNum: placing the pod raises its domain's count
+        # only when the pod matches its OWN selector
+        self_match = 1 if _spread_selects(c, pod.namespace, pod) else 0
+        out.append((c, counts, min(counts.values()) if counts else 0,
+                    self_match))
+    index = tuple(out)
+    state.write(_SPREAD_STATE, index)
+    return index
+
+
 def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
                          snapshot, evictable_fn) -> list[Pod] | None:
     """Can eviction make this node pass the pod's inter-pod constraints?
@@ -219,6 +275,16 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
     preemption planner so it never churns victims on a node the
     preemptor still couldn't pass (the same contract admissible() gives
     it for node-level admission)."""
+    # DoNotSchedule spread violations: eviction COULD cure skew, but
+    # proving it needs plan simulation — skip such nodes conservatively
+    # rather than churn victims on a still-infeasible node
+    for c, counts, global_min, self_match in _spread_index(
+            state, pod, snapshot):
+        if c[2] != "DoNotSchedule":
+            continue
+        dom = node.labels.get(c[1])
+        if dom is None or counts.get(dom, 0) + self_match - global_min > c[0]:
+            return None
     if not (pod.pod_affinity or pod.pod_anti_affinity
             or snapshot.any_pod_anti_affinity()):
         return []
@@ -261,15 +327,18 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
         they only permit what taints would block."""
         return (bool(pod.node_selector) or bool(pod.node_affinity)
                 or bool(pod.preferred_affinity) or bool(pod.pod_affinity)
-                or bool(pod.pod_anti_affinity) or snapshot.any_taints()
+                or bool(pod.pod_anti_affinity) or bool(pod.topology_spread)
+                or snapshot.any_taints()
                 or snapshot.any_pod_anti_affinity())
 
     def score_relevant(self, pod: Pod, snapshot) -> bool:
-        """Score-side gate: only preferred affinity and PreferNoSchedule
-        taints contribute to scoring — inter-pod terms (which re-enable
-        the FILTER for every pod via the symmetry rule) must not drag the
-        constant-zero score hook back into the hot loop cluster-wide."""
-        return bool(pod.preferred_affinity) or snapshot.any_taints()
+        """Score-side gate: only preferred affinity, spread constraints,
+        and PreferNoSchedule taints contribute to scoring — inter-pod
+        terms (which re-enable the FILTER for every pod via the symmetry
+        rule) must not drag the constant-zero score hook back into the
+        hot loop cluster-wide."""
+        return (bool(pod.preferred_affinity) or bool(pod.topology_spread)
+                or snapshot.any_taints())
 
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
         sel = pod.node_selector
@@ -287,6 +356,10 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                 pod.pod_affinity or pod.pod_anti_affinity
                 or snapshot.any_pod_anti_affinity()):
             st = self._filter_pod_affinity(state, pod, node, snapshot)
+            if not st.ok:
+                return st
+        if snapshot is not None and pod.topology_spread:
+            st = self._filter_spread(state, pod, node, snapshot)
             if not st.ok:
                 return st
         if node.taints:
@@ -329,9 +402,49 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                     f"podAntiAffinity (topologyKey={key})")
         return Status.success()
 
+    def _filter_spread(self, state: CycleState, pod: Pod, node: NodeInfo,
+                       snapshot) -> Status:
+        """DoNotSchedule topologySpreadConstraints: placing here must keep
+        (candidate domain count + 1) - global minimum <= maxSkew. A node
+        without the topologyKey cannot satisfy a DoNotSchedule constraint
+        (upstream semantics)."""
+        for c, counts, global_min, self_match in _spread_index(
+                state, pod, snapshot):
+            if c[2] != "DoNotSchedule":
+                continue
+            dom = node.labels.get(c[1])
+            if dom is None:
+                return Status.unschedulable(
+                    f"{node.name}: node has no {c[1]!r} label "
+                    f"(topologySpreadConstraint)")
+            if counts.get(dom, 0) + self_match - global_min > c[0]:
+                return Status.unschedulable(
+                    f"{node.name}: topologySpreadConstraint maxSkew={c[0]} "
+                    f"exceeded for {c[1]}={dom}")
+        return Status.success()
+
     def score(self, state: CycleState, pod: Pod, node: NodeInfo
               ) -> tuple[float, Status]:
         score = 0.0
+        if pod.topology_spread:
+            snapshot = state.read_or("snapshot")
+            if snapshot is not None:
+                # ScheduleAnyway constraints: penalize skew instead of
+                # filtering (upstream PodTopologySpread scoring). Nodes
+                # OUTSIDE the spreading space (no topologyKey) score
+                # strictly worse than any in-space domain — scoring them
+                # 0 would invert the preference and pile the workload
+                # onto unlabeled nodes.
+                for c, counts, global_min, _self in _spread_index(
+                        state, pod, snapshot):
+                    if c[2] != "ScheduleAnyway":
+                        continue
+                    dom = node.labels.get(c[1])
+                    if dom is not None:
+                        score -= float(counts.get(dom, 0) - global_min)
+                    else:
+                        score -= float(
+                            max(counts.values(), default=0) + 1 - global_min)
         # preferred nodeAffinity: sum of weights of matching preference
         # terms (upstream NodeAffinity scoring; weights 1-100 per term)
         for w, term in pod.preferred_affinity:
@@ -342,3 +455,13 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
             n = len(untolerated(pod, node.taints, (PREFER_NO_SCHEDULE,)))
             score -= 100.0 * n
         return score, Status.success()
+
+    def normalize(self, state: CycleState, pod: Pod,
+                  scores: dict[str, float]) -> None:
+        """Min-max rescale like the other score plugins: raw admission
+        scores mix units (preference weights, skew counts, taint
+        penalties) whose magnitudes would otherwise be swamped by — or
+        swamp — the telemetry scorer's [0,100] range."""
+        from ..framework import min_max_normalize
+
+        min_max_normalize(scores)

@@ -147,6 +147,43 @@ def _parse_pod_affinity_terms(spec, which: str) -> tuple:
     return tuple(out)
 
 
+def _parse_topology_spread(spec) -> tuple:
+    """spec.topologySpreadConstraints -> tuple of (max_skew, topology_key,
+    when_unsatisfiable, match_labels frozenset, match_expressions tuple,
+    match_all). Entries without a positive integer maxSkew or a
+    topologyKey are dropped (the apiserver rejects them); cli validate
+    reports them. LabelSelector semantics as in _parse_pod_affinity_terms
+    (nil = no pods, {} = all pods in the namespace)."""
+    raw = _as_dict(spec).get("topologySpreadConstraints")
+    out = []
+    for c in (raw if isinstance(raw, list) else []):
+        c = _as_dict(c)
+        skew = c.get("maxSkew")
+        key = str(c.get("topologyKey", ""))
+        if (not isinstance(skew, int) or isinstance(skew, bool)
+                or skew < 1 or not key):
+            continue
+        raw_sel = c.get("labelSelector")
+        sel = _as_dict(raw_sel)
+        ml = _as_dict(sel.get("matchLabels"))
+        raw_exprs = sel.get("matchExpressions")
+        exprs = tuple(
+            (str(e.get("key", "")), str(e.get("operator", "")),
+             tuple(str(v) for v in e.get("values") or ())
+             if isinstance(e.get("values"), list) else ())
+            for e in (raw_exprs if isinstance(raw_exprs, list) else [])
+            if isinstance(e, dict)
+        )
+        out.append((
+            skew, key,
+            str(c.get("whenUnsatisfiable", "DoNotSchedule")),
+            frozenset((str(k), str(v)) for k, v in ml.items()),
+            exprs,
+            isinstance(raw_sel, dict) and not ml and not exprs,
+        ))
+    return tuple(out)
+
+
 @dataclass
 class Pod:
     name: str
@@ -189,6 +226,11 @@ class Pod:
     # (upstream InterPodAffinity semantics).
     pod_affinity: tuple = ()
     pod_anti_affinity: tuple = ()
+    # spec.topologySpreadConstraints: tuple of (max_skew, topology_key,
+    # when_unsatisfiable, match_labels frozenset, match_expressions tuple,
+    # match_all) — DoNotSchedule constraints filter, ScheduleAnyway ones
+    # score (skew penalty)
+    topology_spread: tuple = ()
     created: float = field(default_factory=time.time)
 
     @property
@@ -259,4 +301,5 @@ class Pod:
             pod_affinity=_parse_pod_affinity_terms(spec, "podAffinity"),
             pod_anti_affinity=_parse_pod_affinity_terms(
                 spec, "podAntiAffinity"),
+            topology_spread=_parse_topology_spread(spec),
         )
