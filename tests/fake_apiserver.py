@@ -101,12 +101,15 @@ class FakeApiState:
         })
 
     def add_node(self, name: str, labels: dict | None = None,
-                 taints: list | None = None) -> None:
+                 taints: list | None = None,
+                 allocatable: dict | None = None) -> None:
         obj: dict = {"metadata": {"name": name}}
         if labels:
             obj["metadata"]["labels"] = dict(labels)
         if taints:
             obj["spec"] = {"taints": list(taints)}
+        if allocatable:
+            obj["status"] = {"allocatable": dict(allocatable)}
         self.upsert("nodes", obj)
 
     def add_pod(self, manifest: dict) -> dict:

@@ -606,3 +606,22 @@ spec:
         assert "no topologyKey" in out.replace("\n", " ")
         assert "whenUnsatisfiable='Maybe'" in out
         assert "counts no pods" in out
+
+    def test_resource_request_lint(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badreq
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  containers:
+    - name: c
+      resources:
+        requests: {cpu: lots, memory: 1Qx}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "cpu request 'lots'" in out
+        assert "memory request '1Qx'" in out

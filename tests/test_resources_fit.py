@@ -1,0 +1,218 @@
+"""NodeResourcesFit: container cpu/memory requests vs node allocatable.
+
+The kube-scheduler the reference embedded checked every pod's effective
+container requests against node allocatable by default; accelerator labels
+alone don't stop a memory-hungry sidecar from overcommitting a host. Nodes
+reporting no allocatable (in-memory fakes, accelerator-only fleets) are
+unconstrained — the feature engages only where Node objects carry
+status.allocatable.
+"""
+
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.quantity import (
+    parse_cpu_millis, parse_memory_bytes, pod_requests)
+
+
+class TestQuantities:
+    def test_cpu(self):
+        assert parse_cpu_millis("500m") == 500
+        assert parse_cpu_millis("2") == 2000
+        assert parse_cpu_millis(1) == 1000
+        assert parse_cpu_millis("1.5") == 1500
+        assert parse_cpu_millis("abc") is None
+        assert parse_cpu_millis(None) is None
+
+    def test_memory(self):
+        assert parse_memory_bytes("1Gi") == 1024 ** 3
+        assert parse_memory_bytes("512Mi") == 512 * 1024 ** 2
+        assert parse_memory_bytes("1G") == 10 ** 9
+        assert parse_memory_bytes("100") == 100
+        assert parse_memory_bytes(2048) == 2048
+        assert parse_memory_bytes("1Qx") is None
+
+    def test_pod_requests_sum_and_init_floor(self):
+        cpu, mem = pod_requests({
+            "containers": [
+                {"resources": {"requests": {"cpu": "500m",
+                                            "memory": "1Gi"}}},
+                {"resources": {"requests": {"cpu": "250m",
+                                            "memory": "512Mi"}}},
+            ],
+            "initContainers": [
+                {"resources": {"requests": {"cpu": "2",
+                                            "memory": "256Mi"}}},
+            ],
+        })
+        # cpu: init (2000m) exceeds the container sum (750m) -> floor wins
+        assert cpu == 2000
+        # memory: container sum (1.5Gi) exceeds the init max
+        assert mem == (1024 + 512) * 1024 ** 2
+
+
+def _cluster(allocatable_of: dict, chips=4):
+    store = TelemetryStore()
+    now = time.time()
+    c = FakeCluster(store)
+    for n, alloc in allocatable_of.items():
+        m = make_tpu_node(n, chips=chips)
+        m.heartbeat = now + 1e8
+        store.put(m)
+        c.add_node(n)
+        if alloc is not None:
+            c.set_node_meta(n, allocatable=alloc)
+    return c
+
+
+def requesting_pod(name, cpu="500m", memory="1Gi", chips="1"):
+    return Pod.from_manifest({
+        "metadata": {"name": name, "labels": {"scv/number": chips}},
+        "spec": {"schedulerName": "yoda-scheduler",
+                 "containers": [{"name": "c", "resources": {
+                     "requests": {"cpu": cpu, "memory": memory}}}]},
+    })
+
+
+class TestFit:
+    def test_requests_respect_allocatable(self):
+        # each node fits exactly one 2-cpu pod: the two must split
+        c = _cluster({"n1": (2000, 4 * 1024 ** 3),
+                      "n2": (2000, 8 * 1024 ** 3)})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        a = requesting_pod("a", cpu="2")
+        b = requesting_pod("b", cpu="2")
+        sched.submit(a)
+        sched.run_until_idle()
+        sched.submit(b)
+        sched.run_until_idle()
+        assert a.phase == PodPhase.BOUND and b.phase == PodPhase.BOUND
+        assert {a.node, b.node} == {"n1", "n2"}
+
+    def test_overcommit_rejected(self):
+        c = _cluster({"n1": (1000, 1024 ** 3)})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        big = requesting_pod("big", cpu="4")
+        sched.submit(big)
+        sched.run_until_idle()
+        assert big.phase == PodPhase.FAILED
+
+    def test_memory_dimension(self):
+        c = _cluster({"n1": (8000, 1024 ** 3)})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        a = requesting_pod("a", cpu="100m", memory="768Mi")
+        b = requesting_pod("b", cpu="100m", memory="768Mi")
+        sched.submit(a)
+        sched.run_until_idle()
+        sched.submit(b)
+        sched.run_until_idle()
+        assert a.phase == PodPhase.BOUND
+        assert b.phase == PodPhase.FAILED  # 1.5Gi > 1Gi allocatable
+
+    def test_no_allocatable_unconstrained(self):
+        c = _cluster({"n1": None})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        huge = requesting_pod("huge", cpu="128", memory="1024Gi")
+        sched.submit(huge)
+        sched.run_until_idle()
+        assert huge.phase == PodPhase.BOUND
+
+    def test_requestless_pods_skip_the_check(self):
+        c = _cluster({"n1": (100, 100)})  # tiny allocatable
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        plain = Pod("plain", labels={"scv/number": "1"})
+        sched.submit(plain)
+        sched.run_until_idle()
+        assert plain.phase == PodPhase.BOUND
+
+    def test_preemption_skips_uncurable_resource_node(self):
+        """Even evicting every evictable pod can't fit the preemptor's
+        cpu: no victims may be planned there."""
+        c = _cluster({"n1": (1000, 8 * 1024 ** 3)}, chips=2)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        low = requesting_pod("low", cpu="500m")
+        sched.submit(low)
+        sched.run_until_idle()
+        hp = requesting_pod("hp", cpu="2")
+        hp.labels["scv/priority"] = "9"
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.FAILED
+        assert low.phase == PodPhase.BOUND
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 0
+
+    def test_preemption_frees_cpu(self):
+        """Chips fit but cpu doesn't: preemption must evict the
+        lower-priority requester (upstream NodeResourcesFit preemption)."""
+        c = _cluster({"n1": (2000, 8 * 1024 ** 3)}, chips=4)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3))
+        low = requesting_pod("low", cpu="1500m")
+        sched.submit(low)
+        sched.run_until_idle()
+        assert low.phase == PodPhase.BOUND
+        hp = requesting_pod("hp", cpu="1")
+        hp.labels["scv/priority"] = "9"
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND and hp.node == "n1"
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 1
+
+    def test_nominated_cpu_hold_blocks_thieves(self):
+        """While a preemption victim drains, a third pod must not steal
+        the cpu the preemptor is entitled to."""
+        from yoda_scheduler_tpu.scheduler.plugins import ChipAllocator
+
+        c = _cluster({"n1": (2000, 8 * 1024 ** 3)}, chips=4)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        # simulate the drain window by hand: victim terminating, preemptor
+        # nominated with its cpu recorded
+        victim = requesting_pod("victim", cpu="1500m")
+        c.bind(victim, "n1", [(0, 0, 0)])
+        victim.terminating = True
+        sched.allocator.nominate("default/hp", "n1", 1, 9,
+                                 cpu_millis=1000, memory_bytes=0)
+        thief = requesting_pod("thief", cpu="500m")
+        sched.submit(thief)
+        sched.run_until_idle()
+        # victim still holds 1500m; nominated hold adds 1000m -> 2500m
+        # committed of 2000m: the thief must NOT bind
+        assert thief.phase == PodPhase.FAILED
+
+    def test_reprieve_spares_zero_contribution_victims(self):
+        """When only cpu is short, a pod that frees no cpu must not be
+        evicted alongside the one that does (upstream's reprieve)."""
+        c = _cluster({"n1": (2000, 8 * 1024 ** 3)}, chips=8)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3))
+        no_cpu = Pod("no-cpu", labels={"scv/number": "1",
+                                       "scv/priority": "1"})
+        cpu_hog = requesting_pod("hog", cpu="1500m")
+        cpu_hog.labels["scv/priority"] = "2"
+        sched.submit(no_cpu)
+        sched.submit(cpu_hog)
+        sched.run_until_idle()
+        hp = requesting_pod("hp", cpu="1")
+        hp.labels["scv/priority"] = "9"
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND
+        assert no_cpu.phase == PodPhase.BOUND, \
+            "the zero-cpu pod must be reprieved, only the hog evicted"
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 1
+
+    def test_negative_quantities_rejected(self):
+        assert parse_cpu_millis("-2") is None
+        assert parse_memory_bytes("-1Gi") is None
+        assert parse_memory_bytes(-5) is None
+        assert parse_memory_bytes("1Ei") == 1024 ** 6
+        assert parse_memory_bytes("1500m") == 1

@@ -363,6 +363,30 @@ def cmd_validate(args) -> int:
                     f"(the apiserver requires a preference)")
             else:
                 lint_term(preference, "preference")
+        from .utils.quantity import parse_cpu_millis, parse_memory_bytes
+
+        for section in ("containers", "initContainers"):
+            raw_cs = spec_doc.get(section) or []
+            for i, ctr in enumerate(raw_cs
+                                    if isinstance(raw_cs, list) else []):
+                if not isinstance(ctr, dict):
+                    continue
+                res = ctr.get("resources")
+                req = (res or {}).get("requests") if isinstance(res, dict) \
+                    else None
+                if not isinstance(req, dict):
+                    continue
+                if "cpu" in req and parse_cpu_millis(req["cpu"]) is None:
+                    problems.append(
+                        f"{where}: {name}: {section}[{i}] cpu request "
+                        f"{req['cpu']!r} is not a valid quantity — the "
+                        f"request is silently ignored")
+                if "memory" in req and \
+                        parse_memory_bytes(req["memory"]) is None:
+                    problems.append(
+                        f"{where}: {name}: {section}[{i}] memory request "
+                        f"{req['memory']!r} is not a valid quantity — the "
+                        f"request is silently ignored")
         raw_spread = spec_doc.get("topologySpreadConstraints") or []
         if not isinstance(raw_spread, list):
             problems.append(

@@ -371,9 +371,12 @@ def _pod_from_api(item: dict) -> Pod | None:
     return p
 
 
-def _node_meta_from_api(item: dict) -> tuple[dict, tuple]:
-    """Node object -> (metadata.labels, spec.taints) for the admission
-    plugin (plugins/admission.py). Taints normalised to plain dicts."""
+def _node_meta_from_api(item: dict) -> tuple[dict, tuple, tuple | None]:
+    """Node object -> (metadata.labels, spec.taints, status.allocatable as
+    (cpu millicores, memory bytes) or None) for the admission plugin
+    (plugins/admission.py). Taints normalised to plain dicts."""
+    from ..utils.quantity import parse_cpu_millis, parse_memory_bytes
+
     labels = dict(item.get("metadata", {}).get("labels", {}) or {})
     taints = tuple(
         {
@@ -383,7 +386,15 @@ def _node_meta_from_api(item: dict) -> tuple[dict, tuple]:
         }
         for t in item.get("spec", {}).get("taints", []) or []
     )
-    return labels, taints
+    alloc_raw = (item.get("status") or {}).get("allocatable")
+    alloc = None
+    if isinstance(alloc_raw, dict):
+        cpu = parse_cpu_millis(alloc_raw.get("cpu"))
+        mem = parse_memory_bytes(alloc_raw.get("memory"))
+        if cpu is not None or mem is not None:
+            alloc = (cpu if cpu is not None else 1 << 60,
+                     mem if mem is not None else 1 << 60)
+    return labels, taints, alloc
 
 
 def _rv_of(obj: dict) -> str | None:
@@ -551,7 +562,7 @@ class KubeCluster:
             # a label/taint edit must invalidate the node's cached NodeInfo
             # and filter verdicts even though membership is unchanged
             for n, meta in metas.items():
-                if self._node_meta.get(n, ({}, ())) != meta:
+                if self._node_meta.get(n, ({}, (), None)) != meta:
                     self._bump(n)
             self._nodes = names
             self._node_meta = metas
@@ -573,7 +584,7 @@ class KubeCluster:
                     self._bump(name)
                 self._nodes.add(name)
                 meta = _node_meta_from_api(obj)
-                if self._node_meta.get(name, ({}, ())) != meta:
+                if self._node_meta.get(name, ({}, (), None)) != meta:
                     self._node_meta[name] = meta
                     self._bump(name)
 
@@ -764,7 +775,14 @@ class KubeCluster:
         """Node-object (metadata.labels, spec.taints) for the admission
         plugin; empty for unknown nodes."""
         with self._lock:
-            return self._node_meta.get(name, ({}, ()))
+            return self._node_meta.get(name, ({}, (), None))[:2]
+
+    def node_allocatable(self, name: str) -> tuple | None:
+        """status.allocatable as (cpu millicores, memory bytes), or None
+        when the node reports none (no cpu/mem constraint)."""
+        with self._lock:
+            meta = self._node_meta.get(name)
+            return meta[2] if meta is not None else None
 
     def pods_version(self, node: str) -> int:
         with self._lock:

@@ -119,18 +119,26 @@ class FakeCluster:
             return self._pdbs
 
     def set_node_meta(self, name: str, labels: dict[str, str] | None = None,
-                      taints: list[dict] | tuple = ()) -> None:
-        """Node-object metadata.labels / spec.taints (admission plugin
-        inputs). Bumps the node's change counter: a label or taint edit
-        must invalidate cached NodeInfos and filter verdicts."""
+                      taints: list[dict] | tuple = (),
+                      allocatable: tuple | None = None) -> None:
+        """Node-object metadata.labels / spec.taints / status.allocatable
+        as (cpu millicores, memory bytes) (admission plugin inputs). Bumps
+        the node's change counter: an edit must invalidate cached
+        NodeInfos and filter verdicts."""
         with self._lock:
             self.add_node(name)
-            self._meta[name] = (dict(labels or {}), tuple(taints))
+            self._meta[name] = (dict(labels or {}), tuple(taints),
+                                allocatable)
             self._bump(name)
 
     def node_meta(self, name: str) -> tuple[dict[str, str], tuple]:
         with self._lock:
-            return self._meta.get(name, ({}, ()))
+            return self._meta.get(name, ({}, (), None))[:2]
+
+    def node_allocatable(self, name: str) -> tuple | None:
+        with self._lock:
+            meta = self._meta.get(name)
+            return meta[2] if meta is not None else None
 
     # ---------------------------------------------------------------- reading
     def node_names(self) -> list[str]:

@@ -150,7 +150,7 @@ def default_profile(config: SchedulerConfig,
     gang_permit = GangPermit(gangs, timeout_s=config.gang_timeout_s,
                              allocator=allocator)
     topo = TopologyScore(allocator, weight=config.topology_weight)
-    admission = NodeAdmission()
+    admission = NodeAdmission(allocator)
     profile = Profile(
         queue_sort=PrioritySort(),
         # GangPermit.pre_filter computes multi-slice plans for gangs no
@@ -326,6 +326,10 @@ class Scheduler:
                             p.pod_anti_affinity
                             for n in dirty if n in infos
                             for p in infos[n].pods)
+                    if snap._any_alloc is not None:
+                        fresh._any_alloc = snap._any_alloc or any(
+                            infos[n].allocatable is not None
+                            for n in dirty if n in infos)
                     self._snap = (fresh, pv, tv, nv0)
                     return fresh
         return self._full_snapshot()
@@ -339,11 +343,14 @@ class Scheduler:
         cluster = self.cluster
         meta_fn = getattr(cluster, "node_meta", None)
         labels, taints = meta_fn(name) if meta_fn is not None else ({}, ())
+        alloc_fn = getattr(cluster, "node_allocatable", None)
         if metrics is _UNSET:
             metrics = cluster.telemetry.get(name)
         return NodeInfo(name=name, metrics=metrics,
                         pods=cluster.pods_on(name), labels=labels,
-                        taints=taints)
+                        taints=taints,
+                        allocatable=alloc_fn(name)
+                        if alloc_fn is not None else None)
 
     def _full_snapshot(self) -> Snapshot:
         cluster = self.cluster
@@ -446,14 +453,15 @@ class Scheduler:
                         or self.allocator.nomination_of(pod.key) is None))
         if (pod.node_selector or pod.tolerations or pod.node_affinity
                 or pod.pod_affinity or pod.pod_anti_affinity
-                or pod.topology_spread):
+                or pod.topology_spread or pod.cpu_millis
+                or pod.memory_bytes):
             memo_key = (spec, frozenset(pod.node_selector.items()),
                         tuple((t.get("key", ""), t.get("operator", "Equal"),
                                t.get("value", ""), t.get("effect", ""))
                               for t in pod.tolerations),
                         pod.node_affinity, pod.pod_affinity,
                         pod.pod_anti_affinity, pod.topology_spread,
-                        pod.namespace)
+                        pod.cpu_millis, pod.memory_bytes, pod.namespace)
         else:
             # namespace is part of even the plain class: a bound pod's
             # anti-affinity (symmetry rule) can repel pods of one
@@ -572,10 +580,14 @@ class Scheduler:
                             self.allocator.nominate_gang(
                                 spec.gang_name, slice_id, spec.chips,
                                 spec.priority,
-                                expires_at=now + 2 * self.config.gang_timeout_s)
+                                expires_at=now + 2 * self.config.gang_timeout_s,
+                                cpu_millis=pod.cpu_millis,
+                                memory_bytes=pod.memory_bytes)
                         else:
-                            self.allocator.nominate(pod.key, nominated,
-                                                    spec.chips, spec.priority)
+                            self.allocator.nominate(
+                                pod.key, nominated, spec.chips, spec.priority,
+                                cpu_millis=pod.cpu_millis,
+                                memory_bytes=pod.memory_bytes)
                     self.metrics.inc("preemptions_total")
                     # budget-violating preemptions are legal (best-effort,
                     # upstream semantics) but operators need to SEE them

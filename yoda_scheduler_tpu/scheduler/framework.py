@@ -128,6 +128,10 @@ class NodeInfo:
     # carry them, the Node objects do.
     labels: dict[str, str] = field(default_factory=dict)
     taints: tuple = ()
+    # status.allocatable as (cpu millicores, memory bytes); None = the
+    # node reports no allocatable, i.e. no cpu/mem constraint (in-memory
+    # fakes and accelerator-only deployments)
+    allocatable: tuple | None = None
     # process-unique identity for version-keyed caches (id() can be reused
     # after GC; the serial never is). A NodeInfo is immutable once built, so
     # serial equality == same telemetry + same bound-pod set.
@@ -138,6 +142,7 @@ class NodeInfo:
     _claimed_chips: int | None = field(default=None, repr=False, compare=False)
     _claimed_hbm: int | None = field(default=None, repr=False, compare=False)
     _assigned: set | None = field(default=None, repr=False, compare=False)
+    _req_cpu_mem: tuple | None = field(default=None, repr=False, compare=False)
 
     def claimed_chips(self) -> int:
         """Chips already claimed by bound pods' labels (allocation view)."""
@@ -168,6 +173,21 @@ class NodeInfo:
             self._claimed_hbm = total
         return self._claimed_hbm
 
+    def requested_cpu_mem(self) -> tuple[int, int]:
+        """(cpu millicores, memory bytes) requested by bound pods —
+        NodeResourcesFit accounting. Terminating pods COUNT: they hold
+        their resources until deletion, exactly as their chips stay
+        assigned (the preemptor waiting on them holds a nomination, and
+        the engine's victims-draining guard covers the window). Memoized
+        per NodeInfo."""
+        if self._req_cpu_mem is None:
+            cpu = mem = 0
+            for p in self.pods:
+                cpu += p.cpu_millis
+                mem += p.memory_bytes
+            self._req_cpu_mem = (cpu, mem)
+        return self._req_cpu_mem
+
     def assigned_coords(self) -> set[tuple[int, int, int]]:
         """ICI coords claimed by bound pods (from bind-time chip assignment)."""
         if self._assigned is None:
@@ -195,6 +215,7 @@ class Snapshot:
         # the dirty set cannot have changed it
         self._any_taints: bool | None = None
         self._any_pod_anti: bool | None = None
+        self._any_alloc: bool | None = None
 
     def get(self, name: str) -> NodeInfo | None:
         return self._node_infos.get(name)
@@ -210,6 +231,16 @@ class Snapshot:
             self._any_taints = any(
                 ni.taints for ni in self._node_infos.values())
         return self._any_taints
+
+    def any_allocatable(self) -> bool:
+        """True when any node reports status.allocatable — without one,
+        NodeResourcesFit has nothing to constrain and pods with ordinary
+        container requests stay out of the admission hot loops."""
+        if self._any_alloc is None:
+            self._any_alloc = any(
+                ni.allocatable is not None
+                for ni in self._node_infos.values())
+        return self._any_alloc
 
     def any_pod_anti_affinity(self) -> bool:
         """True when any bound pod carries required podAntiAffinity — the

@@ -275,6 +275,17 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
     preemption planner so it never churns victims on a node the
     preemptor still couldn't pass (the same contract admissible() gives
     it for node-level admission)."""
+    # NodeResourcesFit: if even evicting every evictable pod leaves too
+    # little cpu/mem for the preemptor, the node is uncurable
+    if (pod.cpu_millis or pod.memory_bytes) and node.allocatable is not None:
+        keep_cpu = keep_mem = 0
+        for p in node.pods:
+            if not p.terminating and not evictable_fn(p):
+                keep_cpu += p.cpu_millis
+                keep_mem += p.memory_bytes
+        if (keep_cpu + pod.cpu_millis > node.allocatable[0]
+                or keep_mem + pod.memory_bytes > node.allocatable[1]):
+            return None
     # DoNotSchedule spread violations: eviction COULD cure skew, but
     # proving it needs plan simulation — skip such nodes conservatively
     # rather than churn victims on a still-infeasible node
@@ -318,6 +329,12 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
     name = "node-admission"
     weight = 1
 
+    def __init__(self, allocator=None) -> None:
+        # ChipAllocator (optional): source of nominated-preemptor cpu/mem
+        # holds, so a third pod can't steal resources a preemption freed
+        # while the victims drain
+        self.allocator = allocator
+
     def relevant(self, pod: Pod, snapshot) -> bool:
         """Hot-loop gate (core.py): on an untainted cluster a pod without
         selectors, affinities, or inter-pod terms — and with no bound pod
@@ -328,6 +345,8 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
         return (bool(pod.node_selector) or bool(pod.node_affinity)
                 or bool(pod.preferred_affinity) or bool(pod.pod_affinity)
                 or bool(pod.pod_anti_affinity) or bool(pod.topology_spread)
+                or (bool(pod.cpu_millis or pod.memory_bytes)
+                    and snapshot.any_allocatable())
                 or snapshot.any_taints()
                 or snapshot.any_pod_anti_affinity())
 
@@ -362,6 +381,38 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
             st = self._filter_spread(state, pod, node, snapshot)
             if not st.ok:
                 return st
+        # NodeResourcesFit: cpu/memory requests vs node allocatable
+        # (nodes reporting no allocatable are unconstrained — in-memory
+        # fakes and accelerator-only fleets)
+        if (pod.cpu_millis or pod.memory_bytes) \
+                and node.allocatable is not None:
+            used_cpu, used_mem = node.requested_cpu_mem()
+            if self.allocator is not None:
+                spec = state.read_or("workload_spec")
+                prio = spec.priority if spec is not None else 0
+                hold_cpu, hold_mem = self.allocator.nominated_cpu_mem(
+                    node.name, prio, pod.key)
+                used_cpu += hold_cpu
+                used_mem += hold_mem
+                m = node.metrics
+                if m is not None and m.slice_id:
+                    gcpu, gmem = self.allocator.gang_cpu_mem_hold(
+                        m.slice_id, prio,
+                        exclude_gang=spec.gang_name if spec is not None
+                        else None)
+                    used_cpu += gcpu
+                    used_mem += gmem
+            alloc_cpu, alloc_mem = node.allocatable
+            if used_cpu + pod.cpu_millis > alloc_cpu:
+                return Status.unschedulable(
+                    f"{node.name}: insufficient cpu "
+                    f"({used_cpu}m used + {pod.cpu_millis}m requested "
+                    f"> {alloc_cpu}m allocatable)")
+            if used_mem + pod.memory_bytes > alloc_mem:
+                return Status.unschedulable(
+                    f"{node.name}: insufficient memory "
+                    f"({used_mem} used + {pod.memory_bytes} requested "
+                    f"> {alloc_mem} allocatable)")
         if node.taints:
             bad = untolerated(pod, node.taints, (NO_SCHEDULE, NO_EXECUTE))
             if bad:

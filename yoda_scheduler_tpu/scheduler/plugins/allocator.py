@@ -78,14 +78,14 @@ class ChipAllocator(ReservePlugin):
         # free pool, but pods of lower-or-equal priority must not consume
         # them first (or co-hosted profiles rebind victims into the hole
         # and the preemptor livelocks).
-        self._nominated: dict[str, tuple[str, int, int]] = {}  # pod.key -> (node, chips, priority)
+        self._nominated: dict[str, tuple] = {}  # pod.key -> (node, chips, priority, cpu_millis, memory_bytes)
         # gang-level nominations: a gang that preempted is entitled to
         # `chips_per_host` on EVERY host of its chosen slice until it
         # completes, fails, or the entitlement expires — victims free
         # capacity on several hosts at once and single-pod holds can't
         # cover hosts whose member hasn't cycled yet.
         # gang -> (slice_id, chips_per_host, priority, expires_at)
-        self._gang_nominated: dict[str, tuple[str, int, int, float]] = {}
+        self._gang_nominated: dict[str, tuple] = {}  # gang -> (slice, chips/host, prio, expiry, cpu/host, mem/host)
         # global version over reservations + nominations (cheap read) — the
         # engine's unschedulable-class memo keys on it
         self._version = 0
@@ -236,9 +236,11 @@ class ChipAllocator(ReservePlugin):
         return score
 
     # ---------------------------------------------------------- nominations
-    def nominate(self, pod_key: str, node: str, chips: int, priority: int) -> None:
+    def nominate(self, pod_key: str, node: str, chips: int, priority: int,
+                 cpu_millis: int = 0, memory_bytes: int = 0) -> None:
         with self._lock:
-            self._nominated[pod_key] = (node, chips, priority)
+            self._nominated[pod_key] = (node, chips, priority,
+                                        cpu_millis, memory_bytes)
             self._version += 1
 
     def unnominate(self, pod_key: str) -> None:
@@ -246,16 +248,20 @@ class ChipAllocator(ReservePlugin):
             if self._nominated.pop(pod_key, None) is not None:
                 self._version += 1
 
-    def nomination_of(self, pod_key: str) -> tuple[str, int, int] | None:
-        """(node, chips, priority) this pod is entitled to, if any."""
+    def nomination_of(self, pod_key: str) -> tuple | None:
+        """(node, chips, priority, cpu_millis, memory_bytes) this pod is
+        entitled to, if any."""
         with self._lock:
             return self._nominated.get(pod_key)
 
     def nominate_gang(self, gang: str, slice_id: str, chips_per_host: int,
-                      priority: int, expires_at: float) -> None:
+                      priority: int, expires_at: float,
+                      cpu_millis: int = 0, memory_bytes: int = 0) -> None:
+        """cpu_millis/memory_bytes are PER HOST (one gang member each)."""
         with self._lock:
             self._gang_nominated[gang] = (slice_id, chips_per_host, priority,
-                                          expires_at)
+                                          expires_at, cpu_millis,
+                                          memory_bytes)
             self._version += 1
 
     def unnominate_gang(self, gang: str) -> None:
@@ -280,7 +286,8 @@ class ChipAllocator(ReservePlugin):
             return 0  # fast path (GIL-atomic read)
         with self._lock:
             hold = 0
-            for gang, (sid, chips, prio, exp) in list(self._gang_nominated.items()):
+            for gang, nom in list(self._gang_nominated.items()):
+                sid, chips, prio, exp = nom[:4]
                 if now is not None and exp < now:
                     del self._gang_nominated[gang]
                     self._version += 1
@@ -288,6 +295,23 @@ class ChipAllocator(ReservePlugin):
                 if sid == slice_id and prio >= priority and gang != exclude_gang:
                     hold += chips
             return hold
+
+    def gang_cpu_mem_hold(self, slice_id: str, priority: int,
+                          exclude_gang: str | None = None
+                          ) -> tuple[int, int]:
+        """(cpu millicores, memory bytes) PER HOST held on `slice_id` for
+        nominated gangs that outrank (or tie) `priority` — the cpu/mem
+        twin of gang_hold."""
+        if not self._gang_nominated:
+            return 0, 0
+        with self._lock:
+            cpu = mem = 0
+            for gang, nom in self._gang_nominated.items():
+                if (nom[0] == slice_id and nom[2] >= priority
+                        and gang != exclude_gang):
+                    cpu += nom[4]
+                    mem += nom[5]
+            return cpu, mem
 
     def nominated_hold(self, node: str, priority: int,
                        exclude_key: str | None = None) -> int:
@@ -298,9 +322,27 @@ class ChipAllocator(ReservePlugin):
             return 0  # fast path: nominations are rare (GIL-atomic read)
         with self._lock:
             return sum(
-                chips for key, (n, chips, prio) in self._nominated.items()
-                if n == node and prio >= priority and key != exclude_key
+                nom[1] for key, nom in self._nominated.items()
+                if nom[0] == node and nom[2] >= priority
+                and key != exclude_key
             )
+
+    def nominated_cpu_mem(self, node: str, priority: int,
+                          exclude_key: str | None = None) -> tuple[int, int]:
+        """(cpu millicores, memory bytes) on `node` held for nominated
+        preemptors that outrank (or tie) `priority` — the cpu/mem twin of
+        nominated_hold, so a third pod cannot steal the resources a
+        preemption freed during the victims' drain window."""
+        if not self._nominated:
+            return 0, 0
+        with self._lock:
+            cpu = mem = 0
+            for key, nom in self._nominated.items():
+                if nom[0] == node and nom[2] >= priority \
+                        and key != exclude_key:
+                    cpu += nom[3]
+                    mem += nom[4]
+            return cpu, mem
 
     def holds_for(self, spec: WorkloadSpec, node_info: NodeInfo,
                   pod_key: str | None, now: float | None = None) -> int:

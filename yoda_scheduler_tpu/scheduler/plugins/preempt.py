@@ -96,7 +96,7 @@ class PriorityPreemption(PostFilterPlugin):
             if obstacles is None:
                 continue
             victims = self._plan_node(spec, my_prio, node, pod_key=pod.key,
-                                      ledger=ledger)
+                                      ledger=ledger, pod=pod)
             if victims is None:
                 continue  # capacity unreachable even with evictions
             seen_keys = {v.key for v in victims}
@@ -190,7 +190,7 @@ class PriorityPreemption(PostFilterPlugin):
                 if host.name in covered:
                     continue
                 victims = self._plan_node(spec, my_prio, host, pod_key=pod.key,
-                                          ledger=ledger)
+                                          ledger=ledger, pod=pod)
                 if victims is None:
                     continue  # this host can't reach spec.chips at all
                 # per-host cost leads with this host's own PDB violations
@@ -246,12 +246,14 @@ class PriorityPreemption(PostFilterPlugin):
 
     def _plan_node(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
                    pod_key: str | None = None,
-                   ledger: DisruptionLedger | None = None
-                   ) -> list[Pod] | None:
-        """Victims on this node that free `spec.chips` qualifying chips:
-        [] when the node already fits without evicting, None when it cannot
-        reach the target at all. Shared by the single-pod path and the
-        per-host step of gang slice planning."""
+                   ledger: DisruptionLedger | None = None,
+                   pod: Pod | None = None) -> list[Pod] | None:
+        """Victims on this node that free `spec.chips` qualifying chips AND
+        (when `pod` carries container requests and the node reports
+        allocatable) enough cpu/memory: [] when the node already fits
+        without evicting, None when it cannot reach the target at all.
+        Shared by the single-pod path and the per-host step of gang slice
+        planning."""
         m = node.metrics
         free = self.allocator.free_coords(node)
         # capacity already held for OTHER nominated preemptors (pod-level
@@ -265,7 +267,28 @@ class PriorityPreemption(PostFilterPlugin):
             c.coords for c in m.healthy_chips()
             if c.hbm_total_mb >= spec.min_free_mb and c.clock_mhz >= spec.min_clock_mhz
         }
-        if len(free & ok_coords) - hold >= spec.chips:
+        # cpu/mem target (NodeResourcesFit): how much must be freed.
+        # Nominated preemptors' cpu/mem holds count as used, exactly as
+        # holds_for does for chips — otherwise two preemptors prove
+        # themselves into the same freed resources.
+        need_cpu = need_mem = 0
+        used_cpu = used_mem = 0
+        if (pod is not None and (pod.cpu_millis or pod.memory_bytes)
+                and node.allocatable is not None):
+            used_cpu, used_mem = node.requested_cpu_mem()
+            hold_cpu, hold_mem = self.allocator.nominated_cpu_mem(
+                node.name, spec.priority, pod_key)
+            used_cpu += hold_cpu
+            used_mem += hold_mem
+            need_cpu, need_mem = pod.cpu_millis, pod.memory_bytes
+
+        def resources_fit() -> bool:
+            if not need_cpu and not need_mem:
+                return True
+            return (used_cpu + need_cpu <= node.allocatable[0]
+                    and used_mem + need_mem <= node.allocatable[1])
+
+        if len(free & ok_coords) - hold >= spec.chips and resources_fit():
             return []  # fits as-is; nothing to evict here
         # fast reject before sorting: with no evictable lower-priority pod
         # the target is unreachable. This is the common case for every node
@@ -286,16 +309,50 @@ class PriorityPreemption(PostFilterPlugin):
         tracker = (ledger.tracker()
                    if ledger is not None and ledger.budgets else None)
         victims: list[Pod] = []
-        while len(free & ok_coords) - hold < spec.chips:
+        while (len(free & ok_coords) - hold < spec.chips
+               or not resources_fit()):
             if not pool:
                 return None
+            chips_met = len(free & ok_coords) - hold >= spec.chips
+            candidates = pool
+            if chips_met:
+                # only the resource target remains: restrict picks to pods
+                # that actually free some of the short resource — evicting
+                # resource-less pods makes no progress
+                candidates = [
+                    p for p in pool
+                    if (used_cpu + need_cpu > node.allocatable[0]
+                        and p.cpu_millis)
+                    or (used_mem + need_mem > node.allocatable[1]
+                        and p.memory_bytes)
+                ]
+                if not candidates:
+                    return None
             if tracker is None:
-                v = pool.pop(0)
+                v = min(candidates, key=_priority)
             else:
-                v = min(pool,
+                v = min(candidates,
                         key=lambda p: (tracker.would_violate(p), _priority(p)))
-                pool.remove(v)
                 tracker.consume_one(v)
+            pool.remove(v)
             victims.append(v)
             free = free | v.assigned_chips()
+            used_cpu -= v.cpu_millis
+            used_mem -= v.memory_bytes
+        # reprieve pass (upstream parity): drop victims whose eviction
+        # turned out unnecessary — early chip-driven picks can be
+        # superseded by later resource-driven ones. Highest priority
+        # reprieved first (spare the most valuable workloads).
+        for v in sorted(victims, key=_priority, reverse=True):
+            without = free - v.assigned_chips()
+            if (len(without & ok_coords) - hold >= spec.chips
+                    and (not need_cpu and not need_mem
+                         or (used_cpu + v.cpu_millis + need_cpu
+                             <= node.allocatable[0]
+                             and used_mem + v.memory_bytes + need_mem
+                             <= node.allocatable[1]))):
+                victims.remove(v)
+                free = without
+                used_cpu += v.cpu_millis
+                used_mem += v.memory_bytes
         return victims

@@ -45,7 +45,7 @@ def registered() -> list[str]:
 
 
 register("priority-sort", lambda cfg, alloc, gangs: PrioritySort())
-register("node-admission", lambda cfg, alloc, gangs: NodeAdmission())
+register("node-admission", lambda cfg, alloc, gangs: NodeAdmission(alloc))
 register("telemetry-filter",
          lambda cfg, alloc, gangs: TelemetryFilter(alloc, gangs, cfg.telemetry_max_age_s))
 register("max-collection", lambda cfg, alloc, gangs: MaxCollection(alloc))

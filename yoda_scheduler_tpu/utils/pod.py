@@ -10,6 +10,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from .memo import memo
+from .quantity import pod_requests
 
 
 class PodPhase(str, Enum):
@@ -231,6 +232,10 @@ class Pod:
     # match_all) — DoNotSchedule constraints filter, ScheduleAnyway ones
     # score (skew penalty)
     topology_spread: tuple = ()
+    # effective container resource requests (upstream NodeResourcesFit
+    # inputs): cpu in millicores, memory in bytes; 0 = unconstrained
+    cpu_millis: int = 0
+    memory_bytes: int = 0
     created: float = field(default_factory=time.time)
 
     @property
@@ -274,6 +279,7 @@ class Pod:
                     spec.get("priorityClassName", ""))
             if isinstance(prio, int) and not isinstance(prio, bool):
                 labels[PRIORITY_LABEL] = str(prio)
+        cpu_m, mem_b = pod_requests(spec)
         return cls(
             name=meta.get("name", "pod"),
             namespace=meta.get("namespace", "default"),
@@ -302,4 +308,6 @@ class Pod:
             pod_anti_affinity=_parse_pod_affinity_terms(
                 spec, "podAntiAffinity"),
             topology_spread=_parse_topology_spread(spec),
+            cpu_millis=cpu_m,
+            memory_bytes=mem_b,
         )
