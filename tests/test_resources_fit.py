@@ -216,3 +216,38 @@ class TestFit:
         assert parse_memory_bytes(-5) is None
         assert parse_memory_bytes("1Ei") == 1024 ** 6
         assert parse_memory_bytes("1500m") == 1
+
+    def test_gang_cpu_hold_counts_in_planning_and_expires(self):
+        """A nominated gang's per-host cpu hold must (a) stop single-pod
+        preemption from proving a zero-victim fit the filter then
+        rejects, and (b) lapse with the entitlement."""
+        from yoda_scheduler_tpu.telemetry import make_v4_slice
+
+        store = TelemetryStore()
+        now = time.time()
+        c = FakeCluster(store)
+        for m in make_v4_slice("s", "2x2x4"):
+            m.heartbeat = now + 1e8
+            store.put(m)
+            c.add_node(m.node)
+            c.set_node_meta(m.node, allocatable=(2000, 8 * 1024 ** 3))
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        sched.allocator.nominate_gang(
+            "g", "s", 4, 9, expires_at=now + 3600,
+            cpu_millis=1500, memory_bytes=0)
+        pod = requesting_pod("wants-cpu", cpu="1")
+        sched.submit(pod)
+        sched.run_until_idle()
+        # every host of the slice holds 1500m for the gang: 1000m more
+        # doesn't fit anywhere and preemption must not nominate either
+        assert pod.phase == PodPhase.FAILED
+        # expired entitlement releases the cpu
+        sched.allocator.unnominate_gang("g")
+        sched.allocator.nominate_gang(
+            "g", "s", 4, 9, expires_at=now - 1, cpu_millis=1500,
+            memory_bytes=0)
+        pod2 = requesting_pod("wants-cpu-2", cpu="1")
+        sched.submit(pod2)
+        sched.run_until_idle()
+        assert pod2.phase == PodPhase.BOUND

@@ -90,3 +90,20 @@ def test_ring_attention_kernel_path_lowers_for_tpu():
     exp = jax.export.export(
         jax.jit(jax.grad(loss, argnums=(0, 1, 2))), platforms=["tpu"])(q, k, v)
     assert [a.shape for a in exp.out_avals] == [(1, 2, 1024, 128)] * 3
+
+
+def test_flash_gqa_lowers_for_tpu():
+    """Grouped-KV index maps (several q-head grid rows sharing one kv
+    row) must survive Mosaic lowering, forward and backward."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (1, 8, 256, 128), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 2, 256, 128), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 2, 256, 128), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    exp = jax.export.export(
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))), platforms=["tpu"])(q, k, v)
+    assert [a.shape for a in exp.out_avals] == [
+        (1, 8, 256, 128), (1, 2, 256, 128), (1, 2, 256, 128)]

@@ -133,6 +133,14 @@ def rotary(x, theta: float, positions=None):
     return out.reshape(b, s, h, hd).astype(x.dtype)
 
 
+def _handles_gqa(impl) -> bool:
+    """Does this attention impl accept k/v with fewer heads than q?
+    (functools.partial wrappers are looked through)."""
+    return bool(getattr(impl, "handles_gqa",
+                        getattr(getattr(impl, "func", None),
+                                "handles_gqa", False)))
+
+
 def _attention_block(x, layer, config: LlamaConfig, attn_impl):
     b, s, d = x.shape
     h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -142,7 +150,10 @@ def _attention_block(x, layer, config: LlamaConfig, attn_impl):
     v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
     q = rotary(q, config.rope_theta)
     k = rotary(k, config.rope_theta)
-    if kvh != h:  # GQA: broadcast KV heads to Q heads
+    if kvh != h and not _handles_gqa(attn_impl):
+        # GQA broadcast for attention impls that need equal head counts
+        # (ulysses all-to-all resharding); GQA-aware impls (the flash
+        # kernel, ring) read grouped KV natively — no repeated HBM tensor
         rep = h // kvh
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

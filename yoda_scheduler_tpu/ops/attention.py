@@ -36,8 +36,15 @@ def reference_attention(q, k, v, causal: bool = True):
 def reference_attention_with_lse(q, k, v, causal: bool = True):
     """reference_attention plus per-row log-sum-exp of the scaled scores
     ([B, H, S] fp32) — the statistic that lets partial attentions over
-    key/value chunks be merged exactly (parallel/ring.py)."""
-    _, _, sq, d = q.shape
+    key/value chunks be merged exactly (parallel/ring.py). GQA accepted:
+    k/v may carry fewer heads than q (h % kvh == 0); they broadcast."""
+    _, h, sq, d = q.shape
+    kvh = k.shape[1]
+    if kvh != h:
+        if h % kvh:
+            raise ValueError(f"q heads {h} not a multiple of kv heads {kvh}")
+        k = jnp.repeat(k, h // kvh, axis=1)
+        v = jnp.repeat(v, h // kvh, axis=1)
     sk = k.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(d, scores.dtype))
@@ -143,6 +150,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    kvh = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     assert sq % block_q == 0 and sk % block_k == 0, (
@@ -150,9 +158,16 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     )
     sm_scale = 1.0 / (d ** 0.5)
     bh = b * h
+    rep = h // kvh
     qr = q.reshape(bh, sq, d)
-    kr = k.reshape(bh, sk, d)
-    vr = v.reshape(bh, sk, d)
+    # GQA: K/V stay at their native head count — the index map routes each
+    # q head's grid row to its group's kv row, so grouped heads share one
+    # VMEM copy instead of reading a jnp.repeat'ed tensor from HBM
+    kr = k.reshape(b * kvh, sk, d)
+    vr = v.reshape(b * kvh, sk, d)
+
+    def kv_row(bhi, qi):
+        return ((bhi // h) * kvh + (bhi % h) // rep, 0, 0)
 
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_k=sk, causal=causal,
@@ -163,8 +178,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         grid=(bh, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bhi, qi: (bhi, qi, 0)),
-            pl.BlockSpec((1, sk, d), lambda bhi, qi: (bhi, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bhi, qi: (bhi, 0, 0)),
+            pl.BlockSpec((1, sk, d), kv_row),
+            pl.BlockSpec((1, sk, d), kv_row),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bhi, qi: (bhi, qi, 0)),
@@ -288,11 +303,25 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     caller consumed it (flash_attention_with_lse). It needs NO kernel
     change: d lse/d s = p per row, so ds = p*(dp - delta + g_lse)*scale —
     algebraically the same as shrinking delta by g_lse before streaming it
-    into the unchanged kernels."""
+    into the unchanged kernels.
+
+    GQA (kv heads < q heads): the backward broadcasts K/V to full heads
+    and group-sums dk/dv afterwards — the same cost as the pre-GQA
+    repeated-KV path; only the forward gets the grouped-read saving."""
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    kvh = k.shape[1]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+        dq, dk, dv = _flash_backward(q, k, v, o, lse, do, causal, block_q,
+                                     block_k, interpret, g_lse=g_lse)
+        return (dq,
+                dk.reshape(b, kvh, rep, sk, d).sum(axis=2),
+                dv.reshape(b, kvh, rep, sk, d).sum(axis=2))
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     sm_scale = 1.0 / (d ** 0.5)
@@ -456,6 +485,16 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
     return _flash_pair(q, k, v, causal, *blocks)
 
 
+# every entry point in this module accepts GQA-shaped inputs (k/v with
+# fewer heads than q); the model layer checks this flag before deciding
+# whether it must broadcast KV itself for a custom attention impl
+flash_attention.handles_gqa = True
+flash_attention_with_lse.handles_gqa = True
+reference_attention.handles_gqa = True
+reference_attention_with_lse.handles_gqa = True
+manual_region_attention.handles_gqa = True
+
+
 def _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
                     block_k_bwd):
     """Shared block resolution; None means 'use the XLA reference path'."""
@@ -465,6 +504,9 @@ def _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
         # ill-defined (the reference would emit uniform attention over fully
         # masked scores); refuse rather than silently diverge per path
         raise ValueError(f"causal attention needs seq_q <= seq_kv, got {sq} > {sk}")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"q heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}")
     # explicit block sizes keep their exact pre-auto-selection semantics
     # (clamped to the sequence; non-divisors fall back): callers shrink
     # blocks deliberately for VMEM pressure and must not be second-guessed

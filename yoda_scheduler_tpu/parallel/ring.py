@@ -122,8 +122,12 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp"):
     )(q, k, v)
 
 
+ring_attention.handles_gqa = True  # per-chunk compute is GQA-aware
+
+
 def make_ring_attn(mesh, axis_name: str = "sp"):
     """attn_impl adapter for models.llama.llama_forward."""
     def attn(q, k, v):
         return ring_attention(q, k, v, mesh, axis_name)
+    attn.handles_gqa = True
     return attn

@@ -399,7 +399,8 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                     gcpu, gmem = self.allocator.gang_cpu_mem_hold(
                         m.slice_id, prio,
                         exclude_gang=spec.gang_name if spec is not None
-                        else None)
+                        else None,
+                        now=state.read_or("now"))
                     used_cpu += gcpu
                     used_mem += gmem
             alloc_cpu, alloc_mem = node.allocatable

@@ -297,16 +297,21 @@ class ChipAllocator(ReservePlugin):
             return hold
 
     def gang_cpu_mem_hold(self, slice_id: str, priority: int,
-                          exclude_gang: str | None = None
-                          ) -> tuple[int, int]:
+                          exclude_gang: str | None = None,
+                          now: float | None = None) -> tuple[int, int]:
         """(cpu millicores, memory bytes) PER HOST held on `slice_id` for
         nominated gangs that outrank (or tie) `priority` — the cpu/mem
-        twin of gang_hold."""
+        twin of gang_hold, with the same lazy expiry pruning (a gang that
+        never completed must not poison the slice's cpu accounting)."""
         if not self._gang_nominated:
             return 0, 0
         with self._lock:
             cpu = mem = 0
-            for gang, nom in self._gang_nominated.items():
+            for gang, nom in list(self._gang_nominated.items()):
+                if now is not None and nom[3] < now:
+                    del self._gang_nominated[gang]
+                    self._version += 1
+                    continue
                 if (nom[0] == slice_id and nom[2] >= priority
                         and gang != exclude_gang):
                     cpu += nom[4]

@@ -280,6 +280,16 @@ class PriorityPreemption(PostFilterPlugin):
                 node.name, spec.priority, pod_key)
             used_cpu += hold_cpu
             used_mem += hold_mem
+            if m is not None and m.slice_id:
+                # gang-level holds count too, exactly as holds_for folds
+                # gang_hold into the chips side — otherwise this planner
+                # proves a zero-victim fit the admission filter then
+                # rejects, and the preemptor ping-pongs on the node
+                gcpu, gmem = self.allocator.gang_cpu_mem_hold(
+                    m.slice_id, spec.priority,
+                    exclude_gang=spec.gang_name if spec.is_gang else None)
+                used_cpu += gcpu
+                used_mem += gmem
             need_cpu, need_mem = pod.cpu_millis, pod.memory_bytes
 
         def resources_fit() -> bool:
