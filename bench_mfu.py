@@ -340,6 +340,31 @@ def attention_bench(on_tpu: bool, peak: float | None = None) -> dict:
             "xla_fwdbwd_ms": ms(t_ref_g),
             "fwdbwd_speedup": speedup(t_ref_g, t_flash_g),
         }
+    # GQA: grouped-KV kernel reads vs broadcasting KV to full heads first
+    # (the pre-GQA path). 16 q heads over 4 kv heads at the longest benched
+    # sequence that fit — the delta is the saved KV HBM traffic.
+    if on_tpu and out:
+        s = max(int(k[1:]) for k in out)
+        b = max(1, 8192 // s)
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, 4, s, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, 4, s, d), jnp.bfloat16)
+        _progress(f"gqa S={s} B={b} heads 16:4")
+        t_grouped = _kernel_time_s(
+            lambda q, k, v: flash_attention(q, k, v, causal=True),
+            q, k, v, n1, n2)
+        t_repeat = _kernel_time_s(
+            lambda q, k, v: flash_attention(
+                q, jnp.repeat(k, 4, axis=1), jnp.repeat(v, 4, axis=1),
+                causal=True),
+            q, k, v, n1, n2)
+        out["gqa_16q_4kv"] = {
+            "seq": s, "batch": b,
+            "grouped_fwd_ms": ms(t_grouped),
+            "repeated_fwd_ms": ms(t_repeat),
+            "grouped_speedup": speedup(t_repeat, t_grouped),
+        }
     return out
 
 
@@ -373,8 +398,10 @@ def main() -> None:
     # largest sequence where the XLA baseline still runs (above that, the
     # baseline OOMs and the "speedup" is infinite)
     numeric = {k: v for k, v in attn.items()
-               if isinstance(v["fwd_speedup"], (int, float))}
-    top_s = max(numeric or attn, key=lambda k: int(k[1:]))
+               if isinstance(v.get("fwd_speedup"), (int, float))}
+    seq_keys = [k for k in (numeric or attn)
+                if k.startswith("S") and k[1:].isdigit()]
+    top_s = max(seq_keys, key=lambda k: int(k[1:])) if seq_keys else None
     watchdog.cancel()  # completed in time
     print(json.dumps({
         "metric": "llama_train_mfu",
@@ -384,8 +411,10 @@ def main() -> None:
         # vs_baseline: the Pallas flash kernel against this repo's own
         # plain-XLA reference_attention at the longest benched sequence
         # (fwd; the reference publishes no numbers of its own — BASELINE.md)
-        "vs_baseline": (attn[top_s]["fwd_speedup"]
-                        if isinstance(attn[top_s]["fwd_speedup"], (int, float))
+        "vs_baseline": (attn[top_s].get("fwd_speedup")
+                        if top_s is not None
+                        and isinstance(attn[top_s].get("fwd_speedup"),
+                                       (int, float))
                         else None),
         "backend": jax.default_backend(),
         "train": train,

@@ -166,3 +166,33 @@ class TestRingGradients:
         gr_ref = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gr_ring, gr_ref):
             assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+
+class TestRingGQA:
+    def test_ring_gqa_matches_reference(self):
+        """Grouped KV through the ring (kvh divides tp=1): parity with the
+        repeated-KV reference over the full sequence."""
+        mesh = make_mesh({"sp": 4})
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
+        q = jax.random.normal(kq, (1, 4, 128, 32))
+        k = jax.random.normal(kk, (1, 2, 128, 32))
+        v = jax.random.normal(kv, (1, 2, 128, 32))
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+        ref = reference_attention(q, jnp.repeat(k, 2, axis=1),
+                                  jnp.repeat(v, 2, axis=1))
+        assert out.shape == q.shape
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+    def test_ring_gqa_indivisible_tp_broadcasts(self):
+        """kvh=2 cannot split over tp=4: the ring broadcasts KV to full
+        heads (pre-GQA behavior) instead of crashing on shard_map
+        divisibility."""
+        mesh = make_mesh({"sp": 2, "tp": 4})
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(10), 3)
+        q = jax.random.normal(kq, (1, 8, 64, 32))
+        k = jax.random.normal(kk, (1, 2, 64, 32))
+        v = jax.random.normal(kv, (1, 2, 64, 32))
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+        ref = reference_attention(q, jnp.repeat(k, 4, axis=1),
+                                  jnp.repeat(v, 4, axis=1))
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5

@@ -114,6 +114,14 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp"):
     seq = q.shape[2]
     if seq % axis_size:
         raise ValueError(f"seq {seq} not divisible by {axis_name}={axis_size}")
+    # GQA: grouped KV flows through untouched when its head axis still
+    # splits over 'tp'; otherwise broadcast to full heads first (the
+    # pre-GQA behavior) so tp configs that worked before keep working
+    h, kvh = q.shape[1], k.shape[1]
+    if kvh != h and kvh % mesh.shape.get("tp", 1):
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     spec = P(("dp", "fsdp"), "tp", axis_name, None)
     body = functools.partial(_ring_body, axis_name=axis_name,
                              axis_size=axis_size)

@@ -96,7 +96,7 @@ class PriorityPreemption(PostFilterPlugin):
             if obstacles is None:
                 continue
             victims = self._plan_node(spec, my_prio, node, pod_key=pod.key,
-                                      ledger=ledger, pod=pod)
+                                      ledger=ledger, pod=pod, now=now)
             if victims is None:
                 continue  # capacity unreachable even with evictions
             seen_keys = {v.key for v in victims}
@@ -190,7 +190,7 @@ class PriorityPreemption(PostFilterPlugin):
                 if host.name in covered:
                     continue
                 victims = self._plan_node(spec, my_prio, host, pod_key=pod.key,
-                                          ledger=ledger, pod=pod)
+                                          ledger=ledger, pod=pod, now=now)
                 if victims is None:
                     continue  # this host can't reach spec.chips at all
                 # per-host cost leads with this host's own PDB violations
@@ -241,13 +241,14 @@ class PriorityPreemption(PostFilterPlugin):
         if spec.accelerator is not None and m.accelerator != spec.accelerator:
             return None
         victims = self._plan_node(spec, my_prio, node, pod_key=pod_key,
-                                  ledger=ledger)
+                                  ledger=ledger, now=now)
         return victims or None
 
     def _plan_node(self, spec: WorkloadSpec, my_prio: int, node: NodeInfo,
                    pod_key: str | None = None,
                    ledger: DisruptionLedger | None = None,
-                   pod: Pod | None = None) -> list[Pod] | None:
+                   pod: Pod | None = None,
+                   now: float | None = None) -> list[Pod] | None:
         """Victims on this node that free `spec.chips` qualifying chips AND
         (when `pod` carries container requests and the node reports
         allocatable) enough cpu/memory: [] when the node already fits
@@ -284,10 +285,12 @@ class PriorityPreemption(PostFilterPlugin):
                 # gang-level holds count too, exactly as holds_for folds
                 # gang_hold into the chips side — otherwise this planner
                 # proves a zero-victim fit the admission filter then
-                # rejects, and the preemptor ping-pongs on the node
+                # rejects, and the preemptor ping-pongs on the node.
+                # `now` prunes expired entitlements like the filter does.
                 gcpu, gmem = self.allocator.gang_cpu_mem_hold(
                     m.slice_id, spec.priority,
-                    exclude_gang=spec.gang_name if spec.is_gang else None)
+                    exclude_gang=spec.gang_name if spec.is_gang else None,
+                    now=now)
                 used_cpu += gcpu
                 used_mem += gmem
             need_cpu, need_mem = pod.cpu_millis, pod.memory_bytes
