@@ -276,3 +276,113 @@ class TestPreemptionInterplay:
         assert hp.phase == PodPhase.FAILED
         assert filler.phase == PodPhase.BOUND
         assert sched.metrics.counters.get("pods_evicted_total", 0) == 0
+
+
+class TestPreferredPodAffinity:
+    def test_prefers_cohosted_domain(self):
+        """Preferred podAffinity pulls a pod toward the domain holding its
+        companion without ever blocking placement elsewhere."""
+        c = _cluster({"n1": "a", "n2": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        anchor = mk_pod("anchor", {"app": "cache"})
+        sched.submit(anchor)
+        sched.run_until_idle()
+        anchor_zone = "a" if anchor.node == "n1" else "b"
+        follower = mk_pod("f", {"app": "web"}, {"podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 100, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "cache"}},
+                    "topologyKey": "zone"}}]}})
+        sched.submit(follower)
+        sched.run_until_idle()
+        assert follower.phase == PodPhase.BOUND
+        follower_zone = "a" if follower.node == "n1" else "b"
+        assert follower_zone == anchor_zone
+
+    def test_preferred_anti_pushes_away(self):
+        c = _cluster({"n1": "a", "n2": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        noisy = mk_pod("noisy", {"app": "noisy"})
+        sched.submit(noisy)
+        sched.run_until_idle()
+        noisy_zone = "a" if noisy.node == "n1" else "b"
+        quiet = mk_pod("quiet", {"app": "quiet"}, {"podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 100, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "noisy"}},
+                    "topologyKey": "zone"}}]}})
+        sched.submit(quiet)
+        sched.run_until_idle()
+        assert quiet.phase == PodPhase.BOUND
+        quiet_zone = "a" if quiet.node == "n1" else "b"
+        assert quiet_zone != noisy_zone
+
+    def test_never_blocks(self):
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        noisy = mk_pod("noisy", {"app": "noisy"})
+        sched.submit(noisy)
+        sched.run_until_idle()
+        quiet = mk_pod("quiet", {"app": "quiet"}, {"podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 100, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "noisy"}},
+                    "topologyKey": "zone"}}]}})
+        sched.submit(quiet)
+        sched.run_until_idle()
+        assert quiet.phase == PodPhase.BOUND  # only option, despite penalty
+
+    def test_malformed_entries_dropped(self):
+        p = mk_pod("p", {}, {"podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 500, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"a": "b"}},
+                    "topologyKey": "zone"}},
+                {"weight": 50},
+                "notadict",
+            ]}})
+        assert p.preferred_pod_affinity == ()
+
+    def test_multiplicity_weights_per_matching_pod(self):
+        """3 companions in zone a vs 1 in zone b: the follower must land
+        in a (upstream weights once per matching pod, not per domain)."""
+        c = _cluster({"n1": "a", "n2": "b"}, chips=8)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        for i in range(3):
+            p = mk_pod(f"ca{i}", {"app": "cache"})
+            c.bind(p, "n1", [(i, 0, 0)])
+        c.bind(mk_pod("cb", {"app": "cache"}), "n2", [(0, 0, 0)])
+        # equalize capacity load so the telemetry scorer ties and the
+        # preference multiplicity decides
+        for i in range(2):
+            c.bind(mk_pod(f"fill{i}", {"app": "other"}), "n2",
+                   [(i + 1, 0, 0)])
+        follower = mk_pod("f", {"app": "web"}, {"podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 10, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "cache"}},
+                    "topologyKey": "zone"}}]}})
+        sched.submit(follower)
+        sched.run_until_idle()
+        assert follower.phase == PodPhase.BOUND and follower.node == "n1"
+
+    def test_symmetric_preferred_anti_steers_incoming(self):
+        """A bound pod's preferred anti-affinity against app=web pushes an
+        incoming web pod (with no affinity stanza of its own) to the other
+        zone — upstream's symmetric preferred scoring."""
+        c = _cluster({"n1": "a", "n2": "b"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        sensitive = mk_pod("sensitive", {"app": "db"}, {"podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 100, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "zone"}}]}})
+        sched.submit(sensitive)
+        sched.run_until_idle()
+        sensitive_zone = "a" if sensitive.node == "n1" else "b"
+        web = mk_pod("web", {"app": "web"})
+        sched.submit(web)
+        sched.run_until_idle()
+        assert web.phase == PodPhase.BOUND
+        web_zone = "a" if web.node == "n1" else "b"
+        assert web_zone != sensitive_zone

@@ -558,13 +558,18 @@ spec:
     podAffinity:
       preferredDuringSchedulingIgnoredDuringExecution:
         - weight: 10
+        - weight: 500
+          podAffinityTerm:
+            labelSelector: {matchLabels: {a: b}}
+            topologyKey: zone
 """)
         out = capsys.readouterr().out
         assert rc == 1
         assert "no topologyKey" in out
         assert "no labelSelector" in out.replace("\n", " ")
         assert "operator 'Inn'" in out
-        assert "preferred podAffinity is not modelled" in out
+        assert "podAffinityTerm" in out
+        assert "weight 500" in out
 
     def test_valid_pod_affinity_passes(self, tmp_path, capsys):
         rc = self._run(tmp_path, """

@@ -418,33 +418,29 @@ def cmd_validate(args) -> int:
                     f"{where}: {name}: topologySpreadConstraint has no "
                     f"labelSelector — it counts no pods, so the spread "
                     f"is vacuous")
-        # inter-pod (anti-)affinity: required terms only; preferred pod
-        # affinity is not modelled (flagged so nobody relies on it)
+        # inter-pod (anti-)affinity: required terms filter, preferred
+        # entries score by signed weight
         for which in ("podAffinity", "podAntiAffinity"):
             block = as_dict(aff.get(which), which)
-            if block.get("preferredDuringSchedulingIgnoredDuringExecution"):
+            raw_prefs_pod = block.get(
+                "preferredDuringSchedulingIgnoredDuringExecution") or []
+            if not isinstance(raw_prefs_pod, list):
                 problems.append(
-                    f"{where}: {name}: preferred {which} is not modelled "
-                    f"by this scheduler — the preference is ignored")
-            raw_pod_terms = block.get(
-                "requiredDuringSchedulingIgnoredDuringExecution") or []
-            if not isinstance(raw_pod_terms, list):
-                problems.append(
-                    f"{where}: {name}: {which} required terms is "
-                    f"{type(raw_pod_terms).__name__}, not a list")
-                raw_pod_terms = []
-            for term in raw_pod_terms:
-                term = as_dict(term, f"{which} term")
+                    f"{where}: {name}: preferred {which} is "
+                    f"{type(raw_prefs_pod).__name__}, not a list")
+                raw_prefs_pod = []
+            def lint_pod_term(term, ctx):
+                term = as_dict(term, ctx)
                 if not term.get("topologyKey"):
                     problems.append(
-                        f"{where}: {name}: {which} term has no topologyKey "
+                        f"{where}: {name}: {ctx} has no topologyKey "
                         f"(the apiserver requires one; without it the term "
                         f"can never be satisfied)")
                 sel = term.get("labelSelector")
                 if not sel or not isinstance(sel, dict) or not (
                         sel.get("matchLabels") or sel.get("matchExpressions")):
                     problems.append(
-                        f"{where}: {name}: {which} term has no "
+                        f"{where}: {name}: {ctx} has no "
                         f"labelSelector — it matches no pods")
                 else:
                     for e in (sel.get("matchExpressions") or []):
@@ -453,9 +449,34 @@ def cmd_validate(args) -> int:
                         if op not in ("In", "NotIn", "Exists",
                                       "DoesNotExist"):
                             problems.append(
-                                f"{where}: {name}: {which} matchExpressions "
+                                f"{where}: {name}: {ctx} matchExpressions "
                                 f"operator {op!r} (must be In/NotIn/Exists/"
                                 f"DoesNotExist)")
+
+            for pref in raw_prefs_pod:
+                pref = as_dict(pref, f"preferred {which} entry")
+                w = pref.get("weight")
+                if not (isinstance(w, int) and not isinstance(w, bool)
+                        and 1 <= w <= 100):
+                    problems.append(
+                        f"{where}: {name}: preferred {which} weight {w!r} "
+                        f"(must be an integer in 1-100)")
+                if not isinstance(pref.get("podAffinityTerm"), dict):
+                    problems.append(
+                        f"{where}: {name}: preferred {which} entry has no "
+                        f"podAffinityTerm — it can never match")
+                else:
+                    lint_pod_term(pref["podAffinityTerm"],
+                                  f"preferred {which} term")
+            raw_pod_terms = block.get(
+                "requiredDuringSchedulingIgnoredDuringExecution") or []
+            if not isinstance(raw_pod_terms, list):
+                problems.append(
+                    f"{where}: {name}: {which} required terms is "
+                    f"{type(raw_pod_terms).__name__}, not a list")
+                raw_pod_terms = []
+            for term in raw_pod_terms:
+                lint_pod_term(term, f"{which} term")
 
     for path in args.manifests:
         with open(path) as f:

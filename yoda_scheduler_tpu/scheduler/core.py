@@ -330,6 +330,11 @@ class Scheduler:
                         fresh._any_alloc = snap._any_alloc or any(
                             infos[n].allocatable is not None
                             for n in dirty if n in infos)
+                    if snap._any_pref_pod is not None:
+                        fresh._any_pref_pod = snap._any_pref_pod or any(
+                            p.preferred_pod_affinity
+                            for n in dirty if n in infos
+                            for p in infos[n].pods)
                     self._snap = (fresh, pv, tv, nv0)
                     return fresh
         return self._full_snapshot()

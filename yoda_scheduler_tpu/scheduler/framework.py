@@ -216,6 +216,7 @@ class Snapshot:
         self._any_taints: bool | None = None
         self._any_pod_anti: bool | None = None
         self._any_alloc: bool | None = None
+        self._any_pref_pod: bool | None = None
 
     def get(self, name: str) -> NodeInfo | None:
         return self._node_infos.get(name)
@@ -241,6 +242,16 @@ class Snapshot:
                 ni.allocatable is not None
                 for ni in self._node_infos.values())
         return self._any_alloc
+
+    def any_preferred_pod_affinity(self) -> bool:
+        """True when any bound pod carries preferred inter-pod terms —
+        their symmetric scoring makes them relevant to every incoming
+        pod (gates the admission score hook like any_taints)."""
+        if self._any_pref_pod is None:
+            self._any_pref_pod = any(
+                p.preferred_pod_affinity
+                for ni in self._node_infos.values() for p in ni.pods)
+        return self._any_pref_pod
 
     def any_pod_anti_affinity(self) -> bool:
         """True when any bound pod carries required podAntiAffinity — the

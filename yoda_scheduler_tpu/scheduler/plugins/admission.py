@@ -133,21 +133,11 @@ def _pod_affinity_index(state: CycleState, pod: Pod, snapshot) -> tuple:
 
     affinity = []
     for term in pod.pod_affinity:
-        key = term[3]
-        found = set()
-        if key:
-            for ni in nodes:
-                dom = ni.labels.get(key)
-                if dom is None:
-                    continue
-                if any(not p.terminating
-                       and _pod_term_selects(term, pod.namespace, p)
-                       for p in ni.pods):
-                    found.add(dom)
-        if not found and _pod_term_selects(term, pod.namespace, pod):
+        counts = _term_domain_counts(term, pod.namespace, nodes)
+        if not counts and _pod_term_selects(term, pod.namespace, pod):
             affinity.append((term, _SELF_SATISFIED))
         else:
-            affinity.append((term, frozenset(found)))
+            affinity.append((term, frozenset(counts)))
 
     anti = []
     for term in pod.pod_anti_affinity:
@@ -206,6 +196,65 @@ def admissible(pod: Pod, node: NodeInfo) -> bool:
                                    (NO_SCHEDULE, NO_EXECUTE)):
         return False
     return True
+
+
+_PREF_POD_AFF_STATE = "admission/preferred-pod-affinity-index"
+
+
+def _term_domain_counts(term: tuple, subject_ns: str, nodes) -> dict:
+    """{topology-domain value: number of matching bound pods} for one
+    PodAffinityTerm — the shared scan behind both the required-affinity
+    index and preferred scoring (multiplicity matters for the latter:
+    upstream weights once per matching pod, not once per domain)."""
+    key = term[3]
+    counts: dict = {}
+    if key:
+        for ni in nodes:
+            dom = ni.labels.get(key)
+            if dom is None:
+                continue
+            n = sum(1 for p in ni.pods
+                    if not p.terminating
+                    and _pod_term_selects(term, subject_ns, p))
+            if n:
+                counts[dom] = counts.get(dom, 0) + n
+    return counts
+
+
+def _preferred_pod_affinity_index(state: CycleState, pod: Pod,
+                                  snapshot) -> tuple:
+    """Per-cycle index for PREFERRED inter-pod (anti-)affinity scoring.
+    Two contribution kinds, both upstream InterPodAffinity semantics:
+
+    - the incoming pod's own preferred terms: (weight, key,
+      {domain: matching-pod count}) — weight accrues once per matching
+      pod in the candidate's domain
+    - SYMMETRIC entries from bound pods' preferred terms that select the
+      incoming pod: (weight, key, {domain-of-that-bound-pod: 1})
+    """
+    cached = state.read_or(_PREF_POD_AFF_STATE)
+    if cached is not None:
+        return cached
+    nodes = snapshot.list()
+    out = []
+    for w, term in pod.preferred_pod_affinity:
+        counts = _term_domain_counts(term, pod.namespace, nodes)
+        if counts:
+            out.append((w, term[3], counts))
+    if snapshot.any_preferred_pod_affinity():
+        for ni in nodes:
+            for bound in ni.pods:
+                if bound.terminating:
+                    continue
+                for w, term in bound.preferred_pod_affinity:
+                    key = term[3]
+                    dom = ni.labels.get(key) if key else None
+                    if dom is not None and _pod_term_selects(
+                            term, bound.namespace, pod):
+                        out.append((w, key, {dom: 1}))
+    index = tuple(out)
+    state.write(_PREF_POD_AFF_STATE, index)
+    return index
 
 
 _SPREAD_STATE = "admission/topology-spread-index"
@@ -344,7 +393,9 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
         they only permit what taints would block."""
         return (bool(pod.node_selector) or bool(pod.node_affinity)
                 or bool(pod.preferred_affinity) or bool(pod.pod_affinity)
-                or bool(pod.pod_anti_affinity) or bool(pod.topology_spread)
+                or bool(pod.pod_anti_affinity)
+                or bool(pod.preferred_pod_affinity)
+                or bool(pod.topology_spread)
                 or (bool(pod.cpu_millis or pod.memory_bytes)
                     and snapshot.any_allocatable())
                 or snapshot.any_taints()
@@ -357,6 +408,8 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
         rule) must not drag the constant-zero score hook back into the
         hot loop cluster-wide."""
         return (bool(pod.preferred_affinity) or bool(pod.topology_spread)
+                or bool(pod.preferred_pod_affinity)
+                or snapshot.any_preferred_pod_affinity()
                 or snapshot.any_taints())
 
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
@@ -478,6 +531,18 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
     def score(self, state: CycleState, pod: Pod, node: NodeInfo
               ) -> tuple[float, Status]:
         score = 0.0
+        snapshot = state.read_or("snapshot")
+        if snapshot is not None and (
+                pod.preferred_pod_affinity
+                or snapshot.any_preferred_pod_affinity()):
+            # preferred inter-pod (anti-)affinity, incl. bound pods'
+            # symmetric terms: signed weight per matching pod in the
+            # candidate's domain (index computed once per cycle)
+            for w, key, counts in _preferred_pod_affinity_index(
+                    state, pod, snapshot):
+                dom = node.labels.get(key) if key else None
+                if dom is not None and dom in counts:
+                    score += w * counts[dom]
         if pod.topology_spread:
             snapshot = state.read_or("snapshot")
             if snapshot is not None:

@@ -122,29 +122,53 @@ def _parse_pod_affinity_terms(spec, which: str) -> tuple:
     raise; cli validate reports them."""
     raw = _as_dict(_as_dict(_as_dict(spec).get("affinity")).get(which)).get(
         "requiredDuringSchedulingIgnoredDuringExecution")
+    return tuple(_parse_pod_term(t) for t in (raw if isinstance(raw, list)
+                                              else []))
+
+
+def _parse_pod_term(term) -> tuple:
+    """One PodAffinityTerm -> the 5-tuple documented above."""
+    term = _as_dict(term)
+    raw_sel = term.get("labelSelector")
+    sel = _as_dict(raw_sel)
+    ml = _as_dict(sel.get("matchLabels"))
+    raw_exprs = sel.get("matchExpressions")
+    exprs = tuple(
+        (str(e.get("key", "")), str(e.get("operator", "")),
+         tuple(str(v) for v in e.get("values") or ())
+         if isinstance(e.get("values"), list) else ())
+        for e in (raw_exprs if isinstance(raw_exprs, list) else [])
+        if isinstance(e, dict)
+    )
+    namespaces = term.get("namespaces")
+    return (
+        frozenset((str(k), str(v)) for k, v in ml.items()),
+        exprs,
+        tuple(str(n) for n in namespaces)
+        if isinstance(namespaces, list) else (),
+        str(term.get("topologyKey", "")),
+        isinstance(raw_sel, dict) and not ml and not exprs,
+    )
+
+
+def _parse_preferred_pod_affinity(spec, which: str, sign: int) -> tuple:
+    """spec.affinity.{which}.preferredDuringSchedulingIgnoredDuring
+    Execution -> tuple of (signed weight, PodAffinityTerm tuple). Entries
+    with an out-of-range weight or no podAffinityTerm are dropped (the
+    apiserver rejects them; cli validate reports)."""
+    raw = _as_dict(_as_dict(_as_dict(spec).get("affinity")).get(which)).get(
+        "preferredDuringSchedulingIgnoredDuringExecution")
     out = []
-    for term in (raw if isinstance(raw, list) else []):
-        term = _as_dict(term)
-        raw_sel = term.get("labelSelector")
-        sel = _as_dict(raw_sel)
-        ml = _as_dict(sel.get("matchLabels"))
-        raw_exprs = sel.get("matchExpressions")
-        exprs = tuple(
-            (str(e.get("key", "")), str(e.get("operator", "")),
-             tuple(str(v) for v in e.get("values") or ())
-             if isinstance(e.get("values"), list) else ())
-            for e in (raw_exprs if isinstance(raw_exprs, list) else [])
-            if isinstance(e, dict)
-        )
-        namespaces = term.get("namespaces")
-        out.append((
-            frozenset((str(k), str(v)) for k, v in ml.items()),
-            exprs,
-            tuple(str(n) for n in namespaces)
-            if isinstance(namespaces, list) else (),
-            str(term.get("topologyKey", "")),
-            isinstance(raw_sel, dict) and not ml and not exprs,
-        ))
+    for pref in (raw if isinstance(raw, list) else []):
+        pref = _as_dict(pref)
+        w = pref.get("weight")
+        if (not isinstance(w, int) or isinstance(w, bool)
+                or not 1 <= w <= 100):
+            continue
+        term_raw = pref.get("podAffinityTerm")
+        if not isinstance(term_raw, dict):
+            continue
+        out.append((sign * w, _parse_pod_term(term_raw)))
     return tuple(out)
 
 
@@ -227,6 +251,10 @@ class Pod:
     # (upstream InterPodAffinity semantics).
     pod_affinity: tuple = ()
     pod_anti_affinity: tuple = ()
+    # preferred inter-pod (anti-)affinity: tuples of (signed weight, term)
+    # — positive for podAffinity preferences, negative for podAntiAffinity
+    # (upstream scores them as one summed term list)
+    preferred_pod_affinity: tuple = ()
     # spec.topologySpreadConstraints: tuple of (max_skew, topology_key,
     # when_unsatisfiable, match_labels frozenset, match_expressions tuple,
     # match_all) — DoNotSchedule constraints filter, ScheduleAnyway ones
@@ -307,6 +335,9 @@ class Pod:
             pod_affinity=_parse_pod_affinity_terms(spec, "podAffinity"),
             pod_anti_affinity=_parse_pod_affinity_terms(
                 spec, "podAntiAffinity"),
+            preferred_pod_affinity=(
+                _parse_preferred_pod_affinity(spec, "podAffinity", 1)
+                + _parse_preferred_pod_affinity(spec, "podAntiAffinity", -1)),
             topology_spread=_parse_topology_spread(spec),
             cpu_millis=cpu_m,
             memory_bytes=mem_b,
