@@ -97,3 +97,24 @@ class TestShardedDecode:
         # can flip on a near-tie; the early tokens must agree exactly
         assert jnp.array_equal(single[:, :4], got[:, :4])
         assert got.shape == single.shape
+
+
+def test_sliding_window_inference_matches_training():
+    """A windowed model's cached-generation logits must match the
+    training-path forward exactly — inference silently attending beyond
+    the window would diverge from what was trained."""
+    import dataclasses
+
+    from yoda_scheduler_tpu.models.llama import (
+        LlamaConfig, init_llama, llama_forward)
+    from yoda_scheduler_tpu.models.generate import KVCache, prefill
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=16)
+    params = init_llama(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 48), 0,
+                                cfg.vocab_size)
+    train_logits = llama_forward(params, tokens, cfg)
+    cache = KVCache.zeros(cfg, 1, 64)
+    gen_logits, cache = prefill(params, tokens, cache, cfg)
+    err = jnp.max(jnp.abs(train_logits[0, -1] - gen_logits[0]))
+    assert float(err) < 1e-4

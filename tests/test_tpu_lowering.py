@@ -107,3 +107,17 @@ def test_flash_gqa_lowers_for_tpu():
         jax.jit(jax.grad(loss, argnums=(0, 1, 2))), platforms=["tpu"])(q, k, v)
     assert [a.shape for a in exp.out_avals] == [
         (1, 8, 256, 128), (1, 2, 256, 128), (1, 2, 256, 128)]
+
+
+def test_sliding_window_lowers_for_tpu():
+    """Windowed kernels add dynamic LOWER loop bounds (start_kb) and a
+    clipped upper bound in dk/dv — lower fwd+bwd for the TPU target."""
+    q, k, v = qkv()
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True,
+                               window=100).astype(jnp.float32).sum()
+
+    exp = jax.export.export(
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))), platforms=["tpu"])(q, k, v)
+    assert [a.shape for a in exp.out_avals] == [(1, 2, 256, 128)] * 3

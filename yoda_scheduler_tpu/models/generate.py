@@ -58,9 +58,12 @@ jax.tree_util.register_dataclass(
     KVCache, data_fields=["k", "v", "length"], meta_fields=[])
 
 
-def _cached_attention(q, k_cache, v_cache, q_positions, cache_len):
+def _cached_attention(q, k_cache, v_cache, q_positions, cache_len,
+                      window: int | None = None):
     """q [B, Sq, H, D] against cache [B, max_len, kvH, D]; causal against
-    absolute positions, masked beyond cache_len. Returns [B, Sq, H, D]."""
+    absolute positions, masked beyond cache_len; `window` applies the
+    model's sliding window so inference matches training. Returns
+    [B, Sq, H, D]."""
     b, sq, h, d = q.shape
     kvh = k_cache.shape[2]
     if kvh != h:  # GQA broadcast at attention time
@@ -73,6 +76,9 @@ def _cached_attention(q, k_cache, v_cache, q_positions, cache_len):
     k_pos = jnp.arange(k_cache.shape[1])
     mask = (k_pos[None, None, None, :] <= q_positions[:, None, :, None]) & (
         k_pos[None, None, None, :] < cache_len)
+    if window is not None:
+        mask = mask & (k_pos[None, None, None, :]
+                       > q_positions[:, None, :, None] - window)
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
@@ -112,7 +118,8 @@ def _forward_with_cache(params, tokens, positions, cache: KVCache,
             k_cache, k, (0, cache.length, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v, (0, cache.length, 0, 0))
-        o = _cached_attention(q, k_cache, v_cache, positions, new_len)
+        o = _cached_attention(q, k_cache, v_cache, positions, new_len,
+                              window=config.sliding_window)
         x = x + o.reshape(b, s, h * hd) @ layer["wo"]
         x, _ = _mlp_block(x, layer, config)  # same FFN as training
         return (x,), (k_cache, v_cache)

@@ -43,6 +43,11 @@ class LlamaConfig:
     experts_per_token: int = 2
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Mistral-class sliding-window attention: each token attends to the
+    # last `sliding_window` positions only (None = full causal). The flash
+    # kernel skips out-of-window K blocks entirely, so long-sequence
+    # attention cost becomes O(S * window) instead of O(S^2 / 2).
+    sliding_window: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -192,7 +197,14 @@ def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
     return_aux, -> (logits, aux) where aux is the mean per-layer MoE
     load-balance loss (0 when dense)."""
     if attn_impl is None:
-        attn_impl = partial(flash_attention, causal=True)
+        attn_impl = partial(flash_attention, causal=True,
+                            window=config.sliding_window)
+    elif config.sliding_window is not None:
+        # a custom impl (ring/ulysses) would silently ignore the window
+        # and attend globally — refuse rather than diverge from the config
+        raise ValueError(
+            "sliding_window requires the default flash attention impl; "
+            "custom attn_impl callers must apply the window themselves")
     x = params["embed"][tokens]
 
     def layer_body(carry, layer):

@@ -26,14 +26,17 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def reference_attention(q, k, v, causal: bool = True):
+def reference_attention(q, k, v, causal: bool = True,
+                        window: int | None = None):
     """Plain-XLA attention; the numerical reference for the kernel and the
-    backward-pass recompute. [B, H, S, D] in/out; fp32 softmax accumulation."""
-    out, _ = reference_attention_with_lse(q, k, v, causal)
+    backward-pass recompute. [B, H, S, D] in/out; fp32 softmax accumulation.
+    `window` (requires causal): token i attends to keys (i-window, i]."""
+    out, _ = reference_attention_with_lse(q, k, v, causal, window)
     return out
 
 
-def reference_attention_with_lse(q, k, v, causal: bool = True):
+def reference_attention_with_lse(q, k, v, causal: bool = True,
+                                 window: int | None = None):
     """reference_attention plus per-row log-sum-exp of the scaled scores
     ([B, H, S] fp32) — the statistic that lets partial attentions over
     key/value chunks be merged exactly (parallel/ring.py). GQA accepted:
@@ -48,10 +51,15 @@ def reference_attention_with_lse(q, k, v, causal: bool = True):
     sk = k.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(d, scores.dtype))
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     if causal:
         qi = jnp.arange(sq)[:, None] + (sk - sq)  # support kv longer than q
         ki = jnp.arange(sk)[None, :]
-        scores = jnp.where(ki <= qi, scores, _NEG_INF)
+        mask = ki <= qi
+        if window is not None:
+            mask = mask & (ki > qi - window)
+        scores = jnp.where(mask, scores, _NEG_INF)
     lse = jax.scipy.special.logsumexp(scores, axis=-1)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v), lse
@@ -59,7 +67,7 @@ def reference_attention_with_lse(q, k, v, causal: bool = True):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                   seq_k: int, causal: bool, sm_scale: float, block_q: int,
-                  kv_offset: int):
+                  kv_offset: int, window: int | None = None):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -86,7 +94,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask = mask & (k_pos > q_pos - window)
+            s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -103,7 +114,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         num_iter = jnp.minimum((last_kb + block_k - 1) // block_k, num_kb)
     else:
         num_iter = num_kb
-    m, l, acc = jax.lax.fori_loop(0, num_iter, body, (m, l, acc))
+    if causal and window is not None:
+        # K blocks entirely below the window contribute nothing either:
+        # the oldest visible key for this q block is q_start - window + 1
+        first_tok = kv_offset + qi * block_q - (window - 1)
+        start_kb = jnp.maximum(first_tok // block_k, 0)
+    else:
+        start_kb = 0
+    m, l, acc = jax.lax.fori_loop(start_kb, num_iter, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[0, :, :] = (acc / l).astype(o_ref.dtype)
     # log-sum-exp per row (softmax statistics the backward kernels re-derive
@@ -145,7 +163,7 @@ def _f32_shape_like(q, shape):
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, window: int | None = None):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
@@ -172,6 +190,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_k=sk, causal=causal,
         sm_scale=sm_scale, block_q=block_q, kv_offset=sk - sq,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -199,7 +218,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, seq_k: int, causal: bool,
-                         sm_scale: float, block_q: int, kv_offset: int):
+                         sm_scale: float, block_q: int, kv_offset: int,
+                         window: int | None = None):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -221,7 +241,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask = mask & (k_pos > q_pos - window)
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                       # [Bq, Bk]; masked -> 0
         dp = jax.lax.dot_general(
             do, v, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -235,14 +258,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         num_iter = jnp.minimum((last_kb + block_k - 1) // block_k, num_kb)
     else:
         num_iter = num_kb
-    acc = jax.lax.fori_loop(0, num_iter, body, acc)
+    if causal and window is not None:
+        first_tok = kv_offset + qi * block_q - (window - 1)
+        start_kb = jnp.maximum(first_tok // block_k, 0)
+    else:
+        start_kb = 0
+    acc = jax.lax.fori_loop(start_kb, num_iter, body, acc)
     dq_ref[0, :, :] = acc.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, seq_q: int,
                           causal: bool, sm_scale: float, block_k: int,
-                          kv_offset: int):
+                          kv_offset: int, window: int | None = None):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
@@ -267,7 +295,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask = mask & (k_pos > q_pos - window)
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                       # [Bq, Bk]
         dv_new = dv + jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -287,13 +318,20 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         start_qb = jnp.maximum((ki * block_k - kv_offset) // block_q, 0)
     else:
         start_qb = 0
-    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (dk, dv))
+    if causal and window is not None:
+        # q rows at or beyond k_last + window see none of this k block
+        last_q_tok = (ki + 1) * block_k - 1 + (window - 1) - kv_offset
+        end_qb = jnp.clip(last_q_tok // block_q + 1, start_qb, num_qb)
+    else:
+        end_qb = num_qb
+    dk, dv = jax.lax.fori_loop(start_qb, end_qb, body, (dk, dv))
     dk_ref[0, :, :] = dk.astype(dk_ref.dtype)
     dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
-                    block_k: int, interpret: bool, g_lse=None):
+                    block_k: int, interpret: bool, g_lse=None,
+                    window: int | None = None):
     """Fused FlashAttention backward: two Pallas kernels (dq over q blocks;
     dk/dv over k blocks), re-deriving probabilities from the forward's
     saved log-sum-exp instead of recomputing the online softmax or ever
@@ -318,7 +356,8 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
         dq, dk, dv = _flash_backward(q, k, v, o, lse, do, causal, block_q,
-                                     block_k, interpret, g_lse=g_lse)
+                                     block_k, interpret, g_lse=g_lse,
+                                     window=window)
         return (dq,
                 dk.reshape(b, kvh, rep, sk, d).sum(axis=2),
                 dv.reshape(b, kvh, rep, sk, d).sum(axis=2))
@@ -342,7 +381,8 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_k=block_k, seq_k=sk, causal=causal,
-            sm_scale=sm_scale, block_q=block_q, kv_offset=sk - sq),
+            sm_scale=sm_scale, block_q=block_q, kv_offset=sk - sq,
+            window=window),
         grid=(bh, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bhi, qi: (bhi, qi, 0)),
@@ -360,7 +400,8 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq, causal=causal,
-            sm_scale=sm_scale, block_k=block_k, kv_offset=sk - sq),
+            sm_scale=sm_scale, block_k=block_k, kv_offset=sk - sq,
+            window=window),
         grid=(bh, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, sq, d), lambda bhi, ki: (bhi, 0, 0)),
@@ -384,41 +425,43 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
             dv.reshape(b, h, sk, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_pair(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_pair(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+                window):
     """Kernel entry returning (out [B,H,S,D], lse [B,H,S] fp32). The lse
     output makes chunked/distributed callers (ring attention) mergeable;
     plain flash_attention discards it (its cotangent is then zero and the
     backward reduces to the classic one)."""
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
-                              interpret=_use_interpret())
+                              interpret=_use_interpret(), window=window)
     b, h, sq, _ = q.shape
     return out, lse.reshape(b, h, sq)
 
 
 def _flash_pair_fwd(q, k, v, causal, block_q, block_k, block_q_bwd,
-                    block_k_bwd):
+                    block_k_bwd, window):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
-                              interpret=_use_interpret())
+                              interpret=_use_interpret(), window=window)
     b, h, sq, _ = q.shape
     return (out, lse.reshape(b, h, sq)), (q, k, v, out, lse)
 
 
 def _flash_pair_bwd(causal, block_q, block_k, block_q_bwd, block_k_bwd,
-                    res, g):
+                    window, res, g):
     q, k, v, o, lse = res
     g_out, g_lse = g
     return _flash_backward(q, k, v, o, lse, g_out, causal, block_q_bwd,
                            block_k_bwd, interpret=_use_interpret(),
-                           g_lse=g_lse)
+                           g_lse=g_lse, window=window)
 
 
 _flash_pair.defvjp(_flash_pair_fwd, _flash_pair_bwd)
 
 
-def _flash(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd):
+def _flash(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+           window=None):
     out, _ = _flash_pair(q, k, v, causal, block_q, block_k, block_q_bwd,
-                         block_k_bwd)
+                         block_k_bwd, window)
     return out
 
 
@@ -446,7 +489,8 @@ def _auto_block(seq: int) -> int:
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int | None = None, block_k: int | None = None,
                     block_q_bwd: int | None = None,
-                    block_k_bwd: int | None = None):
+                    block_k_bwd: int | None = None,
+                    window: int | None = None):
     """Fused attention entry point; [B, H, S, D] -> [B, H, S, D].
 
     Compiles to the Pallas kernel on TPU; interpret-mode (same code path)
@@ -462,27 +506,28 @@ def flash_attention(q, k, v, causal: bool = True,
     tuning may diverge.
     """
     blocks = _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
-                             block_k_bwd)
+                             block_k_bwd, window)
     if blocks is None:
-        return reference_attention(q, k, v, causal)
-    return _flash(q, k, v, causal, *blocks)
+        return reference_attention(q, k, v, causal, window)
+    return _flash(q, k, v, causal, *blocks, window=window)
 
 
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              block_q: int | None = None,
                              block_k: int | None = None,
                              block_q_bwd: int | None = None,
-                             block_k_bwd: int | None = None):
+                             block_k_bwd: int | None = None,
+                             window: int | None = None):
     """flash_attention plus the per-row log-sum-exp of the scaled scores
     ([B, H, S] fp32). The LSE lets partial attentions over key/value chunks
     be merged exactly — the primitive behind ring/context parallelism
     (parallel/ring.py). Differentiable in both outputs (the LSE cotangent
     folds into the fused backward at zero extra kernel cost)."""
     blocks = _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
-                             block_k_bwd)
+                             block_k_bwd, window)
     if blocks is None:
-        return reference_attention_with_lse(q, k, v, causal)
-    return _flash_pair(q, k, v, causal, *blocks)
+        return reference_attention_with_lse(q, k, v, causal, window)
+    return _flash_pair(q, k, v, causal, *blocks, window)
 
 
 # every entry point in this module accepts GQA-shaped inputs (k/v with
@@ -496,8 +541,10 @@ manual_region_attention.handles_gqa = True
 
 
 def _resolve_blocks(q, k, causal, block_q, block_k, block_q_bwd,
-                    block_k_bwd):
+                    block_k_bwd, window=None):
     """Shared block resolution; None means 'use the XLA reference path'."""
+    if window is not None and (not causal or window < 1):
+        raise ValueError("sliding window requires causal=True and window >= 1")
     sq, sk = q.shape[2], k.shape[2]
     if causal and sq > sk:
         # rows beyond the kv horizon would attend to nothing — the math is

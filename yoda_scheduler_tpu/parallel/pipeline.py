@@ -172,6 +172,11 @@ def pipelined_llama_loss(params: dict, tokens: jax.Array,
     if mesh.shape.get("sp", 1) > 1:
         raise ValueError("pipeline step runs with sp=1 (ring attention's own "
                          "shard_map does not nest inside the pp region)")
+    if config.sliding_window is not None:
+        # manual_region_attention attends globally: silently dropping the
+        # window would make the pp path diverge from single-path training
+        raise ValueError(
+            "sliding_window is not supported on the pipeline path yet")
     attn_impl = manual_region_attention
 
     x = params["embed"][tokens]                     # [B, S, d]
