@@ -131,3 +131,84 @@ def test_concurrent_multi_profile_engines():
     assert all(p.phase in (PodPhase.BOUND, PodPhase.FAILED) for p in pods)
     assert sum(p.phase == PodPhase.BOUND for p in pods) == NODES * CHIPS
     _assert_no_double_booking(pods)
+
+
+def test_anti_affinity_invariant_under_concurrent_submit():
+    """Anti-affinity replicas submitted from several threads while the
+    scheduler loop runs and node meta mutates: at most one replica per
+    host, every pod resolves, no stale cached verdict slips a second
+    replica onto a host (the memo self-disables while anti-affinity pods
+    are bound)."""
+    cluster, store = _mk_cluster()
+    for i in range(NODES):
+        cluster.set_node_meta(f"n{i}",
+                              labels={"kubernetes.io/hostname": f"n{i}"})
+    sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9,
+                                               max_attempts=3,
+                                               preemption=False))
+    stop = threading.Event()
+    driver = threading.Thread(target=_drive, args=(sched.run_one, stop))
+    hb = threading.Thread(target=_heartbeat, args=(store, stop))
+
+    def churn_meta():
+        # concurrent label edits on an IRRELEVANT key: each one bumps the
+        # node's change counter, hammering the NodeInfo cache + memo
+        # invalidation paths the anti-affinity verdicts depend on
+        i = 0
+        while not stop.is_set():
+            cluster.set_node_meta(
+                f"n{i % NODES}",
+                labels={"kubernetes.io/hostname": f"n{i % NODES}",
+                        "churn": str(i)})
+            i += 1
+            time.sleep(0.002)
+
+    meta = threading.Thread(target=churn_meta)
+    driver.start()
+    hb.start()
+    meta.start()
+
+    ANTI = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "spread"}},
+             "topologyKey": "kubernetes.io/hostname"}]}}
+    pods = []
+
+    def submit(start):
+        for i in range(start, start + 6):
+            p = Pod.from_manifest({
+                "metadata": {"name": f"r{i}",
+                             "labels": {"scv/number": "1",
+                                        "app": "spread"}},
+                "spec": {"schedulerName": "yoda-scheduler",
+                         "affinity": ANTI}})
+            pods.append(p)
+            sched.submit(p)
+            time.sleep(0.001)
+
+    try:
+        subs = [threading.Thread(target=submit, args=(s,)) for s in (0, 6)]
+        for t in subs:
+            t.start()
+        for t in subs:
+            t.join()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(p.phase != PodPhase.PENDING for p in pods):
+                break
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        driver.join(timeout=5)
+        hb.join(timeout=5)
+        meta.join(timeout=5)
+    # every pod RESOLVES: 8 bind (one per host), the 4 excess fail after
+    # max_attempts — a pod stuck PENDING means the invariant broke
+    assert all(p.phase != PodPhase.PENDING for p in pods), \
+        f"unresolved: {[(p.name, p.phase) for p in pods]}"
+    bound = [p for p in pods if p.phase == PodPhase.BOUND]
+    assert len(bound) == NODES, \
+        f"{len(bound)} bound of {len(pods)} ({[p.phase for p in pods]})"
+    assert sum(p.phase == PodPhase.FAILED for p in pods) == len(pods) - NODES
+    hosts = [p.node for p in bound]
+    assert len(set(hosts)) == len(hosts), f"double-placed: {hosts}"
