@@ -416,9 +416,12 @@ class TestSpecPriority:
                             {"key": "metadata.name", "operator": "In",
                              "values": ["node-5"]}]}]}}}},
         })
-        # field selectors aren't modelled: the term must match NOTHING
-        # (match-all would scatter a node-pinned pod across the fleet)
+        # metadata.name matchFields ARE modelled: the term matches only
+        # the named node (and nothing when no node name is supplied —
+        # match-all would scatter a node-pinned pod across the fleet)
         assert not affinity_matches(pinned, {"any": "labels"})
+        assert affinity_matches(pinned, {}, "node-5")
+        assert not affinity_matches(pinned, {}, "node-6")
         empty = Pod.from_manifest({
             "metadata": {"name": "e", "labels": {"scv/number": "1"}},
             "spec": {"schedulerName": "yoda-scheduler", "affinity": {
@@ -503,3 +506,91 @@ class TestPreferredAffinity:
             "notadict",
         ])
         assert pod.preferred_affinity == ()
+
+
+class TestMatchFields:
+    def test_metadata_name_pins_to_node(self):
+        """matchFields on metadata.name (the only field the API accepts)
+        pins a pod to named nodes."""
+        c = _cluster(["a", "b", "c"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = Pod.from_manifest({
+            "metadata": {"name": "pinned", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchFields": [
+                            {"key": "metadata.name", "operator": "In",
+                             "values": ["b"]}]}]}}}},
+        })
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "b"
+
+    def test_metadata_name_notin_excludes(self):
+        c = _cluster(["a", "b"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = Pod.from_manifest({
+            "metadata": {"name": "avoids-a", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchFields": [
+                            {"key": "metadata.name", "operator": "NotIn",
+                             "values": ["a"]}]}]}}}},
+        })
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "b"
+
+    def test_unknown_field_still_matches_nothing(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            affinity_matches)
+
+        pod = Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchFields": [
+                            {"key": "spec.unschedulable", "operator": "In",
+                             "values": ["false"]}]}]}}}},
+        })
+        assert not affinity_matches(pod, {}, "any-node")
+
+    def test_preferred_affinity_matchfields_scores_by_name(self):
+        """metadata.name matchFields in a PREFERRED term must also resolve
+        against the node name in scoring."""
+        p = NodeAdmission()
+        pod = Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 40, "preference": {"matchFields": [
+                            {"key": "metadata.name", "operator": "In",
+                             "values": ["fav"]}]}}]}}},
+        })
+        s_fav, _ = p.score(CycleState(), pod, ni(name="fav"))
+        s_other, _ = p.score(CycleState(), pod, ni(name="other"))
+        assert (s_fav, s_other) == (40.0, 0.0)
+
+    def test_malformed_matchfields_shape_unmatchable(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            affinity_matches)
+
+        pod = Pod.from_manifest({
+            "metadata": {"name": "p", "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler", "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{
+                            "matchExpressions": [
+                                {"key": "pool", "operator": "Exists"}],
+                            "matchFields": {"key": "metadata.name",
+                                            "operator": "In",
+                                            "values": ["n"]}}]}}}},
+        })
+        # a malformed node pin must not be dropped: the term stays
+        # unmatchable even though its matchExpressions would pass
+        assert not affinity_matches(pod, {"pool": "x"}, "n")

@@ -429,7 +429,7 @@ spec:
       requiredDuringSchedulingIgnoredDuringExecution:
         nodeSelectorTerms:
           - matchFields:
-              - {key: metadata.name, operator: In, values: [node-5]}
+              - {key: spec.unschedulable, operator: In, values: ["false"]}
 ---
 apiVersion: v1
 kind: Pod
@@ -448,7 +448,7 @@ spec:
         out = capsys.readouterr().out
         assert rc == 1
         assert "nodeAffinity is list, not a mapping" in out
-        assert "matchFields is not supported" in out
+        assert "only metadata.name" in out
         assert "not a string" in out
         assert "Traceback" not in out
 
@@ -630,3 +630,47 @@ spec:
         assert rc == 1
         assert "cpu request 'lots'" in out
         assert "memory request '1Qx'" in out
+
+    def test_matchfields_lint_details(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: mf
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        nodeSelectorTerms:
+          - matchFields: {key: metadata.name, operator: In, values: [n]}
+          - matchFields:
+              - {key: metadata.name, operator: Inn, values: [n]}
+          - matchFields:
+              - {key: metadata.name, operator: In}
+""")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "not a list" in out
+        assert "operator 'Inn'" in out
+        assert "non-empty values" in out
+
+    def test_valid_matchfields_passes(self, tmp_path, capsys):
+        rc = self._run(tmp_path, """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: okmf
+  labels: {scv/number: "1"}
+spec:
+  schedulerName: yoda-scheduler
+  affinity:
+    nodeAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        nodeSelectorTerms:
+          - matchFields:
+              - {key: metadata.name, operator: In, values: [node-5]}
+""")
+        out = capsys.readouterr().out
+        assert rc == 0 and "OK" in out

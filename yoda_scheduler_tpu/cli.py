@@ -285,11 +285,36 @@ def cmd_validate(args) -> int:
             "requiredDuringSchedulingIgnoredDuringExecution")
         def lint_term(term, what):
             term = as_dict(term, what)
-            if term.get("matchFields"):
+            raw_fields = term.get("matchFields")
+            if raw_fields is not None and not isinstance(raw_fields, list):
                 problems.append(
-                    f"{where}: {name}: nodeAffinity matchFields is not "
-                    f"supported by this scheduler — the term will match "
-                    f"no node")
+                    f"{where}: {name}: matchFields is "
+                    f"{type(raw_fields).__name__}, not a list — the term "
+                    f"will match no node")
+                raw_fields = []
+            for e in (raw_fields or []):
+                if not isinstance(e, dict):
+                    problems.append(
+                        f"{where}: {name}: matchFields entry is "
+                        f"{type(e).__name__}, not a mapping")
+                    continue
+                fk = e.get("key")
+                if fk != "metadata.name":
+                    problems.append(
+                        f"{where}: {name}: nodeAffinity matchFields key "
+                        f"{fk!r} is not supported (only metadata.name) — "
+                        f"the term will match no node")
+                    continue
+                op = e.get("operator", "")
+                vals = e.get("values") or []
+                if op not in ("In", "NotIn"):
+                    problems.append(
+                        f"{where}: {name}: matchFields operator {op!r} "
+                        f"(metadata.name supports In/NotIn)")
+                elif not vals:
+                    problems.append(
+                        f"{where}: {name}: matchFields {op} requires "
+                        f"non-empty values — matches nothing as written")
             raw_exprs = term.get("matchExpressions") or []
             if not isinstance(raw_exprs, list):
                 problems.append(

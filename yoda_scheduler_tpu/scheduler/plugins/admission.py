@@ -25,7 +25,7 @@ matches every effect.
 from __future__ import annotations
 
 from ..framework import CycleState, FilterPlugin, NodeInfo, ScorePlugin, Status
-from ...utils.pod import Pod
+from ...utils.pod import NODE_NAME_FIELD, Pod
 
 NO_SCHEDULE = "NoSchedule"
 NO_EXECUTE = "NoExecute"
@@ -73,14 +73,24 @@ def _match_expression(labels: dict, key: str, op: str, values: tuple) -> bool:
     return False  # unknown operator matches nothing (apiserver rejects it)
 
 
-def affinity_matches(pod: Pod, labels: dict) -> bool:
+def affinity_matches(pod: Pod, labels: dict,
+                     node_name: str | None = None) -> bool:
     """Required nodeAffinity: terms OR together, expressions within a term
-    AND together; no terms = no constraint."""
+    AND together; no terms = no constraint. matchFields expressions on
+    metadata.name resolve against `node_name`."""
     terms = pod.node_affinity
     if not terms:
         return True
+
+    def match(k, op, vals):
+        if k == NODE_NAME_FIELD:
+            if node_name is None:
+                return False
+            return _match_expression({k: node_name}, k, op, vals)
+        return _match_expression(labels, k, op, vals)
+
     return any(
-        all(_match_expression(labels, k, op, vals) for k, op, vals in term)
+        all(match(k, op, vals) for k, op, vals in term)
         for term in terms
     )
 
@@ -190,7 +200,7 @@ def admissible(pod: Pod, node: NodeInfo) -> bool:
         for k, v in pod.node_selector.items():
             if labels.get(k) != v:
                 return False
-    if not affinity_matches(pod, node.labels):
+    if not affinity_matches(pod, node.labels, node.name):
         return False
     if node.taints and untolerated(pod, node.taints,
                                    (NO_SCHEDULE, NO_EXECUTE)):
@@ -420,7 +430,8 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                 if labels.get(k) != v:
                     return Status.unschedulable(
                         f"{node.name}: nodeSelector {k}={v} not satisfied")
-        if pod.node_affinity and not affinity_matches(pod, node.labels):
+        if pod.node_affinity and not affinity_matches(
+                pod, node.labels, node.name):
             return Status.unschedulable(
                 f"{node.name}: required nodeAffinity not satisfied")
         snapshot = state.read_or("snapshot")
@@ -563,9 +574,12 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                         score -= float(
                             max(counts.values(), default=0) + 1 - global_min)
         # preferred nodeAffinity: sum of weights of matching preference
-        # terms (upstream NodeAffinity scoring; weights 1-100 per term)
+        # terms (upstream NodeAffinity scoring; weights 1-100 per term);
+        # metadata.name matchFields resolve against the node's NAME
         for w, term in pod.preferred_affinity:
-            if all(_match_expression(node.labels, k, op, vals)
+            if all(_match_expression(
+                    {k: node.name} if k == NODE_NAME_FIELD else node.labels,
+                    k, op, vals)
                    for k, op, vals in term):
                 score += w
         if node.taints:

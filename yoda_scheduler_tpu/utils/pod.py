@@ -39,10 +39,15 @@ _WELL_KNOWN_PRIORITY = {
 
 # sentinel expression no node can satisfy (_match_expression returns False
 # for unknown operators): represents terms we cannot evaluate — empty
-# terms (match nothing per the API spec) and matchFields terms (field
-# selectors are not modelled; treating them as match-all would schedule a
-# node-pinned pod anywhere)
+# terms (match nothing per the API spec) and matchFields terms other than
+# metadata.name (treating an unevaluable field selector as match-all would
+# schedule a node-pinned pod anywhere)
 _UNMATCHABLE_EXPR = ("", "__unsupported__", ())
+
+# matchFields on metadata.name (the only field selector the NodeAffinity
+# API accepts) translates to an expression on this reserved key, which the
+# matcher resolves against the node's NAME rather than its labels
+NODE_NAME_FIELD = "__field:metadata.name"
 
 
 def _as_dict(x):
@@ -52,10 +57,11 @@ def _as_dict(x):
 def _parse_term(term) -> tuple:
     """One nodeSelectorTerm/preference -> tuple of (key, operator,
     values-tuple) expressions. Shared by the required and preferred
-    parsers so both evaluate expressions identically. Unevaluable content
-    (non-dict expressions, matchFields, empty terms) yields the
-    unmatchable sentinel; malformed shapes never raise (cli validate
-    reports them)."""
+    parsers so both evaluate expressions identically. matchFields on
+    metadata.name (the only field the API accepts there) becomes a
+    NODE_NAME_FIELD expression; other unevaluable content (non-dict
+    expressions, unknown matchFields, empty terms) yields the unmatchable
+    sentinel. Malformed shapes never raise (cli validate reports them)."""
     term = _as_dict(term)
     exprs = []
     raw_exprs = term.get("matchExpressions")
@@ -67,8 +73,19 @@ def _parse_term(term) -> tuple:
         exprs.append((str(e.get("key", "")), str(e.get("operator", "")),
                       tuple(str(v) for v in vals)
                       if isinstance(vals, list) else ()))
-    if term.get("matchFields"):
+    raw_fields = term.get("matchFields")
+    if raw_fields is not None and not isinstance(raw_fields, list):
+        # a malformed node pin must not be DROPPED — the term would lose
+        # its constraint and the pod could bind anywhere
         exprs.append(_UNMATCHABLE_EXPR)
+    for e in (raw_fields if isinstance(raw_fields, list) else []):
+        if not isinstance(e, dict) or e.get("key") != "metadata.name":
+            exprs.append(_UNMATCHABLE_EXPR)
+            continue
+        vals = e.get("values")
+        exprs.append((NODE_NAME_FIELD, str(e.get("operator", "")),
+                      tuple(str(v) for v in vals)
+                      if isinstance(vals, list) else ()))
     if not exprs:
         exprs.append(_UNMATCHABLE_EXPR)  # empty term matches nothing
     return tuple(exprs)
