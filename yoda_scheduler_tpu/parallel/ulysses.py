@@ -24,6 +24,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 try:
@@ -68,6 +69,18 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp"):
             f"local head count {local_heads} (H={heads}, tp={tp}) not "
             f"divisible by {axis_name}={n} — use ring attention for this "
             "shape")
+    # GQA: K/V travel the all-to-alls at their NATIVE head count when the
+    # kv-head axis survives the same tp and sp splits (the local attention
+    # is GQA-aware); otherwise broadcast to full heads first — the pre-GQA
+    # behavior, so shapes that worked before keep working
+    kvh = k.shape[1]
+    if heads % kvh:
+        raise ValueError(
+            f"q heads {heads} not a multiple of kv heads {kvh}")
+    if kvh != heads and (kvh % tp or (kvh // tp) % n):
+        rep = heads // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     spec = P(("dp", "fsdp"), "tp", axis_name, None)
     body = partial(_ulysses_body, axis_name=axis_name)
     return shard_map(
@@ -75,8 +88,12 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp"):
     )(q, k, v)
 
 
+ulysses_attention.handles_gqa = True  # grouped KV rides the all-to-alls
+
+
 def make_ulysses_attn(mesh, axis_name: str = "sp"):
     """attn_impl adapter for models.llama.llama_forward."""
     def attn(q, k, v):
         return ulysses_attention(q, k, v, mesh, axis_name)
+    attn.handles_gqa = True
     return attn
