@@ -101,6 +101,45 @@ class TestExpertParallelTraining:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    def test_no_involuntary_rematerialization(self):
+        """The ep-grouped batch axes used to force GSPMD 'Involuntary full
+        rematerialization' (replicate-then-partition) on the MoE dispatch
+        path — fixed by the moe_part sharding constraints (models/moe.py,
+        parallel/train.py:_make_moe_part). The warning is emitted by XLA's
+        C++ logger straight to stderr, so compile in a subprocess."""
+        import subprocess
+        import sys
+
+        prog = (
+            "import os\n"
+            "os.environ['XLA_FLAGS'] = "
+            "'--xla_force_host_platform_device_count=8'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "from yoda_scheduler_tpu.models import LlamaConfig\n"
+            "from yoda_scheduler_tpu.parallel import ("
+            "build_llama_train_step, make_mesh, mesh_shape_for)\n"
+            "cfg = LlamaConfig.tiny_moe()\n"
+            "mesh = make_mesh(mesh_shape_for(8, ep=2, tp=2))\n"
+            "init_fn, step_fn, batch_sh = build_llama_train_step(cfg, mesh)\n"
+            "params, opt = init_fn(jax.random.PRNGKey(0))\n"
+            "t = jax.device_put(jax.random.randint("
+            "jax.random.PRNGKey(1), (8, 128), 0, cfg.vocab_size), batch_sh)\n"
+            "_, _, loss = step_fn(params, opt, t)\n"
+            "print('loss', float(loss))\n"
+        )
+        import os
+
+        # TF_CPP_MIN_LOG_LEVEL>=2 would suppress the C++ LOG(WARNING) and
+        # let the assertion pass vacuously
+        env = {**os.environ, "TF_CPP_MIN_LOG_LEVEL": "0"}
+        out = subprocess.run([sys.executable, "-c", prog], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "Involuntary full rematerialization" not in out.stderr, \
+            out.stderr[-2000:]
+
     def test_ep_sharded_matches_single_device(self):
         mesh = make_mesh(mesh_shape_for(8, tp=2, ep=2, dp=2))
         init_fn, step_fn, batch_sh = build_llama_train_step(

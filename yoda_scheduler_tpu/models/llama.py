@@ -169,7 +169,7 @@ def _attention_block(x, layer, config: LlamaConfig, attn_impl):
     return x + o @ layer["wo"]
 
 
-def _mlp_block(x, layer, config: LlamaConfig):
+def _mlp_block(x, layer, config: LlamaConfig, moe_part=None):
     """Dense or MoE FFN with residual; returns (y, aux) — aux is the MoE
     load-balance loss, 0 for the dense path."""
     xn = rms_norm(x, layer["mlp_norm"], config.norm_eps)
@@ -177,25 +177,27 @@ def _mlp_block(x, layer, config: LlamaConfig):
         from .moe import moe_ffn
         y, aux = moe_ffn(xn, layer, config.num_experts,
                          config.experts_per_token,
-                         config.expert_capacity_factor)
+                         config.expert_capacity_factor, part=moe_part)
         return x + y, aux
     gate = jax.nn.silu((xn @ layer["w_gate"]).astype(jnp.float32)).astype(x.dtype)
     return x + (gate * (xn @ layer["w_up"])) @ layer["w_down"], jnp.float32(0)
 
 
-def transformer_layer(x, layer, config: LlamaConfig, attn_impl):
+def transformer_layer(x, layer, config: LlamaConfig, attn_impl,
+                      moe_part=None):
     """One decoder layer: attention + (dense|MoE) FFN. Returns (y, aux)."""
     y = _attention_block(x, layer, config, attn_impl)
-    return _mlp_block(y, layer, config)
+    return _mlp_block(y, layer, config, moe_part=moe_part)
 
 
 # ------------------------------------------------------------------ forward
 def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
                   attn_impl=None, remat: bool = False,
-                  return_aux: bool = False):
+                  return_aux: bool = False, moe_part=None):
     """tokens [B, S] int32 -> logits [B, S, vocab] (fp32); with
     return_aux, -> (logits, aux) where aux is the mean per-layer MoE
-    load-balance loss (0 when dense)."""
+    load-balance loss (0 when dense). `moe_part` is the MoE sharding-
+    constraint hook (models/moe.py:moe_ffn)."""
     if attn_impl is None:
         attn_impl = partial(flash_attention, causal=True,
                             window=config.sliding_window)
@@ -205,11 +207,19 @@ def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
         raise ValueError(
             "sliding_window requires the default flash attention impl; "
             "custom attn_impl callers must apply the window themselves")
-    x = params["embed"][tokens]
+    if moe_part is not None:
+        # gather the fsdp-sharded table before the lookup and anchor the
+        # result on the batch activation layout — a d-sharded lookup output
+        # can't be resharded onto the grouped (dp,fsdp,ep) batch axes
+        # without a GSPMD full rematerialization
+        x = moe_part(moe_part(params["embed"], "table")[tokens], "combine")
+    else:
+        x = params["embed"][tokens]
 
     def layer_body(carry, layer):
         x, aux = carry
-        y, a = transformer_layer(x, layer, config, attn_impl)
+        y, a = transformer_layer(x, layer, config, attn_impl,
+                                 moe_part=moe_part)
         return (y, aux + a), None
 
     if remat:
@@ -226,7 +236,8 @@ def llama_forward(params: dict, tokens: jax.Array, config: LlamaConfig,
 
 
 def llama_loss(params: dict, tokens: jax.Array, config: LlamaConfig,
-               attn_impl=None, remat: bool = False) -> jax.Array:
+               attn_impl=None, remat: bool = False,
+               moe_part=None) -> jax.Array:
     """Next-token cross-entropy over tokens [B, S].
 
     Runs the full sequence and masks the final position (rather than slicing
@@ -234,7 +245,7 @@ def llama_loss(params: dict, tokens: jax.Array, config: LlamaConfig,
     sequence parallelism."""
     s = tokens.shape[1]
     logits, aux = llama_forward(params, tokens, config, attn_impl, remat,
-                                return_aux=True)
+                                return_aux=True, moe_part=moe_part)
     targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -94,10 +94,22 @@ def _top_k_dispatch(router_logits, num_experts: int, k: int, capacity: int):
 
 
 def moe_ffn(x, layer: dict, num_experts: int, k: int,
-            capacity_factor: float):
+            capacity_factor: float, part=None):
     """x [B,S,d] -> (y [B,S,d], aux scalar). `layer` holds this layer's
     router/we_* slices (no leading L axis). SwiGLU experts, bf16 matmuls
-    with fp32 accumulation like the dense path."""
+    with fp32 accumulation like the dense path.
+
+    `part(tensor, role)` applies a sharding constraint for the given role
+    ("dispatch" [E,B,C,·] expert-major, "hidden" [E,B,C,f], "combine"
+    [B,S,d] batch-major); built by parallel/train.py from the mesh. Without
+    explicit constraints GSPMD cannot split the grouped batch axes
+    (dp·fsdp·ep on B) from the expert axis (ep on E) and falls back to
+    involuntary full rematerialization — the constraints pin the layouts so
+    the reshard compiles to the dispatch/combine all-to-all pair over ep.
+    None (single-device, shard_map per-device views) is a no-op.
+    """
+    if part is None:
+        part = lambda t, role: t
     b, s, d = x.shape
     cap = expert_capacity(s, num_experts, k, capacity_factor)
 
@@ -107,15 +119,17 @@ def moe_ffn(x, layer: dict, num_experts: int, k: int,
 
     # dispatch: [B,S,E,C] x [B,S,d] -> [E,B,C,d]; with we_* sharded over ep
     # this is where GSPMD inserts the forward all-to-all
-    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x)
+    expert_in = part(
+        jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x), "dispatch")
     gate = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["we_gate"],
                       preferred_element_type=jnp.float32)
     up = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["we_up"],
                     preferred_element_type=jnp.float32)
-    h = (jax.nn.silu(gate) * up).astype(x.dtype)
-    expert_out = jnp.einsum("ebcf,efd->ebcd", h, layer["we_down"])
+    h = part((jax.nn.silu(gate) * up).astype(x.dtype), "hidden")
+    expert_out = part(
+        jnp.einsum("ebcf,efd->ebcd", h, layer["we_down"]), "dispatch")
 
     # combine: the return all-to-all; fp32 weighted sum of expert outputs
     y = jnp.einsum("bsec,ebcd->bsd", combine.astype(jnp.float32),
                    expert_out.astype(jnp.float32))
-    return y.astype(x.dtype), aux
+    return part(y.astype(x.dtype), "combine"), aux

@@ -63,7 +63,11 @@ def build_llama_train_step(
     batch_sh = NamedSharding(mesh, batch_spec(sp=sp > 1))
     tx = optax.adamw(learning_rate)
 
-    loss_fn = partial(llama_loss, config=config, attn_impl=attn_impl, remat=remat)
+    moe_part = None
+    if config.is_moe and mesh.shape.get("ep", 1) > 1:
+        moe_part = _make_moe_part(mesh, sp=sp > 1)
+    loss_fn = partial(llama_loss, config=config, attn_impl=attn_impl,
+                      remat=remat, moe_part=moe_part)
 
     def _init(key):
         params = init_llama(config, key)
@@ -88,6 +92,39 @@ def build_llama_train_step(
         donate_argnums=(0, 1),
     )
     return init_fn, step_fn, batch_sh
+
+
+def _make_moe_part(mesh, sp: bool):
+    """Sharding-constraint hook for moe_ffn (models/moe.py): pins the
+    expert-major intermediates to P("ep", ("dp","fsdp"), ...) and the
+    combined output back to the batch layout, so the ep reshard compiles to
+    the dispatch/combine all-to-all pair instead of GSPMD's involuntary
+    full rematerialization (seen as [1,1,2,4]->[4,1,1,2] replicate-then-
+    partition warnings in MULTICHIP_r03.json)."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = {
+        # [E, B, C, d] — expert axis over ep, batch over the data axes; the
+        # model dim stays unsharded going into the column-parallel expert
+        # matmul (tp splits its OUTPUT, Megatron-style)
+        "dispatch": P("ep", ("dp", "fsdp"), None, None),
+        # [E, B, C, f] — expert hidden, tp column split
+        "hidden": P("ep", ("dp", "fsdp"), None, "tp"),
+        # [B, S, d] — back to the activation layout of the dense path
+        "combine": P(("dp", "fsdp", "ep"), "sp" if sp else None, None),
+        # [vocab, d] — embedding table gathered whole before the token
+        # lookup (the usual FSDP weights-gathered-at-use posture); a
+        # d-sharded table makes the lookup output d-sharded, which GSPMD
+        # cannot reshard onto the grouped (dp,fsdp,ep) batch axes without
+        # a full rematerialization
+        "table": P(None, None),
+    }
+
+    def part(t, role):
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh, specs[role]))
+
+    return part
 
 
 def _shard_opt_state_like(tx, config: LlamaConfig, param_sh, mesh):
