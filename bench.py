@@ -132,16 +132,24 @@ def run_burst(profile_kind: str):
     h = sched.metrics.histogram("schedule_latency_ms")
     hc = sched.metrics.histogram("cycle_latency_ms")
     per_class = {}
+    per_class_n = {}
     for cls in ("gang", "topology", "tpu-multi", "tpu-single", "gpu",
                 "unlabeled"):
         ch = sched.metrics.histograms.get("schedule_latency_ms_class_" + cls)
         if ch is not None:
             per_class[cls] = round(ch.quantile(0.5), 3)
+        # sample count: failed pods contribute NO latency sample, so a
+        # profile that fails a class's hard pods shows a flattering p50
+        # over the easy remainder (r03's topology comparison) — the count
+        # makes that visible. 0 (not an absent key) when every pod of the
+        # class failed, so "fully failed" can't read as "not in workload"
+        per_class_n[cls] = ch.n if ch is not None else 0
     return {
         "p50_ms": h.quantile(0.5),
         "p99_ms": h.quantile(0.99),
         # per-class decomposition: aggregate p50 hides class-mix effects
         "per_class_p50_ms": per_class,
+        "per_class_bound": per_class_n,
         # baseline honesty: binds the naive device-plugin emulation had to
         # reject because the allocation-blind filter overcommitted the node
         # (each one cost that pod a retry with backoff)

@@ -60,6 +60,36 @@ def test_priority_sort_orders_and_fifo_ties():
     assert less(qp("first", prio=2, enqueued=1.0), qp("second", prio=2, enqueued=2.0))
 
 
+def test_priority_sort_most_constrained_first_within_priority():
+    """Equal priority: exact-topology pods first, then gang members, then
+    chip count descending, then FIFO — and priority still dominates all."""
+    sort = PrioritySort()
+    q = SchedulingQueue(sort.less, key=sort.key)
+    q.add(Pod("single", labels={"scv/number": "1"}), now=0.0)
+    q.add(Pod("multi", labels={"scv/number": "4"}), now=1.0)
+    q.add(Pod("gangm", labels={"scv/number": "4", "tpu/gang-name": "g",
+                               "tpu/gang-size": "2"}), now=2.0)
+    q.add(Pod("topo", labels={"scv/number": "4", "tpu/topology": "2x2"}),
+          now=3.0)
+    q.add(Pod("vip", labels={"scv/priority": "1"}), now=4.0)
+    order = [q.pop(now=10.0).pod.name for _ in range(5)]
+    assert order == ["vip", "topo", "gangm", "multi", "single"]
+
+
+def test_reference_sort_is_priority_only():
+    # the baseline keeps the reference's sort.go semantics: no constraint
+    # tie-break, FIFO within a priority band
+    from yoda_scheduler_tpu.scheduler.plugins.reference_emulation import RefSort
+
+    sort = RefSort()
+    q = SchedulingQueue(sort.less, key=sort.key)
+    q.add(Pod("plain", labels={}), now=0.0)
+    q.add(Pod("topo", labels={"scv/number": "4", "tpu/topology": "2x2"}),
+          now=1.0)
+    order = [q.pop(now=10.0).pod.name for _ in range(2)]
+    assert order == ["plain", "topo"]
+
+
 def test_queue_pop_priority_order():
     q = SchedulingQueue(PrioritySort().less)
     for name, prio in [("a", 1), ("b", 9), ("c", 5)]:

@@ -100,10 +100,15 @@ class TestServeLoop:
             daemon=True)
         t.start()
         try:
+            # the bind and the chip-assignment annotation are separate API
+            # calls — wait for BOTH (checking nodeName alone races the
+            # annotation read below)
             ok = wait_for(lambda: all(
                 (server.state.pod(n) or {}).get("spec", {}).get("nodeName")
+                and "tpu/assigned-chips" in (server.state.pod(n) or {}).get(
+                    "metadata", {}).get("annotations", {})
                 for n in ("a", "b")))
-            assert ok, "both profiles' pods must bind"
+            assert ok, "both profiles' pods must bind with chips assigned"
             chips = set()
             for n in ("a", "b"):
                 ann = server.state.pod(n)["metadata"]["annotations"]

@@ -227,13 +227,34 @@ class TelemetryDecrementingCluster:
         self._inner.telemetry.put(m)
 
 
+class RefSort:
+    """The reference's queue order exactly: strict ``scv/priority`` only
+    (sort.go:8-18) — none of PrioritySort's most-constrained-first
+    tie-break. FIFO on ties is kept as engine glue (the comparator must be
+    a strict weak order; upstream's queue masked that for the reference)."""
+
+    name = "ref-priority-sort"
+
+    def less(self, a, b) -> bool:
+        from .sort import pod_priority
+
+        pa, pb = pod_priority(a), pod_priority(b)
+        if pa != pb:
+            return pa > pb
+        return a.enqueued < b.enqueued
+
+    def key(self, info):
+        from .sort import pod_priority
+
+        return (-pod_priority(info), info.enqueued)
+
+
 def reference_profile(config: SchedulerConfig):
     """A Profile wired with only reference-equivalent capability."""
     from ..core import Profile
-    from .sort import PrioritySort
 
     return Profile(
-        queue_sort=PrioritySort(),
+        queue_sort=RefSort(),
         filter=[RefFilter()],
         pre_score=[RefMaxCollection()],
         score=[RefScore()],

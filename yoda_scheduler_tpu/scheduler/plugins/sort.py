@@ -1,15 +1,28 @@
 """Queue-sort plugin: strict priority by ``scv/priority`` label.
 
 Reference: pkg/yoda/sort/sort.go:8-18 — higher label value schedules first,
-absent/unparseable treated as 0. We add a FIFO tie-break on enqueue time so
-equal-priority pods cannot starve each other (the reference's comparator is
-not a strict weak order on ties; upstream's queue happened to mask that).
+absent/unparseable treated as 0. We add two tie-breaks the reference lacks:
+
+- **most-constrained-first** among equal priority: pods pinned to an exact
+  ICI block shape (``tpu/topology``) first, then gang members, then by chip
+  count descending. Classic bin-packing order — block-shaped and multi-chip
+  jobs place while slices are still whole, instead of retrying against
+  space the easy pods fragmented; easy pods lose a cycle or two, hard pods
+  stop paying the whole queue's length in wait.
+- FIFO on enqueue time last, so equal-priority/equal-constraint pods cannot
+  starve each other (the reference's comparator is not a strict weak order
+  on ties; upstream's queue happened to mask that).
 """
 
 from __future__ import annotations
 
 from ..framework import QueueSortPlugin, QueuedPodInfo
-from ...utils.labels import PRIORITY_LABEL
+from ...utils.labels import (
+    GANG_NAME_LABEL,
+    NUMBER_LABEL,
+    PRIORITY_LABEL,
+    TOPOLOGY_LABEL,
+)
 
 
 def pod_priority(info: QueuedPodInfo) -> int:
@@ -22,6 +35,23 @@ def pod_priority(info: QueuedPodInfo) -> int:
         return 0  # queue sort cannot reject; the filter will surface the error
 
 
+def constraint_rank(info: QueuedPodInfo) -> int:
+    """Placement difficulty of a pod — higher schedules first within a
+    priority band. Exact-topology > gang > more chips > fewer; the bands
+    are spaced so chip count never outranks a structural constraint."""
+    labels = info.pod.labels
+    try:
+        chips = int(labels.get(NUMBER_LABEL) or 1)
+    except ValueError:
+        chips = 1
+    rank = min(max(chips, 0), 1 << 19)
+    if TOPOLOGY_LABEL in labels:
+        rank += 1 << 21
+    if GANG_NAME_LABEL in labels:
+        rank += 1 << 20
+    return rank
+
+
 class PrioritySort(QueueSortPlugin):
     name = "priority-sort"
 
@@ -29,9 +59,12 @@ class PrioritySort(QueueSortPlugin):
         pa, pb = pod_priority(a), pod_priority(b)
         if pa != pb:
             return pa > pb
+        ca, cb = constraint_rank(a), constraint_rank(b)
+        if ca != cb:
+            return ca > cb
         return a.enqueued < b.enqueued
 
     def key(self, info: QueuedPodInfo):
         """Sort key consistent with less(): lets the queue use a heap
         (O(log n) pop) instead of a comparator scan (O(n))."""
-        return (-pod_priority(info), info.enqueued)
+        return (-pod_priority(info), -constraint_rank(info), info.enqueued)
