@@ -61,8 +61,9 @@ def test_priority_sort_orders_and_fifo_ties():
 
 
 def test_priority_sort_most_constrained_first_within_priority():
-    """Equal priority: exact-topology pods first, then gang members, then
-    chip count descending, then FIFO — and priority still dominates all."""
+    """Equal priority: gang members first, then exact-topology pods, then
+    FIFO (chip count deliberately does NOT rank) — and priority still
+    dominates all."""
     sort = PrioritySort()
     q = SchedulingQueue(sort.less, key=sort.key)
     q.add(Pod("single", labels={"scv/number": "1"}), now=0.0)
@@ -73,7 +74,7 @@ def test_priority_sort_most_constrained_first_within_priority():
           now=3.0)
     q.add(Pod("vip", labels={"scv/priority": "1"}), now=4.0)
     order = [q.pop(now=10.0).pod.name for _ in range(5)]
-    assert order == ["vip", "topo", "gangm", "multi", "single"]
+    assert order == ["vip", "gangm", "topo", "single", "multi"]
 
 
 def test_reference_sort_is_priority_only():

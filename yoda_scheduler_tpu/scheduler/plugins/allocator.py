@@ -15,6 +15,7 @@ import threading
 
 from ..framework import CycleState, NodeInfo, ReservePlugin, Status
 from ...telemetry.schema import TpuNodeMetrics
+from ...utils.changelog import ChangeLog
 from ...topology.torus import Coord, best_fit_block, fits_shape, parse_topology
 from ...utils.labels import WorkloadSpec
 from ...utils.pod import Pod
@@ -86,17 +87,24 @@ class ChipAllocator(ReservePlugin):
         # cover hosts whose member hasn't cycled yet.
         # gang -> (slice_id, chips_per_host, priority, expires_at)
         self._gang_nominated: dict[str, tuple] = {}  # gang -> (slice, chips/host, prio, expiry, cpu/host, mem/host)
-        # global version over reservations + nominations (cheap read) — the
-        # engine's unschedulable-class memo keys on it
-        self._version = 0
+        # change log over reservations + nominations: version is the
+        # global counter the engine's unschedulable-class memo keys on;
+        # the per-key attribution feeds the per-class feasible-list cache
+        # (core.py) — "*" marks a change whose node set is not knowable
+        # here (gang slice entitlements span hosts), forcing a full
+        # re-filter
+        self._changes = ChangeLog()
 
     @property
     def version(self) -> int:
-        return self._version
+        return self._changes.version
+
+    def changes_since(self, version: int):
+        return self._changes.changes_since(version)
 
     def _bump(self, node: str) -> None:
         self._pending_ver[node] = self._pending_ver.get(node, 0) + 1
-        self._version += 1
+        self._changes.record(node)
 
     def forget_nodes(self, gone: set[str]) -> None:
         """Drop cached per-node state for nodes that left the cluster
@@ -241,12 +249,13 @@ class ChipAllocator(ReservePlugin):
         with self._lock:
             self._nominated[pod_key] = (node, chips, priority,
                                         cpu_millis, memory_bytes)
-            self._version += 1
+            self._changes.record(node)
 
     def unnominate(self, pod_key: str) -> None:
         with self._lock:
-            if self._nominated.pop(pod_key, None) is not None:
-                self._version += 1
+            nom = self._nominated.pop(pod_key, None)
+            if nom is not None:
+                self._changes.record(nom[0])
 
     def nomination_of(self, pod_key: str) -> tuple | None:
         """(node, chips, priority, cpu_millis, memory_bytes) this pod is
@@ -262,12 +271,12 @@ class ChipAllocator(ReservePlugin):
             self._gang_nominated[gang] = (slice_id, chips_per_host, priority,
                                           expires_at, cpu_millis,
                                           memory_bytes)
-            self._version += 1
+            self._changes.record("*")
 
     def unnominate_gang(self, gang: str) -> None:
         with self._lock:
             if self._gang_nominated.pop(gang, None) is not None:
-                self._version += 1
+                self._changes.record("*")
 
     def gang_nomination_of(self, gang: str) -> tuple[str, int, int, float] | None:
         with self._lock:
@@ -290,7 +299,7 @@ class ChipAllocator(ReservePlugin):
                 sid, chips, prio, exp = nom[:4]
                 if now is not None and exp < now:
                     del self._gang_nominated[gang]
-                    self._version += 1
+                    self._changes.record("*")
                     continue
                 if sid == slice_id and prio >= priority and gang != exclude_gang:
                     hold += chips
@@ -310,7 +319,7 @@ class ChipAllocator(ReservePlugin):
             for gang, nom in list(self._gang_nominated.items()):
                 if now is not None and nom[3] < now:
                     del self._gang_nominated[gang]
-                    self._version += 1
+                    self._changes.record("*")
                     continue
                 if (nom[0] == slice_id and nom[2] >= priority
                         and gang != exclude_gang):

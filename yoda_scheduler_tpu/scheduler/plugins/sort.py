@@ -3,12 +3,17 @@
 Reference: pkg/yoda/sort/sort.go:8-18 — higher label value schedules first,
 absent/unparseable treated as 0. We add two tie-breaks the reference lacks:
 
-- **most-constrained-first** among equal priority: pods pinned to an exact
-  ICI block shape (``tpu/topology``) first, then gang members, then by chip
-  count descending. Classic bin-packing order — block-shaped and multi-chip
-  jobs place while slices are still whole, instead of retrying against
-  space the easy pods fragmented; easy pods lose a cycle or two, hard pods
-  stop paying the whole queue's length in wait.
+- **most-constrained-first** among equal priority: gang members first
+  (a gang consumes whole hosts across one slice — the largest structural
+  demand), then pods pinned to an exact ICI block shape
+  (``tpu/topology``), then FIFO. Classic bin-packing order — gangs and
+  block-shaped jobs place while slices are still whole, instead of
+  retrying against space the easy pods fragmented; easy pods lose a
+  cycle or two, hard pods stop paying the whole queue's length in wait.
+  Only STRUCTURAL constraints rank; plain chip count deliberately does
+  not (reordering 2-chip jobs ahead of 1-chip jobs spends the same chips
+  on fewer pods under capacity pressure, with no contiguity gain to show
+  for it).
 - FIFO on enqueue time last, so equal-priority/equal-constraint pods cannot
   starve each other (the reference's comparator is not a strict weak order
   on ties; upstream's queue happened to mask that).
@@ -17,12 +22,7 @@ absent/unparseable treated as 0. We add two tie-breaks the reference lacks:
 from __future__ import annotations
 
 from ..framework import QueueSortPlugin, QueuedPodInfo
-from ...utils.labels import (
-    GANG_NAME_LABEL,
-    NUMBER_LABEL,
-    PRIORITY_LABEL,
-    TOPOLOGY_LABEL,
-)
+from ...utils.labels import GANG_NAME_LABEL, PRIORITY_LABEL, TOPOLOGY_LABEL
 
 
 def pod_priority(info: QueuedPodInfo) -> int:
@@ -36,19 +36,14 @@ def pod_priority(info: QueuedPodInfo) -> int:
 
 
 def constraint_rank(info: QueuedPodInfo) -> int:
-    """Placement difficulty of a pod — higher schedules first within a
-    priority band. Exact-topology > gang > more chips > fewer; the bands
-    are spaced so chip count never outranks a structural constraint."""
+    """Structural placement difficulty of a pod — higher schedules first
+    within a priority band. Gang > exact-topology > unconstrained."""
     labels = info.pod.labels
-    try:
-        chips = int(labels.get(NUMBER_LABEL) or 1)
-    except ValueError:
-        chips = 1
-    rank = min(max(chips, 0), 1 << 19)
-    if TOPOLOGY_LABEL in labels:
-        rank += 1 << 21
+    rank = 0
     if GANG_NAME_LABEL in labels:
-        rank += 1 << 20
+        rank += 2
+    if TOPOLOGY_LABEL in labels:
+        rank += 1
     return rank
 
 

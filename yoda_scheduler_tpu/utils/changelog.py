@@ -40,4 +40,10 @@ class ChangeLog:
             return cur, set()
         if not self._log or self._log[0][0] > version + 1:
             return cur, None
-        return cur, {k for v, k in self._log if v > version}
+        # versions are appended in increasing order: bisect to the first
+        # entry past `version` instead of scanning the whole ring (hot on
+        # the per-class feasible-repair path at 1000 nodes)
+        from bisect import bisect_right
+
+        i = bisect_right(self._log, version, key=lambda e: e[0])
+        return cur, {k for _, k in self._log[i:]}
