@@ -1,0 +1,115 @@
+"""Measured device duty cycle for utilisation-aware scoring.
+
+SURVEY §2.2 calls for replacing the reference's clock-as-performance proxy
+(reference pkg/yoda/filter/filter.go:35-50) with measured MXU utilisation.
+libtpu's gRPC metrics service (the `tpu-info` path) is not guaranteed
+present on every host, so this is the documented fallback: a **probe
+sampler**.
+
+Estimator: every `period_s`, enqueue a trivial op on the device and time
+enqueue→complete. TPU cores execute their stream in order, so while the
+device is running someone's kernel the probe waits behind it; a probe that
+takes much longer than the idle baseline means the device was busy at that
+instant. The duty cycle is an exponentially-weighted average of that busy
+indicator — a sampled estimate of "fraction of time with work in flight",
+which is exactly the signal the scorer needs to sink noisy neighbours
+(plugins/score.py duty_cycle term).
+
+Cost: one ~O(1) element-wise op per period per chip — microseconds of
+device time every 250ms, negligible against any real workload.
+
+Caveats (why this is an estimate, not a measurement):
+- sampling, so short kernels between probes are missed; EWMA smooths it
+- the probe itself requires the runtime lock; a host-side-blocked runtime
+  reads as busy (arguably correct for scheduling purposes)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class DutyCycleSampler:
+    """Background probe loop for ONE device. `duty_pct` is always readable
+    (0.0 until the first samples land)."""
+
+    def __init__(self, device, period_s: float = 0.25,
+                 alpha: float = 0.2) -> None:
+        self.device = device
+        self.period_s = period_s
+        self.alpha = alpha
+        self.duty_pct = 0.0
+        self._baseline_s: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------------- probe
+    def _make_probe(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jax.device_put(jnp.float32(0.0), self.device)
+        fn = jax.jit(lambda v: v + 1.0)
+        fn(x).block_until_ready()  # compile outside the timed path
+        return fn, x
+
+    def sample_once(self, fn=None, x=None) -> float:
+        """One timed probe; returns the enqueue→complete latency in
+        seconds and folds it into duty_pct."""
+        if fn is None:
+            fn, x = self._make_probe()
+        t0 = time.perf_counter()
+        fn(x).block_until_ready()
+        dt = time.perf_counter() - t0
+        # the baseline is the best latency ever seen (idle dispatch);
+        # "busy" = well above it. The 1ms absolute floor keeps scheduler
+        # jitter on the host from reading as device busyness.
+        if self._baseline_s is None or dt < self._baseline_s:
+            self._baseline_s = dt
+        busy = dt > max(4.0 * self._baseline_s, self._baseline_s + 1e-3)
+        self.duty_pct += self.alpha * ((100.0 if busy else 0.0) - self.duty_pct)
+        return dt
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "DutyCycleSampler":
+        if self._thread is not None:
+            return self
+        probe = self._make_probe()
+
+        def loop() -> None:
+            while not self._stop.wait(self.period_s):
+                try:
+                    self.sample_once(*probe)
+                except Exception:
+                    return  # device gone; leave the last estimate standing
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class DutySamplerPool:
+    """Lazily one sampler per device; `duty_of` is the lookup the sniffer
+    threads through to chip construction (sniffer.local_node_metrics)."""
+
+    def __init__(self, period_s: float = 0.25) -> None:
+        self.period_s = period_s
+        self._samplers: dict[int, DutyCycleSampler] = {}
+        self._lock = threading.Lock()
+
+    def duty_of(self, device) -> float:
+        with self._lock:
+            s = self._samplers.get(device.id)
+            if s is None:
+                s = DutyCycleSampler(device, self.period_s).start()
+                self._samplers[device.id] = s
+        return s.duty_pct
+
+    def stop(self) -> None:
+        with self._lock:
+            for s in self._samplers.values():
+                s.stop()

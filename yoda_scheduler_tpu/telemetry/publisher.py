@@ -80,18 +80,28 @@ def run_publisher(client: KubeClient, node_name: str | None = None,
     sleep. Publish errors are logged and retried next tick — a transient
     API outage must not kill the DaemonSet pod (the staleness gate already
     protects the scheduler from frozen data)."""
+    from .duty import DutySamplerPool
     from .sniffer import local_node_metrics
 
     pub = CrPublisher(client)
     stop = stop_event or threading.Event()
+    # long-running publisher: measure duty cycles with the probe sampler
+    # pool so the scheduler's utilisation term sees real busyness (a
+    # --once snapshot has no sampling window; its duty reads 0 = neutral)
+    pool = None if once else DutySamplerPool()
+    duty_of = pool.duty_of if pool is not None else None
     published = 0
-    while True:
-        try:
-            pub.publish(local_node_metrics(node_name))
-            published += 1
-        except Exception as e:
-            log.warning("publish failed (next tick retries): %s", e)
-        if once:
-            return 0 if published else 1
-        if stop.wait(interval_s):
-            return 0
+    try:
+        while True:
+            try:
+                pub.publish(local_node_metrics(node_name, duty_of=duty_of))
+                published += 1
+            except Exception as e:
+                log.warning("publish failed (next tick retries): %s", e)
+            if once:
+                return 0 if published else 1
+            if stop.wait(interval_s):
+                return 0
+    finally:
+        if pool is not None:
+            pool.stop()

@@ -52,14 +52,24 @@ def generation_of(device_kind: str) -> str:
     return name if name in GENERATIONS else ""
 
 
-def local_node_metrics(node_name: str | None = None) -> TpuNodeMetrics:
-    """Snapshot this host's accelerator telemetry as a TpuNodeMetrics."""
+def local_node_metrics(node_name: str | None = None, duty_of=None,
+                       devices=None) -> TpuNodeMetrics:
+    """Snapshot this host's accelerator telemetry as a TpuNodeMetrics.
+
+    `duty_of(device) -> float` supplies the measured duty cycle (0..100)
+    per chip — the long-running entry points (run_daemon, run_publisher)
+    pass a DutySamplerPool lookup (telemetry/duty.py); one-shot snapshots
+    default to 0 (the score term treats unmeasured as neutral).
+    `devices` overrides the chip inventory (dependency injection for
+    tests and future remote sources); default is this host's TPU devices.
+    """
     import jax
 
     from ..topology.generations import GENERATIONS
 
     name = node_name or socket.gethostname()
-    devices = [d for d in jax.local_devices() if d.platform == "tpu"]
+    if devices is None:
+        devices = [d for d in jax.local_devices() if d.platform == "tpu"]
     generation = (generation_of(getattr(devices[0], "device_kind", ""))
                   if devices else "")
     gen = GENERATIONS.get(generation)
@@ -87,6 +97,8 @@ def local_node_metrics(node_name: str | None = None) -> TpuNodeMetrics:
                             getattr(d, "num_cores", None) or _DEFAULT_MXUS),
                 power_w=gen.power_w if gen else _DEFAULT_POWER_W,
                 coords=coords,  # type: ignore[arg-type]
+                duty_cycle_pct=float(duty_of(d)) if duty_of is not None
+                else 0.0,
             )
         )
     return TpuNodeMetrics(
@@ -102,16 +114,22 @@ def local_node_metrics(node_name: str | None = None) -> TpuNodeMetrics:
 
 def run_daemon(store, node_name: str | None = None, interval_s: float = 5.0, stop_event=None):
     """Publish local metrics into a TelemetryStore on an interval — the
-    in-process stand-in for the per-node sniffer DaemonSet."""
+    in-process stand-in for the per-node sniffer DaemonSet. Long-running,
+    so it carries a duty-cycle sampler pool (telemetry/duty.py): the
+    utilisation term in scoring works from REAL probes, not fake data."""
     import threading
 
+    from .duty import DutySamplerPool
+
     stop = stop_event or threading.Event()
+    pool = DutySamplerPool()
 
     def loop() -> None:
         while not stop.wait(interval_s):
-            store.put(local_node_metrics(node_name))
+            store.put(local_node_metrics(node_name, duty_of=pool.duty_of))
+        pool.stop()
 
-    store.put(local_node_metrics(node_name))
+    store.put(local_node_metrics(node_name, duty_of=pool.duty_of))
     t = threading.Thread(target=loop, daemon=True)
     t.start()
     return stop
