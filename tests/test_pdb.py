@@ -96,12 +96,37 @@ class TestLedger:
             picks.append(v)
         assert c_ in picks, "second pick must avoid draining the budget"
 
-    def test_percentage_budget_unevaluable(self):
+    def test_percentage_min_available_evaluates(self):
+        """minAvailable: "50%" resolves against the OBSERVED matching pod
+        count (4 pods -> must keep ceil(2) = 2 -> may evict 2)."""
         b = DisruptionBudget.from_manifest({
             "metadata": {"name": "pct"},
             "spec": {"selector": {"matchLabels": {"app": "serve"}},
                      "minAvailable": "50%"}})
-        assert b.min_available is None
+        assert b.min_available is None and b.min_available_pct == 50
+        pods = [pod(f"p{i}", {"app": "serve"}) for i in range(4)]
+        led = DisruptionLedger([b], pods)
+        assert led.violations_for(pods[:2]) == 0   # 2 left >= 2 required
+        assert led.violations_for(pods[:3]) == 1   # 1 left < 2 required
+
+    def test_percentage_max_unavailable_rounds_up(self):
+        # 3 pods, maxUnavailable 50% -> ceil(1.5) = 2 may be disrupted
+        b = DisruptionBudget.from_manifest({
+            "metadata": {"name": "pct"},
+            "spec": {"selector": {"matchLabels": {"app": "serve"}},
+                     "maxUnavailable": "50%"}})
+        assert b.max_unavailable_pct == 50
+        pods = [pod(f"p{i}", {"app": "serve"}) for i in range(3)]
+        led = DisruptionLedger([b], pods)
+        assert led.violations_for(pods[:2]) == 0
+        assert led.violations_for(pods) == 1
+
+    def test_percentage_garbage_is_unevaluable(self):
+        b = DisruptionBudget.from_manifest({
+            "metadata": {"name": "bad"},
+            "spec": {"selector": {"matchLabels": {"app": "serve"}},
+                     "minAvailable": "abc%"}})
+        assert b.min_available is None and b.min_available_pct is None
         led = DisruptionLedger([b], [pod("p", {"app": "serve"})])
         assert led.violations_for([pod("q", {"app": "serve"})]) == 0
 

@@ -186,12 +186,14 @@ class TestParsing:
                              "values": ["a"]}]},
                      "namespaces": ["prod"],
                      "topologyKey": "zone"}]}})
-        ((ml, exprs, namespaces, key, match_all),) = p.pod_anti_affinity
+        ((ml, exprs, namespaces, key, match_all, ns_sel),) = \
+            p.pod_anti_affinity
         assert ml == frozenset({("app", "web")})
         assert exprs == (("tier", "In", ("a",)),)
         assert namespaces == ("prod",)
         assert key == "zone"
         assert match_all is False
+        assert ns_sel is None  # no namespaceSelector in the manifest
 
     def test_malformed_never_raises(self):
         p = mk_pod("p", {}, {"podAffinity": "notadict"})
@@ -386,3 +388,93 @@ class TestPreferredPodAffinity:
         assert web.phase == PodPhase.BOUND
         web_zone = "a" if web.node == "n1" else "b"
         assert web_zone != sensitive_zone
+
+
+class TestNamespaceSelector:
+    """podAffinityTerm.namespaceSelector (VERDICT r3 missing #4): the
+    applicable namespaces come from NAMESPACE labels, unioned with the
+    explicit list; {} selects every namespace."""
+
+    def _anti_ns(self, match_labels, ns_selector):
+        return {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": match_labels},
+                 "namespaceSelector": ns_selector,
+                 "topologyKey": "kubernetes.io/hostname"}]}}
+
+    def test_selector_picks_namespaces_by_label(self):
+        c = _cluster({"n1": "a", "n2": "b"})
+        c.set_namespace_labels("team-a", {"env": "prod"})
+        c.set_namespace_labels("team-b", {"env": "dev"})
+        # a conflicting pod in the PROD namespace on n1, and one in the
+        # DEV namespace on n2
+        c.bind(Pod("prod-web", namespace="team-a",
+                   labels={"app": "web"}), "n1", [(0, 0, 0)])
+        c.bind(Pod("dev-web", namespace="team-b",
+                   labels={"app": "web"}), "n2", [(0, 0, 0)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        # anti-affinity against web pods in env=prod namespaces only:
+        # n1 is repelled, n2 (dev conflict, not selected) is fine
+        p = mk_pod("p", {}, self._anti_ns(
+            {"app": "web"},
+            {"matchLabels": {"env": "prod"}}))
+        sched.submit(p)
+        sched.run_until_idle()
+        assert p.phase == PodPhase.BOUND and p.node == "n2"
+
+    def test_empty_selector_selects_all_namespaces(self):
+        c = _cluster({"n1": "a", "n2": "b"})
+        c.set_namespace_labels("team-a", {"env": "prod"})
+        c.bind(Pod("other-web", namespace="team-a",
+                   labels={"app": "web"}), "n1", [(0, 0, 0)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        # {} selects EVERY namespace: the default-namespace pod is
+        # repelled from n1 by the team-a conflict
+        p = mk_pod("p", {}, self._anti_ns({"app": "web"}, {}))
+        sched.submit(p)
+        sched.run_until_idle()
+        assert p.phase == PodPhase.BOUND and p.node == "n2"
+
+    def test_unresolvable_selector_matches_nothing(self):
+        """Without a namespace-labels source the selector must be
+        CONSERVATIVE (select no namespaces), not match-all: the pod still
+        binds even next to a would-be conflict."""
+        from yoda_scheduler_tpu.scheduler.plugins.admission import (
+            _pod_term_selects)
+
+        p = mk_pod("p", {}, self._anti_ns({"app": "web"},
+                                          {"matchLabels": {"env": "prod"}}))
+        other = Pod("w", namespace="team-a", labels={"app": "web"})
+        term = p.pod_anti_affinity[0]
+        assert _pod_term_selects(term, "default", other,
+                                 ns_labels_of=None) is False
+        assert _pod_term_selects(
+            term, "default", other,
+            ns_labels_of=lambda ns: {"env": "prod"}) is True
+
+    def test_union_with_explicit_namespaces(self):
+        c = _cluster({"n1": "a", "n2": "b"})
+        c.set_namespace_labels("team-a", {"env": "prod"})
+        c.bind(Pod("listed-web", namespace="listed",
+                   labels={"app": "web"}), "n1", [(0, 0, 0)])
+        c.bind(Pod("selected-web", namespace="team-a",
+                   labels={"app": "web"}), "n2", [(0, 0, 0)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        anti_term = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": "web"}},
+                 "namespaces": ["listed"],
+                 "namespaceSelector": {"matchLabels": {"env": "prod"}},
+                 "topologyKey": "kubernetes.io/hostname"}]}}
+        p = mk_pod("p", {}, anti_term)
+        sched.submit(p)
+        sched.run_until_idle()
+        # both the explicit namespace (n1) and the selected one (n2)
+        # repel: nothing fits
+        assert p.phase == PodPhase.FAILED

@@ -130,9 +130,14 @@ class TestParsing:
                          "notadict",
                      ]}})
         assert len(p.topology_spread) == 1
-        skew, key, when, ml, exprs, match_all = p.topology_spread[0]
+        (skew, key, when, ml, exprs, match_all,
+         min_domains, mlk, na_policy, nt_policy) = p.topology_spread[0]
         assert (skew, key, when) == (2, "zone", "ScheduleAnyway")
         assert ml == frozenset({("a", "b")})
+        # fine-grain defaults (upstream): no minDomains, no matchLabelKeys,
+        # affinity honoured, taints ignored
+        assert (min_domains, mlk, na_policy, nt_policy) == (
+            None, (), "Honor", "Ignore")
 
 
 class TestReviewRegressions:
@@ -179,3 +184,149 @@ class TestReviewRegressions:
                          {"maxSkew": 1, "topologyKey": "zone",
                           "labelSelector": {}}]}})
         assert p.topology_spread[0][5] is True  # match_all
+
+
+class TestFineGrain:
+    """Upstream PodTopologySpread fine-grain fields (VERDICT r3 missing
+    #4): minDomains, matchLabelKeys, nodeAffinityPolicy,
+    nodeTaintsPolicy."""
+
+    def _pod(self, name, constraint_extra=None, spec_extra=None,
+             labels=None):
+        return Pod.from_manifest({
+            "metadata": {"name": name,
+                         "labels": {"scv/number": "1", "app": "web",
+                                    **(labels or {})}},
+            "spec": {
+                "schedulerName": "yoda-scheduler",
+                "topologySpreadConstraints": [{
+                    "maxSkew": 1, "topologyKey": "zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    **(constraint_extra or {})}],
+                **(spec_extra or {}),
+            },
+        })
+
+    def test_min_domains_forces_new_domains(self):
+        """minDomains=2 with only one populated domain: the global min is
+        treated as 0, so piling a second pod into zone a (count 1 -> 2,
+        skew 2 > 1) must be refused even though zone a is the ONLY domain
+        — without minDomains a single-domain space always passes."""
+        c = _cluster({"n1": "a"})
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        p1 = self._pod("w0", {"minDomains": 2})
+        p2 = self._pod("w1", {"minDomains": 2})
+        for p in (p1, p2):
+            sched.submit(p)
+            sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND
+        assert p2.phase == PodPhase.FAILED  # must wait for a second domain
+        # control: the same two pods WITHOUT minDomains both land in a
+        c2 = _cluster({"m1": "a"})
+        sched2 = Scheduler(c2, SchedulerConfig(telemetry_max_age_s=1e9))
+        q1, q2 = self._pod("v0"), self._pod("v1")
+        for p in (q1, q2):
+            sched2.submit(p)
+            sched2.run_until_idle()
+        assert q1.phase == PodPhase.BOUND and q2.phase == PodPhase.BOUND
+
+    def test_match_label_keys_spread_per_revision(self):
+        """matchLabelKeys=[rev]: pods of revision r2 spread against OTHER
+        r2 pods only — two bound r1 pods in zone a must not block an r2
+        pod from zone a."""
+        c = _cluster({"n1": "a", "n2": "b"})
+        # two r1 pods bound in zone a: plain count a=2, b=0
+        for i in range(2):
+            c.bind(Pod(f"old{i}", labels={"app": "web", "rev": "r1"}),
+                   "n1", [(i, 0, 0)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        # without matchLabelKeys the r2 pod sees skew a=3 vs min 0 -> only
+        # zone b is admissible
+        plain = self._pod("plain", labels={"rev": "r2"})
+        sched.submit(plain)
+        sched.run_until_idle()
+        assert plain.node == "n2"
+        # with matchLabelKeys=[rev], r1 pods are invisible to the r2
+        # constraint — zone a (0 r2 pods) is as good as b; bind somewhere
+        scoped = self._pod("scoped", {"matchLabelKeys": ["rev"]},
+                           labels={"rev": "r2"})
+        sched.submit(scoped)
+        sched.run_until_idle()
+        assert scoped.phase == PodPhase.BOUND
+
+    def test_node_affinity_policy_honor_excludes_unselected_nodes(self):
+        """Default Honor: nodes the pod's own nodeSelector excludes are
+        outside the spreading space — their empty domain must not hold
+        the global minimum at 0 and block placement."""
+        c = _cluster({"n1": "a", "n2": "b"})
+        c.set_node_meta("n1", labels={"zone": "a", "pool": "tpu"})
+        c.set_node_meta("n2", labels={"zone": "b"})  # excluded by selector
+        # one bound matching pod in zone a -> with n2 IN the space, zone b
+        # would hold min=0 and a second zone-a pod would exceed skew
+        c.bind(Pod("w-old", labels={"app": "web"}), "n1", [(0, 0, 0)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        honor = self._pod("honor", spec_extra={
+            "nodeSelector": {"pool": "tpu"}})
+        sched.submit(honor)
+        sched.run_until_idle()
+        assert honor.phase == PodPhase.BOUND and honor.node == "n1"
+        # control: nodeAffinityPolicy Ignore keeps n2 in the space, the
+        # zone-b minimum stays 0, and the placement is refused
+        ignore = self._pod("ignore", {"nodeAffinityPolicy": "Ignore"},
+                           spec_extra={"nodeSelector": {"pool": "tpu"}})
+        sched.submit(ignore)
+        sched.run_until_idle()
+        assert ignore.phase == PodPhase.FAILED
+
+    def test_node_taints_policy_honor_excludes_tainted_nodes(self):
+        """nodeTaintsPolicy Honor: an untolerated-tainted node is outside
+        the spreading space (its empty domain doesn't pin the minimum);
+        the default Ignore keeps it in."""
+        c = _cluster({"n1": "a", "n2": "b"})
+        c.set_node_meta("n2", labels={"zone": "b"}, taints=(
+            {"key": "dedicated", "value": "other",
+             "effect": "NoSchedule"},))
+        c.bind(Pod("w-old", labels={"app": "web"}), "n1", [(0, 0, 0)])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1,
+                                             preemption=False))
+        # default Ignore: zone b is in the space with count 0 -> a second
+        # zone-a pod exceeds the skew, and n2 itself is untolerated ->
+        # nothing fits
+        default = self._pod("default")
+        sched.submit(default)
+        sched.run_until_idle()
+        assert default.phase == PodPhase.FAILED
+        honor = self._pod("honor", {"nodeTaintsPolicy": "Honor"})
+        sched.submit(honor)
+        sched.run_until_idle()
+        assert honor.phase == PodPhase.BOUND and honor.node == "n1"
+
+
+class TestFeasibleMemoSoundness:
+    def test_multi_node_zones_never_exceed_skew(self):
+        """Code-review regression (r4): the per-class feasible-list memo
+        repaired only CHANGED nodes, but a bind flips the spread verdict
+        of unchanged same-zone siblings — with 4 nodes per zone the burst
+        ended 2-vs-4. Spread pods must take the full scan (core.py
+        feas_ok gate); placement may never exceed maxSkew."""
+        zones = {f"a{i}": "a" for i in range(4)}
+        zones.update({f"b{i}": "b" for i in range(4)})
+        c = _cluster(zones)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pods = [spread_pod(f"w{i}") for i in range(6)]
+        for p in pods:
+            sched.submit(p)
+            sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        per_zone = {"a": 0, "b": 0}
+        for p in pods:
+            per_zone[zones[p.node]] += 1
+        assert per_zone == {"a": 3, "b": 3}, per_zone

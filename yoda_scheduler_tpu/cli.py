@@ -443,6 +443,30 @@ def cmd_validate(args) -> int:
                     f"{where}: {name}: topologySpreadConstraint has no "
                     f"labelSelector — it counts no pods, so the spread "
                     f"is vacuous")
+            md = c.get("minDomains")
+            if md is not None:
+                if not (isinstance(md, int) and not isinstance(md, bool)
+                        and md >= 1):
+                    problems.append(
+                        f"{where}: {name}: minDomains={md!r} (must be an "
+                        f"integer >= 1)")
+                elif when == "ScheduleAnyway":
+                    problems.append(
+                        f"{where}: {name}: minDomains is only honoured "
+                        f"with whenUnsatisfiable=DoNotSchedule (apiserver "
+                        f"rejects it with ScheduleAnyway)")
+            for fld, allowed in (("nodeAffinityPolicy", ("Honor", "Ignore")),
+                                 ("nodeTaintsPolicy", ("Honor", "Ignore"))):
+                v = c.get(fld)
+                if v is not None and v not in allowed:
+                    problems.append(
+                        f"{where}: {name}: {fld}={v!r} (must be Honor or "
+                        f"Ignore)")
+            mlk = c.get("matchLabelKeys")
+            if mlk is not None and not isinstance(mlk, list):
+                problems.append(
+                    f"{where}: {name}: matchLabelKeys is "
+                    f"{type(mlk).__name__}, not a list")
         # inter-pod (anti-)affinity: required terms filter, preferred
         # entries score by signed weight
         for which in ("podAffinity", "podAntiAffinity"):
@@ -538,14 +562,17 @@ def cmd_validate(args) -> int:
                         continue
                     for fld in ("minAvailable", "maxUnavailable"):
                         v = pspec.get(fld)
-                        if v is not None and not (
-                                isinstance(v, int) and not isinstance(v, bool)):
+                        if v is None:
+                            continue
+                        ok_int = isinstance(v, int) and not isinstance(v, bool)
+                        ok_pct = (isinstance(v, str) and v.endswith("%")
+                                  and v[:-1].isdigit()
+                                  and 0 <= int(v[:-1]) <= 100)
+                        if not ok_int and not ok_pct:
                             problems.append(
-                                f"{path}: {name}: {fld}={v!r} — percentage "
-                                f"budgets need the controller's scale "
-                                f"resolution; this scheduler evaluates only "
-                                f"integer budgets, so this one protects "
-                                f"nothing")
+                                f"{path}: {name}: {fld}={v!r} — must be an "
+                                f"integer or a percentage string like "
+                                f"\"50%\"; this budget protects nothing")
                     sel = pspec.get("selector")
                     if sel is None:
                         # policy/v1: selector {} selects ALL pods in the

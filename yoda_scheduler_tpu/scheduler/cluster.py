@@ -44,6 +44,7 @@ class FakeCluster:
         self._bound: dict[str, list[Pod]] = {}  # node -> pods
         self._meta: dict[str, tuple[dict, tuple]] = {}  # node -> (labels, taints)
         self._pdbs: tuple = ()
+        self._namespaces: dict[str, dict] = {}  # ns -> metadata.labels
         # monotonic per-node change counter (bind/evict/removal): lets the
         # scheduler reuse per-node snapshot state across cycles — a bind
         # invalidates one node, not the whole cluster
@@ -117,6 +118,18 @@ class FakeCluster:
     def disruption_budgets(self) -> tuple:
         with self._lock:
             return self._pdbs
+
+    def set_namespace_labels(self, ns: str, labels: dict[str, str]) -> None:
+        """Install a namespace object's metadata.labels (podAffinityTerm
+        namespaceSelector input). Bumps the membership version like a PDB
+        change: verdicts anywhere can depend on namespace labels."""
+        with self._lock:
+            self._namespaces[ns] = dict(labels)
+            self._nodes_ver += 1
+
+    def namespace_labels_map(self) -> dict[str, dict]:
+        with self._lock:
+            return dict(self._namespaces)
 
     def set_node_meta(self, name: str, labels: dict[str, str] | None = None,
                       taints: list[dict] | tuple = (),

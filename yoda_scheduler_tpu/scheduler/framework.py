@@ -202,13 +202,20 @@ class Snapshot:
     """Immutable-ish view of cluster + telemetry taken at cycle start."""
 
     def __init__(self, node_infos: dict[str, NodeInfo],
-                 budgets: tuple = ()) -> None:
+                 budgets: tuple = (),
+                 namespaces: dict[str, dict] | None = None) -> None:
         self._node_infos = node_infos
         # PodDisruptionBudgets in force this cycle (utils/pdb.py model);
         # preemption consults them when ranking victim plans. A budget
         # change bumps the cluster's membership version, so incremental
         # snapshots never carry stale budgets.
         self.budgets = budgets
+        # namespace -> metadata.labels, for podAffinityTerm
+        # namespaceSelector resolution; None (no namespace source) makes
+        # namespace_labels return None and selectors match conservatively
+        # nothing (admission._pod_term_selects). Namespace label changes
+        # bump the cluster membership version like budget changes do.
+        self._namespaces = namespaces
         # lazily-computed cluster facts used for plugin relevance gating
         # (core.py builds the per-cycle active-plugin lists from them);
         # incremental snapshots inherit the value from their parent when
@@ -220,6 +227,14 @@ class Snapshot:
 
     def get(self, name: str) -> NodeInfo | None:
         return self._node_infos.get(name)
+
+    def namespace_labels(self, ns: str) -> dict | None:
+        """metadata.labels of a namespace; {} for a known-labelless
+        namespace, None when this snapshot has no namespace source at
+        all (selectors then match nothing — conservative)."""
+        if self._namespaces is None:
+            return None
+        return self._namespaces.get(ns, {})
 
     def list(self) -> list[NodeInfo]:
         return list(self._node_infos.values())

@@ -95,15 +95,56 @@ def affinity_matches(pod: Pod, labels: dict,
     )
 
 
-def _pod_term_selects(term: tuple, subject_ns: str, candidate: Pod) -> bool:
+def _term_applies_ns(term: tuple, subject_ns: str, ns: str,
+                     ns_labels_of) -> bool:
+    """Is namespace `ns` applicable for this PodAffinityTerm? Applicable
+    namespaces are the UNION of the term's explicit list and the
+    namespaces its namespaceSelector picks (matched against NAMESPACE
+    labels via `ns_labels_of`); with neither, the owner's namespace
+    (upstream semantics). An EMPTY namespaceSelector ({}) selects every
+    namespace; a selector we cannot resolve (no namespace-labels source)
+    selects nothing — conservative."""
+    namespaces = term[2]
+    ns_sel = term[5] if len(term) > 5 else None
+    if namespaces and ns in namespaces:
+        return True
+    if ns_sel is not None:
+        sml, sexprs, sall = ns_sel
+        if sall:
+            return True
+        nl = ns_labels_of(ns) if ns_labels_of is not None else None
+        if nl is not None and (
+                all(nl.get(k) == v for k, v in sml)
+                and all(_match_expression(nl, k, op, vals)
+                        for k, op, vals in sexprs)):
+            return True
+    if not namespaces and ns_sel is None:
+        return ns == subject_ns
+    return False
+
+
+def _pod_term_selects(term: tuple, subject_ns: str, candidate: Pod,
+                      ns_labels_of=None, ns_memo: dict | None = None) -> bool:
     """Does one PodAffinityTerm's labelSelector select `candidate`?
-    `subject_ns` is the namespace of the pod OWNING the term (terms with
-    no explicit namespaces apply to the owner's namespace). LabelSelector
-    semantics: a NIL (absent) selector matches no pods; a present-but-
-    EMPTY selector matches every pod in the applicable namespaces."""
-    ml, exprs, namespaces, _key, match_all = term
-    if candidate.namespace not in (namespaces or (subject_ns,)):
+    Namespace applicability per _term_applies_ns; `ns_memo` (a per-index-
+    build dict) memoises it per (term, namespace) — the index scans are
+    O(nodes x bound pods) and re-deriving a namespaceSelector verdict per
+    candidate repeats identical work. LabelSelector semantics: a NIL
+    (absent) selector matches no pods; a present-but-EMPTY selector
+    matches every pod in the applicable namespaces."""
+    if ns_memo is not None:
+        mkey = (id(term), candidate.namespace)
+        in_ns = ns_memo.get(mkey)
+        if in_ns is None:
+            in_ns = _term_applies_ns(term, subject_ns, candidate.namespace,
+                                     ns_labels_of)
+            ns_memo[mkey] = in_ns
+    else:
+        in_ns = _term_applies_ns(term, subject_ns, candidate.namespace,
+                                 ns_labels_of)
+    if not in_ns:
         return False
+    ml, exprs, _namespaces, _key, match_all = term[:5]
     if match_all:
         return True
     if not ml and not exprs:
@@ -140,11 +181,15 @@ def _pod_affinity_index(state: CycleState, pod: Pod, snapshot) -> tuple:
     if cached is not None:
         return cached
     nodes = snapshot.list()
+    nlo = getattr(snapshot, "namespace_labels", None)
+    ns_memo: dict = {}
 
     affinity = []
     for term in pod.pod_affinity:
-        counts = _term_domain_counts(term, pod.namespace, nodes)
-        if not counts and _pod_term_selects(term, pod.namespace, pod):
+        counts = _term_domain_counts(term, pod.namespace, nodes, nlo,
+                                     ns_memo)
+        if not counts and _pod_term_selects(term, pod.namespace, pod, nlo,
+                                            ns_memo):
             affinity.append((term, _SELF_SATISFIED))
         else:
             affinity.append((term, frozenset(counts)))
@@ -160,7 +205,7 @@ def _pod_affinity_index(state: CycleState, pod: Pod, snapshot) -> tuple:
                     continue
                 for p in ni.pods:
                     if not p.terminating and _pod_term_selects(
-                            term, pod.namespace, p):
+                            term, pod.namespace, p, nlo, ns_memo):
                         by_dom.setdefault(dom, []).append(p)
         anti.append((term, by_dom))
 
@@ -211,7 +256,9 @@ def admissible(pod: Pod, node: NodeInfo) -> bool:
 _PREF_POD_AFF_STATE = "admission/preferred-pod-affinity-index"
 
 
-def _term_domain_counts(term: tuple, subject_ns: str, nodes) -> dict:
+def _term_domain_counts(term: tuple, subject_ns: str, nodes,
+                        ns_labels_of=None, ns_memo: dict | None = None
+                        ) -> dict:
     """{topology-domain value: number of matching bound pods} for one
     PodAffinityTerm — the shared scan behind both the required-affinity
     index and preferred scoring (multiplicity matters for the latter:
@@ -225,7 +272,8 @@ def _term_domain_counts(term: tuple, subject_ns: str, nodes) -> dict:
                 continue
             n = sum(1 for p in ni.pods
                     if not p.terminating
-                    and _pod_term_selects(term, subject_ns, p))
+                    and _pod_term_selects(term, subject_ns, p, ns_labels_of,
+                                          ns_memo))
             if n:
                 counts[dom] = counts.get(dom, 0) + n
     return counts
@@ -246,9 +294,12 @@ def _preferred_pod_affinity_index(state: CycleState, pod: Pod,
     if cached is not None:
         return cached
     nodes = snapshot.list()
+    nlo = getattr(snapshot, "namespace_labels", None)
+    ns_memo: dict = {}
     out = []
     for w, term in pod.preferred_pod_affinity:
-        counts = _term_domain_counts(term, pod.namespace, nodes)
+        counts = _term_domain_counts(term, pod.namespace, nodes, nlo,
+                                     ns_memo)
         if counts:
             out.append((w, term[3], counts))
     if snapshot.any_preferred_pod_affinity():
@@ -260,7 +311,7 @@ def _preferred_pod_affinity_index(state: CycleState, pod: Pod,
                     key = term[3]
                     dom = ni.labels.get(key) if key else None
                     if dom is not None and _pod_term_selects(
-                            term, bound.namespace, pod):
+                            term, bound.namespace, pod, nlo, ns_memo):
                         out.append((w, key, {dom: 1}))
     index = tuple(out)
     state.write(_PREF_POD_AFF_STATE, index)
@@ -270,12 +321,21 @@ def _preferred_pod_affinity_index(state: CycleState, pod: Pod,
 _SPREAD_STATE = "admission/topology-spread-index"
 
 
-def _spread_selects(constraint: tuple, pod_ns: str, candidate: Pod) -> bool:
+def _spread_selects(constraint: tuple, pod: Pod, candidate: Pod) -> bool:
     """Does a topologySpreadConstraint's labelSelector select `candidate`?
-    Spread selectors are namespace-local to the incoming pod."""
-    _skew, _key, _when, ml, exprs, match_all = constraint
-    if candidate.namespace != pod_ns:
+    Spread selectors are namespace-local to the incoming pod.
+    matchLabelKeys (upstream fine grain): the INCOMING pod's values for
+    those label keys become exact requirements on the candidate — the
+    pod-template-hash idiom, spreading within one revision only. A key
+    the incoming pod lacks is skipped (upstream drops it)."""
+    _skew, _key, _when, ml, exprs, match_all = constraint[:6]
+    mlk = constraint[7] if len(constraint) > 7 else ()
+    if candidate.namespace != pod.namespace:
         return False
+    for k in mlk:
+        v = pod.labels.get(k)
+        if v is not None and candidate.labels.get(k) != v:
+            return False
     if match_all:
         return True
     if not ml and not exprs:
@@ -290,13 +350,18 @@ def _spread_selects(constraint: tuple, pod_ns: str, candidate: Pod) -> bool:
 
 def _spread_index(state: CycleState, pod: Pod, snapshot) -> tuple:
     """Per-cycle index: for each of the pod's spread constraints,
-    (constraint, {domain: matching-pod count}, global minimum count).
-    Domains are the distinct values of the constraint's topologyKey over
-    nodes that carry the key; nodes without the key neither host domains
-    nor count toward the minimum (upstream treats them as outside the
-    spreading space; upstream's additional node-inclusion refinement —
-    only nodes passing the pod's own selectors define domains — is not
-    modelled)."""
+    (constraint, {domain: matching-pod count}, global minimum count,
+    self-match). Domains are the distinct values of the constraint's
+    topologyKey over nodes IN THE SPREADING SPACE:
+
+    - nodes without the key are outside it (upstream semantics)
+    - nodeAffinityPolicy Honor (the default): nodes the pod's own
+      nodeSelector / required nodeAffinity exclude are outside it
+    - nodeTaintsPolicy Honor: nodes with untolerated NoSchedule/NoExecute
+      taints are outside it (default Ignore)
+    - minDomains (DoNotSchedule only): while the space holds fewer than
+      minDomains domains, the global minimum is treated as 0, forcing new
+      pods onto new domains (upstream semantics)"""
     cached = state.read_or(_SPREAD_STATE)
     if cached is not None:
         return cached
@@ -304,23 +369,48 @@ def _spread_index(state: CycleState, pod: Pod, snapshot) -> tuple:
     out = []
     for c in pod.topology_spread:
         key = c[1]
+        min_domains = c[6] if len(c) > 6 else None
+        na_policy = c[8] if len(c) > 8 else "Honor"
+        nt_policy = c[9] if len(c) > 9 else "Ignore"
         counts: dict = {}
         for ni in nodes:
             dom = ni.labels.get(key)
             if dom is None:
                 continue
+            if na_policy != "Ignore" and not _node_passes_pod_node_affinity(
+                    pod, ni):
+                continue
+            if (nt_policy == "Honor" and ni.taints
+                    and untolerated(pod, ni.taints,
+                                    (NO_SCHEDULE, NO_EXECUTE))):
+                continue
             counts[dom] = counts.get(dom, 0) + sum(
                 1 for p in ni.pods
-                if not p.terminating and _spread_selects(c, pod.namespace, p)
+                if not p.terminating and _spread_selects(c, pod, p)
             )
+        global_min = min(counts.values()) if counts else 0
+        if (min_domains is not None and c[2] == "DoNotSchedule"
+                and len(counts) < min_domains):
+            global_min = 0
         # upstream selfMatchNum: placing the pod raises its domain's count
         # only when the pod matches its OWN selector
-        self_match = 1 if _spread_selects(c, pod.namespace, pod) else 0
-        out.append((c, counts, min(counts.values()) if counts else 0,
-                    self_match))
+        self_match = 1 if _spread_selects(c, pod, pod) else 0
+        out.append((c, counts, global_min, self_match))
     index = tuple(out)
     state.write(_SPREAD_STATE, index)
     return index
+
+
+def _node_passes_pod_node_affinity(pod: Pod, ni: NodeInfo) -> bool:
+    """Is this node inside the pod's own nodeSelector + required
+    nodeAffinity? (The spreading-space membership test behind
+    nodeAffinityPolicy: Honor.)"""
+    if pod.node_selector:
+        labels = ni.labels
+        for k, v in pod.node_selector.items():
+            if labels.get(k) != v:
+                return False
+    return affinity_matches(pod, ni.labels, ni.name)
 
 
 def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
@@ -375,9 +465,10 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
             if not evictable_fn(conflict):
                 return None
             must[conflict.key] = conflict
+    nlo = getattr(snapshot, "namespace_labels", None)
     for term, owner, key, dom in reverse:
         if labels.get(key) == dom and _pod_term_selects(
-                term, owner.namespace, pod):
+                term, owner.namespace, pod, nlo):
             if not evictable_fn(owner):
                 return None
             must[owner.key] = owner
@@ -493,6 +584,7 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
         driven by the per-cycle index (one cluster scan per pod cycle, not
         per node)."""
         aff, anti, reverse = _pod_affinity_index(state, pod, snapshot)
+        nlo = getattr(snapshot, "namespace_labels", None)
         labels = node.labels
         for term, domains in aff:
             if domains is _SELF_SATISFIED:
@@ -512,7 +604,7 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                     f"(topologyKey={key})")
         for term, owner, key, dom in reverse:
             if labels.get(key) == dom and _pod_term_selects(
-                    term, owner.namespace, pod):
+                    term, owner.namespace, pod, nlo):
                 return Status.unschedulable(
                     f"{node.name}: repelled by a bound pod's "
                     f"podAntiAffinity (topologyKey={key})")

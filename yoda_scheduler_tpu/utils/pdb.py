@@ -10,10 +10,13 @@ serving workload declared a budget. The standalone engine restores it:
   scheduler consumes — namespace, label selector (matchLabels AND
   matchExpressions with In/NotIn/Exists/DoesNotExist; an EMPTY selector
   matches every pod in the namespace, policy/v1 semantics), and exactly
-  one of minAvailable / maxUnavailable. Integer forms only: percentage
-  forms require the controller's scale-subresource resolution and are
-  treated as unevaluable — they protect nothing here, and `cli validate`
-  flags them.
+  one of minAvailable / maxUnavailable, integer or percentage ("50%").
+  Percentages resolve against the OBSERVED matching pod count (healthy +
+  terminating) at ledger-build time — the in-cache approximation of the
+  disruption controller's scale-subresource expectedCount (equal in
+  steady state; during a rollout the observed count tracks reality
+  faster than the declared scale). Rounding follows upstream
+  `GetScaledValueFromIntOrPercent(..., roundUp=true)` for both fields.
 - `DisruptionLedger`: per-cycle allowance accounting. Built once from the
   cluster's bound pods, then consulted/consumed as a victim plan grows.
 
@@ -56,6 +59,10 @@ class DisruptionBudget:
     match_all: bool = False
     min_available: int | None = None
     max_unavailable: int | None = None
+    # percentage forms, 0-100 (e.g. minAvailable: "50%"); resolved against
+    # the observed matching-pod count when the ledger is built
+    min_available_pct: int | None = None
+    max_unavailable_pct: int | None = None
 
     def matches(self, pod) -> bool:
         if pod.namespace != self.namespace:
@@ -73,8 +80,8 @@ class DisruptionBudget:
 
     @classmethod
     def from_manifest(cls, manifest: dict) -> "DisruptionBudget":
-        """policy/v1 PodDisruptionBudget object -> model. Percentage
-        budgets parse to None/None (unevaluable — see module docstring)."""
+        """policy/v1 PodDisruptionBudget object -> model. Integer and
+        percentage forms both evaluate (module docstring)."""
         meta = manifest.get("metadata") or {}
         spec = manifest.get("spec") or {}
         sel = spec.get("selector")
@@ -92,6 +99,15 @@ class DisruptionBudget:
         def as_int(v):
             return v if isinstance(v, int) and not isinstance(v, bool) else None
 
+        def as_pct(v):
+            if isinstance(v, str) and v.endswith("%"):
+                try:
+                    pct = int(v[:-1])
+                except ValueError:
+                    return None
+                return pct if 0 <= pct <= 100 else None
+            return None
+
         return cls(
             name=meta.get("name", "pdb"),
             namespace=meta.get("namespace", "default"),
@@ -102,6 +118,8 @@ class DisruptionBudget:
             match_all=sel is not None and not ml and not exprs,
             min_available=as_int(spec.get("minAvailable")),
             max_unavailable=as_int(spec.get("maxUnavailable")),
+            min_available_pct=as_pct(spec.get("minAvailable")),
+            max_unavailable_pct=as_pct(spec.get("maxUnavailable")),
         )
 
 
@@ -116,10 +134,17 @@ class DisruptionLedger:
     def __init__(self, budgets, all_pods) -> None:
         self.budgets = [b for b in budgets
                         if b.min_available is not None
-                        or b.max_unavailable is not None]
+                        or b.max_unavailable is not None
+                        or b.min_available_pct is not None
+                        or b.max_unavailable_pct is not None]
         self._allow: dict[tuple[str, str], int] = {}
         if not self.budgets:
             return
+
+        def ceil_pct(pct: int, count: int) -> int:
+            # upstream GetScaledValueFromIntOrPercent(..., roundUp=true)
+            return -((-pct * count) // 100)
+
         for b in self.budgets:
             healthy = disrupting = 0
             for p in all_pods:
@@ -128,10 +153,17 @@ class DisruptionLedger:
                         disrupting += 1
                     else:
                         healthy += 1
-            if b.max_unavailable is not None:
-                allow = b.max_unavailable - disrupting
+            observed = healthy + disrupting  # expectedCount approximation
+            max_unavail = b.max_unavailable
+            if max_unavail is None and b.max_unavailable_pct is not None:
+                max_unavail = ceil_pct(b.max_unavailable_pct, observed)
+            min_avail = b.min_available
+            if min_avail is None and b.min_available_pct is not None:
+                min_avail = ceil_pct(b.min_available_pct, observed)
+            if max_unavail is not None:
+                allow = max_unavail - disrupting
             else:
-                allow = healthy - b.min_available
+                allow = healthy - min_avail
             self._allow[(b.namespace, b.name)] = allow
 
     def violations_for(self, victims) -> int:

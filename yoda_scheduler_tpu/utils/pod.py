@@ -130,23 +130,27 @@ def _parse_preferred_affinity(spec) -> tuple:
 def _parse_pod_affinity_terms(spec, which: str) -> tuple:
     """spec.affinity.{podAffinity|podAntiAffinity}.requiredDuringScheduling
     IgnoredDuringExecution -> tuple of (match_labels frozenset,
-    match_expressions tuple, namespaces tuple, topology_key, match_all).
-    LabelSelector semantics: a NIL (absent) selector matches no pods; a
-    PRESENT-but-empty selector ({}) matches every pod in the applicable
-    namespaces — match_all carries that distinction. An empty topologyKey
-    is invalid upstream and parses to "" (the admission plugin treats it
-    as never satisfiable / never conflicting). Malformed shapes never
-    raise; cli validate reports them."""
+    match_expressions tuple, namespaces tuple, topology_key, match_all,
+    namespace_selector). LabelSelector semantics: a NIL (absent) selector
+    matches no pods; a PRESENT-but-empty selector ({}) matches every pod
+    in the applicable namespaces — match_all carries that distinction.
+    namespace_selector is None (absent) or (ml, exprs, all) matched
+    against NAMESPACE labels; applicable namespaces are the UNION of the
+    explicit list and the selector's matches (upstream semantics). An
+    empty topologyKey is invalid upstream and parses to "" (the admission
+    plugin treats it as never satisfiable / never conflicting). Malformed
+    shapes never raise; cli validate reports them."""
     raw = _as_dict(_as_dict(_as_dict(spec).get("affinity")).get(which)).get(
         "requiredDuringSchedulingIgnoredDuringExecution")
     return tuple(_parse_pod_term(t) for t in (raw if isinstance(raw, list)
                                               else []))
 
 
-def _parse_pod_term(term) -> tuple:
-    """One PodAffinityTerm -> the 5-tuple documented above."""
-    term = _as_dict(term)
-    raw_sel = term.get("labelSelector")
+def _parse_label_selector(raw_sel) -> tuple:
+    """A LabelSelector dict -> (match_labels frozenset, match_expressions
+    tuple, match_all). match_all marks the PRESENT-but-empty selector
+    ({}: matches everything); an absent selector is the caller's concern
+    (nil semantics differ per API)."""
     sel = _as_dict(raw_sel)
     ml = _as_dict(sel.get("matchLabels"))
     raw_exprs = sel.get("matchExpressions")
@@ -157,14 +161,35 @@ def _parse_pod_term(term) -> tuple:
         for e in (raw_exprs if isinstance(raw_exprs, list) else [])
         if isinstance(e, dict)
     )
-    namespaces = term.get("namespaces")
     return (
         frozenset((str(k), str(v)) for k, v in ml.items()),
+        exprs,
+        isinstance(raw_sel, dict) and not ml and not exprs,
+    )
+
+
+def _parse_pod_term(term) -> tuple:
+    """One PodAffinityTerm -> the 6-tuple documented above."""
+    term = _as_dict(term)
+    raw_sel = term.get("labelSelector")
+    ml, exprs, match_all = _parse_label_selector(raw_sel)
+    if raw_sel is None:
+        match_all = False  # nil labelSelector selects no pods
+    namespaces = term.get("namespaces")
+    # namespaceSelector (matched against NAMESPACE labels): None when
+    # absent (term applies to explicit namespaces, else the owner's);
+    # an empty selector ({}) selects EVERY namespace
+    raw_ns_sel = term.get("namespaceSelector")
+    ns_sel = (_parse_label_selector(raw_ns_sel)
+              if isinstance(raw_ns_sel, dict) else None)
+    return (
+        ml,
         exprs,
         tuple(str(n) for n in namespaces)
         if isinstance(namespaces, list) else (),
         str(term.get("topologyKey", "")),
         isinstance(raw_sel, dict) and not ml and not exprs,
+        ns_sel,
     )
 
 
@@ -192,10 +217,25 @@ def _parse_preferred_pod_affinity(spec, which: str, sign: int) -> tuple:
 def _parse_topology_spread(spec) -> tuple:
     """spec.topologySpreadConstraints -> tuple of (max_skew, topology_key,
     when_unsatisfiable, match_labels frozenset, match_expressions tuple,
-    match_all). Entries without a positive integer maxSkew or a
+    match_all, min_domains, match_label_keys, node_affinity_policy,
+    node_taints_policy). Entries without a positive integer maxSkew or a
     topologyKey are dropped (the apiserver rejects them); cli validate
     reports them. LabelSelector semantics as in _parse_pod_affinity_terms
-    (nil = no pods, {} = all pods in the namespace)."""
+    (nil = no pods, {} = all pods in the namespace).
+
+    Fine-grain fields (upstream PodTopologySpread semantics):
+    - min_domains: None, or the minimum number of eligible domains —
+      below it the global minimum is treated as 0 (forces spreading onto
+      new domains); only honoured for DoNotSchedule upstream
+    - match_label_keys: label keys whose values are copied from the
+      INCOMING pod into the selector as exact requirements (the
+      pod-template-hash idiom: spread within one revision)
+    - node_affinity_policy: "Honor" (default — nodes the pod's own
+      nodeSelector/affinity exclude are outside the spreading space) or
+      "Ignore"
+    - node_taints_policy: "Ignore" (default) or "Honor" (untolerated
+      tainted nodes are outside the spreading space)
+    """
     raw = _as_dict(spec).get("topologySpreadConstraints")
     out = []
     for c in (raw if isinstance(raw, list) else []):
@@ -206,22 +246,22 @@ def _parse_topology_spread(spec) -> tuple:
                 or skew < 1 or not key):
             continue
         raw_sel = c.get("labelSelector")
-        sel = _as_dict(raw_sel)
-        ml = _as_dict(sel.get("matchLabels"))
-        raw_exprs = sel.get("matchExpressions")
-        exprs = tuple(
-            (str(e.get("key", "")), str(e.get("operator", "")),
-             tuple(str(v) for v in e.get("values") or ())
-             if isinstance(e.get("values"), list) else ())
-            for e in (raw_exprs if isinstance(raw_exprs, list) else [])
-            if isinstance(e, dict)
-        )
+        ml, exprs, match_all = _parse_label_selector(raw_sel)
+        if raw_sel is None:
+            match_all = False
+        md = c.get("minDomains")
+        mlk = c.get("matchLabelKeys")
         out.append((
             skew, key,
             str(c.get("whenUnsatisfiable", "DoNotSchedule")),
-            frozenset((str(k), str(v)) for k, v in ml.items()),
+            ml,
             exprs,
-            isinstance(raw_sel, dict) and not ml and not exprs,
+            match_all,
+            md if isinstance(md, int) and not isinstance(md, bool)
+            and md >= 1 else None,
+            tuple(str(k) for k in mlk) if isinstance(mlk, list) else (),
+            str(c.get("nodeAffinityPolicy", "Honor")),
+            str(c.get("nodeTaintsPolicy", "Ignore")),
         ))
     return tuple(out)
 
