@@ -410,7 +410,8 @@ class Reflector:
 
     def __init__(self, client: KubeClient, path: str, on_replace, on_event,
                  relist_s: float = 300.0, watch_timeout_s: float = 60.0,
-                 backoff_s: float = 0.5, max_backoff_s: float = 15.0) -> None:
+                 backoff_s: float = 0.5, max_backoff_s: float = 15.0,
+                 optional: bool = False) -> None:
         self.client = client
         self.path = path
         self.on_replace = on_replace
@@ -420,9 +421,24 @@ class Reflector:
         self.backoff_s = backoff_s
         self.max_backoff_s = max_backoff_s
         self.last_list_at = 0.0
+        # optional resources (namespaces without RBAC, API groups the
+        # control plane lacks): a 403/404 LIST counts as synced-empty
+        # instead of blocking wait_synced forever; retried on the relist
+        # interval in case the resource appears later
+        self.optional = optional
+        self.absent = False
 
     def list_once(self) -> str | None:
-        doc = self.client.list_all(self.path)
+        self.absent = False
+        try:
+            doc = self.client.list_all(self.path)
+        except ApiError as e:
+            if self.optional and e.status in (403, 404):
+                self.on_replace([])
+                self.last_list_at = time.monotonic()
+                self.absent = True
+                return None
+            raise
         self.on_replace(doc.get("items", []))
         self.last_list_at = time.monotonic()
         return _rv_of(doc)
@@ -434,6 +450,12 @@ class Reflector:
             try:
                 rv = self.list_once()
                 backoff = self.backoff_s
+                if self.absent:
+                    # optional resource the server doesn't serve: don't
+                    # hammer it with doomed watches — re-probe at the
+                    # relist cadence in case it appears later
+                    stop.wait(self.relist_s)
+                    continue
                 while not stop.is_set():
                     if time.monotonic() - self.last_list_at > self.relist_s:
                         break  # periodic full resync
@@ -505,6 +527,7 @@ class KubeCluster:
         self._nodes: set[str] = set()
         self._node_meta: dict[str, tuple[dict, tuple]] = {}  # name -> (labels, taints)
         self._pdbs: tuple = ()                   # DisruptionBudget models
+        self._namespaces: dict[str, dict] = {}   # ns -> metadata.labels
         self._pods: dict[str, Pod] = {}          # key -> non-terminal pod
         self._by_node: dict[str, dict[str, Pod]] = {}  # node -> key -> pod
         self._pods_ver: dict[str, int] = {}      # node -> change counter
@@ -529,6 +552,9 @@ class KubeCluster:
                 Reflector(client, PDB_PATH,
                           self._replace_pdbs, self._pdb_event,
                           relist_s=relist_s),
+                Reflector(client, "/api/v1/namespaces",
+                          self._replace_namespaces, self._namespace_event,
+                          relist_s=relist_s, optional=True),
             ]
 
     # ----------------------------------------------------- watch-cache apply
@@ -691,6 +717,36 @@ class KubeCluster:
         with self._lock:
             return self._pdbs
 
+    def _replace_namespaces(self, items: list[dict]) -> None:
+        fresh = {
+            i.get("metadata", {}).get("name", ""): dict(
+                i.get("metadata", {}).get("labels") or {})
+            for i in items if i.get("metadata", {}).get("name")
+        }
+        with self._lock:
+            if fresh != self._namespaces:
+                # namespaceSelector verdicts can change anywhere:
+                # invalidate via the membership version (like PDBs)
+                self._nodes_ver += 1
+            self._namespaces = fresh
+
+    def _namespace_event(self, typ: str, obj: dict) -> None:
+        name = obj.get("metadata", {}).get("name")
+        if not name:
+            return
+        labels = dict(obj.get("metadata", {}).get("labels") or {})
+        with self._lock:
+            if typ == "DELETED":
+                if self._namespaces.pop(name, None) is not None:
+                    self._nodes_ver += 1
+            elif self._namespaces.get(name) != labels:
+                self._namespaces[name] = labels
+                self._nodes_ver += 1
+
+    def namespace_labels_map(self) -> dict[str, dict]:
+        with self._lock:
+            return dict(self._namespaces)
+
     def _replace_metrics(self, items: list[dict]) -> None:
         self._apply_metrics([TpuNodeMetrics.from_cr(i) for i in items])
 
@@ -717,6 +773,11 @@ class KubeCluster:
         except ApiError:
             pdb_doc = {}  # control planes without the policy API group
         self._replace_pdbs(pdb_doc.get("items", []))
+        try:
+            ns_doc = self.client.list_all("/api/v1/namespaces")
+        except ApiError:
+            ns_doc = {}  # RBAC without namespace list: selectors inert
+        self._replace_namespaces(ns_doc.get("items", []))
 
     def start(self) -> None:
         if self.watch_mode:
@@ -958,18 +1019,30 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
             # run every engine each pass (a generator inside any() would
             # short-circuit and starve later profiles behind a busy first);
             # isolate failures so one profile's persistent exception can't
-            # starve its co-hosted profiles of cycles
-            outcomes = []
-            for name, e in sched.engines.items():
-                try:
-                    outcomes.append(e.run_one())
-                except Exception as exc:
-                    log.error("profile %s cycle error: %s", name, exc)
-                    # None = "no progress": a persistently-throwing profile
-                    # must not defeat the all-idle poll_s wait below, or the
-                    # loop hot-spins re-listing the API server
-                    outcomes.append(None)
-            if all(o is None for o in outcomes):
+            # starve its co-hosted profiles of cycles. Drain up to 64
+            # cycles per intake pass: the intake bookkeeping above is
+            # O(pending), so one-cycle-per-pass made a 1000-pod burst
+            # O(pending^2) — new arrivals wait at most one batch, well
+            # under the poll interval they'd wait anyway
+            idle = False
+            for _ in range(64):
+                outcomes = []
+                for name, e in sched.engines.items():
+                    try:
+                        outcomes.append(e.run_one())
+                    except Exception as exc:
+                        log.error("profile %s cycle error: %s", name, exc)
+                        # None = "no progress": a persistently-throwing
+                        # profile must not defeat the all-idle poll_s wait
+                        # below, or the loop hot-spins re-listing the API
+                        # server
+                        outcomes.append(None)
+                if all(o is None for o in outcomes):
+                    idle = True
+                    break
+                if stop.is_set():
+                    break
+            if idle:
                 stop.wait(poll_s)
         except Exception as e:
             log.error("cycle error: %s", e)

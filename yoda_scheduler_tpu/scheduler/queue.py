@@ -41,6 +41,21 @@ class SchedulingQueue:
         self._max = max_backoff_s
         self._active: list = []  # infos, or (key, seq, info) heap entries
         self._backoff: list[QueuedPodInfo] = []
+        # pod-key membership counts: contains() is called once per PENDING
+        # pod per serve pass (k8s/client._serve intake), so it must be
+        # O(1), not a queue scan — at 1000 pending pods the scan made the
+        # serve loop O(n^2) per pass
+        self._key_counts: dict[str, int] = {}
+
+    def _inc(self, key: str) -> None:
+        self._key_counts[key] = self._key_counts.get(key, 0) + 1
+
+    def _dec(self, key: str) -> None:
+        n = self._key_counts.get(key, 0) - 1
+        if n <= 0:
+            self._key_counts.pop(key, None)
+        else:
+            self._key_counts[key] = n
 
     def _push_active(self, info: QueuedPodInfo) -> None:
         if self._key is not None:
@@ -59,6 +74,7 @@ class SchedulingQueue:
         if now is not None:
             info.enqueued = now
         self._push_active(info)
+        self._inc(pod.key)
 
     def __len__(self) -> int:
         return len(self._active) + len(self._backoff)
@@ -84,12 +100,16 @@ class SchedulingQueue:
         if not self._active:
             return None
         if self._key is not None:
-            return heapq.heappop(self._active)[2]
+            info = heapq.heappop(self._active)[2]
+            self._dec(info.pod.key)
+            return info
         best_i = 0
         for i in range(1, len(self._active)):
             if self._less(self._active[i], self._active[best_i]):
                 best_i = i
-        return self._active.pop(best_i)
+        info = self._active.pop(best_i)
+        self._dec(info.pod.key)
+        return info
 
     def requeue_backoff(self, info: QueuedPodInfo, now: float | None = None) -> None:
         """Return an unschedulable pod with exponential backoff 1s -> 10s."""
@@ -102,6 +122,7 @@ class SchedulingQueue:
                     self._max)
         info.not_before = now + delay
         self._backoff.append(info)
+        self._inc(info.pod.key)
 
     def requeue_immediate(self, info: QueuedPodInfo) -> None:
         """Return a pod to the active queue with no backoff — used for a
@@ -109,6 +130,7 @@ class SchedulingQueue:
         next pop (the nominated-node fast-retry analogue)."""
         info.not_before = 0.0
         self._push_active(info)
+        self._inc(info.pod.key)
 
     def remove(self, pod_key: str) -> list[QueuedPodInfo]:
         """Drop a pod from the active queue and backoff lot (external
@@ -132,11 +154,12 @@ class SchedulingQueue:
             if q.pod.key == pod_key:
                 removed.append(q)
         self._backoff = [q for q in self._backoff if q.pod.key != pod_key]
+        for _ in removed:
+            self._dec(pod_key)
         return removed
 
     def contains(self, pod_key: str) -> bool:
-        return any(q.pod.key == pod_key for q in self._active_infos()) or any(
-            q.pod.key == pod_key for q in self._backoff)
+        return pod_key in self._key_counts
 
     def next_ready_at(self) -> float | None:
         """Earliest not_before among parked pods (None if active non-empty)."""
