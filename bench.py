@@ -313,7 +313,10 @@ def run_serve_scale(n_nodes: int = 200, n_pods: int = 1000):
                     name = b[seen_binds].get("metadata", {}).get("name", "")
                     bind_t.setdefault(name, now)
                     seen_binds += 1
-                pending_ingest = {k for k in add_t if k not in ingest_t}
+                # list(dict) is GIL-atomic; iterating add_t directly would
+                # race the main thread's inserts mid-comprehension
+                pending_ingest = {k for k in list(add_t)
+                                  if k not in ingest_t}
                 if pending_ingest:
                     known = cluster.known_pod_keys()
                     for k in pending_ingest:

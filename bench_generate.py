@@ -1,0 +1,274 @@
+"""Inference bench on the real TPU chip: Llama prefill latency + KV-cache
+decode throughput (BASELINE #4's serving scenario — bench_mfu.py covers
+training, this covers generation: models/generate.py).
+
+Measures, for the same ~950M Llama shape bench_mfu.py trains, at
+B in {1, 8} with a 2048-token prompt and 512 generated tokens:
+
+- prefill wall ms (prompt -> seeded KV cache, one full forward)
+- steady-state decode tokens/s/chip (one jitted lax.scan over 512
+  KV-cache decode steps)
+- the same pair under Mistral-style sliding-window attention
+  (window=1024): the cache stays full-size, but attention reads mask to
+  the window
+
+vs_baseline: cached decode against NO-KV-cache generation (re-running the
+full prefix forward per token) — the optimization a naive port would
+ship without; the reference publishes no numbers of its own (BASELINE.md).
+
+Sanity guard: decode at small batch is weights-bandwidth-bound; a sample
+whose implied HBM read rate (param bytes x steps/s) exceeds the chip's
+spec bandwidth is re-measured and then nulled, never committed
+(bench_mfu.py's above-peak rule, bandwidth edition).
+
+Run WITHOUT JAX_PLATFORMS=cpu for real numbers; on a CPU host it falls
+back to a tiny shape so the harness completes. Output: ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from bench_util import (
+    honor_cpu_platform,
+    make_budget,
+    make_progress,
+    make_sync,
+    probe_devices,
+    start_watchdog,
+)
+
+_progress = make_progress("bench_generate")
+BUDGET_S, _remaining = make_budget("BENCH_GEN_BUDGET_S", 480)
+
+_progress("importing jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+honor_cpu_platform(jax)
+_sync = make_sync(jax, jnp)
+_progress("jax imported")
+
+# spec-sheet HBM bandwidth per chip, GB/s (the decode sanity ceiling):
+# cloud.google.com/tpu/docs/system-architecture-tpu-vm
+HBM_GBPS = {
+    "v6": 1640.0,       # v6e (Trillium)
+    "v5p": 2765.0,
+    "v5 lite": 819.0,   # v5e
+    "v5e": 819.0,
+    "v4": 1228.0,
+    "v3": 900.0,
+    "v2": 700.0,
+}
+
+
+def hbm_gbps(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, bw in HBM_GBPS.items():
+        if sub in kind:
+            return bw
+    return None
+
+
+def _median_time(fn, reps: int = 3) -> float:
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn())
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _serving_config(on_tpu: bool):
+    from yoda_scheduler_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        # the shape bench_mfu.py trains (so the two artifacts describe one
+        # model), ~950M params
+        return LlamaConfig(vocab_size=32000, dim=2048, n_layers=16,
+                           n_heads=16, n_kv_heads=16, ffn_dim=5632,
+                           max_seq_len=4096)
+    return LlamaConfig.tiny()
+
+
+def _bench_one(params, config, batch: int, prompt_len: int, new_tokens: int,
+               window: int | None, bw_peak_gbps: float | None,
+               param_bytes: int) -> dict:
+    """One (batch, window) cell: prefill ms + steady-state decode tok/s."""
+    from dataclasses import replace
+
+    from yoda_scheduler_tpu.models.generate import (
+        KVCache, decode_step, prefill)
+
+    cfg = replace(config, sliding_window=window)
+    max_len = prompt_len + new_tokens
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab_size, jnp.int32)
+
+    prefill_j = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))
+    cache0 = KVCache.zeros(cfg, batch, max_len)
+    logits, cache = prefill_j(params, prompt, cache0)  # compile
+    _sync(logits)
+    _progress(f"B={batch} window={window}: prefill compiled")
+    t_prefill = _median_time(lambda: prefill_j(params, prompt, cache0)[0])
+
+    # steady state from the seeded cache; scan length must be static, so
+    # it is closed over rather than passed
+    n = new_tokens
+
+    @jax.jit
+    def decode_n(logits, cache):
+        def step(carry, _):
+            logits, cache = carry
+            tok = jnp.argmax(logits, axis=-1)
+            logits, cache = decode_step(params, tok, cache, cfg)
+            return (logits, cache), ()
+
+        (logits, cache), _ = jax.lax.scan(step, (logits, cache), None,
+                                          length=n)
+        return logits, cache
+
+    out = decode_n(logits, cache)  # compile
+    _sync(out[0])
+    _progress(f"B={batch} window={window}: decode compiled; timing")
+    t_decode = _median_time(lambda: decode_n(logits, cache))
+    tok_s = batch * n / t_decode
+
+    # bandwidth sanity: each decode step must stream the weights once
+    # (batch amortises, so the ceiling only binds meaningfully at B=1,
+    # where weight reads dominate)
+    implied_gbps = (param_bytes * (n / t_decode)) / 1e9
+    suspect = (bw_peak_gbps is not None and batch == 1
+               and implied_gbps > 1.2 * bw_peak_gbps)
+    if suspect:
+        _progress(f"B={batch}: {tok_s:.0f} tok/s implies "
+                  f"{implied_gbps:.0f} GB/s > spec {bw_peak_gbps:.0f}; "
+                  "re-measuring")
+        t_decode = _median_time(lambda: decode_n(logits, cache))
+        tok_s = batch * n / t_decode
+        implied_gbps = (param_bytes * (n / t_decode)) / 1e9
+        if implied_gbps > 1.2 * bw_peak_gbps:
+            return {"batch": batch, "window": window,
+                    "prefill_ms": round(t_prefill * 1e3, 1),
+                    "decode_tokens_per_sec": None,
+                    "error": "implied HBM rate above spec; sample nulled"}
+    return {
+        "batch": batch,
+        "window": window,
+        "prompt_len": prompt_len,
+        "new_tokens": n,
+        "prefill_ms": round(t_prefill * 1e3, 1),
+        "decode_step_ms": round(t_decode * 1e3 / n, 3),
+        "decode_tokens_per_sec": round(tok_s, 1),
+        "implied_weights_gbps_lower_bound": round(
+            param_bytes * (n / t_decode) / 1e9, 1),
+    }
+
+
+def _no_cache_baseline(params, config, batch: int, prompt_len: int) -> dict:
+    """Tokens/s of generation WITHOUT a KV cache: the full prefix forward
+    re-runs per token (what a naive port ships). Timed as the slope
+    between generating 2 and 4 tokens so the one-off prompt forward
+    cancels."""
+    from yoda_scheduler_tpu.models.llama import llama_forward
+
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (batch, prompt_len),
+                                0, config.vocab_size, jnp.int32)
+
+    def gen_n(n):
+        @jax.jit
+        def run(prompt):
+            def step(toks, _):
+                logits = llama_forward(params, toks, config)
+                nxt = jnp.argmax(logits[:, -1], axis=-1)
+                return jnp.concatenate(
+                    [toks[:, 1:], nxt[:, None]], axis=1), ()
+
+            toks, _ = jax.lax.scan(step, prompt, None, length=n)
+            return toks
+
+        return run
+
+    r2, r4 = gen_n(2), gen_n(4)
+    _sync(r2(prompt))  # compile
+    _sync(r4(prompt))
+    t2 = _median_time(lambda: r2(prompt))
+    t4 = _median_time(lambda: r4(prompt))
+    per_tok = max(t4 - t2, 1e-9) / 2
+    return {"batch": batch, "prompt_len": prompt_len,
+            "tokens_per_sec": round(batch / per_tok, 2),
+            "per_token_ms": round(per_tok * 1e3, 1)}
+
+
+def main() -> None:
+    watchdog = start_watchdog("llama_decode_tokens_per_sec", "tok/s",
+                              BUDGET_S)
+    devices = probe_devices(jax, "llama_decode_tokens_per_sec", "tok/s",
+                            _progress)
+    on_tpu = devices[0].platform == "tpu"
+    _progress(f"backend={jax.default_backend()} on_tpu={on_tpu}")
+
+    from yoda_scheduler_tpu.models.llama import init_llama
+
+    config = _serving_config(on_tpu)
+    params = init_llama(config, jax.random.PRNGKey(0))
+    param_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree.leaves(params))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    _progress(f"params: {n_params / 1e6:.0f}M ({param_bytes / 1e9:.2f} GB)")
+
+    prompt_len, new_tokens = (2048, 512) if on_tpu else (64, 16)
+    window = 1024 if on_tpu else 32
+    bw = hbm_gbps(devices[0].device_kind) if on_tpu else None
+
+    cells = []
+    for b in (1, 8):
+        for w in (None, window):
+            if cells and _remaining() < 90:
+                cells.append({"batch": b, "window": w,
+                              "skipped": "budget"})
+                continue
+            try:
+                cells.append(_bench_one(params, config, b, prompt_len,
+                                        new_tokens, w, bw, param_bytes))
+            except Exception as e:
+                cells.append({"batch": b, "window": w,
+                              "error": f"{type(e).__name__}: {str(e)[:160]}"})
+
+    baseline = None
+    if _remaining() > 60:
+        try:
+            baseline = _no_cache_baseline(params, config,
+                                          8 if on_tpu else 2, prompt_len)
+        except Exception as e:
+            baseline = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+    headline = next(
+        (c for c in cells
+         if c.get("batch") == 8 and c.get("window") is None
+         and c.get("decode_tokens_per_sec")), None)
+    vs = None
+    if (headline and baseline
+            and isinstance(baseline.get("tokens_per_sec"), (int, float))
+            and baseline["tokens_per_sec"] > 0):
+        vs = round(headline["decode_tokens_per_sec"]
+                   / baseline["tokens_per_sec"], 2)
+    watchdog.cancel()
+    print(json.dumps({
+        "metric": "llama_decode_tokens_per_sec",
+        "value": headline["decode_tokens_per_sec"] if headline else None,
+        "unit": "tok/s",
+        # vs_baseline: KV-cache decode against no-cache generation at the
+        # same batch (the reference publishes nothing — BASELINE.md)
+        "vs_baseline": vs,
+        "backend": jax.default_backend(),
+        "model_params": n_params,
+        "device_kind": devices[0].device_kind,
+        "cells": cells,
+        "no_cache_baseline": baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

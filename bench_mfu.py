@@ -19,71 +19,29 @@ Output: ONE JSON line, same contract as bench.py.
 from __future__ import annotations
 
 import json
-import os
-import sys
-import threading
 import time
 
-_T0 = time.time()
+from bench_util import (
+    honor_cpu_platform,
+    make_budget,
+    make_progress,
+    make_sync,
+    probe_devices,
+    start_watchdog,
+)
 
-
-def _progress(msg: str) -> None:
-    print(f"[bench_mfu] +{time.time() - _T0:.1f}s {msg}", file=sys.stderr,
-          flush=True)
-
-
+_progress = make_progress("bench_mfu")
 # wall-clock budget for the WHOLE bench: candidates stop escalating and
-# attention sequence lengths stop growing once it is spent (the driver
-# gives the bench a bounded slot; a partial artifact beats a timeout)
-BUDGET_S = float(os.environ.get("BENCH_MFU_BUDGET_S", "480"))
-
-
-def _remaining() -> float:
-    return BUDGET_S - (time.time() - _T0)
-
-
-# persistent compilation cache: first run pays XLA compile (~20-40s per
-# shape on TPU), reruns are seconds
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.expanduser("~/.cache/jax_comp_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# attention sequence lengths stop growing once it is spent
+BUDGET_S, _remaining = make_budget("BENCH_MFU_BUDGET_S", 480)
 
 _progress("importing jax")
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-# honor JAX_PLATFORMS=cpu through jax.config: this environment's TPU
-# plugin (sitecustomize) force-selects its platform regardless of the env
-# var, so the documented CPU fallback would otherwise still dial the TPU
-# tunnel — and hang the whole bench when the tunnel is wedged
-if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
+honor_cpu_platform(jax)
+_sync = make_sync(jax, jnp)
 _progress("jax imported")
-
-
-def _probe_devices(timeout_s: float = 90.0):
-    """Enumerate devices under a watchdog: device init over a TPU tunnel
-    has been observed to hang indefinitely — fail fast with a diagnostic
-    instead of eating the whole bench budget (VERDICT r2 weak #2)."""
-    result: list = []
-
-    def go():
-        result.append(jax.devices())
-
-    t = threading.Thread(target=go, daemon=True)
-    _progress("enumerating devices (watchdog %ds)" % int(timeout_s))
-    t.start()
-    t.join(timeout=timeout_s)
-    if not result:
-        print(json.dumps({
-            "metric": "llama_train_mfu", "value": None, "unit": "%",
-            "vs_baseline": None,
-            "error": f"device enumeration hung > {timeout_s}s",
-        }))
-        sys.exit(0)
-    _progress(f"devices: {result[0]}")
-    return result[0]
 
 
 # bf16 peak FLOP/s per chip by device_kind substring (public spec sheets:
@@ -105,15 +63,6 @@ def peak_flops(device_kind: str) -> float | None:
         if sub in kind:
             return peak
     return None
-
-
-def _sync(x) -> None:
-    """Force full device completion. Over the axon tunnel a host->device
-    round trip is ~60ms and block_until_ready has proven unreliable as a
-    fence, so the sync is a device_get of a scalar reduction of the result
-    — the transfer cannot start before the computation finished."""
-    leaf = jax.tree.leaves(x)[0]
-    jax.device_get(jnp.sum(leaf.astype(jnp.float32)))
 
 
 def _time_fn(fn, *args, warmup: int = 2, iters: int = 10) -> float:
@@ -369,26 +318,8 @@ def attention_bench(on_tpu: bool, peak: float | None = None) -> dict:
 
 
 def main() -> None:
-    # hard ceiling: a wedged device tunnel mid-compile would otherwise hang
-    # forever inside XLA where the cooperative budget checks never run —
-    # emit a diagnostic JSON instead of eating the driver's whole slot.
-    # A THREAD timer, not SIGALRM: Python signal handlers only run between
-    # bytecodes on the main thread, so a hang inside a single native XLA
-    # call would defer SIGALRM forever; a daemon thread fires regardless.
-    def _on_deadline():
-        print(json.dumps({
-            "metric": "llama_train_mfu", "value": None, "unit": "%",
-            "vs_baseline": None,
-            "error": f"hard budget exceeded ({BUDGET_S + 120:.0f}s): device "
-                     "hung mid-run",
-        }), flush=True)
-        os._exit(0)
-
-    watchdog = threading.Timer(BUDGET_S + 120, _on_deadline)
-    watchdog.daemon = True
-    watchdog.start()
-
-    devices = _probe_devices()
+    watchdog = start_watchdog("llama_train_mfu", "%", BUDGET_S)
+    devices = probe_devices(jax, "llama_train_mfu", "%", _progress)
     on_tpu = devices[0].platform == "tpu"
     _progress(f"backend={jax.default_backend()} on_tpu={on_tpu} "
               f"budget={BUDGET_S}s")

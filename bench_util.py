@@ -1,0 +1,116 @@
+"""Shared harness for the on-chip workload benches (bench_mfu.py,
+bench_generate.py): progress logging, wall-clock budgets, watchdogged
+device enumeration, and the tunnel-safe completion fence. One copy so a
+fix to the fence or the watchdog applies to every bench.
+
+Import order matters: import this BEFORE jax — it pins the persistent
+compilation cache env vars that must be set pre-import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/jax_comp_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+_T0 = time.time()
+
+
+def make_progress(tag: str):
+    """Stderr progress line with elapsed time, named per bench."""
+
+    def _progress(msg: str) -> None:
+        print(f"[{tag}] +{time.time() - _T0:.1f}s {msg}", file=sys.stderr,
+              flush=True)
+
+    return _progress
+
+
+def make_budget(env_var: str, default_s: float):
+    """(budget_s, remaining_fn): wall-clock budget for the WHOLE bench —
+    candidates stop escalating once it is spent (the driver gives the
+    bench a bounded slot; a partial artifact beats a timeout)."""
+    budget = float(os.environ.get(env_var, str(default_s)))
+
+    def _remaining() -> float:
+        return budget - (time.time() - _T0)
+
+    return budget, _remaining
+
+
+def honor_cpu_platform(jax) -> None:
+    """Honor JAX_PLATFORMS=cpu through jax.config: this environment's TPU
+    plugin (sitecustomize) force-selects its platform regardless of the
+    env var, so the documented CPU fallback would otherwise still dial
+    the TPU tunnel — and hang the whole bench when the tunnel is
+    wedged."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def probe_devices(jax, metric: str, unit: str, progress,
+                  timeout_s: float = 90.0):
+    """Enumerate devices under a watchdog: device init over a TPU tunnel
+    has been observed to hang indefinitely — fail fast with a diagnostic
+    JSON instead of eating the whole bench budget."""
+    result: list = []
+
+    def go():
+        result.append(jax.devices())
+
+    t = threading.Thread(target=go, daemon=True)
+    progress("enumerating devices (watchdog %ds)" % int(timeout_s))
+    t.start()
+    t.join(timeout=timeout_s)
+    if not result:
+        print(json.dumps({
+            "metric": metric, "value": None, "unit": unit,
+            "vs_baseline": None,
+            "error": f"device enumeration hung > {timeout_s}s",
+        }))
+        sys.exit(0)
+    progress(f"devices: {result[0]}")
+    return result[0]
+
+
+def make_sync(jax, jnp):
+    """Full-completion fence. Over the axon tunnel a host->device round
+    trip is ~60ms and block_until_ready has proven unreliable as a fence,
+    so the sync is a device_get of a scalar reduction of the result — the
+    transfer cannot start before the computation finished."""
+
+    def _sync(x) -> None:
+        leaf = jax.tree.leaves(x)[0]
+        jax.device_get(jnp.sum(leaf.astype(jnp.float32)))
+
+    return _sync
+
+
+def start_watchdog(metric: str, unit: str, budget_s: float,
+                   grace_s: float = 120.0):
+    """Hard ceiling: a wedged device tunnel mid-compile hangs inside XLA
+    where cooperative budget checks never run — emit a diagnostic JSON
+    and exit instead of eating the driver's whole slot. A THREAD timer,
+    not SIGALRM: signal handlers only run between bytecodes on the main
+    thread, so a hang inside one native XLA call would defer SIGALRM
+    forever; a daemon thread fires regardless."""
+
+    def _on_deadline():
+        print(json.dumps({
+            "metric": metric, "value": None, "unit": unit,
+            "vs_baseline": None,
+            "error": f"hard budget exceeded ({budget_s + grace_s:.0f}s): "
+                     "device hung mid-run",
+        }), flush=True)
+        os._exit(0)
+
+    watchdog = threading.Timer(budget_s + grace_s, _on_deadline)
+    watchdog.daemon = True
+    watchdog.start()
+    return watchdog
