@@ -239,8 +239,9 @@ def main() -> None:
     baseline = None
     if _remaining() > 60:
         try:
-            baseline = _no_cache_baseline(params, config,
-                                          8 if on_tpu else 2, prompt_len)
+            # batch MUST match the headline cell (B=8) — vs_baseline is a
+            # cache-vs-no-cache comparison, not a batch comparison
+            baseline = _no_cache_baseline(params, config, 8, prompt_len)
         except Exception as e:
             baseline = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 

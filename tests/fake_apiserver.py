@@ -132,7 +132,15 @@ class FakeApiState:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.0"  # close-delimited watch streams
+    # HTTP/1.1 so ordinary JSON responses (which carry Content-Length)
+    # keep the connection alive — a real API server does, and the client
+    # pools connections. Watch streams stay close-delimited: _watch sends
+    # "Connection: close" explicitly (no Content-Length, no chunking)
+    protocol_version = "HTTP/1.1"
+    # NODELAY (socketserver reads this off the HANDLER class): keep-alive
+    # clients make many small exchanges per connection; Nagle + delayed
+    # ACK would stall each one ~40ms on loopback
+    disable_nagle_algorithm = True
     state: FakeApiState = None  # set by make_server
 
     def log_message(self, *args):  # quiet
@@ -148,8 +156,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(raw)
 
     def _body(self) -> dict:
-        n = int(self.headers.get("Content-Length", 0) or 0)
-        return json.loads(self.rfile.read(n)) if n else {}
+        return json.loads(self._raw_body) if self._raw_body else {}
 
     def _injected_fault(self, path: str, method: str) -> int | None:
         with self.state.cond:
@@ -163,6 +170,11 @@ class _Handler(BaseHTTPRequestHandler):
     def _route(self, method: str) -> None:
         s = self.state
         path = self.path
+        # drain the request body EAGERLY: under HTTP/1.1 keep-alive an
+        # unread body (e.g. a fault-injected early response to a PUT)
+        # would be parsed as the next request's start line -> 400
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        self._raw_body = self.rfile.read(n) if n else b""
         with s.cond:
             s.requests.append((method, path))
         fault = self._injected_fault(path, method)
@@ -245,6 +257,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
+        # the stream has no Content-Length: it is delimited by the
+        # connection closing, which HTTP/1.1 must announce
+        self.send_header("Connection", "close")
+        self.close_connection = True
         self.end_headers()
 
         with s.cond:

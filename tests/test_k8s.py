@@ -178,29 +178,90 @@ def test_list_bound_pods_includes_containercreating(client, api):
     assert by_node["n1"][0].assigned_chips() == {(0, 0, 0)}
 
 
-def test_patch_uses_merge_patch_content_type(api):
-    captured = {}
-    def transport(method, path, body, timeout):
-        return api.transport(method, path, body, timeout)
-    c = KubeClient("https://fake", transport=transport)
-    # inspect the real urllib header logic directly
-    import urllib.request
-    orig = urllib.request.urlopen
+def test_patch_uses_merge_patch_content_type():
+    # intercept at the pooled-connection layer the real transport uses
+    import http.client
+
     reqs = []
-    class R:
-        status = 200
-        def read(self): return b"{}"
-        def __enter__(self): return self
-        def __exit__(self, *a): return False
-    def fake_open(req, timeout=None, context=None):
-        reqs.append(req)
-        return R()
-    urllib.request.urlopen = fake_open
+
+    class FakeConn:
+        timeout = None
+
+        def __init__(self):
+            import socket
+
+            # a real connected socket pair so the transport's
+            # connect-time NODELAY setup has something to poke
+            self.sock, self._peer = socket.socketpair()
+
+        def connect(self):
+            pass
+
+        def request(self, method, path, body=None, headers=None):
+            reqs.append((method, path, dict(headers or {})))
+
+        def getresponse(self):
+            class R:
+                status = 200
+                will_close = False
+
+                def read(self):
+                    return b"{}"
+
+            return R()
+
+        def close(self):
+            pass
+
+    real = KubeClient("https://fake")
+    real._tlocal.conn = FakeConn()
+    real.request("PATCH", "/api/v1/namespaces/d/pods/p", {"metadata": {}})
+    real.request("POST", "/api/v1/namespaces/d/pods/p/binding", {"x": 1})
+    assert reqs[0][2]["Content-Type"] == "application/merge-patch+json"
+    assert reqs[1][2]["Content-Type"] == "application/json"
+
+
+def test_keepalive_reconnects_after_server_close():
+    """A pooled keep-alive connection the server half-closed between
+    requests must reconnect silently — without consuming the caller's
+    retry budget or surfacing an error."""
+    import http.server
+    import socketserver
+    import threading
+
+    served = []
+
+    class OneShot(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            served.append(self.path)
+            body = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            # NO "Connection: close" header: the response promises
+            # keep-alive, then the server rudely closes anyway — the
+            # client only discovers the half-closed socket when it REUSES
+            # the pooled connection (RemoteDisconnected), which is the
+            # branch under test. An announced close would make the client
+            # drop the connection eagerly via will_close and never reuse.
+            self.end_headers()
+            self.wfile.write(body)
+            self.close_connection = True
+
+        def log_message(self, *a):
+            pass
+
+    httpd = socketserver.ThreadingTCPServer(("127.0.0.1", 0), OneShot)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
     try:
-        real = KubeClient("https://fake")
-        real.request("PATCH", "/api/v1/namespaces/d/pods/p", {"metadata": {}})
-        real.request("POST", "/api/v1/namespaces/d/pods/p/binding", {"x": 1})
+        c = KubeClient(f"http://127.0.0.1:{httpd.server_address[1]}")
+        # retries=0 proves the reconnect does not burn the retry budget
+        for _ in range(3):
+            assert c.request("GET", "/x", retries=0) == {}
+        assert served == ["/x", "/x", "/x"]
     finally:
-        urllib.request.urlopen = orig
-    assert reqs[0].get_header("Content-type") == "application/merge-patch+json"
-    assert reqs[1].get_header("Content-type") == "application/json"
+        httpd.shutdown()
+        httpd.server_close()

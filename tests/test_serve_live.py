@@ -172,19 +172,21 @@ class TestGangLive:
             server.state.compact("pods")
             server.state.add_pod(gang_pod("w2"))
             server.state.add_pod(gang_pod("w3"))
+            # wait for the bind AND the chip-assignment annotation — they
+            # are separate API calls (binding POST, then PATCH), so
+            # checking nodeName alone races the annotation assert below
             ok = wait_for(lambda: all(
                 (server.state.pod(f"w{i}") or {}).get("spec", {}).get(
-                    "nodeName") for i in range(4)), timeout=20.0)
-            assert ok, "gang never fully bound after the relist"
+                    "nodeName")
+                and "tpu/assigned-chips" in (server.state.pod(f"w{i}")
+                                             or {}).get("metadata", {}).get(
+                    "annotations", {})
+                for i in range(4)), timeout=20.0)
+            assert ok, "gang never fully bound (with chips) after the relist"
             nodes = {(server.state.pod(f"w{i}") or {})["spec"]["nodeName"]
                      for i in range(4)}
             assert nodes == {"s1-host-0", "s1-host-1", "s1-host-2",
                              "s1-host-3"}, nodes
-            # every member carries a chip assignment annotation
-            for i in range(4):
-                ann = server.state.pod(f"w{i}")["metadata"].get(
-                    "annotations", {})
-                assert "tpu/assigned-chips" in ann
         finally:
             stop.set()
             t.join(timeout=5.0)
