@@ -144,7 +144,15 @@ class KubeClient:
             self._tlocal.conn = conn
         conn.timeout = timeout
         if conn.sock is None:
-            conn.connect()
+            try:
+                conn.connect()
+            except BaseException:
+                # a failed TLS handshake leaves conn.sock set to the
+                # PLAIN socket — pooling it would make the next attempt
+                # skip connect() and write the request (Bearer token
+                # included) unencrypted to whatever killed the handshake
+                self._drop_conn()
+                raise
             # persistent small-request traffic: Nagle against delayed
             # ACKs adds ~40-200ms stalls per exchange on a reused
             # connection (fresh connections never lived long enough)
@@ -215,6 +223,19 @@ class KubeClient:
                 raise
             if r.will_close:
                 self._drop_conn()
+            if (r.status in (301, 302, 307, 308)
+                    and method in ("GET", "HEAD")):
+                # rare (an ingress normalising http->https): delegate the
+                # follow to urllib, whose redirect handling the stream
+                # path already relies on — safe methods only; a mutating
+                # verb must surface the 3xx rather than replay silently
+                req = self._mk_request(method, path, body)
+                try:
+                    with urllib.request.urlopen(req, timeout=timeout,
+                                                context=self._ctx) as u:
+                        return u.status, u.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
             return r.status, raw
 
     def _urllib_stream(self, method: str, path: str, timeout: float):
