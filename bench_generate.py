@@ -31,6 +31,7 @@ import json
 import time
 
 from bench_util import (
+    detect_tpu,
     honor_cpu_platform,
     make_budget,
     make_progress,
@@ -206,7 +207,7 @@ def main() -> None:
                               BUDGET_S)
     devices = probe_devices(jax, "llama_decode_tokens_per_sec", "tok/s",
                             _progress)
-    on_tpu = devices[0].platform == "tpu"
+    on_tpu = detect_tpu(devices)
     _progress(f"backend={jax.default_backend()} on_tpu={on_tpu}")
 
     from yoda_scheduler_tpu.models.llama import init_llama

@@ -22,6 +22,7 @@ import json
 import time
 
 from bench_util import (
+    detect_tpu,
     honor_cpu_platform,
     make_budget,
     make_progress,
@@ -320,7 +321,7 @@ def attention_bench(on_tpu: bool, peak: float | None = None) -> dict:
 def main() -> None:
     watchdog = start_watchdog("llama_train_mfu", "%", BUDGET_S)
     devices = probe_devices(jax, "llama_train_mfu", "%", _progress)
-    on_tpu = devices[0].platform == "tpu"
+    on_tpu = detect_tpu(devices)
     _progress(f"backend={jax.default_backend()} on_tpu={on_tpu} "
               f"budget={BUDGET_S}s")
     train = llama_train_bench(on_tpu)

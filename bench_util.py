@@ -79,6 +79,17 @@ def probe_devices(jax, metric: str, unit: str, progress,
     return result[0]
 
 
+def detect_tpu(devices) -> bool:
+    """Is the first device a TPU? The tunnel bridge has surfaced as
+    platform "axon" with TPU device kinds — trust the kind when the
+    platform name is odd. One copy for every bench and the session
+    script."""
+    if not devices:
+        return False
+    return (devices[0].platform == "tpu"
+            or "tpu" in getattr(devices[0], "device_kind", "").lower())
+
+
 def make_sync(jax, jnp):
     """Full-completion fence. Over the axon tunnel a host->device round
     trip is ~60ms and block_until_ready has proven unreliable as a fence,

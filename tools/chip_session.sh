@@ -1,0 +1,69 @@
+#!/bin/bash
+# One-command chip measurement session (VERDICT r3 items 1 + 2).
+#
+# Run when the TPU tunnel is up. Produces/refreshes the committed perf
+# artifacts:
+#
+#   BENCH_MFU.json       train MFU + flash-vs-XLA kernel table
+#                        (hardened bench: median-of-3 loop slope, enforced
+#                        above-peak nulling, 512x512 default blocks, GQA
+#                        grouped-KV, sliding-window rows)
+#   BENCH_GENERATE.json  prefill ms + KV-cache decode tokens/s at B in
+#                        {1,8}, 2048-token prompt, 512 new tokens, with
+#                        and without sliding window (bandwidth-guarded)
+#
+# The script FAILS (non-zero, artifact untouched) when a bench crashes or
+# produces a null/error value — a stale artifact must never masquerade as
+# fresh. After a successful run: update the PERFORMANCE.md tables to cite
+# these artifacts, verify `attention.S2048.fwd_speedup >= 1` (the r3
+# counter-claim this session exists to retire), and commit both JSONs.
+#
+# Optional deeper sweep when time remains (feeds ops/attention.py block
+# defaults): python tools/tune_attention.py --bwd
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== probing TPU =="
+timeout 120 python -c "
+import jax
+from bench_util import detect_tpu
+ds = jax.devices()
+print(ds)
+assert detect_tpu(ds), 'no TPU'
+" || { echo "TPU unreachable - not running the session"; exit 1; }
+
+check() {  # check <file> : non-null value, no error key, tpu backend
+    python - "$1" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d.get("value") is not None, f"null value: {d.get('error')}"
+assert "error" not in d, d["error"]
+print(f"{sys.argv[1]}: value={d['value']} {d.get('unit')} "
+      f"vs_baseline={d.get('vs_baseline')}")
+EOF
+}
+
+echo "== bench_mfu (train MFU + kernels) =="
+python bench_mfu.py > BENCH_MFU.json.tmp
+check BENCH_MFU.json.tmp
+mv BENCH_MFU.json.tmp BENCH_MFU.json
+python - <<'EOF'
+import json
+d = json.load(open("BENCH_MFU.json"))
+for k, v in (d.get("attention") or {}).items():
+    print(" ", k, "fwd_speedup:", v.get("fwd_speedup"),
+          "fwdbwd:", v.get("fwdbwd_speedup"))
+EOF
+
+echo "== bench_generate (prefill + decode) =="
+python bench_generate.py > BENCH_GENERATE.json.tmp
+check BENCH_GENERATE.json.tmp
+mv BENCH_GENERATE.json.tmp BENCH_GENERATE.json
+python - <<'EOF'
+import json
+d = json.load(open("BENCH_GENERATE.json"))
+for c in d.get("cells") or []:
+    print(" ", c)
+EOF
+
+echo "== done: review the numbers, update PERFORMANCE.md, commit both artifacts =="

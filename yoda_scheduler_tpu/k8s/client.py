@@ -223,28 +223,34 @@ class KubeClient:
                 raise
             if r.will_close:
                 self._drop_conn()
-            if (r.status in (301, 302, 307, 308)
-                    and method in ("GET", "HEAD")):
-                # rare (an ingress normalising http->https): delegate the
-                # follow to urllib, whose redirect handling the stream
-                # path already relies on — safe methods only; a mutating
-                # verb must surface the 3xx rather than replay silently
-                req = self._mk_request(method, path, body)
-                try:
-                    with urllib.request.urlopen(req, timeout=timeout,
-                                                context=self._ctx) as u:
-                        return u.status, u.read()
-                except urllib.error.HTTPError as e:
-                    return e.code, e.read()
+            # redirects are REFUSED, never followed: auto-following would
+            # replay the Authorization Bearer token to whatever Location
+            # the server returned (possibly another host, possibly an
+            # https->http downgrade). Kubernetes API endpoints do not
+            # redirect; a 3xx here means a misconfigured ingress and
+            # surfaces as ApiError(status) for the operator to fix. The
+            # stream path refuses identically (_no_redirect_opener).
             return r.status, raw
+
+    def _no_redirect_opener(self):
+        """urllib opener that refuses redirects instead of following them
+        with the Authorization header attached (same policy as the pooled
+        REST transport)."""
+        class _NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None  # urllib then raises HTTPError(3xx)
+
+        handlers: list = [_NoRedirect()]
+        if self._ctx is not None:
+            handlers.append(urllib.request.HTTPSHandler(context=self._ctx))
+        return urllib.request.build_opener(*handlers)
 
     def _urllib_stream(self, method: str, path: str, timeout: float):
         """Yield response lines from a streaming (watch) request. The HTTP
         status is checked before the first yield; non-2xx raises ApiError."""
         req = self._mk_request(method, path, None)
         try:
-            resp = urllib.request.urlopen(req, timeout=timeout,
-                                          context=self._ctx)
+            resp = self._no_redirect_opener().open(req, timeout=timeout)
         except urllib.error.HTTPError as e:
             raise ApiError(method, path, e.code, e.read()) from None
         self._live_streams.add(resp)
