@@ -176,3 +176,75 @@ class TestUnschedulableClassMemo:
         sched.run_one()
         t = self._trace_of_last(sched)
         assert t.filter_verdicts  # scanned, not memoised
+
+
+class TestFeasibleClassMemo:
+    def test_classmates_hit_the_memo_and_still_place_correctly(self):
+        """A burst of identical pods: the first pays the full scan, later
+        classmates repair the cached feasible list (feas_memo_hits_total
+        counts them) and every pod still binds with correct capacity
+        accounting — n2 fills exactly after its chips run out."""
+        cluster, store, sched = mk_sched(chips=2, nodes=("n1", "n2"))
+        pods = [Pod(f"p{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(4)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        # 4 chips total, 4 single-chip pods: both nodes exactly full
+        per_node = {"n1": 0, "n2": 0}
+        for p in pods:
+            per_node[p.node] += 1
+        assert per_node == {"n1": 2, "n2": 2}
+        assert sched.metrics.counters.get("feas_memo_hits_total", 0) >= 2
+
+    def test_repair_drops_a_filled_node(self):
+        """After n1 fills, a repaired feasible list must re-filter the
+        dirty node and stop offering it — the 3rd classmate lands on n2,
+        never 'successfully' on a full n1."""
+        cluster, store, sched = mk_sched(chips=2, nodes=("n1", "n2"))
+        # bias scoring off: fill n1 first via pre-bound pods
+        for i in range(2):
+            cluster.bind(Pod(f"pre{i}", labels={"scv/number": "1"}),
+                         "n1", [(i, 0, 0)])
+        pods = [Pod(f"p{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(2)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND and p.node == "n2"
+                   for p in pods)
+
+    def test_stale_node_leaves_a_repaired_list(self):
+        """Staleness moves with TIME, not with any change log: a node
+        whose sniffer stops publishing must fall out of the cached
+        feasible list even though no version changed."""
+        from yoda_scheduler_tpu.scheduler.core import FakeClock
+
+        store = TelemetryStore()
+        t0 = 1000.0
+        for n in ("n1", "n2"):
+            m = make_tpu_node(n, chips=4)
+            m.heartbeat = t0
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        clock = FakeClock(start=t0)
+        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=60.0),
+                          clock=clock)
+        p1 = Pod("p1", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(p1)
+        sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND
+        # keep n2 fresh, let n1's sniffer die; advance past max_age
+        clock.advance(120.0)
+        m = store.get("n2")
+        m.heartbeat = t0 + 120.0
+        store.put(m)
+        p2 = Pod("p2", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p2.phase == PodPhase.BOUND
+        assert p2.node == "n2", "stale n1 must not be served from the memo"

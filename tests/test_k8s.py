@@ -265,3 +265,35 @@ def test_keepalive_reconnects_after_server_close():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_redirects_are_refused_on_both_paths():
+    """3xx responses must surface as ApiError, NEVER be followed — an
+    auto-follow would replay the Authorization Bearer token to whatever
+    Location the server handed back (another host, or an https->http
+    downgrade). Covers the pooled REST path and the watch stream."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tests")
+    from fake_apiserver import FakeApiServer
+
+    from yoda_scheduler_tpu.k8s.client import ApiError
+
+    with FakeApiServer() as srv:
+        srv.state.add_node("n1")
+        c = KubeClient(srv.url, token="secret-token")
+        # REST path: injected 301 raises (non-retryable), no follow
+        srv.state.fail("/api/v1/nodes", 301, times=1, method="GET")
+        try:
+            c.request("GET", "/api/v1/nodes", retries=0)
+            assert False, "3xx must raise"
+        except ApiError as e:
+            assert e.status == 301
+        # stream path: a 301 on the watch GET raises before any yield
+        srv.state.fail("/api/v1/pods", 301, times=1)
+        try:
+            for _ in c.watch("/api/v1/pods", "0", timeout_s=2.0):
+                pass
+            assert False, "3xx must raise"
+        except ApiError as e:
+            assert e.status == 301

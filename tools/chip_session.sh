@@ -32,12 +32,21 @@ print(ds)
 assert detect_tpu(ds), 'no TPU'
 " || { echo "TPU unreachable - not running the session"; exit 1; }
 
-check() {  # check <file> : non-null value, no error key, tpu backend
-    python - "$1" <<'EOF'
+check() {  # check <file> [cells]: fail on null value / error keys.
+    # With "cells", every per-cell measurement must have succeeded too
+    # (bench_generate promises all four cells; bench_mfu's per-attempt
+    # errors are by-design escalation stops and are NOT failures).
+    python - "$1" "${2:-}" <<'EOF'
 import json, sys
 d = json.load(open(sys.argv[1]))
 assert d.get("value") is not None, f"null value: {d.get('error')}"
 assert "error" not in d, d["error"]
+if sys.argv[2] == "cells":
+    bad = [c for c in d.get("cells", []) if "error" in c]
+    assert not bad, f"failed cells: {bad}"
+    skipped = [c for c in d.get("cells", []) if "skipped" in c]
+    if skipped:
+        print(f"WARNING: budget-skipped cells: {skipped}", file=sys.stderr)
 print(f"{sys.argv[1]}: value={d['value']} {d.get('unit')} "
       f"vs_baseline={d.get('vs_baseline')}")
 EOF
@@ -57,7 +66,7 @@ EOF
 
 echo "== bench_generate (prefill + decode) =="
 python bench_generate.py > BENCH_GENERATE.json.tmp
-check BENCH_GENERATE.json.tmp
+check BENCH_GENERATE.json.tmp cells
 mv BENCH_GENERATE.json.tmp BENCH_GENERATE.json
 python - <<'EOF'
 import json

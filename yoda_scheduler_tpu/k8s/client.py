@@ -77,8 +77,12 @@ class KubeClient:
         self._base_path = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
         # open streaming responses; close_streams() unblocks reflector
         # threads parked in readline() so stop() doesn't wait on a socket
-        # timeout (set add/discard are atomic under the GIL)
+        # timeout (set add/discard are atomic under the GIL). _closing
+        # marks the terminal shutdown so a stream that finishes OPENING
+        # just after close_streams ran is shut down at registration
+        # instead of blocking its reflector until the watch deadline.
         self._live_streams: set = set()
+        self._closing = False
         if transport is not None:
             self._transport = transport
             # injected fakes stream only if they provide the stream side
@@ -254,6 +258,13 @@ class KubeClient:
         except urllib.error.HTTPError as e:
             raise ApiError(method, path, e.code, e.read()) from None
         self._live_streams.add(resp)
+        if self._closing:
+            # shutdown raced this stream's open: close it NOW (nothing
+            # has read from it yet, so no parked reader to unblock), or
+            # the reflector blocks in readline() until the watch deadline
+            self._live_streams.discard(resp)
+            resp.close()
+            return
         try:
             while True:
                 line = resp.readline()
@@ -270,9 +281,16 @@ class KubeClient:
         Linux — shut the socket down first."""
         import socket as _socket
 
+        self._closing = True
+
         for resp in list(self._live_streams):
             try:
-                raw = getattr(getattr(resp, "fp", None), "raw", None)
+                # the response's file object is either a BufferedReader
+                # over a raw SocketIO (fp.raw._sock) or the SocketIO
+                # itself (fp._sock) depending on how the stream was
+                # opened — dig through both shapes
+                fp = getattr(resp, "fp", None)
+                raw = getattr(fp, "raw", fp)
                 sock = getattr(raw, "_sock", None)
                 if sock is not None:
                     sock.shutdown(_socket.SHUT_RDWR)
