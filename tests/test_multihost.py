@@ -1,0 +1,86 @@
+"""Multi-host runtime bring-up (parallel/multihost.py): env contract,
+single-process fallbacks, and process-local batch assembly. True
+multi-process behavior needs real hosts; these pin everything testable
+in one process (the same posture as the virtual-mesh sharding tests)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from yoda_scheduler_tpu.parallel import (
+    build_llama_train_step,
+    gang_process_env,
+    global_batch,
+    initialize_multihost,
+    make_mesh,
+    mesh_shape_for,
+)
+from yoda_scheduler_tpu.models import LlamaConfig
+
+
+class TestEnvContract:
+    def test_explicit_vars_win(self, monkeypatch):
+        monkeypatch.setenv("YODA_COORDINATOR", "gang-svc:1234")
+        monkeypatch.setenv("YODA_NUM_PROCESSES", "4")
+        monkeypatch.setenv("YODA_PROCESS_ID", "2")
+        assert gang_process_env() == ("gang-svc:1234", 4, 2)
+
+    def test_statefulset_ordinal_fallback(self, monkeypatch):
+        monkeypatch.delenv("YODA_COORDINATOR", raising=False)
+        monkeypatch.delenv("YODA_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("YODA_PROCESS_ID", raising=False)
+        monkeypatch.setattr("socket.gethostname", lambda: "llama-w-3")
+        coord, n, pid = gang_process_env()
+        assert coord is None and n == 0 and pid == 3
+
+    def test_plain_hostname_is_process_zero(self, monkeypatch):
+        monkeypatch.delenv("YODA_PROCESS_ID", raising=False)
+        monkeypatch.setattr("socket.gethostname", lambda: "devbox")
+        assert gang_process_env()[2] == 0
+
+
+class TestInitialize:
+    def test_single_process_fallback_on_cpu(self, monkeypatch):
+        for v in ("YODA_COORDINATOR", "YODA_NUM_PROCESSES",
+                  "YODA_PROCESS_ID"):
+            monkeypatch.delenv(v, raising=False)
+        # CPU host, no coordinator: single-process path, no exception
+        assert initialize_multihost() is False
+
+    def test_arguments_override_env(self, monkeypatch):
+        """A bogus coordinator must be ATTEMPTED (proving the args path)
+        — jax.distributed.initialize on an unreachable address raises or
+        times out; we intercept before the network by faking the API."""
+        calls = {}
+
+        def fake_init(coordinator_address=None, num_processes=None,
+                      process_id=None):
+            calls.update(coordinator=coordinator_address,
+                         n=num_processes, pid=process_id)
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        assert initialize_multihost("c:1", 4, 1) is True
+        assert calls == {"coordinator": "c:1", "n": 4, "pid": 1}
+
+
+class TestGlobalBatch:
+    def test_single_process_passthrough_matches_device_put(self):
+        mesh = make_mesh(mesh_shape_for(8, tp=2))
+        cfg = LlamaConfig.tiny()
+        _, step_fn, batch_sh = build_llama_train_step(cfg, mesh)
+        local = jnp.zeros((8, 128), jnp.int32)
+        arr = global_batch(local, batch_sh)
+        assert arr.shape == (8, 128)
+        assert arr.sharding == batch_sh
+
+
+class TestValidation:
+    def test_coordinator_without_num_processes_raises(self, monkeypatch):
+        for v in ("YODA_NUM_PROCESSES", "YODA_PROCESS_ID"):
+            monkeypatch.delenv(v, raising=False)
+        with pytest.raises(ValueError, match="NUM_PROCESSES"):
+            initialize_multihost("c:1")
+
+    def test_process_id_out_of_range_raises(self):
+        with pytest.raises(ValueError, match="outside"):
+            initialize_multihost("c:1", 4, 4)

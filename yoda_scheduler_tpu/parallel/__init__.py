@@ -4,6 +4,7 @@ from .ring import ring_attention, make_ring_attn
 from .ulysses import ulysses_attention, make_ulysses_attn
 from .train import build_llama_train_step
 from .checkpoint import TrainCheckpointer
+from .multihost import gang_process_env, global_batch, initialize_multihost
 from .pipeline import (
     build_pipelined_llama_train_step,
     llama_pipeline_param_specs,
@@ -24,6 +25,9 @@ __all__ = [
     "make_ulysses_attn",
     "build_llama_train_step",
     "TrainCheckpointer",
+    "gang_process_env",
+    "global_batch",
+    "initialize_multihost",
     "build_pipelined_llama_train_step",
     "llama_pipeline_param_specs",
     "llama_pipeline_shardings",

@@ -1,0 +1,122 @@
+"""Multi-host runtime bring-up for gang-scheduled jobs.
+
+The scheduler places a gang's members one per host across a pod slice
+(plugins/gang.py); what runs INSIDE those pods is the same pjit program
+on every host, and JAX only fuses the hosts into one logical device
+cluster after ``jax.distributed.initialize``. The reference leaned on
+NCCL/MPI rendezvous outside its repo (SURVEY §5 "distributed
+communication backend"); here the rendezvous contract is first-party and
+matches what the gang placement publishes:
+
+- On Cloud TPU / GKE TPU node pools, ``jax.distributed.initialize()``
+  self-configures from the TPU metadata — a gang member needs no env at
+  all (the common path).
+- Anywhere else, three env vars carry the gang's shape:
+  ``YODA_COORDINATOR`` (host:port of member 0 — in k8s, the gang's
+  headless-Service DNS name), ``YODA_NUM_PROCESSES`` (= tpu/gang-size),
+  ``YODA_PROCESS_ID`` (the member's index; the telemetry host_index of
+  its node). The k8s-standard fallbacks (a StatefulSet's ordinal in the
+  hostname) are derived when explicit vars are absent.
+
+Data feeding: each host owns only its local devices, so the global [B,S]
+batch must be assembled from per-process shards —
+``global_batch`` wraps ``jax.make_array_from_process_local_data`` with
+the train step's batch sharding so callers never hand-compute which rows
+live where.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+
+
+def gang_process_env() -> tuple[str | None, int, int]:
+    """(coordinator, num_processes, process_id) from the environment.
+
+    Explicit YODA_* vars win; a StatefulSet-style ``name-<ordinal>``
+    hostname supplies the process id when unset. coordinator None means
+    'let jax.distributed self-configure' (Cloud TPU metadata)."""
+    coord = os.environ.get("YODA_COORDINATOR") or None
+    n = int(os.environ.get("YODA_NUM_PROCESSES", "0") or 0)
+    pid_raw = os.environ.get("YODA_PROCESS_ID")
+    if pid_raw is not None and pid_raw != "":
+        pid = int(pid_raw)
+    else:
+        m = re.search(r"-(\d+)$", socket.gethostname())
+        pid = int(m.group(1)) if m else 0
+    return coord, n, pid
+
+
+def initialize_multihost(coordinator: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> bool:
+    """Bring this process into the job's distributed runtime. Returns
+    True when a multi-process runtime was initialized, False for the
+    single-process case (no coordinator configured and not on a
+    self-configuring TPU pod) — callers can run single-host unchanged.
+
+    Safe to call twice (the second call is a no-op), and arguments
+    override the environment for tests and bespoke launchers."""
+    import jax
+
+    env_coord, env_n, env_pid = gang_process_env()
+    coordinator = coordinator if coordinator is not None else env_coord
+    num_processes = num_processes if num_processes is not None else env_n
+    process_id = process_id if process_id is not None else env_pid
+
+    if jax.distributed.is_initialized():  # already up: no-op
+        return jax.process_count() > 1
+
+    if coordinator:
+        # fail HERE with a clear message, not after every gang member
+        # spends the coordinator timeout on an impossible configuration
+        if num_processes < 1:
+            raise ValueError(
+                "YODA_COORDINATOR is set but YODA_NUM_PROCESSES is not "
+                "(or < 1) — a coordinated gang needs its process count")
+        if not 0 <= process_id < num_processes:
+            raise ValueError(
+                f"process_id {process_id} outside [0, {num_processes})")
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return True
+    # Cloud TPU pods self-configure — but the probe must NOT touch the
+    # XLA backend (jax.local_devices() would initialize it, after which
+    # jax.distributed.initialize raises): read the platform markers the
+    # TPU runtime exposes instead
+    if _looks_like_tpu_host():
+        try:
+            jax.distributed.initialize()
+            return jax.process_count() > 1
+        except Exception:
+            pass  # single-chip VMs with no metadata service
+    return False
+
+
+def _looks_like_tpu_host() -> bool:
+    """TPU presence WITHOUT initializing any JAX backend: the runtime's
+    env markers or the accelerator device nodes."""
+    if any(k in os.environ for k in (
+            "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",
+            "TPU_ACCELERATOR_TYPE", "TPU_SKIP_MDS_QUERY")):
+        return True
+    return os.path.exists("/dev/accel0") or os.path.exists("/dev/vfio/0")
+
+
+def global_batch(local_batch, batch_sharding):
+    """Assemble the GLOBAL array from this process's local shard.
+
+    `local_batch` holds only the rows this host feeds (global batch //
+    process_count when the batch axis spans hosts); the returned
+    jax.Array is addressable-shard-correct for `batch_sharding` (whose
+    mesh it carries) and can be passed straight to the jitted train
+    step. Single-process meshes pass through with a plain device_put."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, batch_sharding)
+    return jax.make_array_from_process_local_data(
+        batch_sharding, local_batch)
