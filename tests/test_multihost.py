@@ -32,6 +32,9 @@ class TestEnvContract:
         monkeypatch.setattr("socket.gethostname", lambda: "llama-w-3")
         coord, n, pid = gang_process_env()
         assert coord is None and n == 0 and pid == 3
+        # the worker idiom the example uses: "name-w3" also resolves
+        monkeypatch.setattr("socket.gethostname", lambda: "llama2-7b-w3")
+        assert gang_process_env()[2] == 3
 
     def test_plain_hostname_is_process_zero(self, monkeypatch):
         monkeypatch.delenv("YODA_PROCESS_ID", raising=False)

@@ -44,7 +44,10 @@ def gang_process_env() -> tuple[str | None, int, int]:
     if pid_raw is not None and pid_raw != "":
         pid = int(pid_raw)
     else:
-        m = re.search(r"-(\d+)$", socket.gethostname())
+        # trailing ordinal, with or without a letter prefix: a
+        # StatefulSet's "name-3" and the worker idiom "name-w3" both
+        # resolve; anything else is process 0
+        m = re.search(r"-[a-z]?(\d+)$", socket.gethostname())
         pid = int(m.group(1)) if m else 0
     return coord, n, pid
 
@@ -92,7 +95,12 @@ def initialize_multihost(coordinator: str | None = None,
             jax.distributed.initialize()
             return jax.process_count() > 1
         except Exception:
-            pass  # single-chip VMs with no metadata service
+            # a PROVABLY multi-host slice must not silently downgrade to
+            # single-process (collectives would hang far from the real
+            # cause); only the single-chip-VM / no-metadata case falls
+            # back
+            if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+                raise
     return False
 
 
