@@ -96,34 +96,44 @@ def _serving_config(on_tpu: bool):
 def _bench_one(params, config, batch: int, prompt_len: int, new_tokens: int,
                window: int | None, bw_peak_gbps: float | None,
                param_bytes: int) -> dict:
-    """One (batch, window) cell: prefill ms + steady-state decode tok/s."""
+    """One (batch, window) cell: prefill ms + steady-state decode tok/s.
+    Windowed cells decode against the ROLLING ring cache (O(window) HBM,
+    models/generate.py RollingKVCache) — the capability the window
+    exists for; full-attention cells use the prompt+new-sized cache."""
     from dataclasses import replace
 
     from yoda_scheduler_tpu.models.generate import (
-        KVCache, decode_step, prefill)
+        KVCache, RollingKVCache, decode_step, decode_step_rolling, prefill)
 
     cfg = replace(config, sliding_window=window)
+    rolling = window is not None and window < prompt_len + new_tokens
     max_len = prompt_len + new_tokens
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, cfg.vocab_size, jnp.int32)
 
     prefill_j = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))
-    cache0 = KVCache.zeros(cfg, batch, max_len)
+    # rolling: the prefill cache is prompt-sized and temporary; the ring
+    # it folds into is window-sized
+    cache0 = KVCache.zeros(cfg, batch, prompt_len if rolling else max_len)
     logits, cache = prefill_j(params, prompt, cache0)  # compile
     _sync(logits)
     _progress(f"B={batch} window={window}: prefill compiled")
     t_prefill = _median_time(lambda: prefill_j(params, prompt, cache0)[0])
+    if rolling:
+        cache = jax.jit(RollingKVCache.from_prefill,
+                        static_argnums=1)(cache, window)
 
     # steady state from the seeded cache; scan length must be static, so
     # it is closed over rather than passed
     n = new_tokens
+    step_fn = decode_step_rolling if rolling else decode_step
 
     @jax.jit
     def decode_n(logits, cache):
         def step(carry, _):
             logits, cache = carry
             tok = jnp.argmax(logits, axis=-1)
-            logits, cache = decode_step(params, tok, cache, cfg)
+            logits, cache = step_fn(params, tok, cache, cfg)
             return (logits, cache), ()
 
         (logits, cache), _ = jax.lax.scan(step, (logits, cache), None,

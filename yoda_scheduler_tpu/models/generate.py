@@ -59,11 +59,13 @@ jax.tree_util.register_dataclass(
 
 
 def _cached_attention(q, k_cache, v_cache, q_positions, cache_len,
-                      window: int | None = None):
+                      window: int | None = None, k_positions=None):
     """q [B, Sq, H, D] against cache [B, max_len, kvH, D]; causal against
-    absolute positions, masked beyond cache_len; `window` applies the
-    model's sliding window so inference matches training. Returns
-    [B, Sq, H, D]."""
+    absolute positions; `window` applies the model's sliding window so
+    inference matches training. The linear cache passes `cache_len`
+    (slot i holds position i, masked beyond the valid prefix); the ring
+    cache passes `k_positions` [max_len] (each slot's ABSOLUTE position,
+    -1 = never written). Returns [B, Sq, H, D]."""
     b, sq, h, d = q.shape
     kvh = k_cache.shape[2]
     if kvh != h:  # GQA broadcast at attention time
@@ -73,9 +75,14 @@ def _cached_attention(q, k_cache, v_cache, q_positions, cache_len,
     scale = 1.0 / (d ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) * scale
-    k_pos = jnp.arange(k_cache.shape[1])
-    mask = (k_pos[None, None, None, :] <= q_positions[:, None, :, None]) & (
-        k_pos[None, None, None, :] < cache_len)
+    if k_positions is None:
+        k_pos = jnp.arange(k_cache.shape[1])
+        valid = k_pos[None, None, None, :] < cache_len
+    else:
+        k_pos = k_positions
+        valid = k_pos[None, None, None, :] >= 0
+    mask = (k_pos[None, None, None, :]
+            <= q_positions[:, None, :, None]) & valid
     if window is not None:
         mask = mask & (k_pos[None, None, None, :]
                        > q_positions[:, None, :, None] - window)
@@ -83,6 +90,44 @@ def _cached_attention(q, k_cache, v_cache, q_positions, cache_len,
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
     return o.astype(q.dtype)
+
+
+def _run_layers(params, tokens, positions, k_all, v_all, write_at,
+                config: LlamaConfig, cache_len=None, k_positions=None):
+    """The shared decode/prefill layer walk: project QKV at `positions`,
+    write K/V into each layer's buffer at slot `write_at`, attend against
+    the buffer (linear mask via `cache_len`, ring mask via
+    `k_positions` — exactly one must be given), residual + FFN. Returns
+    (logits [B, S, vocab], new_k, new_v)."""
+    x = params["embed"][tokens]
+
+    def layer_body(carry, inputs):
+        x, = carry
+        layer, k_cache, v_cache = inputs
+        b, s, d = x.shape
+        h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+        xn = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = (xn @ layer["wq"]).reshape(b, s, h, hd)
+        k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
+        v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
+        q = rotary(q, config.rope_theta, positions)
+        k = rotary(k, config.rope_theta, positions)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k, (0, write_at, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v, (0, write_at, 0, 0))
+        o = _cached_attention(q, k_cache, v_cache, positions, cache_len,
+                              window=config.sliding_window,
+                              k_positions=k_positions)
+        x = x + o.reshape(b, s, h * hd) @ layer["wo"]
+        x, _ = _mlp_block(x, layer, config)  # same FFN as training
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer_body, (x,), (params["layers"], k_all, v_all))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_k, new_v
 
 
 def _forward_with_cache(params, tokens, positions, cache: KVCache,
@@ -100,34 +145,10 @@ def _forward_with_cache(params, tokens, positions, cache: KVCache,
             raise ValueError(
                 f"KV cache full: length {int(cache.length)} + "
                 f"{tokens.shape[1]} new > max_len {max_len}")
-    x = params["embed"][tokens]
     new_len = cache.length + tokens.shape[1]
-
-    def layer_body(carry, inputs):
-        x, = carry
-        layer, k_cache, v_cache = inputs
-        b, s, d = x.shape
-        h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
-        xn = rms_norm(x, layer["attn_norm"], config.norm_eps)
-        q = (xn @ layer["wq"]).reshape(b, s, h, hd)
-        k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
-        v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
-        q = rotary(q, config.rope_theta, positions)
-        k = rotary(k, config.rope_theta, positions)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k, (0, cache.length, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v, (0, cache.length, 0, 0))
-        o = _cached_attention(q, k_cache, v_cache, positions, new_len,
-                              window=config.sliding_window)
-        x = x + o.reshape(b, s, h * hd) @ layer["wo"]
-        x, _ = _mlp_block(x, layer, config)  # same FFN as training
-        return (x,), (k_cache, v_cache)
-
-    (x,), (new_k, new_v) = jax.lax.scan(
-        layer_body, (x,), (params["layers"], cache.k, cache.v))
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits, new_k, new_v = _run_layers(
+        params, tokens, positions, cache.k, cache.v, cache.length, config,
+        cache_len=new_len)
     return logits, KVCache(k=new_k, v=new_v, length=new_len)
 
 
@@ -149,14 +170,121 @@ def decode_step(params, token, cache: KVCache, config: LlamaConfig):
     return logits[:, 0], cache
 
 
+# ------------------------------------------------- rolling (ring) KV cache
+@dataclass(frozen=True)
+class RollingKVCache:
+    """Ring-buffer cache for sliding-window models: `window` slots per
+    layer instead of prompt+generated — decode HBM stays O(window) no
+    matter how long the generation runs (the point of a Mistral-style
+    window). `slot_pos[w]` holds the ABSOLUTE position stored in slot w
+    (-1 = never written); position p lives in slot p % window."""
+    k: jax.Array        # [L, B, window, kvH, D]
+    v: jax.Array
+    slot_pos: jax.Array  # [window] int32
+    next_pos: jax.Array  # scalar int32: next absolute position to write
+
+    @classmethod
+    def from_prefill(cls, cache: KVCache, window: int) -> "RollingKVCache":
+        """Fold a freshly-prefilled full cache (length == prompt length)
+        into the ring: only the last `window` positions can ever be
+        attended again under the sliding window."""
+        max_len = cache.k.shape[2]
+        # the last `window` absolute positions ending at length-1 (early
+        # negatives mark not-yet-written slots for short prompts). The
+        # slot index comes from the UNCLIPPED positions: W consecutive
+        # integers are distinct mod W, so every scatter index is unique —
+        # scattering via the clipped gather index would hit slot 0 many
+        # times for short prompts, and XLA's duplicate-index scatter
+        # order is unspecified (a -1 could win over position 0 on TPU)
+        abs_pos = cache.length - window + jnp.arange(window)
+        slot = (abs_pos % window).astype(jnp.int32)
+        gather = jnp.clip(abs_pos, 0, max_len - 1)
+        k = jnp.zeros(cache.k.shape[:2] + (window,) + cache.k.shape[3:],
+                      cache.k.dtype)
+        v = jnp.zeros_like(k)
+        k = k.at[:, :, slot].set(cache.k[:, :, gather])
+        v = v.at[:, :, slot].set(cache.v[:, :, gather])
+        slot_pos = jnp.zeros((window,), jnp.int32).at[slot].set(
+            jnp.where(abs_pos >= 0, abs_pos, -1).astype(jnp.int32))
+        return cls(k=k, v=v, slot_pos=slot_pos,
+                   next_pos=cache.length.astype(jnp.int32))
+
+
+jax.tree_util.register_dataclass(
+    RollingKVCache, data_fields=["k", "v", "slot_pos", "next_pos"],
+    meta_fields=[])
+
+
+def decode_step_rolling(params, token, cache: RollingKVCache,
+                        config: LlamaConfig):
+    """One decode step against the ring: token [B] -> (logits [B, vocab],
+    cache). Requires config.sliding_window == cache window size."""
+    window = cache.k.shape[2]
+    b = token.shape[0]
+    p = cache.next_pos
+    slot = (p % window).astype(jnp.int32)
+    positions = jnp.broadcast_to(p, (b, 1))
+    x = params["embed"][token[:, None]]
+    # every layer writes the same slot: update slot_pos once
+    new_slot_pos = cache.slot_pos.at[slot].set(p)
+
+    def layer_body(carry, inputs):
+        x, = carry
+        layer, k_ring, v_ring = inputs
+        b, s, d = x.shape
+        h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+        xn = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = (xn @ layer["wq"]).reshape(b, s, h, hd)
+        k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
+        v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
+        q = rotary(q, config.rope_theta, positions)
+        k = rotary(k, config.rope_theta, positions)
+        k_ring = jax.lax.dynamic_update_slice(k_ring, k, (0, slot, 0, 0))
+        v_ring = jax.lax.dynamic_update_slice(v_ring, v, (0, slot, 0, 0))
+        # mask by the ring's ABSOLUTE positions: valid slots hold
+        # p-window < pos <= p (never-written slots carry -1)
+        if kvh != h:
+            rep = h // kvh
+            kk = jnp.repeat(k_ring, rep, axis=2)
+            vv = jnp.repeat(v_ring, rep, axis=2)
+        else:
+            kk, vv = k_ring, v_ring
+        scale = 1.0 / (hd ** 0.5)
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        kk.astype(jnp.float32)) * scale
+        sp = new_slot_pos[None, None, None, :]
+        mask = (sp >= 0) & (sp <= p) & (sp > p - window)
+        s_ = jnp.where(mask, s_, -1e30)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s_, axis=-1),
+                       vv.astype(jnp.float32)).astype(x.dtype)
+        x = x + o.reshape(b, s, h * hd) @ layer["wo"]
+        x, _ = _mlp_block(x, layer, config)
+        return (x,), (k_ring, v_ring)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer_body, (x,), (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits[:, 0], RollingKVCache(k=new_k, v=new_v,
+                                        slot_pos=new_slot_pos,
+                                        next_pos=p + 1)
+
+
 def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
              temperature: float = 0.0, key: jax.Array | None = None,
-             max_len: int | None = None):
+             max_len: int | None = None, rolling: bool | None = None):
     """Generate `max_new_tokens` continuations of prompt [B, S].
 
     temperature 0 = greedy argmax; > 0 = categorical sampling (requires
     `key`). Returns [B, max_new_tokens]. Jit-able as a whole: prefill once,
     then one lax.scan over decode steps.
+
+    `rolling` (sliding-window models only): decode against a ring buffer
+    of `sliding_window` slots instead of a prompt+generated-sized cache —
+    identical outputs (the window masks the same positions either way),
+    O(window) decode HBM. Default: auto — rolling whenever the window is
+    smaller than prompt + new tokens. The prompt-sized prefill cache is
+    temporary either way.
     """
     b, s = prompt.shape
     max_len = max_len or (s + max_new_tokens)
@@ -165,8 +293,11 @@ def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
             f"max_len {max_len} < prompt {s} + new {max_new_tokens}")
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires `key`")
-    cache = KVCache.zeros(config, b, max_len)
-    logits, cache = prefill(params, prompt, cache, config)
+    window = config.sliding_window
+    if rolling is None:
+        rolling = window is not None and window < s + max_new_tokens
+    if rolling and window is None:
+        raise ValueError("rolling cache requires config.sliding_window")
     key = key if key is not None else jax.random.PRNGKey(0)
 
     def pick(logits, k):
@@ -174,13 +305,30 @@ def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
             return jax.random.categorical(k, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
+    keys = jax.random.split(key, max_new_tokens)
+    if rolling:
+        pre = KVCache.zeros(config, b, s)  # prompt-sized, then discarded
+        logits, pre = prefill(params, prompt, pre, config)
+        cache = RollingKVCache.from_prefill(pre, window)
+
+        def step_r(carry, k):
+            logits, cache = carry
+            tok = pick(logits, k)
+            logits, cache = decode_step_rolling(params, tok, cache, config)
+            return (logits, cache), tok
+
+        (_, _), tokens = jax.lax.scan(step_r, (logits, cache), keys)
+        return tokens.T
+
+    cache = KVCache.zeros(config, b, max_len)
+    logits, cache = prefill(params, prompt, cache, config)
+
     def step(carry, k):
         logits, cache = carry
         tok = pick(logits, k)
         logits, cache = decode_step(params, tok, cache, config)
         return (logits, cache), tok
 
-    keys = jax.random.split(key, max_new_tokens)
     (_, _), tokens = jax.lax.scan(step, (logits, cache), keys)
     return tokens.T  # [B, max_new_tokens]
 
