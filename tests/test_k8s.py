@@ -8,7 +8,7 @@ import time
 
 import pytest
 
-from yoda_scheduler_tpu.k8s.client import KubeClient, KubeCluster
+from yoda_scheduler_tpu.k8s.client import ApiError, KubeClient, KubeCluster
 from yoda_scheduler_tpu.k8s.leaderelect import LeaderElector
 from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
 from yoda_scheduler_tpu.utils.pod import Pod
@@ -297,3 +297,140 @@ def test_redirects_are_refused_on_both_paths():
             assert False, "3xx must raise"
         except ApiError as e:
             assert e.status == 301
+
+
+def test_namespace_map_absent_not_empty(client):
+    """ADVICE r4 (medium): a denied/missing namespace LIST must leave the
+    namespace source ABSENT (namespace_labels_map() -> None, selectors
+    match nothing), never install an empty 'known' map under which every
+    DoesNotExist/NotIn namespaceSelector matches EVERY namespace."""
+    store = TelemetryStore()
+    cluster = KubeCluster(client, store)
+    # never synced: absent
+    assert cluster.namespace_labels_map() is None
+    # poll-mode resync against the canned fake (404 on /api/v1/namespaces)
+    cluster.resync()
+    assert cluster.namespace_labels_map() is None
+    # the snapshot consumer contract: None namespaces -> namespace_labels
+    # returns None for any ns (conservative), not {}
+    from yoda_scheduler_tpu.scheduler.framework import Snapshot
+    snap = Snapshot({}, namespaces=cluster.namespace_labels_map())
+    assert snap.namespace_labels("default") is None
+
+
+def test_namespace_map_present_when_served():
+    """Once the namespace LIST succeeds the map is real — including {}
+    labels for a labelless namespace — and a later denial flips it back
+    to absent."""
+    served = {"allow": True}
+
+    def transport(method, path, body, timeout):
+        base = path.partition("?")[0]
+        if base == "/version":
+            return 200, b'{"gitVersion": "fake"}'
+        if base == "/api/v1/namespaces":
+            if not served["allow"]:
+                return 403, b"{}"
+            return 200, json.dumps({"items": [
+                {"metadata": {"name": "prod", "labels": {"team": "ml"}}},
+                {"metadata": {"name": "bare"}},
+            ]}).encode()
+        if base in ("/api/v1/pods", "/api/v1/nodes"):
+            return 200, b'{"items": []}'
+        if base.startswith("/apis/metrics.yoda.tpu"):
+            return 200, b'{"items": []}'
+        return 404, b"{}"
+
+    cluster = KubeCluster(KubeClient("https://fake", transport=transport),
+                          TelemetryStore())
+    cluster.resync()
+    m = cluster.namespace_labels_map()
+    assert m == {"prod": {"team": "ml"}, "bare": {}}
+    ver = cluster.nodes_version
+    # RBAC revoked: the source goes absent again (and verdicts invalidate)
+    served["allow"] = False
+    cluster.resync()
+    assert cluster.namespace_labels_map() is None
+    assert cluster.nodes_version > ver
+
+
+def test_reflector_absent_skips_replace():
+    """Watch-mode: the optional namespaces Reflector must NOT install an
+    empty map on 403/404 — it reports absence via on_absent and leaves
+    the cache untouched."""
+    from yoda_scheduler_tpu.k8s.client import ApiError, Reflector
+
+    calls = {"replace": 0, "absent": []}
+
+    class DenyingClient:
+        def list_all(self, path, **kw):
+            raise ApiError("GET", path, 403)
+
+    r = Reflector(DenyingClient(), "/api/v1/namespaces",
+                  lambda items: calls.__setitem__(
+                      "replace", calls["replace"] + 1),
+                  lambda t, o: None, optional=True,
+                  on_absent=lambda a: calls["absent"].append(a))
+    assert r.list_once() is None
+    assert r.absent and calls["replace"] == 0 and calls["absent"] == [True]
+    # repeat denial: no duplicate transition callback
+    assert r.list_once() is None
+    assert calls["absent"] == [True]
+
+
+def test_nonidempotent_post_not_silently_replayed():
+    """ADVICE r4: an ambiguous connection failure (RemoteDisconnected
+    after the request was written) must NOT silently replay a POST — the
+    server may have fully processed the mutation (a bind), and a replay
+    surfaces as a spurious 409. GETs keep the silent reconnect (covered
+    by test_keepalive_reconnects_after_server_close)."""
+    import http.server
+    import socketserver
+    import threading
+
+    served = []
+
+    class FlakyPost(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):  # warm the pooled connection
+            served.append(("GET", self.path))
+            body = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            served.append(("POST", self.path))
+            # read the body, then drop the connection with no response:
+            # the ambiguous case — the mutation may have been applied
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.connection.close()
+            self.close_connection = True
+
+        def log_message(self, *a):
+            pass
+
+    httpd = socketserver.ThreadingTCPServer(("127.0.0.1", 0), FlakyPost)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = KubeClient(f"http://127.0.0.1:{httpd.server_address[1]}")
+        assert c.request("GET", "/warm", retries=0) == {}
+        try:
+            # DEFAULT retry budget: the ambiguity guard must hold at the
+            # request() layer too, not only when the caller disables
+            # retries (the budget must never be spent replaying a
+            # possibly-applied mutation)
+            c.request("POST", "/api/v1/namespaces/d/pods/p/binding",
+                      body={"x": 1})
+            assert False, "ambiguous POST failure must raise"
+        except ApiError as e:
+            assert e.status == 0  # transport-level, surfaced not replayed
+        # exactly ONE POST reached the server: no silent replay
+        assert [s for s in served if s[0] == "POST"] == [
+            ("POST", "/api/v1/namespaces/d/pods/p/binding")]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -58,6 +58,15 @@ class ApiError(RuntimeError):
         super().__init__(f"{method} {path} -> {status}: {body[:200]!r}")
 
 
+class AmbiguousRequestError(ConnectionError):
+    """A NON-IDEMPOTENT request (POST/PUT/DELETE) failed after it may
+    already have been written to the server — the mutation may or may not
+    have been applied. Never retried by request(): a replayed bind or
+    lease POST whose first copy succeeded surfaces as a spurious 409
+    (ADVICE r4). Callers see ApiError(status=0) and own the recovery
+    (bind's 409 protocol; the watch cache self-heals the state)."""
+
+
 class WatchExpired(Exception):
     """The watch resourceVersion was compacted away (410 Gone): the caller
     must re-list and start a fresh watch."""
@@ -203,7 +212,15 @@ class KubeClient:
         # one silent reconnect: a pooled connection the server idled out
         # half-closes between requests (plain FIN or a TLS close_notify),
         # which is not a request failure and must not consume the
-        # caller's retry budget
+        # caller's retry budget. Only IDEMPOTENT requests (GET/HEAD, and
+        # merge-PATCH whose replay converges) may be replayed on an
+        # ambiguous failure — a RemoteDisconnected after a POST (bind,
+        # eviction) can arrive AFTER the server fully processed the
+        # mutation, and replaying it would surface a spurious 409 and a
+        # wrong failed cycle (ADVICE r4). Non-idempotent methods retry
+        # only on CannotSendRequest, which provably fires before the
+        # request was written.
+        idempotent = method in ("GET", "HEAD", "PATCH")
         for attempt in (0, 1):
             conn = self._pooled_conn(timeout)
             target = (self.base_url + path
@@ -219,9 +236,13 @@ class KubeClient:
                     _ssl.SSLError,
                     ConnectionResetError, BrokenPipeError) as e:
                 self._drop_conn()
-                if attempt:
-                    raise ConnectionError(str(e)) from e
-                continue
+                if idempotent or isinstance(e, http.client.CannotSendRequest):
+                    if attempt:
+                        raise ConnectionError(str(e)) from e
+                    continue
+                # non-idempotent + possibly-written: typed so request()
+                # never burns its retry budget replaying the mutation
+                raise AmbiguousRequestError(str(e)) from e
             except Exception:
                 self._drop_conn()  # unknown state: never reuse
                 raise
@@ -305,15 +326,22 @@ class KubeClient:
                 timeout: float = 10.0, retries: int | None = None) -> dict:
         """One API call with bounded retry/backoff on transient failures
         (connection errors, 429, 5xx). Non-retryable statuses raise
-        ApiError immediately. Mutating verbs are retried too — Kubernetes
-        writes are level-based (bind/PUT conflicts surface as 409, which is
-        NOT retried here; see `bind` for the 409 recovery protocol)."""
+        ApiError immediately. Mutating verbs are retried on failures that
+        provably preceded the write (connection refused, timeout before
+        send) — but an AMBIGUOUS failure on a non-idempotent verb (the
+        connection died after the request may have reached the server) is
+        never replayed: the mutation may have been applied, and a replay
+        surfaces as a spurious 409 (bind/PUT conflicts surface as 409,
+        which is NOT retried here either; see `bind` for the 409
+        recovery protocol)."""
         retries = self.max_retries if retries is None else retries
         backoff = self.retry_backoff_s
         attempt = 0
         while True:
             try:
                 status, raw = self._transport(method, path, body, timeout)
+            except AmbiguousRequestError as e:
+                raise ApiError(method, path, 0, str(e).encode()) from e
             except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
                 if attempt >= retries:
                     raise ApiError(method, path, 0, str(e).encode()) from e
@@ -553,11 +581,16 @@ class Reflector:
     def __init__(self, client: KubeClient, path: str, on_replace, on_event,
                  relist_s: float = 300.0, watch_timeout_s: float = 60.0,
                  backoff_s: float = 0.5, max_backoff_s: float = 15.0,
-                 optional: bool = False) -> None:
+                 optional: bool = False, on_absent=None) -> None:
         self.client = client
         self.path = path
         self.on_replace = on_replace
         self.on_event = on_event
+        # on_absent(bool): notified when an optional resource transitions
+        # between served and denied/missing, so the cache owner can expose
+        # "absent" (unknown) rather than "empty" (known) — the two have
+        # opposite semantics for negative selectors (DoesNotExist/NotIn)
+        self.on_absent = on_absent
         self.relist_s = relist_s
         self.watch_timeout_s = watch_timeout_s
         self.backoff_s = backoff_s
@@ -571,16 +604,25 @@ class Reflector:
         self.absent = False
 
     def list_once(self) -> str | None:
-        self.absent = False
         try:
             doc = self.client.list_all(self.path)
         except ApiError as e:
             if self.optional and e.status in (403, 404):
-                self.on_replace([])
+                # denied/missing optional resource: do NOT install an empty
+                # map — "no data" must stay distinguishable from "zero
+                # objects" (ADVICE r4: an empty namespace map makes every
+                # DoesNotExist selector match every namespace)
                 self.last_list_at = time.monotonic()
-                self.absent = True
+                if not self.absent:
+                    self.absent = True
+                    if self.on_absent is not None:
+                        self.on_absent(True)
                 return None
             raise
+        if self.absent:
+            self.absent = False
+            if self.on_absent is not None:
+                self.on_absent(False)
         self.on_replace(doc.get("items", []))
         self.last_list_at = time.monotonic()
         return _rv_of(doc)
@@ -670,6 +712,13 @@ class KubeCluster:
         self._node_meta: dict[str, tuple[dict, tuple]] = {}  # name -> (labels, taints)
         self._pdbs: tuple = ()                   # DisruptionBudget models
         self._namespaces: dict[str, dict] = {}   # ns -> metadata.labels
+        # namespace source state: until the first successful LIST, and
+        # whenever the LIST is denied (403/404), the namespace map is
+        # ABSENT — namespace_labels_map() returns None so Snapshot
+        # resolves namespaceSelectors conservatively (match nothing),
+        # never "every namespace is known labelless" (ADVICE r4 medium)
+        self._ns_synced = False
+        self._ns_absent = False
         self._pods: dict[str, Pod] = {}          # key -> non-terminal pod
         self._by_node: dict[str, dict[str, Pod]] = {}  # node -> key -> pod
         self._pods_ver: dict[str, int] = {}      # node -> change counter
@@ -696,7 +745,8 @@ class KubeCluster:
                           relist_s=relist_s),
                 Reflector(client, "/api/v1/namespaces",
                           self._replace_namespaces, self._namespace_event,
-                          relist_s=relist_s, optional=True),
+                          relist_s=relist_s, optional=True,
+                          on_absent=self._namespace_absent),
             ]
 
     # ----------------------------------------------------- watch-cache apply
@@ -866,11 +916,18 @@ class KubeCluster:
             for i in items if i.get("metadata", {}).get("name")
         }
         with self._lock:
-            if fresh != self._namespaces:
+            if fresh != self._namespaces or not self._ns_synced:
                 # namespaceSelector verdicts can change anywhere:
                 # invalidate via the membership version (like PDBs)
                 self._nodes_ver += 1
             self._namespaces = fresh
+            self._ns_synced = True
+
+    def _namespace_absent(self, absent: bool) -> None:
+        with self._lock:
+            if self._ns_absent != absent:
+                self._ns_absent = absent
+                self._nodes_ver += 1  # selector verdicts flip cluster-wide
 
     def _namespace_event(self, typ: str, obj: dict) -> None:
         name = obj.get("metadata", {}).get("name")
@@ -885,8 +942,15 @@ class KubeCluster:
                 self._namespaces[name] = labels
                 self._nodes_ver += 1
 
-    def namespace_labels_map(self) -> dict[str, dict]:
+    def namespace_labels_map(self) -> dict[str, dict] | None:
+        """ns -> metadata.labels; None while the namespace LIST is denied
+        or has never synced. None makes Snapshot._namespaces None, so
+        namespaceSelectors match nothing (the documented conservative
+        fallback) instead of treating every namespace as known-labelless,
+        which would invert DoesNotExist/NotIn semantics."""
         with self._lock:
+            if self._ns_absent or not self._ns_synced:
+                return None
             return dict(self._namespaces)
 
     def _replace_metrics(self, items: list[dict]) -> None:
@@ -917,9 +981,17 @@ class KubeCluster:
         self._replace_pdbs(pdb_doc.get("items", []))
         try:
             ns_doc = self.client.list_all("/api/v1/namespaces")
-        except ApiError:
-            ns_doc = {}  # RBAC without namespace list: selectors inert
-        self._replace_namespaces(ns_doc.get("items", []))
+        except ApiError as e:
+            # RBAC without namespace list (403/404): mark the source
+            # absent so selectors resolve conservatively (match nothing)
+            # — never install an empty "known" map. A TRANSIENT error
+            # (429/5xx brownout) keeps the last-good map instead, same
+            # as the watch-mode Reflector.
+            if e.status in (403, 404):
+                self._namespace_absent(True)
+        else:
+            self._namespace_absent(False)
+            self._replace_namespaces(ns_doc.get("items", []))
 
     def start(self) -> None:
         if self.watch_mode:
