@@ -339,7 +339,11 @@ class Scheduler:
         Staleness is the one verdict input that moves with TIME rather
         than with any version counter (a node whose sniffer died changes
         no log), so it is re-verified here for every unchanged node — an
-        O(1) comparison, unlike the full predicate chain.
+        O(1) comparison, unlike the full predicate chain. The re-check
+        applies only when an active filter advertises `time_dependent`
+        (TelemetryFilter does): a profile with no staleness gate
+        (reference emulation) must get repaired lists its own full scan
+        would produce, not ours (ADVICE r4).
 
         Unchanged nodes the original early-exit scan never checked stay
         unchecked — the class keeps scoring the same candidate set until
@@ -350,14 +354,20 @@ class Scheduler:
         if dirty is None:
             return None
         max_age = self.config.telemetry_max_age_s
+        check_stale = any(getattr(p, "time_dependent", False)
+                          for p in filters)
         repaired = []
         for name in names:
             if name in dirty:
                 continue  # re-checked below so ordering is stable-ish
             node = snapshot.get(name)
-            if (node is not None and node.metrics is not None
-                    and not node.metrics.stale(now=now, max_age_s=max_age)):
-                repaired.append(node)
+            if node is None:
+                continue
+            if check_stale and (
+                    node.metrics is None
+                    or node.metrics.stale(now=now, max_age_s=max_age)):
+                continue
+            repaired.append(node)
         for name in sorted(dirty):
             node = snapshot.get(name)
             if node is None:

@@ -31,6 +31,13 @@ from .gang import GangCoordinator, bound_gang_members
 
 class TelemetryFilter(FilterPlugin):
     name = "telemetry-filter"
+    # advertises a verdict input that moves with TIME rather than with any
+    # cluster version counter (telemetry staleness): the feasible-class
+    # memo repair (core._repair_feasible) re-verifies staleness on
+    # unchanged nodes only when an active filter declares this — profiles
+    # without a staleness gate (reference emulation) must not have one
+    # silently imposed on their repaired lists
+    time_dependent = True
 
     def __init__(self, allocator: ChipAllocator, gangs: GangCoordinator | None = None,
                  telemetry_max_age_s: float = 60.0, require_contiguous: bool = False) -> None:
