@@ -218,53 +218,25 @@ jax.tree_util.register_dataclass(
 def decode_step_rolling(params, token, cache: RollingKVCache,
                         config: LlamaConfig):
     """One decode step against the ring: token [B] -> (logits [B, vocab],
-    cache). Requires config.sliding_window == cache window size."""
+    cache). Requires config.sliding_window == cache window size (the
+    shared layer walk masks with config.sliding_window; the ring's wrap
+    arithmetic uses the buffer size — they must agree)."""
     window = cache.k.shape[2]
+    if config.sliding_window != window:
+        raise ValueError(
+            f"rolling cache window {window} != config.sliding_window "
+            f"{config.sliding_window}")
     b = token.shape[0]
     p = cache.next_pos
     slot = (p % window).astype(jnp.int32)
     positions = jnp.broadcast_to(p, (b, 1))
-    x = params["embed"][token[:, None]]
-    # every layer writes the same slot: update slot_pos once
+    # every layer writes the same slot: update slot_pos once. The shared
+    # walk masks by the ring's ABSOLUTE positions (k_positions): valid
+    # slots hold p-window < pos <= p, never-written slots carry -1.
     new_slot_pos = cache.slot_pos.at[slot].set(p)
-
-    def layer_body(carry, inputs):
-        x, = carry
-        layer, k_ring, v_ring = inputs
-        b, s, d = x.shape
-        h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
-        xn = rms_norm(x, layer["attn_norm"], config.norm_eps)
-        q = (xn @ layer["wq"]).reshape(b, s, h, hd)
-        k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
-        v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
-        q = rotary(q, config.rope_theta, positions)
-        k = rotary(k, config.rope_theta, positions)
-        k_ring = jax.lax.dynamic_update_slice(k_ring, k, (0, slot, 0, 0))
-        v_ring = jax.lax.dynamic_update_slice(v_ring, v, (0, slot, 0, 0))
-        # mask by the ring's ABSOLUTE positions: valid slots hold
-        # p-window < pos <= p (never-written slots carry -1)
-        if kvh != h:
-            rep = h // kvh
-            kk = jnp.repeat(k_ring, rep, axis=2)
-            vv = jnp.repeat(v_ring, rep, axis=2)
-        else:
-            kk, vv = k_ring, v_ring
-        scale = 1.0 / (hd ** 0.5)
-        s_ = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        kk.astype(jnp.float32)) * scale
-        sp = new_slot_pos[None, None, None, :]
-        mask = (sp >= 0) & (sp <= p) & (sp > p - window)
-        s_ = jnp.where(mask, s_, -1e30)
-        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s_, axis=-1),
-                       vv.astype(jnp.float32)).astype(x.dtype)
-        x = x + o.reshape(b, s, h * hd) @ layer["wo"]
-        x, _ = _mlp_block(x, layer, config)
-        return (x,), (k_ring, v_ring)
-
-    (x,), (new_k, new_v) = jax.lax.scan(
-        layer_body, (x,), (params["layers"], cache.k, cache.v))
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits, new_k, new_v = _run_layers(
+        params, token[:, None], positions, cache.k, cache.v, slot, config,
+        k_positions=new_slot_pos)
     return logits[:, 0], RollingKVCache(k=new_k, v=new_v,
                                         slot_pos=new_slot_pos,
                                         next_pos=p + 1)
