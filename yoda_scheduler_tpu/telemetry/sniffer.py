@@ -112,11 +112,14 @@ def local_node_metrics(node_name: str | None = None, duty_of=None,
     )
 
 
-def run_daemon(store, node_name: str | None = None, interval_s: float = 5.0, stop_event=None):
+def run_daemon(store, node_name: str | None = None, interval_s: float = 5.0,
+               stop_event=None, devices=None):
     """Publish local metrics into a TelemetryStore on an interval — the
     in-process stand-in for the per-node sniffer DaemonSet. Long-running,
     so it carries a duty-cycle sampler pool (telemetry/duty.py): the
-    utilisation term in scoring works from REAL probes, not fake data."""
+    utilisation term in scoring works from REAL probes, not fake data.
+    `devices` narrows/overrides the probed inventory (same injection as
+    local_node_metrics — tests probe one live device this way)."""
     import threading
 
     from .duty import DutySamplerPool
@@ -126,10 +129,12 @@ def run_daemon(store, node_name: str | None = None, interval_s: float = 5.0, sto
 
     def loop() -> None:
         while not stop.wait(interval_s):
-            store.put(local_node_metrics(node_name, duty_of=pool.duty_of))
-        pool.stop()
+            store.put(local_node_metrics(node_name, duty_of=pool.duty_of,
+                                         devices=devices))
+        pool.stop()  # joins the per-device sampler threads
 
-    store.put(local_node_metrics(node_name, duty_of=pool.duty_of))
+    store.put(local_node_metrics(node_name, duty_of=pool.duty_of,
+                                 devices=devices))
     t = threading.Thread(target=loop, daemon=True)
     t.start()
     return stop
