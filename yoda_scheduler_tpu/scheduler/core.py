@@ -28,7 +28,7 @@ import threading
 import time
 
 from .cluster import FakeCluster
-from .config import SchedulerConfig, adaptive_percentage
+from .config import SchedulerConfig
 from .framework import (
     BindPlugin,
     Code,
@@ -269,20 +269,24 @@ class Scheduler:
         """kube-scheduler's numFeasibleNodesToFind: all nodes below 100; above
         that, percentageOfNodesToScore (adaptive when 0) with a floor of 100.
 
-        The adaptive default additionally caps candidates at 150: upstream's
-        formula still scores 42% of a 1000-node cluster, and past ~150
-        candidates the min-max-normalised ranking is already saturated —
-        measured on the 1000-node bench, the uncapped adaptive default paid
-        2.6x the p50 of an explicit pct=10 for no packing-quality gain
-        (BENCH_r03 extra.scale). An explicit percentage is honoured as
-        given — the cap applies only when the operator left the choice to
-        the scheduler."""
+        The adaptive default additionally caps candidates at 100 (the
+        floor): upstream's formula still scores 42% of a 1000-node
+        cluster, and past ~100 candidates the min-max-normalised ranking
+        is already saturated. Measured on the 1000-node/5000-pod scale
+        bench (round 5): cap=150 p50 6585ms vs cap=100 p50 2270ms with
+        IDENTICAL placement quality (bound 4046 vs 4060, both runs end
+        with zero free chips — capacity-limited, not choice-limited);
+        the earlier 150 cap still paid 1.6x the p50 of an explicit
+        pct=10 in the round-4 driver run (BENCH_r04 scale). An explicit
+        percentage is honoured as given — the cap applies only when the
+        operator left the choice to the scheduler."""
         if num_nodes < 100:
             return num_nodes
         pct = self.config.percentage_of_nodes_to_score
         if not pct:
-            return min(max(num_nodes * adaptive_percentage(num_nodes) // 100,
-                           100), 150)
+            # adaptive_percentage(n) * n / 100 exceeds 100 for every
+            # n >= 100, so the floor and the cap meet at exactly 100
+            return 100
         if pct >= 100:
             return num_nodes
         return max(num_nodes * pct // 100, 100)
