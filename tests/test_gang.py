@@ -130,3 +130,41 @@ class TestGangAdmission:
         sched.run_until_idle(max_cycles=200)
         assert all(w.phase == PodPhase.FAILED for w in workers)
         assert sched.bin_pack_utilization() == 0.0
+
+
+class TestCandidateNarrowing:
+    def test_chosen_slice_narrows_the_scan(self):
+        """Once the first member fixes the slice, later member cycles
+        must only evaluate that slice's hosts (the engine skips the
+        filter chain for everything else) — and still bind correctly."""
+        nodes = (make_v4_slice("sliceA", "2x2x4")
+                 + make_v4_slice("sliceB", "2x2x4")
+                 + [make_tpu_node(f"lone-{i}", chips=4) for i in range(6)])
+        sched, _ = mk_sched(nodes)
+        workers = gang_pods("g", 4)
+        for w in workers:
+            sched.submit(w)
+        sched.run_one()  # first member: reserves and fixes a slice
+        chosen = sched.gang_permit.gangs.chosen_slice("g")
+        assert chosen in ("sliceA", "sliceB")
+        sched.run_one()  # second member: narrowed cycle
+        t = sched.traces.recent(1)[0]
+        scanned = set(t.filter_verdicts)
+        assert scanned, "second member must scan real nodes"
+        assert all(n.startswith(chosen) for n in scanned), scanned
+        sched.run_until_idle(max_cycles=50)
+        assert all(w.phase == PodPhase.BOUND for w in workers)
+        assert all(w.node.startswith(chosen) for w in workers)
+
+    def test_first_member_skips_undersized_slices(self):
+        """With no chosen slice yet, narrowing keeps only gang-sized
+        slices: a 2-host slice never enters a 4-member gang's scan."""
+        nodes = (make_v4_slice("big", "2x2x4")      # 4 hosts
+                 + make_v4_slice("small", "2x2x2"))  # 2 hosts
+        sched, _ = mk_sched(nodes)
+        for w in gang_pods("g", 4):
+            sched.submit(w)
+        sched.run_one()
+        t = sched.traces.recent(1)[0]
+        assert t.filter_verdicts and all(
+            n.startswith("big") for n in t.filter_verdicts), t.filter_verdicts

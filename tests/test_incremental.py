@@ -165,7 +165,11 @@ class TestUnschedulableClassMemo:
 
     def test_gangs_never_take_the_memo_path(self):
         """Gang verdicts depend on coordinator state outside the version
-        vector: every gang cycle must do the real scan."""
+        vector: every gang cycle must evaluate live state, never the
+        unschedulable-class memo. On a cluster with NO slice nodes the
+        gang pre-filter's sound narrowing now fails the cycle itself
+        with an explicit reason (instead of the scan producing per-node
+        'needs a pod-slice node' verdicts)."""
         cluster, store, sched = mk_sched(chips=2, nodes=("n1",),
                                          preemption=False)
         g = {"tpu/gang-name": "g", "tpu/gang-size": "2", "scv/number": "4",
@@ -175,7 +179,10 @@ class TestUnschedulableClassMemo:
         sched.submit(Pod("g-1", labels=dict(g)))
         sched.run_one()
         t = self._trace_of_last(sched)
-        assert t.filter_verdicts  # scanned, not memoised
+        # a real evaluation happened: the narrowing's reason is recorded
+        # (not a memoised verdict, which the memo counter would show)
+        assert "slice narrowing" in (t.reason or "")
+        assert sched.metrics.counters.get("unsched_memo_hits_total", 0) == 0
 
 
 class TestFeasibleClassMemo:

@@ -31,6 +31,7 @@ from .cluster import FakeCluster
 from .config import SchedulerConfig
 from .framework import (
     BindPlugin,
+    CANDIDATE_NODES_KEY,
     Code,
     CycleState,
     FilterPlugin,
@@ -284,8 +285,11 @@ class Scheduler:
             return num_nodes
         pct = self.config.percentage_of_nodes_to_score
         if not pct:
-            # adaptive_percentage(n) * n / 100 exceeds 100 for every
-            # n >= 100, so the floor and the cap meet at exactly 100
+            # min(max(n*adaptive_pct//100, 100), 100) is identically 100
+            # whatever the formula yields — the floor and the cap meet.
+            # (The formula itself is BELOW 100 for n up to ~204 and above
+            # it past that; the constant is the cap + floor, not the
+            # formula saturating.)
             return 100
         if pct >= 100:
             return num_nodes
@@ -658,10 +662,17 @@ class Scheduler:
                 if ni is not None:
                     order.remove(ni)
                     order.insert(0, ni)
+            # sound candidate narrowing from PreFilter (gang slice
+            # membership / chosen slice / plan quotas): nodes outside the
+            # set are provably infeasible under predicates preemption
+            # cannot relax, so the filter chain is skipped for them
+            cand = state.read_or(CANDIDATE_NODES_KEY)
             feasible = []
             checked = 0
             for i in order:
                 node = nodes[i]
+                if cand is not None and node.name not in cand:
+                    continue
                 checked += 1
                 st = Status.success()
                 for p in filters:

@@ -297,6 +297,15 @@ class QueuedPodInfo:
 # --------------------------------------------------------------------------
 # Plugin interfaces. A plugin implements any subset; the profile wires them in.
 # --------------------------------------------------------------------------
+# CycleState key a PreFilter plugin may write: a frozenset of node names
+# that are the ONLY possible feasible nodes for this pod. The engine then
+# skips the filter chain for every other node. Narrowing must be SOUND —
+# a superset of feasibility under predicates no later phase (including
+# preemption) can relax; gang slice membership / chosen-slice / plan
+# quotas qualify because evictions change none of them.
+CANDIDATE_NODES_KEY = "candidate_nodes"
+
+
 class Plugin:
     name: str = "plugin"
 

@@ -17,7 +17,14 @@ from __future__ import annotations
 
 import threading
 
-from ..framework import CycleState, PermitPlugin, PreFilterPlugin, ReservePlugin, Status
+from ..framework import (
+    CANDIDATE_NODES_KEY,
+    CycleState,
+    PermitPlugin,
+    PreFilterPlugin,
+    ReservePlugin,
+    Status,
+)
 from ...utils.labels import GANG_NAME_LABEL, WorkloadSpec, spec_for
 from ...utils.pod import Pod
 
@@ -103,6 +110,18 @@ class GangCoordinator:
             placed = self._placed.get(gang, {})
             return plan.get(slice_id, 0) - placed.get(slice_id, 0)
 
+    def quotas_left(self, gang: str) -> dict[str, int] | None:
+        """All slices' remaining quotas in ONE lock round-trip (the
+        per-node narrowing pass would otherwise take the lock O(nodes)
+        times per cycle); None when the gang has no plan. Slices absent
+        from the dict have no quota (same verdict as quota_left <= 0)."""
+        with self._lock:
+            plan = self._plan.get(gang)
+            if plan is None:
+                return None
+            placed = self._placed.get(gang, {})
+            return {sid: q - placed.get(sid, 0) for sid, q in plan.items()}
+
     def record_placement(self, gang: str, slice_id: str, delta: int = 1) -> None:
         with self._lock:
             if gang in self._plan:
@@ -147,6 +166,22 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin):
         spec: WorkloadSpec = state.read("workload_spec")
         if not spec.is_gang or self.allocator is None:
             return Status.success()
+        st = self._maybe_plan(state, pod, snapshot, spec)
+        if not st.ok:
+            return st
+        cand = self._write_candidates(state, spec, snapshot)
+        if not cand:
+            # no node can possibly host a member: fail HERE with the
+            # narrowing's reason — the engine's scan would otherwise
+            # skip every node and report an empty "no feasible node"
+            return Status.unschedulable(
+                f"gang {spec.gang_name}: no pod-slice node survives "
+                "slice narrowing (membership / chosen slice / plan "
+                f"quotas / {spec.gang_size} gang-sized slices)")
+        return st
+
+    def _maybe_plan(self, state: CycleState, pod: Pod, snapshot,
+                    spec: WorkloadSpec) -> Status:
         if self.gangs.plan_of(spec.gang_name) is not None:
             return Status.success()  # plan already fixed
         if (self.gangs.chosen_slice(spec.gang_name) is not None
@@ -198,6 +233,41 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin):
         self.gangs.set_plan(spec.gang_name, plan,
                             pre_placed=bound_by_slice)
         return Status.success()
+
+    def _write_candidates(self, state: CycleState, spec: WorkloadSpec,
+                          snapshot) -> frozenset:
+        """Narrow the engine's filter scan to the nodes that can possibly
+        host this gang member (framework.CANDIDATE_NODES_KEY). Hoists the
+        cheap, eviction-invariant predicates of TelemetryFilter's gang
+        branch — slice membership, plan quotas, the chosen (or
+        bound-member-pinned) slice, gang-sized slices — so a 4-host
+        placement stops paying a full-cluster filter fan-out per member
+        cycle. Must stay aligned with _filter_checked's gang rejections:
+        every node skipped here would be rejected there."""
+        gang = spec.gang_name
+        quotas = self.gangs.quotas_left(gang)
+        chosen = self.gangs.chosen_slice(gang) if quotas is None else None
+        if quotas is None and chosen is None:
+            # members already bound pin the slice even when the
+            # coordinator's state is gone (restart / peer bind failure)
+            _, chosen, _ = bound_gang_members(state, gang)
+        names = []
+        for ni in snapshot.list():
+            m = ni.metrics
+            if m is None or not m.slice_id:
+                continue
+            if quotas is not None:
+                if quotas.get(m.slice_id, 0) <= 0:
+                    continue
+            elif chosen is not None:
+                if m.slice_id != chosen:
+                    continue
+            elif m.num_hosts < spec.gang_size:
+                continue
+            names.append(ni.name)
+        cand = frozenset(names)
+        state.write(CANDIDATE_NODES_KEY, cand)
+        return cand
 
     # Reserve: the first member fixes the slice choice for the whole gang
     # (single-slice path) or consumes its planned slice's quota.
