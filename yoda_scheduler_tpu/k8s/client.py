@@ -29,6 +29,7 @@ import json
 import logging
 import os
 import ssl
+import sys
 import threading
 import time
 import urllib.error
