@@ -191,6 +191,28 @@ class TestScoringMath:
         expected = 2800 + 300 + (30000 / 32768) * 100 * 2
         assert s == pytest.approx(expected)
 
+    def test_basic_cache_invalidated_by_pending_reservation(self):
+        """The memoised basic term keys on the allocator pending version:
+        a reservation shrinks the unclaimed set, so a repeated identical
+        (spec, mv, serial) score call must NOT replay the pre-reservation
+        value (r5 basic-score memo)."""
+        alloc = ChipAllocator()
+        state = mk_state({"scv/number": "1"})
+        feas = self.feasible_pair()
+        scorer = TelemetryScore(alloc, ScoreWeights())
+        MaxCollection(alloc).pre_score(state, POD, feas)
+        before, _ = scorer.score(state, POD, feas[0])
+        # reserve 2 chips on the node: same NodeInfo serial (no telemetry
+        # or bound-pod change), but the qualifying set shrank
+        from yoda_scheduler_tpu.scheduler.framework import Snapshot
+        r = Pod("r", labels={"scv/number": "2"})
+        rstate = mk_state({"scv/number": "2"})
+        rstate.write("snapshot", Snapshot({f.name: f for f in feas}))
+        st = alloc.reserve(rstate, r, feas[0].name)
+        assert st.ok
+        after, _ = scorer.score(state, POD, feas[0])
+        assert after < before
+
     def test_clock_normalised_by_max_clock_not_bandwidth(self):
         # the reference divided clock by MaxBandwidth (algorithm.go:60);
         # with bandwidth max 100 and clock max 1200 that inflates the clock

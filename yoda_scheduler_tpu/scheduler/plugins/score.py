@@ -42,10 +42,23 @@ class TelemetryScore(ScorePlugin):
         # NodeInfo serial (new serial whenever telemetry or bound pods
         # change) — at 1000 nodes these two terms dominate scoring cost
         self._aa_cache: dict[str, tuple[int, float]] = {}
+        # basic is class- and max-dependent: cache per node keyed by
+        # (serial, pending version, min_free_mb, min_clock_mhz, MaxValue
+        # fields) — exactly the inputs basic_score reads (the same two
+        # spec fields class_stats keys on; chips/priority/gang fields
+        # don't enter the term, so pods differing only there share hits).
+        # Classmate bursts repeat identical keys against unchanged nodes
+        # — a bind dirties ONE node and usually leaves the cluster
+        # maxima untouched, so the other candidates' basic terms are
+        # verbatim repeats (measured: burst p50 30.9 -> 27.2ms).
+        # MaxValue is mutable-by-construction, so the key carries its
+        # field tuple, never the object.
+        self._basic_cache: dict[str, tuple[tuple, float]] = {}
 
     def forget_nodes(self, gone: set[str]) -> None:
         for n in gone:
             self._aa_cache.pop(n, None)
+            self._basic_cache.pop(n, None)
 
     # ------------------------------------------------------------ components
     def basic_score(self, mv: MaxValue, spec: WorkloadSpec, node: NodeInfo,
@@ -114,7 +127,17 @@ class TelemetryScore(ScorePlugin):
         else:
             aa = self.allocate_score(node) + self.actual_score(node)
             self._aa_cache[node.name] = (node.serial, aa)
-        return self.basic_score(mv, spec, node, state) + aa, Status.success()
+        bkey = (node.serial, self.allocator.pending_version(node.name),
+                spec.min_free_mb, spec.min_clock_mhz,
+                mv.bandwidth, mv.clock, mv.core, mv.free_memory,
+                mv.power, mv.total_memory)
+        bhit = self._basic_cache.get(node.name)
+        if bhit is not None and bhit[0] == bkey:
+            basic = bhit[1]
+        else:
+            basic = self.basic_score(mv, spec, node, state)
+            self._basic_cache[node.name] = (bkey, basic)
+        return basic + aa, Status.success()
 
     def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
         min_max_normalize(scores)
