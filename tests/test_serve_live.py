@@ -17,7 +17,7 @@ from yoda_scheduler_tpu.k8s.client import (
     ApiError, KubeClient, KubeCluster, run_scheduler_against_cluster)
 from yoda_scheduler_tpu.k8s.leaderelect import LeaderElector
 from yoda_scheduler_tpu.scheduler import SchedulerConfig
-from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node, make_v4_slice
 from yoda_scheduler_tpu.utils.pod import Pod
 
 from fake_apiserver import FakeApiServer
@@ -393,3 +393,69 @@ class TestConflictsAndRetry:
         paged = [p for m, p in server.state.requests
                  if "limit=3" in p and "/api/v1/pods" in p]
         assert len(paged) == 3  # 3 pages of <=3
+
+
+class TestAsyncBinding:
+    def test_failed_async_bind_rolls_back_and_retries(self, server):
+        """The bind POST runs on a binder worker (upstream's binding
+        cycle). A terminal wire failure must roll the optimistic cache
+        entry back (chips read free again) and requeue the pod, which
+        then binds on a later attempt."""
+        server.state.add_node("n1")
+        server.state.put_metrics(make_tpu_node("n1", chips=4).to_cr())
+        server.state.add_pod(pending_pod_manifest("p1"))
+        # 404 on the binding subresource is NOT retried by the client:
+        # the dispatched bind fails terminally, exercising the rollback
+        server.state.fail("/pods/p1/binding", 404, times=1, method="POST")
+
+        client = KubeClient(server.url)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=run_scheduler_against_cluster,
+            args=(client, [(SchedulerConfig(), None)]),
+            kwargs={"metrics_port": None, "leader_elect": False,
+                    "poll_s": 0.05, "stop_event": stop},
+            daemon=True)
+        t.start()
+        try:
+            assert wait_for(lambda: (server.state.pod("p1") or {}).get(
+                "spec", {}).get("nodeName") == "n1", timeout=15.0), \
+                "p1 never bound after the failed first attempt"
+            # exactly one binding landed (the failed POST bound nothing)
+            assert len(server.state.bindings) == 1
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+    def test_gang_binds_land_with_async_binding_enabled(self, server):
+        """Gang members bind SYNCHRONOUSLY even when async binding is on
+        (the all-or-nothing invariants read _bind's return value); the
+        gang still ends fully bound on its slice with singles' async
+        machinery active in the same process."""
+        for m in make_v4_slice("s", "2x2x4"):
+            server.state.add_node(m.node)
+            server.state.put_metrics(m.to_cr())
+        for i in range(4):
+            p = pending_pod_manifest(f"w{i}", chips="4")
+            p["metadata"]["labels"].update({
+                "tpu/gang-name": "g", "tpu/gang-size": "4"})
+            server.state.add_pod(p)
+        client = KubeClient(server.url)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=run_scheduler_against_cluster,
+            args=(client, [(SchedulerConfig(), None)]),
+            kwargs={"metrics_port": None, "leader_elect": False,
+                    "poll_s": 0.05, "stop_event": stop},
+            daemon=True)
+        t.start()
+        try:
+            assert wait_for(lambda: all(
+                (server.state.pod(f"w{i}") or {}).get("spec", {}).get(
+                    "nodeName") for i in range(4)), timeout=15.0)
+            nodes = {(server.state.pod(f"w{i}") or {})["spec"]["nodeName"]
+                     for i in range(4)}
+            assert len(nodes) == 4  # one member per host
+        finally:
+            stop.set()
+            t.join(timeout=5.0)

@@ -35,6 +35,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from collections import deque
 
 from ..telemetry.schema import CRD_GROUP, CRD_PLURAL, CRD_VERSION, TpuNodeMetrics
 from ..telemetry.store import TelemetryStore
@@ -730,6 +731,11 @@ class KubeCluster:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._reflectors: list[Reflector] = []
+        # async binder state (see bind_async)
+        self._bind_q: deque = deque()
+        self._bind_event = threading.Event()
+        self._bind_threads: list[threading.Thread] | None = None
+        self._bind_inflight = 0
         if self.watch_mode:
             self._reflectors = [
                 Reflector(client, "/api/v1/nodes",
@@ -1033,13 +1039,18 @@ class KubeCluster:
         return False
 
     def stop(self) -> None:
+        # drain in-flight binds before tearing the transport down: a
+        # dispatched bind the server never saw would strand its pod
+        # Pending until its backoff retry or the next scheduler instance
+        self.flush_binds(timeout=5.0)
         self._stop.set()
+        self._bind_event.set()  # wake parked binder workers so they exit
         # unblock reflectors parked in readline() so they observe the stop
         # event now rather than at their socket timeout
         close = getattr(self.client, "close_streams", None)
         if close is not None:
             close()
-        for t in self._threads:
+        for t in self._threads + (self._bind_threads or []):
             t.join(timeout=2.0)
 
     # ---------------------------------------------------- cluster interface
@@ -1078,6 +1089,14 @@ class KubeCluster:
             return [p for p in self._pods.values()
                     if p.node is None and not p.terminating]
 
+    def pod_bound(self, key: str) -> bool:
+        """Live check: does the cache hold `key` with a node assigned?
+        (The serve loop's watch-confirmed-bind cleanup reads this per
+        key instead of a snapshot so it can't race the binder rollback.)"""
+        with self._lock:
+            p = self._pods.get(key)
+            return p is not None and p.node is not None
+
     def known_pod_keys(self) -> set[str]:
         """Every pod key in the cache (any phase) — the serve loop checks
         tracked pods against this to notice external deletions."""
@@ -1102,6 +1121,102 @@ class KubeCluster:
             # write-through so the next cycle sees the bind without waiting
             # for the watch event (which will confirm it)
             self._set_pod(pod.key, pod)
+
+    # --------------------------------------------------------- async binding
+    # Upstream kube-scheduler's model: the scheduling cycle is serial, the
+    # bind RPC runs in its own goroutine — the engine moves to the next pod
+    # while this one's POST is in flight. The cache is updated OPTIMISTICALLY
+    # (the next cycle must see the chips claimed); a terminal wire failure
+    # rolls the entry back (uid-guarded) and reports through on_fail, whose
+    # owner (the engine) requeues the pod — the same recovery path a
+    # post-Permit bind failure takes upstream.
+    _BIND_WORKERS = 4
+
+    def bind_async(self, pod: Pod, node: str, assigned_chips=None,
+                   on_fail=None) -> None:
+        pod.node = node
+        pod.phase = PodPhase.BOUND
+        if assigned_chips:
+            pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(
+                assigned_chips)
+        with self._lock:
+            self._set_pod(pod.key, pod)
+            if self._bind_threads is None:
+                self._bind_threads = []
+                for i in range(self._BIND_WORKERS):
+                    t = threading.Thread(target=self._bind_loop, daemon=True,
+                                         name=f"binder-{i}")
+                    self._bind_threads.append(t)
+                    t.start()
+            self._bind_q.append((pod, node, assigned_chips, on_fail))
+            self._bind_inflight += 1
+        self._bind_event.set()
+
+    def _bind_loop(self) -> None:
+        while True:
+            self._bind_event.wait()
+            while True:
+                with self._lock:
+                    if not self._bind_q:
+                        if not self._stop.is_set():
+                            # leave the event set during shutdown so every
+                            # parked worker wakes and exits
+                            self._bind_event.clear()
+                        break
+                    pod, node, chips, on_fail = self._bind_q.popleft()
+                try:
+                    try:
+                        self.client.bind(pod, node, chips)
+                    except Exception as e:
+                        # roll the optimistic entry back IN PLACE to
+                        # Pending (the cache object is the same one the
+                        # serve loop's intake reads — dropping it would
+                        # hide the pod until the next relist): chips read
+                        # free again, intake sees it again. IDENTITY
+                        # guard: only the exact object bind_async
+                        # installed is reverted — if the watch already
+                        # replaced it (a fresh bound entry = the bind
+                        # actually landed and this failure was the lost
+                        # response; or a new incarnation), the cache is
+                        # authoritative and nothing is rolled back or
+                        # requeued (the serve loop's watch-confirmed
+                        # cleanup releases any stale queue entry).
+                        rolled_back = False
+                        with self._lock:
+                            cur = self._pods.get(pod.key)
+                            if cur is pod and cur.node == node:
+                                self._by_node.get(node, {}).pop(
+                                    pod.key, None)
+                                cur.node = None
+                                cur.phase = PodPhase.PENDING
+                                cur.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+                                self._bump(node)
+                                rolled_back = True
+                        log.warning("async bind %s -> %s failed: %s%s",
+                                    pod.key, node, e,
+                                    "" if rolled_back
+                                    else " (cache superseded; no rollback)")
+                        if rolled_back and on_fail is not None:
+                            try:
+                                on_fail(pod, node, e)
+                            except Exception:
+                                log.exception("bind on_fail handler failed")
+                finally:
+                    with self._lock:
+                        self._bind_inflight -= 1
+            if self._stop.is_set():
+                return
+
+    def flush_binds(self, timeout: float = 10.0) -> bool:
+        """Wait for dispatched binds to reach the server (shutdown,
+        tests). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._bind_inflight == 0:
+                    return True
+            time.sleep(0.005)
+        return False
 
     def evict(self, pod: Pod) -> None:
         self.client.evict(pod)
@@ -1223,6 +1338,18 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
                     # from its stale queued object
                     sched.forget(key)
                     seen.pop(key, None)
+                elif sched.tracks(key) and cluster.pod_bound(key):
+                    # tracked but the cluster already shows it BOUND: an
+                    # ambiguously-failed async bind actually landed (the
+                    # response was lost, the watch confirmed the bind).
+                    # Without this, the requeued entry re-binds into a
+                    # permanent 409 loop. Ordering matters: tracks() is
+                    # read BEFORE the live bound check — a binder-thread
+                    # rollback flips the entry to Pending before it
+                    # requeues, so a pod that reads tracked-then-bound
+                    # here is genuinely bound, never a mid-rollback
+                    # snapshot (a stale pending_keys set would race that).
+                    sched.forget(key)
             for d, interval, last in deschedulers:
                 now = time.time()
                 if now - last[0] >= interval:

@@ -70,6 +70,11 @@ class SchedulerConfig:
     # periodic slice-defragmentation pass (scheduler/deschedule.py);
     # 0 disables. Victim protection + budget use the descheduler defaults.
     deschedule_interval_s: float = 0.0
+    # dispatch the bind POST on a binder worker (upstream kube-scheduler's
+    # binding-cycle goroutine) when the cluster backend supports it
+    # (KubeCluster.bind_async); the in-memory FakeCluster always binds
+    # synchronously. Wire failures roll back and requeue with backoff.
+    async_binding: bool = True
 
     def with_(self, **kw) -> "SchedulerConfig":
         return replace(self, **kw)
@@ -97,6 +102,8 @@ class SchedulerConfig:
             topology_weight=int(args.get("topologyWeight", defaults.topology_weight)),
             deschedule_interval_s=float(args.get(
                 "descheduleIntervalSeconds", defaults.deschedule_interval_s)),
+            async_binding=bool(args.get("asyncBinding",
+                                        defaults.async_binding)),
         )
 
 

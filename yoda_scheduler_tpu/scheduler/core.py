@@ -878,7 +878,14 @@ class Scheduler:
         """Bind through the configured binder. On failure (API outage
         outlasting the client's retry budget, pod deleted, bound elsewhere)
         the reservation is rolled back and the pod requeued with backoff —
-        an escaped exception here used to strand the pod Pending forever."""
+        an escaped exception here used to strand the pod Pending forever.
+
+        Backends exposing bind_async (the real-API KubeCluster) get
+        upstream kube-scheduler's binding-cycle model: the cache is
+        updated optimistically and the POST runs on a binder worker while
+        this engine moves to the next pod; a terminal wire failure rolls
+        the cache back (freeing the chips — allocation accounting follows
+        the cache) and re-enters the pod through _async_bind_failed."""
         pod = info.pod
         entry = self.allocator.assignment_of(pod) if self.allocator is not None else None
         coords = entry[1] if entry is not None else None
@@ -886,10 +893,26 @@ class Scheduler:
             if self.profile.bind is not None:
                 self.profile.bind.bind(CycleState(), pod, node)
             else:
-                # pass coords through: real-API backends publish them as the
-                # chip-assignment annotation so the claim survives a
-                # scheduler restart
-                self.cluster.bind(pod, node, coords)
+                bind_async = getattr(self.cluster, "bind_async", None)
+                # GANG members always bind synchronously: the anchor-fail
+                # _fail_gang rollback, the peers_ok gate, and the slice
+                # entitlement release below all read _bind's return value
+                # — dispatch-time success would neuter the all-or-nothing
+                # invariants (a half-bound gang with its entitlement
+                # released). Singles get the async binding cycle.
+                is_gang_member = (self.gang_permit is not None
+                                  and self.gang_permit.gang_of(pod))
+                if (bind_async is not None and self.config.async_binding
+                        and not is_gang_member):
+                    # pass coords through: real-API backends publish them
+                    # as the chip-assignment annotation so the claim
+                    # survives a scheduler restart
+                    bind_async(
+                        pod, node, coords,
+                        on_fail=lambda p, n, e, _info=info:
+                            self._async_bind_failed(_info, n, e))
+                else:
+                    self.cluster.bind(pod, node, coords)
         except Exception as e:
             if self.allocator is not None:
                 # release the pending reservation; keep any nomination (a
@@ -915,6 +938,29 @@ class Scheduler:
         self.metrics.inc("pods_scheduled_total")
         self._finish(trace, "bound", node=node)
         return True
+
+    def _async_bind_failed(self, info: QueuedPodInfo, node: str,
+                           err: Exception) -> None:
+        """Binder-worker callback: a dispatched bind never reached the
+        server. The cluster already rolled its cache entry back (the
+        chips read free again); re-enter the pod through the normal
+        backoff path. Runs on a binder thread — take the cycle lock so
+        queue/allocator state never races an in-flight cycle."""
+        with self.cycle_lock:
+            pod = info.pod
+            if self.tracks(pod.key):
+                # the serve loop's intake raced us and already resubmitted
+                # the reverted pod: a second queue entry would double-bind
+                return
+            pod.phase = PodPhase.PENDING
+            pod.node = None
+            self.metrics.inc("bind_errors_total")
+            trace = CycleTrace(pod=pod.key, started=self.clock.time())
+            # the dispatch-time success was already counted in
+            # pods_scheduled_total/latency; the error counter plus the
+            # bind-error trace record the correction
+            self._unschedulable(info, trace, f"async bind failed: {err}",
+                                outcome="bind-error")
 
     def _unschedulable(self, info: QueuedPodInfo, trace: CycleTrace, reason: str,
                        outcome: str = "unschedulable") -> str:
