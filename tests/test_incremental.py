@@ -255,3 +255,87 @@ class TestFeasibleClassMemo:
         sched.run_until_idle()
         assert p2.phase == PodPhase.BOUND
         assert p2.node == "n2", "stale n1 must not be served from the memo"
+
+
+class TestScoreClassMemo:
+    """Round-5 score-repair memo: classmate cycles rescore ONLY dirty
+    nodes; slice-usage coupling and maxima changes force rescoring."""
+
+    def _count_scores(self, sched):
+        counts = {"n": 0, "nodes": []}
+        for p in sched.profile.score:
+            orig = p.score
+
+            def counted(state, pod, node, _orig=orig):
+                counts["n"] += 1
+                counts["nodes"].append(node.name)
+                return _orig(state, pod, node)
+
+            p.score = counted
+        return counts
+
+    def test_classmate_rescores_only_the_dirty_node(self):
+        cluster, store, sched = mk_sched(chips=8, nodes=tuple(
+            f"n{i}" for i in range(20)))
+        pods = [Pod(f"p{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(4)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_one()  # first of class: full score, memo seeded
+        counts = self._count_scores(sched)
+        sched.run_one()  # classmate: only the bound node is dirty
+        assert pods[1].phase == PodPhase.BOUND
+        # 2 score plugins x 1 dirty node (p0's bind target) = 2 calls,
+        # versus 2 x 20 for a full scoring pass
+        assert counts["n"] <= 4, (counts["n"], counts["nodes"])
+
+    def test_slice_usage_coupling_rescores_slice_mates(self):
+        """A bind on one slice host dents the SLICE: clean slice-mates'
+        packing term moved, so they must be rescored, while standalone
+        nodes replay."""
+        from yoda_scheduler_tpu.telemetry import make_v4_slice
+
+        store = TelemetryStore()
+        now = time.time()
+        nodes = make_v4_slice("s", "2x2x4") + [
+            make_tpu_node(f"lone{i}", chips=4) for i in range(6)]
+        for m in nodes:
+            m.heartbeat = now + 1e8
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+                          clock=FakeClock(start=now))
+        pods = [Pod(f"p{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_one()
+        if pods[0].node and pods[0].node.startswith("s-"):
+            counts = self._count_scores(sched)
+            sched.run_one()
+            rescored = set(counts["nodes"])
+            # every host of slice s rescored (usage entry moved)
+            assert {n for n in rescored if n.startswith("s-")} == {
+                m.node for m in nodes if m.node.startswith("s-")}, rescored
+        else:
+            # packing sent p0 to a standalone node: that node alone is
+            # dirty; no slice entry moved
+            counts = self._count_scores(sched)
+            sched.run_one()
+            assert set(counts["nodes"]) == {pods[0].node}, counts["nodes"]
+
+    def test_scores_still_rank_correctly_under_memo(self):
+        """End state sanity: a burst over heterogeneous nodes lands the
+        same way with the memo as a fresh engine computes it."""
+        cluster, store, sched = mk_sched(chips=2, nodes=("a", "b", "c"))
+        pods = [Pod(f"p{i}", labels={"scv/number": "2",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        assert {p.node for p in pods} == {"a", "b", "c"}  # one each

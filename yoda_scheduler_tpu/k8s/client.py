@@ -1133,7 +1133,7 @@ class KubeCluster:
     _BIND_WORKERS = 4
 
     def bind_async(self, pod: Pod, node: str, assigned_chips=None,
-                   on_fail=None) -> None:
+                   on_fail=None, on_success=None) -> None:
         pod.node = node
         pod.phase = PodPhase.BOUND
         if assigned_chips:
@@ -1148,7 +1148,8 @@ class KubeCluster:
                                          name=f"binder-{i}")
                     self._bind_threads.append(t)
                     t.start()
-            self._bind_q.append((pod, node, assigned_chips, on_fail))
+            self._bind_q.append((pod, node, assigned_chips, on_fail,
+                                 on_success))
             self._bind_inflight += 1
         self._bind_event.set()
 
@@ -1163,10 +1164,17 @@ class KubeCluster:
                             # parked worker wakes and exits
                             self._bind_event.clear()
                         break
-                    pod, node, chips, on_fail = self._bind_q.popleft()
+                    pod, node, chips, on_fail, on_success = \
+                        self._bind_q.popleft()
                 try:
                     try:
                         self.client.bind(pod, node, chips)
+                        if on_success is not None:
+                            try:
+                                on_success(pod, node)
+                            except Exception:
+                                log.exception(
+                                    "bind on_success handler failed")
                     except Exception as e:
                         # roll the optimistic entry back IN PLACE to
                         # Pending (the cache object is the same one the

@@ -26,6 +26,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 
 from .cluster import FakeCluster
 from .config import SchedulerConfig
@@ -60,6 +61,8 @@ from .plugins import (
     TelemetryScore,
     TopologyScore,
 )
+from .plugins.prescore import MAX_KEY
+from .plugins.topology import SLICE_USE_KEY
 from ..utils.labels import LabelError, spec_for, workload_class
 from ..utils.obs import CycleTrace, Metrics, TraceLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
@@ -241,6 +244,15 @@ class Scheduler:
         # _schedule_one_locked and _repair_feasible for the soundness
         # envelope.
         self._feas_memo: dict = {}
+        # score-CLASS memo: memo_key -> (cluster versions, MaxValue
+        # tuple, slice-usage map, scorer names, {plugin: {node: raw}}).
+        # Classmate cycles rescore only dirty nodes; see the score
+        # section of _schedule_one_locked for the soundness envelope.
+        self._score_memo: dict = {}
+        # failed async-bind recoveries, appended by binder threads and
+        # drained by run_one on the engine thread (the queue is
+        # engine-thread-only; deque.append/popleft are GIL-atomic)
+        self._bind_failures: deque = deque()
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
@@ -806,17 +818,62 @@ class Scheduler:
                 gate = getattr(p, "relevant", None)
             if gate is None or gate(pod, snapshot):
                 scorers.append(p)
+
+        # SCORE-class memo: a classmate's raw per-plugin scores are
+        # verbatim repeats for every node the change logs call clean —
+        # rescore ONLY dirty nodes (the score twin of _repair_feasible).
+        # Sound only for plugins that DECLARE their per-node inputs via
+        # `score_inputs`: "node" (node serial + allocator pending version
+        # + the pod's label class + the cycle maxima) or
+        # "node+slice_usage" (additionally the node's slice-usage entry).
+        # Any other scorer, a maxima change, or a scorer-set change falls
+        # back to full scoring. Normalize/weighted-sum always re-run on
+        # the full raw vector (min-max is a whole-set operation).
+        mvv = state.read_or(MAX_KEY)
+        mv_t = (mvv.bandwidth, mvv.clock, mvv.core, mvv.free_memory,
+                mvv.power, mvv.total_memory) if mvv is not None else None
+        usage = state.read_or(SLICE_USE_KEY) or {}
+        names_set = tuple(p.name for p in scorers)
+        repairable = feas_ok and all(
+            getattr(p, "score_inputs", None) in ("node", "node+slice_usage")
+            for p in scorers)
+        dirty_s = None
+        hit = self._score_memo.get(memo_key) if repairable else None
+        if hit is not None and hit[1] == mv_t and hit[3] == names_set:
+            _, dirty_s = self._changes_since_vers(hit[0])
+        cached_usage = hit[2] if hit is not None else {}
+        raws: dict[str, dict[str, float]] = {}
         for p in scorers:
             raw: dict[str, float] = {}
+            cached = hit[4].get(p.name, {}) if dirty_s is not None else {}
+            slice_coupled = (getattr(p, "score_inputs", None)
+                             == "node+slice_usage")
             for node in feasible:
+                name = node.name
+                if dirty_s is not None and name not in dirty_s:
+                    m = node.metrics
+                    sid = m.slice_id if m is not None else None
+                    if (not (slice_coupled and sid
+                             and usage.get(sid) != cached_usage.get(sid))
+                            and name in cached):
+                        raw[name] = cached[name]
+                        continue
                 s, st = p.score(state, pod, node)
                 if st.code == Code.ERROR:
                     return self._cycle_error(info, trace, st.message)
-                raw[node.name] = s
-            p.normalize(state, pod, raw)
+                raw[name] = s
+            raws[p.name] = raw
+            # normalize mutates: keep the memo's copy raw
+            nraw = dict(raw)
+            p.normalize(state, pod, nraw)
             w = getattr(p, "weight", 1)
-            for name, s in raw.items():
+            for name, s in nraw.items():
                 totals[name] += w * s
+        if repairable and vers is not None:
+            if len(self._score_memo) > 256:
+                self._score_memo.clear()
+            self._score_memo[memo_key] = (vers, mv_t, usage, names_set,
+                                          raws)
         trace.scores = totals
 
         best_score = max(totals.values())
@@ -889,6 +946,7 @@ class Scheduler:
         pod = info.pod
         entry = self.allocator.assignment_of(pod) if self.allocator is not None else None
         coords = entry[1] if entry is not None else None
+        dispatched_async = False
         try:
             if self.profile.bind is not None:
                 self.profile.bind.bind(CycleState(), pod, node)
@@ -906,11 +964,22 @@ class Scheduler:
                         and not is_gang_member):
                     # pass coords through: real-API backends publish them
                     # as the chip-assignment annotation so the claim
-                    # survives a scheduler restart
+                    # survives a scheduler restart. The preemptor's
+                    # NOMINATION is consumed only on wire success (the
+                    # entitlement must survive a transient bind failure,
+                    # same as the sync failure path below); the callbacks
+                    # touch only thread-safe state — queue recovery is
+                    # marshalled back onto the engine thread via
+                    # _bind_failures (the queue itself is engine-thread
+                    # only).
+                    dispatched_async = True
                     bind_async(
                         pod, node, coords,
                         on_fail=lambda p, n, e, _info=info:
-                            self._async_bind_failed(_info, n, e))
+                            self._bind_failures.append((_info, n, e)),
+                        on_success=lambda p, n:
+                            self.allocator.unnominate(p.key)
+                            if self.allocator is not None else None)
                 else:
                     self.cluster.bind(pod, node, coords)
         except Exception as e:
@@ -924,10 +993,14 @@ class Scheduler:
             return False
         if self.allocator is not None:
             self.allocator.complete(pod)  # reservation consumed
-            self.allocator.unnominate(pod.key)  # entitlement consumed
-        if coords is not None:
-            # publish the chip assignment on the pod regardless of binder, so
-            # allocation accounting sees it next cycle
+            if not dispatched_async:
+                # async dispatch defers this to wire success (on_success)
+                self.allocator.unnominate(pod.key)  # entitlement consumed
+        if coords is not None and not dispatched_async:
+            # publish the chip assignment on the pod regardless of binder,
+            # so allocation accounting sees it next cycle (bind_async set
+            # it itself at dispatch — re-setting here would race the
+            # binder rollback's label pop on a fast failure)
             pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(coords)
         e2e_ms = (self.clock.time() - info.enqueued) * 1e3
         self.metrics.observe("schedule_latency_ms", e2e_ms)
@@ -939,21 +1012,26 @@ class Scheduler:
         self._finish(trace, "bound", node=node)
         return True
 
-    def _async_bind_failed(self, info: QueuedPodInfo, node: str,
-                           err: Exception) -> None:
-        """Binder-worker callback: a dispatched bind never reached the
-        server. The cluster already rolled its cache entry back (the
-        chips read free again); re-enter the pod through the normal
-        backoff path. Runs on a binder thread — take the cycle lock so
-        queue/allocator state never races an in-flight cycle."""
-        with self.cycle_lock:
+    def _drain_bind_failures(self) -> None:
+        """Recover pods whose dispatched binds never reached the server.
+        Binder workers only APPEND to the thread-safe _bind_failures
+        deque; the requeue itself runs HERE, on the engine thread (the
+        SchedulingQueue has no internal lock — a binder-thread mutation
+        would race pop()'s backoff flush and could drop the entry)."""
+        while True:
+            try:
+                info, node, err = self._bind_failures.popleft()
+            except IndexError:
+                return
             pod = info.pod
             if self.tracks(pod.key):
-                # the serve loop's intake raced us and already resubmitted
-                # the reverted pod: a second queue entry would double-bind
-                return
+                # the serve loop's intake raced the rollback and already
+                # resubmitted the reverted pod: a second queue entry
+                # would double-bind
+                continue
             pod.phase = PodPhase.PENDING
             pod.node = None
+            pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
             self.metrics.inc("bind_errors_total")
             trace = CycleTrace(pod=pod.key, started=self.clock.time())
             # the dispatch-time success was already counted in
@@ -1081,6 +1159,7 @@ class Scheduler:
         is ready (queue empty, everyone backing off, or parked at Permit) —
         callers decide how to wait (next_wake_at)."""
         self.check_waiting()
+        self._drain_bind_failures()
         info = self.queue.pop(now=self.clock.time())
         if info is None:
             return None

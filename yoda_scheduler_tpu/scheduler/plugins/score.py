@@ -32,6 +32,12 @@ from .prescore import MAX_KEY, SPEC_KEY, MaxValue
 
 class TelemetryScore(ScorePlugin):
     name = "telemetry-score"
+    # score-memo contract (core._schedule_one_locked score section): this
+    # plugin's raw score for a node is a pure function of the node's
+    # serial, the allocator pending version, the pod's label class, and
+    # the cycle's MaxValue — all covered by the engine's dirty-set +
+    # maxima checks, so clean nodes' scores may be replayed verbatim.
+    score_inputs = "node"
 
     def __init__(self, allocator: ChipAllocator, weights: ScoreWeights | None = None,
                  weight: int = 1) -> None:

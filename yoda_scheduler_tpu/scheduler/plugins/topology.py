@@ -27,6 +27,11 @@ SLICE_USE_KEY = "slice_usage"
 
 class TopologyScore(ScorePlugin, PreScorePlugin):
     name = "topology-score"
+    # score-memo contract: a node's raw score additionally depends on its
+    # SLICE's usage entry (the packing term) — the engine rescures a
+    # clean node whenever its slice's usage entry moved (a bind anywhere
+    # on the slice dents it)
+    score_inputs = "node+slice_usage"
 
     def __init__(self, allocator: ChipAllocator, weight: int = 2,
                  contiguity_frac: float = 0.5) -> None:
