@@ -257,22 +257,25 @@ class TestFeasibleClassMemo:
         assert p2.node == "n2", "stale n1 must not be served from the memo"
 
 
+def count_scores(sched):
+    """Wrap every score plugin to count per-node score() calls (memo
+    tests assert how much scoring a cycle actually did)."""
+    counts = {"n": 0, "nodes": []}
+    for p in sched.profile.score:
+        orig = p.score
+
+        def counted(state, pod, node, _orig=orig):
+            counts["n"] += 1
+            counts["nodes"].append(node.name)
+            return _orig(state, pod, node)
+
+        p.score = counted
+    return counts
+
+
 class TestScoreClassMemo:
     """Round-5 score-repair memo: classmate cycles rescore ONLY dirty
     nodes; slice-usage coupling and maxima changes force rescoring."""
-
-    def _count_scores(self, sched):
-        counts = {"n": 0, "nodes": []}
-        for p in sched.profile.score:
-            orig = p.score
-
-            def counted(state, pod, node, _orig=orig):
-                counts["n"] += 1
-                counts["nodes"].append(node.name)
-                return _orig(state, pod, node)
-
-            p.score = counted
-        return counts
 
     def test_classmate_rescores_only_the_dirty_node(self):
         cluster, store, sched = mk_sched(chips=8, nodes=tuple(
@@ -283,7 +286,7 @@ class TestScoreClassMemo:
         for p in pods:
             sched.submit(p)
         sched.run_one()  # first of class: full score, memo seeded
-        counts = self._count_scores(sched)
+        counts = count_scores(sched)
         sched.run_one()  # classmate: only the bound node is dirty
         assert pods[1].phase == PodPhase.BOUND
         # 2 score plugins x 1 dirty node (p0's bind target) = 2 calls,
@@ -314,7 +317,7 @@ class TestScoreClassMemo:
             sched.submit(p)
         sched.run_one()
         if pods[0].node and pods[0].node.startswith("s-"):
-            counts = self._count_scores(sched)
+            counts = count_scores(sched)
             sched.run_one()
             rescored = set(counts["nodes"])
             # every host of slice s rescored (usage entry moved)
@@ -323,7 +326,7 @@ class TestScoreClassMemo:
         else:
             # packing sent p0 to a standalone node: that node alone is
             # dirty; no slice entry moved
-            counts = self._count_scores(sched)
+            counts = count_scores(sched)
             sched.run_one()
             assert set(counts["nodes"]) == {pods[0].node}, counts["nodes"]
 
@@ -339,3 +342,38 @@ class TestScoreClassMemo:
         sched.run_until_idle()
         assert all(p.phase == PodPhase.BOUND for p in pods)
         assert {p.node for p in pods} == {"a", "b", "c"}  # one each
+
+
+class TestScoreMemoMaximaGuard:
+    def test_maxima_change_forces_full_rescore(self):
+        """When the cycle's MaxValue moved (a node carrying a cluster
+        maximum left the feasible set), clean nodes' cached raw scores
+        are scaled against the WRONG maxima — the memo must miss and
+        rescore everything."""
+        store = TelemetryStore()
+        now = time.time()
+        # A carries the max clock; filling A removes it from feasibility
+        # and drops the maxima for the B/C rescore
+        for name, clock in (("a", 2000), ("b", 1000), ("c", 1500)):
+            m = make_tpu_node(name, chips=4)
+            for c in m.chips:
+                c.clock_mhz = clock
+            m.heartbeat = now + 1e8
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+                          clock=FakeClock(start=now))
+        pods = [Pod(f"p{i}", labels={"scv/number": "4",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(2)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_one()
+        assert pods[0].node == "a"  # highest clock wins the basic term
+        counts = count_scores(sched)
+        sched.run_one()
+        assert pods[1].phase == PodPhase.BOUND
+        # a (full) left feasibility -> maxima moved -> BOTH remaining
+        # nodes rescored by BOTH plugins (no replay): 2 x 2 = 4 calls
+        assert counts["n"] == 4, (counts["n"], counts["nodes"])
