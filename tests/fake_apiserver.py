@@ -155,6 +155,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ plumbing
     def _json(self, status: int, doc: dict) -> None:
+        if getattr(self, "_ambiguous", False):
+            return  # fault -1: the mutation applied, the response is lost
         raw = json.dumps(doc).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
@@ -185,14 +187,28 @@ class _Handler(BaseHTTPRequestHandler):
         with s.cond:
             s.requests.append((method, path))
         fault = self._injected_fault(path, method)
-        if fault is not None:
+        if fault is not None and fault != -1:
             return self._json(fault, {"kind": "Status", "code": fault})
         base, _, query = path.partition("?")
         q = urllib.parse.parse_qs(query)
+        if fault == -1:
+            # AMBIGUOUS-failure injection: PROCESS the request fully,
+            # then kill the connection without writing a response — the
+            # client sees RemoteDisconnected after a mutation the server
+            # applied (the lost-response case the bind recovery handles)
+            self._ambiguous = True
         try:
             self._dispatch(method, base, q)
         except BrokenPipeError:
             pass
+        finally:
+            if getattr(self, "_ambiguous", False):
+                self._ambiguous = False
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                self.close_connection = True
 
     do_GET = lambda self: self._route("GET")
     do_POST = lambda self: self._route("POST")
