@@ -373,3 +373,58 @@ class TestDutyCycleScoring:
         assert sched.run_one() == "bound"
         t = sched.traces.recent(1)[0]
         assert t.scores["unmeasured"] == t.scores["measured-idle"]
+
+
+class TestIncrementalMaxCollection:
+    """The maxima fold is repaired from the change logs: clean nodes'
+    contributions replay; a changed/vanished node that MAY have been an
+    argmax forces the full refold (maxima can only shrink that way)."""
+
+    def _changes_fn(self, dirty_holder):
+        # a minimal changes_since_fn contract: (version, dirty set)
+        def cb(cvers):
+            if cvers is None:
+                return (dirty_holder["v"], None)
+            return (dirty_holder["v"], set(dirty_holder["dirty"]))
+        return cb
+
+    def test_replay_and_shrink_guard(self):
+        alloc = ChipAllocator()
+        a = make_tpu_node("a", chips=4, hbm_free_mb=30000)
+        b = make_tpu_node("b", chips=4, hbm_free_mb=10000)
+        fa, fb = node_info(a), node_info(b)
+        mc = MaxCollection(alloc)
+        holder = {"v": (1,), "dirty": set()}
+
+        st1 = mk_state({})
+        st1.write("changes_since_fn", self._changes_fn(holder))
+        st1.write("cycle_versions", holder["v"])
+        mc.pre_score(st1, POD, [fa, fb])
+        assert st1.read("Max").free_memory == 30000
+
+        # clean replay: same mv without touching a's stats
+        holder["v"] = (2,)
+        st2 = mk_state({})
+        st2.write("changes_since_fn", self._changes_fn(holder))
+        st2.write("cycle_versions", holder["v"])
+        mc.pre_score(st2, POD, [fa, fb])
+        assert st2.read("Max").free_memory == 30000
+
+        # the argmax LEAVES the feasible set: the guard must force the
+        # full refold and the max must SHRINK to b's 10000
+        holder["v"] = (3,)
+        holder["dirty"] = {"a"}
+        st3 = mk_state({})
+        st3.write("changes_since_fn", self._changes_fn(holder))
+        st3.write("cycle_versions", holder["v"])
+        mc.pre_score(st3, POD, [fb])
+        assert st3.read("Max").free_memory == 10000
+
+        # a NON-argmax node leaving must not disturb the cached maxima
+        holder["v"] = (4,)
+        holder["dirty"] = set()
+        st4 = mk_state({})
+        st4.write("changes_since_fn", self._changes_fn(holder))
+        st4.write("cycle_versions", holder["v"])
+        mc.pre_score(st4, POD, [fb])
+        assert st4.read("Max").free_memory == 10000

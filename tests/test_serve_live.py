@@ -476,9 +476,13 @@ class TestAsyncBinding:
 
         client = KubeClient(server.url)
         stop = threading.Event()
+        # SHORT backoff so the 1.2s quiet window below is conclusive: a
+        # live 409 loop would retry at most 0.5s apart and could never
+        # stay quiet for the full window
         t = threading.Thread(
             target=run_scheduler_against_cluster,
-            args=(client, [(SchedulerConfig(), None)]),
+            args=(client, [(SchedulerConfig(pod_initial_backoff_s=0.2,
+                                            pod_max_backoff_s=0.5), None)]),
             kwargs={"metrics_port": None, "leader_elect": False,
                     "poll_s": 0.05, "stop_event": stop},
             daemon=True)
@@ -499,8 +503,8 @@ class TestAsyncBinding:
                 return len([r for r in server.state.requests
                             if r[1].endswith("/binding")])
 
-            # sample-sleep-resample until the count holds still for one
-            # full backoff window (or time out)
+            # sample-sleep-resample until the count holds still for
+            # more than two max-backoff windows (or time out)
             deadline = time.monotonic() + 10.0
             stable = False
             while time.monotonic() < deadline and not stable:

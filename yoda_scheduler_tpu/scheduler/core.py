@@ -613,6 +613,12 @@ class Scheduler:
         snapshot = self.snapshot()
         state.write("snapshot", snapshot)
         state.write("changes_since_fn", self._changes_since_vers)
+        # the version vector sampled BEFORE the snapshot was built:
+        # plugin memos must store THIS vector, never a live re-sample —
+        # an event landing between snapshot build and a later sample
+        # would be absorbed (the memo's version covers it while its data
+        # predates it), and changes_since would never report it again
+        state.write("cycle_versions", vers)
 
         # PreFilter
         for p in self.profile.pre_filter:

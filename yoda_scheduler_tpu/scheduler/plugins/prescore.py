@@ -40,25 +40,64 @@ class MaxCollection(PreScorePlugin):
 
     def __init__(self, allocator: ChipAllocator) -> None:
         self.allocator = allocator
+        # incremental-maxima memo: spec -> (cluster version vector,
+        # {node: maxima tuple}, mv tuple). A classmate cycle folds in
+        # only the nodes the change logs call dirty (or that newly
+        # entered the feasible set). A max can only SHRINK when a node
+        # whose old maxima touched the cached mv changed or left — that
+        # case falls back to the full fold. class_stats' inputs (node
+        # serial, allocator pending version) are both inside the version
+        # vector, so a clean node's maxima cannot have moved.
+        self._memo: dict = {}
+
+    def forget_nodes(self, gone: set[str]) -> None:
+        self._memo.clear()
 
     def pre_score(self, state: CycleState, pod, feasible: list[NodeInfo]) -> Status:
         spec: WorkloadSpec = state.read(SPEC_KEY)
-        mv = MaxValue()
+        cb = state.read_or("changes_since_fn")
+        # store under the CYCLE's pre-snapshot version vector, never a
+        # live re-sample: an event landing between snapshot build and a
+        # later sample would be absorbed (version covers it, data
+        # predates it) and changes_since would never report it again
+        vers = state.read_or("cycle_versions")
+        contribs = None
+        mv6 = None
+        if cb is not None:
+            hit = self._memo.get(spec)
+            if hit is not None:
+                cvers, ccontribs, cmv = hit
+                _, dirty = cb(cvers)
+                if dirty is not None:
+                    names = {n.name for n in feasible}
+                    suspects = ((set(ccontribs) - names)
+                                | (dirty & set(ccontribs)))
+                    if any(any(v == m for v, m in zip(ccontribs[n], cmv))
+                           for n in suspects):
+                        pass  # a potential argmax moved: full fold below
+                    else:
+                        contribs = {n: t for n, t in ccontribs.items()
+                                    if n in names and n not in dirty}
+                        mv6 = list(cmv)
+        if contribs is None:
+            contribs = {}
+            mv6 = [1, 1, 1, 1, 1, 1]
         # fold per-node qualifying-chip maxima (memoised per node state +
-        # label class; allocator.ClassStats) instead of rescanning chips
+        # label class; allocator.ClassStats) for every node not already
+        # carried over from the memo
         for node in feasible:
-            if node.metrics is None:
+            if node.name in contribs or node.metrics is None:
                 continue
             st = self.allocator.class_stats(node, spec.min_free_mb,
                                             spec.min_clock_mhz)
             if st.count == 0:
                 continue
-            bw, ck, co, fm, pw, tm = st.maxima
-            mv.bandwidth = max(mv.bandwidth, bw)
-            mv.clock = max(mv.clock, ck)
-            mv.core = max(mv.core, co)
-            mv.free_memory = max(mv.free_memory, fm)
-            mv.power = max(mv.power, pw)
-            mv.total_memory = max(mv.total_memory, tm)
-        state.write(MAX_KEY, mv)
+            t = st.maxima
+            contribs[node.name] = t
+            mv6 = [max(a, b) for a, b in zip(mv6, t)]
+        if cb is not None and vers is not None:
+            if len(self._memo) > 256:
+                self._memo.clear()
+            self._memo[spec] = (vers, contribs, tuple(mv6))
+        state.write(MAX_KEY, MaxValue(*mv6))
         return Status.success()

@@ -65,10 +65,15 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
         snapshot = state.read_or("snapshot")
         nodes = snapshot.list() if snapshot is not None else feasible
         cb = state.read_or("changes_since_fn")
+        # store under the CYCLE's pre-snapshot version vector, never a
+        # live re-sample — a later sample would absorb an event that
+        # landed after the snapshot was built (version covers it, data
+        # predates it) and changes_since would never report it again
+        vers = state.read_or("cycle_versions")
         if cb is not None and self._usage_state is not None:
             cvers, usage, contrib = self._usage_state
-            vers, dirty = cb(cvers)
-            if dirty is not None:
+            _, dirty = cb(cvers)
+            if dirty is not None and vers is not None:
                 if dirty:
                     usage = dict(usage)
                     contrib = dict(contrib)
@@ -95,10 +100,8 @@ class TopologyScore(ScorePlugin, PreScorePlugin):
             contrib[node.name] = c
             u, t = usage.get(c[0], (0, 0))
             usage[c[0]] = (u + c[1], t + c[2])
-        if cb is not None:
-            vers, _ = cb(None)
-            if vers is not None:
-                self._usage_state = (vers, usage, contrib)
+        if cb is not None and vers is not None:
+            self._usage_state = (vers, usage, contrib)
         state.write(SLICE_USE_KEY, usage)
         return Status.success()
 
