@@ -103,6 +103,57 @@ def make_sync(jax, jnp):
     return _sync
 
 
+def make_checkpoint(env_var: str, default_path: str, progress):
+    """Cross-run measurement checkpoint. The axon tunnel has hung mid-run
+    and cost a whole session's measurements (round 5: the 600s watchdog
+    fired during the S=4096 attention sweep and the already-measured
+    54.25% train MFU died with the process). Each completed section is
+    saved keyed by name the moment it finishes, so a hang loses only the
+    in-flight section — the next attempt (chip_session.sh retries) resumes
+    from what survived. Sections are only reused when the measurement
+    context (device kind, shapes) matches what they were recorded under.
+    Set <env_var>=off to disable."""
+    path = os.environ.get(env_var, default_path)
+
+    class _Checkpoint:
+        def __init__(self) -> None:
+            self.data: dict = {}
+            if path != "off" and os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        self.data = json.load(f)
+                except Exception:
+                    self.data = {}
+
+        def bind_context(self, **ctx) -> None:
+            """Discard saved sections recorded under a different context."""
+            if self.data.get("__ctx__") != ctx:
+                if len(self.data) > (1 if "__ctx__" in self.data else 0):
+                    progress(f"checkpoint context changed; discarding {path}")
+                self.data = {"__ctx__": ctx}
+
+        def get(self, key: str):
+            return self.data.get(key)
+
+        def put(self, key: str, value) -> None:
+            self.data[key] = value
+            if path != "off":
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self.data, f)
+                os.replace(tmp, path)
+
+        def clear(self) -> None:
+            """Called on a fully successful run: the final artifact now owns
+            the numbers; a lingering checkpoint would feed stale sections
+            into a much later session."""
+            self.data = {}
+            if path != "off" and os.path.exists(path):
+                os.remove(path)
+
+    return _Checkpoint()
+
+
 def start_watchdog(metric: str, unit: str, budget_s: float,
                    grace_s: float = 120.0):
     """Hard ceiling: a wedged device tunnel mid-compile hangs inside XLA
