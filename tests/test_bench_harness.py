@@ -1,0 +1,94 @@
+"""Cross-attempt measurement checkpointing (bench_util.make_checkpoint).
+
+The axon tunnel has hung mid-bench and cost a whole session's
+measurements; the checkpoint bridges chip_session.sh retries so a hang
+loses only the in-flight section. These tests pin the contract the
+benches rely on: section persistence, context binding, corruption
+tolerance, and the off switch.
+"""
+
+import json
+import os
+
+import pytest
+
+from bench_util import make_checkpoint
+
+
+def _noop(msg):
+    pass
+
+
+def _make(tmp_path, monkeypatch, name="ck.json", env=None):
+    path = str(tmp_path / name)
+    if env is not None:
+        monkeypatch.setenv("TEST_CKPT", env)
+    else:
+        monkeypatch.setenv("TEST_CKPT", path)
+    return path, make_checkpoint("TEST_CKPT", path, _noop)
+
+
+def test_sections_survive_process_loss(tmp_path, monkeypatch):
+    # first "attempt" saves two sections then dies (new object = new run)
+    path, ck = _make(tmp_path, monkeypatch)
+    ck.bind_context(device_kind="v5e", on_tpu=True)
+    ck.put("train.a", {"mfu": 54.2})
+    ck.put("attn.S2048", {"fwd_speedup": 1.4})
+
+    _, resumed = _make(tmp_path, monkeypatch)
+    resumed.bind_context(device_kind="v5e", on_tpu=True)
+    assert resumed.get("train.a") == {"mfu": 54.2}
+    assert resumed.get("attn.S2048") == {"fwd_speedup": 1.4}
+    assert resumed.get("attn.S4096") is None  # in-flight section lost
+
+
+def test_context_mismatch_discards_sections(tmp_path, monkeypatch):
+    path, ck = _make(tmp_path, monkeypatch)
+    ck.bind_context(device_kind="v5e", on_tpu=True)
+    ck.put("train.a", {"mfu": 54.2})
+
+    _, other = _make(tmp_path, monkeypatch)
+    other.bind_context(device_kind="v4", on_tpu=True)  # different chip
+    assert other.get("train.a") is None
+
+
+def test_clear_removes_file(tmp_path, monkeypatch):
+    path, ck = _make(tmp_path, monkeypatch)
+    ck.bind_context(device_kind="v5e", on_tpu=True)
+    ck.put("train.a", {"mfu": 54.2})
+    assert os.path.exists(path)
+    ck.clear()
+    assert not os.path.exists(path)
+    assert ck.get("train.a") is None
+
+
+def test_corrupt_file_starts_fresh(tmp_path, monkeypatch):
+    path, _ = _make(tmp_path, monkeypatch)
+    with open(path, "w") as f:
+        f.write('{"truncated mid-wri')  # hang during the atomic-replace dance
+    _, ck = _make(tmp_path, monkeypatch)
+    ck.bind_context(device_kind="v5e", on_tpu=True)
+    assert ck.get("train.a") is None
+    ck.put("train.a", {"mfu": 1.0})  # and it can still save
+
+
+def test_off_switch_never_touches_disk(tmp_path, monkeypatch):
+    path, ck = _make(tmp_path, monkeypatch, env="off")
+    ck.bind_context(device_kind="v5e", on_tpu=True)
+    ck.put("train.a", {"mfu": 54.2})
+    assert ck.get("train.a") == {"mfu": 54.2}  # in-memory still works
+    assert not os.path.exists("off")
+    assert not os.path.exists(path)
+    ck.clear()
+
+
+def test_writes_are_atomic_json(tmp_path, monkeypatch):
+    path, ck = _make(tmp_path, monkeypatch)
+    ck.bind_context(device_kind="v5e", on_tpu=True)
+    ck.put("a", {"x": 1})
+    ck.put("b", {"y": [1, 2, 3]})
+    on_disk = json.load(open(path))
+    assert on_disk["a"] == {"x": 1}
+    assert on_disk["b"] == {"y": [1, 2, 3]}
+    assert on_disk["__ctx__"] == {"device_kind": "v5e", "on_tpu": True}
+    assert not os.path.exists(path + ".tmp")
