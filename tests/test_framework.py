@@ -8,7 +8,7 @@ from yoda_scheduler_tpu.scheduler.framework import (
     min_max_normalize,
 )
 from yoda_scheduler_tpu.scheduler.queue import SchedulingQueue
-from yoda_scheduler_tpu.scheduler.config import adaptive_percentage, SchedulerConfig, ScoreWeights
+from yoda_scheduler_tpu.scheduler.config import SchedulerConfig, ScoreWeights
 from yoda_scheduler_tpu.scheduler.plugins.sort import PrioritySort
 from yoda_scheduler_tpu.utils import Pod
 
@@ -115,12 +115,6 @@ def test_queue_backoff_exponential_and_flush():
         info = q.pop(now=25.0)
     q.requeue_backoff(info, now=100.0)
     assert q.next_ready_at() == pytest.approx(110.0)
-
-
-def test_adaptive_percentage():
-    assert adaptive_percentage(50) == 50
-    assert adaptive_percentage(1000) == 42
-    assert adaptive_percentage(10000) == 5   # floor
 
 
 def test_config_from_profile_dict():

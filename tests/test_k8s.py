@@ -103,6 +103,79 @@ def test_bind_posts_binding_and_patches_chips(client, api):
     assert patch and "tpu/assigned-chips" in json.dumps(patch[0][2])
 
 
+class _AmbiguousBindTransport:
+    """Wraps FakeApiServer.transport: the first `drops` binding POSTs die
+    ambiguously (connection lost after the request may have been written).
+    With `applies=True` the server processed the bind before the drop —
+    the lost-response case; otherwise the POST never landed."""
+
+    def __init__(self, api, applies: bool, drops: int = 1):
+        self.api = api
+        self.applies = applies
+        self.drops = drops
+        self.node = None  # what a GET of the pod reports
+        self.post_attempts = 0
+
+    def __call__(self, method, path, body, timeout):
+        from yoda_scheduler_tpu.k8s.client import AmbiguousRequestError
+
+        base = path.partition("?")[0]
+        if method == "POST" and base.endswith("/binding"):
+            self.post_attempts += 1
+            if self.drops > 0:
+                self.drops -= 1
+                if self.applies:
+                    self.api.bound.append(body)
+                    self.node = body["target"]["name"]
+                raise AmbiguousRequestError("connection reset mid-response")
+            self.node = body["target"]["name"]
+        if method == "GET" and base == "/api/v1/namespaces/default/pods/p1":
+            doc = {"metadata": {"name": "p1", "namespace": "default"},
+                   "spec": ({"nodeName": self.node} if self.node else {})}
+            return 200, json.dumps(doc).encode()
+        return self.api.transport(method, path, body, timeout)
+
+
+def test_ambiguous_bind_that_landed_still_patches_chips(api):
+    """The bind POST was processed but the response was lost: bind() must
+    read the pod back, see it bound to us, and still publish the
+    chip-assignment annotation — raising instead leaves the pod bound on
+    the server with its chips invisible to the allocator (double
+    assignment)."""
+    t = _AmbiguousBindTransport(api, applies=True)
+    c = KubeClient("https://fake", transport=t)
+    c.bind(Pod("p1"), "n1", [(0, 0, 0), (1, 0, 0)])
+    assert len(api.bound) == 1  # never replayed: the first POST landed
+    assert t.post_attempts == 1
+    patch = [r for r in api.requests if r[0] == "PATCH"]
+    assert patch and "tpu/assigned-chips" in json.dumps(patch[0][2])
+
+
+def test_ambiguous_bind_that_never_landed_replays_once(api):
+    """The connection died before the server applied the POST: the pod
+    reads back unbound, so exactly one replay is safe and must succeed."""
+    t = _AmbiguousBindTransport(api, applies=False, drops=1)
+    c = KubeClient("https://fake", transport=t)
+    c.bind(Pod("p1"), "n1", [(0, 0, 0)])
+    assert t.post_attempts == 2
+    assert len(api.bound) == 1
+    patch = [r for r in api.requests if r[0] == "PATCH"]
+    assert patch
+
+
+def test_ambiguous_bind_unbound_after_replay_raises(api):
+    """Both the original POST and its single replay die without landing:
+    bind() must surface the failure (the binder rolls back and requeues),
+    never loop."""
+    t = _AmbiguousBindTransport(api, applies=False, drops=2)
+    c = KubeClient("https://fake", transport=t)
+    with pytest.raises(ApiError):
+        c.bind(Pod("p1"), "n1", [(0, 0, 0)])
+    assert t.post_attempts == 2
+    assert api.bound == []
+    assert not [r for r in api.requests if r[0] == "PATCH"]
+
+
 def test_kube_cluster_adapter(client):
     store = TelemetryStore()
     cluster = KubeCluster(client, store)

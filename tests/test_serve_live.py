@@ -514,6 +514,13 @@ class TestAsyncBinding:
             assert stable, "bind POSTs never stabilized"
             assert posts() <= 2  # initial + at most one recovered retry
             assert len(server.state.bindings) == 1
+            # the chip-assignment annotation must survive the lost
+            # response: bind() resolves the ambiguity by reading the pod
+            # back and proceeds to the PATCH — without it the allocator
+            # re-offers this pod's chips (the r5 review's double-assign)
+            ann = (server.state.pod("p1") or {}).get(
+                "metadata", {}).get("annotations", {})
+            assert "tpu/assigned-chips" in ann
         finally:
             stop.set()
             t.join(timeout=5.0)

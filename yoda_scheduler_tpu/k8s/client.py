@@ -468,27 +468,51 @@ class KubeClient:
         """POST the binding subresource. A 409 means the pod is already
         assigned — possibly by OUR earlier attempt whose response was lost
         (the retry path re-POSTs). Recover by reading the pod back: bound to
-        our target = success; bound elsewhere = genuine conflict, raised."""
+        our target = success; bound elsewhere = genuine conflict, raised.
+
+        An AMBIGUOUS wire failure (the connection died after the POST may
+        have reached the server — surfaced by request() as ApiError(0)
+        caused by AmbiguousRequestError) is resolved the same way: read the
+        pod back. Bound to us = the POST landed, proceed — critically, on
+        THROUGH to the chip-assignment PATCH below; raising here would bind
+        the pod on the server while the annotation the allocator reads never
+        gets published, and the node's chips would be offered to the next
+        pod. Unbound = the POST provably never applied, so one replay is
+        safe (a replay racing a still-in-flight original surfaces as 409 and
+        converges through the 409 recovery above)."""
         body = {
             "apiVersion": "v1",
             "kind": "Binding",
             "metadata": {"name": pod.name, "namespace": pod.namespace},
             "target": {"apiVersion": "v1", "kind": "Node", "name": node},
         }
-        try:
-            self.request(
-                "POST",
-                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
-                body)
-        except ApiError as e:
-            if e.status != 409:
-                raise
-            live = self.get_pod(pod.namespace, pod.name)
-            bound_to = (live or {}).get("spec", {}).get("nodeName")
-            if bound_to != node:
-                raise ApiError("POST", "binding(conflict)", 409,
-                               f"pod bound to {bound_to!r}".encode()) from e
-            log.info("bind %s -> %s: 409 but already ours", pod.key, node)
+        for replay in (False, True):
+            try:
+                self.request(
+                    "POST",
+                    f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}"
+                    "/binding", body)
+                break
+            except ApiError as e:
+                ambiguous = (e.status == 0
+                             and isinstance(e.__cause__,
+                                            AmbiguousRequestError))
+                if e.status != 409 and not ambiguous:
+                    raise
+                live = self.get_pod(pod.namespace, pod.name)
+                bound_to = (live or {}).get("spec", {}).get("nodeName")
+                if bound_to == node:
+                    log.info("bind %s -> %s: %s but already ours", pod.key,
+                             node, "ambiguous" if ambiguous else "409")
+                    break
+                if bound_to or not ambiguous:
+                    raise ApiError("POST", "binding(conflict)", 409,
+                                   f"pod bound to {bound_to!r}".encode()) \
+                        from e
+                if replay:
+                    raise  # unbound after a replayed POST: genuine failure
+                log.info("bind %s -> %s: ambiguous failure, pod unbound; "
+                         "replaying POST", pod.key, node)
         if assigned_chips:
             patch = {"metadata": {"annotations": {
                 ASSIGNED_CHIPS_LABEL: format_assigned_chips(assigned_chips)}}}

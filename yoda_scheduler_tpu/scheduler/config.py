@@ -107,8 +107,9 @@ class SchedulerConfig:
         )
 
 
-def adaptive_percentage(num_nodes: int) -> int:
-    """kube-scheduler's adaptive percentageOfNodesToScore formula for the
-    0/default case: max(5, 50 - num_nodes/125), capped at 100."""
-    pct = 50 - num_nodes // 125
-    return max(5, min(100, pct))
+# Note: upstream kube-scheduler's adaptive percentageOfNodesToScore
+# formula (max(5, 50 - num_nodes/125), capped at 100) used to live here,
+# but under the engine's 100-candidate floor and cap it is identically
+# 100 for every cluster size, so the engine inlines the constant —
+# see Engine._num_feasible_to_find (core.py) for the derivation and the
+# measured justification.
