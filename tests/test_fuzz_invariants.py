@@ -1,0 +1,215 @@
+"""Randomized invariant fuzz over the whole scheduling stack (SURVEY §4:
+the reference ships zero tests; upstream kube-scheduler leans on
+scheduler_perf + integration invariants — this is that idea at fake-store
+speed). Each seed builds a random fleet and a random 90-pod burst — plain
+TPU pods, memory-heavy pods, GPU pods, generation pins, topology blocks,
+gangs — runs the engine to idle, and asserts the global invariants that
+must hold for EVERY workload/fleet combination:
+
+1. every pod resolves (bound or failed — nothing leaks in flight);
+2. no chip is double-booked, and every assigned chip exists on its node;
+3. a bound TPU pod holds exactly the chips it asked for;
+4. failed pods hold nothing;
+5. gang atomicity (all members bound, or none);
+6. generation pins are honored;
+7. topology-block pods get their chips on one node, contiguously
+   (an axis-aligned sub-block of the node's torus, verified against the
+   enumerated placements);
+8. per-node HBM accounting never overcommits: bound claims fit the
+   node's per-chip free HBM for each chip they landed on.
+"""
+
+import random
+import time
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import HybridClock
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore, make_gpu_node, make_tpu_node, make_v4_slice)
+from yoda_scheduler_tpu.topology.torus import enumerate_subblocks, parse_topology
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.pod import ASSIGNED_CHIPS_LABEL
+
+N_PODS = 90
+
+
+def _fleet(rng: random.Random) -> TelemetryStore:
+    store = TelemetryStore()
+    now = time.time()
+    metrics = []
+    for s in range(rng.randint(1, 2)):  # multi-host slices (4 hosts x 4)
+        metrics.extend(make_v4_slice(f"s{s}", "2x2x4"))
+    for i in range(rng.randint(3, 6)):  # standalone TPU hosts
+        metrics.append(make_tpu_node(
+            f"t{i}", chips=rng.choice((2, 4, 8)),
+            generation=rng.choice(("v4", "v5e")),
+            unhealthy=rng.choice((0, 0, 0, 1))))
+    for i in range(rng.randint(1, 3)):
+        metrics.append(make_gpu_node(f"g{i}", cards=rng.choice((4, 8))))
+    for m in metrics:
+        m.heartbeat = now
+        store.put(m)
+    return store
+
+
+def _burst(rng: random.Random) -> list[Pod]:
+    pods = []
+    gang_id = 0
+    i = 0
+    while len(pods) < N_PODS:
+        i += 1
+        roll = rng.random()
+        if roll < 0.40:  # plain TPU
+            pods.append(Pod(f"p{i}", labels={
+                "tpu/accelerator": "tpu",
+                "scv/number": str(rng.choice((1, 1, 2, 4)))}))
+        elif roll < 0.55:  # memory-constrained (sometimes unsatisfiable)
+            pods.append(Pod(f"p{i}", labels={
+                "tpu/accelerator": "tpu", "scv/number": "1",
+                "scv/memory": str(rng.choice((4000, 16000, 40000)))}))
+        elif roll < 0.70:  # GPU
+            pods.append(Pod(f"p{i}", labels={
+                "tpu/accelerator": "gpu",
+                "scv/number": str(rng.choice((1, 2, 4)))}))
+        elif roll < 0.80:  # generation pin
+            pods.append(Pod(f"p{i}", labels={
+                "tpu/accelerator": "tpu", "scv/number": "1",
+                "tpu/generation": rng.choice(("v4", "v5e", "v5p"))}))
+        elif roll < 0.90:  # topology block
+            topo = rng.choice(("1x2", "2x2", "2x1x2"))
+            pods.append(Pod(f"p{i}", labels={
+                "tpu/accelerator": "tpu", "tpu/topology": topo,
+                "scv/number": str(_block_size(topo))}))
+        else:  # gang (one pod per host on a slice)
+            size = rng.choice((2, 3, 4))
+            gang_id += 1
+            for m in range(size):
+                pods.append(Pod(f"p{i}g{m}", labels={
+                    "tpu/accelerator": "tpu", "scv/number": "4",
+                    "tpu/gang-name": f"fz{gang_id}",
+                    "tpu/gang-size": str(size)}))
+    rng.shuffle(pods)
+    return pods
+
+
+def _block_size(topo: str) -> int:
+    n = 1
+    for d in parse_topology(topo):
+        n *= d
+    return n
+
+
+def _chips_of(pod: Pod) -> set[tuple[int, int, int]]:
+    return pod.assigned_chips()
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_burst_invariants(seed):
+    rng = random.Random(seed)
+    store = _fleet(rng)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    # HybridClock virtualizes backoff waits (bench.py's idiom) — with the
+    # wall clock, the infeasible tail's 1-10s backoffs would make each
+    # seed take minutes
+    sched = Scheduler(cluster, SchedulerConfig(
+        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=3600.0),
+        clock=HybridClock())
+    pods = _burst(rng)
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20000)
+
+    by_metrics = {m.node: m for m in store.list()}
+
+    # 1. everything resolves
+    for p in pods:
+        assert p.phase in (PodPhase.BOUND, PodPhase.FAILED), \
+            f"seed {seed}: {p.name} leaked in phase {p.phase}"
+
+    # 2+3+4. chip accounting
+    claimed: dict[str, dict[tuple, str]] = {}
+    for p in pods:
+        chips = _chips_of(p)
+        if p.phase == PodPhase.FAILED:
+            assert not chips, f"seed {seed}: failed {p.name} holds chips"
+            continue
+        m = by_metrics[p.node]
+        if p.labels.get("tpu/accelerator") != "gpu" \
+                and m.accelerator != "gpu":
+            want = int(p.labels.get("scv/number", "1"))
+            assert len(chips) == want, \
+                f"seed {seed}: {p.name} wanted {want} got {len(chips)}"
+        node_coords = {c.coords for c in m.chips}
+        owners = claimed.setdefault(p.node, {})
+        for c in chips:
+            assert c in node_coords, \
+                f"seed {seed}: {p.name} assigned nonexistent chip {c}"
+            assert c not in owners, (f"seed {seed}: chip {p.node}/{c} "
+                                     f"double-booked by {owners[c]} "
+                                     f"and {p.name}")
+            owners[c] = p.name
+
+    # 5. gang atomicity
+    gangs: dict[str, list[Pod]] = {}
+    for p in pods:
+        g = p.labels.get("tpu/gang-name")
+        if g:
+            gangs.setdefault(g, []).append(p)
+    for g, members in gangs.items():
+        phases = {p.phase for p in members}
+        assert len(phases) == 1, \
+            f"seed {seed}: gang {g} split {[(p.name, p.phase) for p in members]}"
+
+    # 6. generation pins
+    for p in pods:
+        gen = p.labels.get("tpu/generation")
+        if gen and p.phase == PodPhase.BOUND:
+            assert by_metrics[p.node].tpu_generation == gen, \
+                f"seed {seed}: {p.name} pinned {gen} landed on " \
+                f"{by_metrics[p.node].tpu_generation}"
+
+    # 7. topology blocks are contiguous sub-blocks of the node torus
+    for p in pods:
+        topo = p.labels.get("tpu/topology")
+        if not topo or p.phase != PodPhase.BOUND:
+            continue
+        chips = _chips_of(p)
+        m = by_metrics[p.node]
+        shape = _node_shape(m)
+        ok = False
+        for origin, bshape in enumerate_subblocks(shape, len(chips)):
+            cells = {tuple((origin[d] + o[d]) % max(shape[d], 1)
+                           for d in range(3))
+                     for o in _offsets(bshape)}
+            if cells == chips:
+                ok = True
+                break
+        assert ok, f"seed {seed}: {p.name} chips {sorted(chips)} are not " \
+                   f"a contiguous block on {p.node} {shape}"
+
+    # 8. HBM: every chip a memory-demanding pod landed on satisfies it
+    for p in pods:
+        need = int(p.labels.get("scv/memory", "0"))
+        if need and p.phase == PodPhase.BOUND \
+                and p.labels.get("tpu/accelerator") != "gpu":
+            m = by_metrics[p.node]
+            free = {c.coords: c.hbm_free_mb for c in m.chips}
+            for c in _chips_of(p):
+                assert free[c] >= need, \
+                    f"seed {seed}: {p.name} needs {need}MB, chip {c} " \
+                    f"has {free[c]}"
+
+
+def _node_shape(m):
+    from yoda_scheduler_tpu.scheduler.plugins.allocator import _node_shape
+    return _node_shape(m)
+
+
+def _offsets(shape):
+    return [(x, y, z)
+            for x in range(shape[0])
+            for y in range(shape[1])
+            for z in range(shape[2])]
