@@ -121,15 +121,21 @@ def test_random_burst_invariants(seed):
     for p in pods:
         sched.submit(p)
     sched.run_until_idle(max_cycles=20000)
+    _check_invariants(pods, store, seed)
 
+
+def _check_invariants(pods, store, seed):
+    """The global invariants every fleet/workload combination must satisfy
+    after the engine drains — shared by the serial and concurrent fuzz so
+    the racy regime is held to exactly the same bar."""
     by_metrics = {m.node: m for m in store.list()}
 
     # 1. everything resolves
-    for p in pods:
-        assert p.phase in (PodPhase.BOUND, PodPhase.FAILED), \
-            f"seed {seed}: {p.name} leaked in phase {p.phase}"
+    unresolved = [p.name for p in pods
+                  if p.phase not in (PodPhase.BOUND, PodPhase.FAILED)]
+    assert not unresolved, f"seed {seed}: unresolved {unresolved}"
 
-    # 2+3+4. chip accounting
+    # 2+3+4. chip accounting: exact counts, existing chips, no double-booking
     claimed: dict[str, dict[tuple, str]] = {}
     for p in pods:
         chips = _chips_of(p)
@@ -161,7 +167,8 @@ def test_random_burst_invariants(seed):
     for g, members in gangs.items():
         phases = {p.phase for p in members}
         assert len(phases) == 1, \
-            f"seed {seed}: gang {g} split {[(p.name, p.phase) for p in members]}"
+            f"seed {seed}: gang {g} split " \
+            f"{[(p.name, p.phase) for p in members]}"
 
     # 6. generation pins
     for p in pods:
@@ -213,3 +220,75 @@ def _offsets(shape):
             for x in range(shape[0])
             for y in range(shape[1])
             for z in range(shape[2])]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_burst_invariants_concurrent(seed):
+    """The same random workloads under the racy regime: the engine loop in
+    one thread, three submitter threads, and a telemetry publisher that
+    heartbeats every node while periodically FREEZING one (its telemetry
+    goes stale mid-scheduling, so the staleness gate must fence it off
+    without tripping any of the global invariants)."""
+    import threading
+
+    rng = random.Random(1000 + seed)
+    store = _fleet(rng)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(
+        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=0.4))
+    pods = _burst(rng)
+    stop = threading.Event()
+    churn_done = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            if sched.run_one() is None:
+                time.sleep(0.0005)
+
+    def publish():
+        frozen: str | None = None
+        flips = 0
+        while not stop.is_set():
+            now = time.time()
+            for m in store.list():
+                if m.node != frozen:
+                    m.heartbeat = now
+                    store.put(m)
+            if not churn_done.is_set():
+                flips += 1
+                if flips % 10 == 0:  # roughly every 0.5s
+                    frozen = (None if frozen is not None
+                              else rng.choice(store.list()).node)
+                    if frozen is None and flips >= 40:
+                        churn_done.set()  # stop freezing; let it drain
+            else:
+                frozen = None
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=drive, daemon=True),
+               threading.Thread(target=publish, daemon=True)]
+    for i in range(3):
+        chunk = pods[i::3]
+
+        def submit(chunk=chunk):
+            for p in chunk:
+                sched.submit(p)
+                time.sleep(0.0003)
+
+        threads.append(threading.Thread(target=submit, daemon=True))
+    for t in threads:
+        t.start()
+    deadline = time.time() + 45
+    try:
+        while time.time() < deadline:
+            if all(p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                   for p in pods):
+                break
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+    _check_invariants(pods, store, seed)

@@ -121,6 +121,49 @@ class TestGangAdmission:
         assert slices1 != slices2
         assert sched.bin_pack_utilization() == pytest.approx(100.0)
 
+    def test_doomed_gang_fails_all_members_promptly(self):
+        """One member is individually unsatisfiable (impossible HBM), the
+        other three are fine: once the bad member exhausts its attempts
+        the gang is doomed — parked peers fail immediately and backoff
+        peers fail fast at their next cycle. Nothing cycles forever on
+        the park->timeout->requeue path, which counts no attempts (found
+        by the r5 randomized fuzz)."""
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, SchedulerConfig(
+            gang_timeout_s=10.0, max_attempts=2))
+        workers = gang_pods("half", 4)
+        workers[3].labels["scv/memory"] = "999999999"  # can never fit
+        for w in workers:
+            sched.submit(w)
+        sched.run_until_idle(max_cycles=500)
+        assert all(w.phase == PodPhase.FAILED for w in workers)
+        assert not sched.waiting
+        assert not any(sched.tracks(w.key) for w in workers)
+        # every reservation rolled back: the slice hosts other work again
+        free_pod = Pod("free", labels={"scv/number": "4"})
+        sched.submit(free_pod)
+        sched.run_until_idle(max_cycles=50)
+        assert free_pod.phase == PodPhase.BOUND
+
+    def test_doomed_gang_revives_on_resubmission(self):
+        """Failing once must not poison the gang name: fresh incarnations
+        of the members (the serve loop resubmits recreated pods) assemble
+        and bind."""
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, SchedulerConfig(
+            gang_timeout_s=10.0, max_attempts=2))
+        workers = gang_pods("phoenix", 4)
+        workers[0].labels["scv/memory"] = "999999999"
+        for w in workers:
+            sched.submit(w)
+        sched.run_until_idle(max_cycles=500)
+        assert all(w.phase == PodPhase.FAILED for w in workers)
+        retry = gang_pods("phoenix", 4)  # corrected incarnations
+        for w in retry:
+            sched.submit(w)
+        sched.run_until_idle(max_cycles=500)
+        assert all(w.phase == PodPhase.BOUND for w in retry)
+
     def test_gang_too_big_for_any_slice_fails_cleanly(self):
         nodes = make_v4_slice("s", "2x2x2")  # only 2 hosts
         sched, _ = mk_sched(nodes, SchedulerConfig(max_attempts=2))

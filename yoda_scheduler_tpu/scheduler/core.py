@@ -63,7 +63,8 @@ from .plugins import (
 )
 from .plugins.prescore import MAX_KEY
 from .plugins.topology import SLICE_USE_KEY
-from ..utils.labels import LabelError, spec_for, workload_class
+from ..utils.labels import (
+    GANG_NAME_LABEL, LabelError, spec_for, workload_class)
 from ..utils.obs import CycleTrace, Metrics, TraceLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
@@ -253,6 +254,17 @@ class Scheduler:
         # drained by run_one on the engine thread (the queue is
         # engine-thread-only; deque.append/popleft are GIL-atomic)
         self._bind_failures: deque = deque()
+        # gang -> reason: a member permanently failed during assembly, so
+        # the gang can never reach its size with the current incarnations.
+        # Parked peers are failed at doom time; peers sitting in backoff
+        # fail fast at their next cycle (the park->timeout->requeue loop
+        # counts no attempts, so without this they never resolve).
+        # ENGINE-THREAD-ONLY dict: submit() (any thread) records revivals
+        # in the GIL-atomic deque below and run_one drains it, so a fresh
+        # incarnation of the failed member makes the gang viable again
+        # without cross-thread mutation of the dict.
+        self.doomed_gangs: dict[str, str] = {}
+        self._gang_revivals: deque = deque()
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
@@ -269,6 +281,11 @@ class Scheduler:
         routing, as in kube-scheduler)."""
         if pod.scheduler_name != self.config.scheduler_name:
             return False
+        gang = pod.labels.get(GANG_NAME_LABEL)
+        if gang:
+            # a (re)submitted member can complete the gang again; the
+            # engine thread applies the revival (run_one drains this)
+            self._gang_revivals.append(gang)
         self.queue.add(pod, now=self.clock.time())
         self.metrics.inc("pods_submitted_total")
         return True
@@ -567,6 +584,12 @@ class Scheduler:
             self.failed[pod.key] = str(e)
             self.metrics.inc("pods_failed_total")
             self._finish(trace, "failed", reason=str(e))
+            return "failed"
+        doom = self.doomed_gangs.get(spec.gang_name) if spec.is_gang else None
+        if doom is not None:
+            # a peer permanently failed while this member sat in backoff:
+            # assembly can never finish, fail fast instead of re-parking
+            self._fail_permanently(info, doom, trace=trace)
             return "failed"
         state.write("workload_spec", spec)
 
@@ -1063,20 +1086,30 @@ class Scheduler:
                 # is the whole point of nominatedNodeName semantics.
                 self.allocator.unnominate(info.pod.key)
         if self.config.max_attempts and info.attempts + 1 >= self.config.max_attempts:
-            info.pod.phase = PodPhase.FAILED
-            self.failed[info.pod.key] = reason
-            if self.allocator is not None:
-                self.allocator.unnominate(info.pod.key)  # give the hole back
-                try:
-                    spec = spec_for(info.pod)
-                    if spec.is_gang:
-                        # a permanently-failed member dooms the gang: give
-                        # its slice entitlement back too
-                        self.allocator.unnominate_gang(spec.gang_name)
-                except LabelError:
-                    pass
-            self.metrics.inc("pods_failed_total")
-            self._finish(trace, "failed", reason=reason)
+            try:
+                spec = spec_for(info.pod)
+            except LabelError:
+                spec = None
+            if spec is not None and spec.is_gang:
+                # a permanently-failed member dooms the gang: the remaining
+                # members can never reach gang-size with the current
+                # incarnations, so give the slice entitlement back and fail
+                # the peers too — parked ones NOW, backoff ones at their
+                # next cycle (their park->timeout->requeue loop counts no
+                # attempts, so they would otherwise never resolve)
+                if self.allocator is not None:
+                    self.allocator.unnominate_gang(spec.gang_name)
+                doom = (f"gang {spec.gang_name}: member {info.pod.key} "
+                        f"permanently failed: {reason}")
+                self.doomed_gangs[spec.gang_name] = doom
+                while len(self.doomed_gangs) > 1024:
+                    # never-resubmitted doomed gangs would otherwise
+                    # accumulate forever; oldest doom evicts first (a
+                    # revived-then-stale entry only costs the evicted
+                    # gang's members one extra park/timeout round)
+                    self.doomed_gangs.pop(next(iter(self.doomed_gangs)))
+                self._doom_parked_members(spec.gang_name, doom)
+            self._fail_permanently(info, reason, trace=trace)
             return "failed"
         self.queue.requeue_backoff(info, now=self.clock.time())
         self.metrics.inc("pods_unschedulable_total")
@@ -1119,6 +1152,33 @@ class Scheduler:
         if self.allocator is not None:
             self.allocator.unnominate_gang(gang)
 
+    def _doom_parked_members(self, gang: str, reason: str) -> None:
+        """Permanently fail the gang's parked members (doomed-gang path:
+        a peer exhausted its attempts, so assembly can never finish).
+        Bound members are untouched — members only bind after the gang
+        completed, at which point no assembly failure can occur."""
+        if self.gang_permit is None:
+            return
+        for key in self.gang_permit.fail_gang(gang):
+            w = self.waiting.pop(key, None)
+            if w is None:
+                continue
+            self._unreserve_waiting(w)
+            self._fail_permanently(w.info, reason)
+
+    def _fail_permanently(self, info: QueuedPodInfo, reason: str,
+                          trace: CycleTrace | None = None) -> None:
+        """Terminal failure bookkeeping, shared by the max-attempts branch,
+        the doomed-gang fail-fast, and parked-member dooming."""
+        info.pod.phase = PodPhase.FAILED
+        self.failed[info.pod.key] = reason
+        if self.allocator is not None:
+            self.allocator.unnominate(info.pod.key)
+        self.metrics.inc("pods_failed_total")
+        if trace is None:
+            trace = CycleTrace(pod=info.pod.key, started=self.clock.time())
+        self._finish(trace, "failed", reason=reason)
+
     def _unreserve_waiting(self, w: _WaitingPod) -> None:
         state = CycleState()
         try:
@@ -1148,12 +1208,14 @@ class Scheduler:
             gang = self.gang_permit.gang_of(w.info.pod) if self.gang_permit else None
             if gang:
                 self._fail_gang(gang)  # surviving peers requeue
+                self.doomed_gangs.pop(gang, None)  # gone = not doomed
         for q in self.queue.remove(pod_key):
             # a QUEUED gang member (e.g. mid-preemption, before parking)
             # also takes its gang's state and slice entitlement with it
             gang = self.gang_permit.gang_of(q.pod) if self.gang_permit else None
             if gang:
                 self._fail_gang(gang)
+                self.doomed_gangs.pop(gang, None)
         if self.allocator is not None:
             self.allocator.unnominate(pod_key)
         self.failed.pop(pod_key, None)
@@ -1166,6 +1228,11 @@ class Scheduler:
         callers decide how to wait (next_wake_at)."""
         self.check_waiting()
         self._drain_bind_failures()
+        while True:  # revivals recorded by submit() on any thread
+            try:
+                self.doomed_gangs.pop(self._gang_revivals.popleft(), None)
+            except IndexError:
+                break
         info = self.queue.pop(now=self.clock.time())
         if info is None:
             return None
