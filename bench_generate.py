@@ -6,8 +6,20 @@ Measures, for the same ~950M Llama shape bench_mfu.py trains, at
 B in {1, 8} with a 2048-token prompt and 512 generated tokens:
 
 - prefill wall ms (prompt -> seeded KV cache, one full forward)
-- steady-state decode tokens/s/chip (one jitted lax.scan over 512
-  KV-cache decode steps)
+- steady-state decode tokens/s/chip: an EAGER loop over a donated jitted
+  decode step — one dispatch per token, with the emitted token computed
+  inside the jit (only device handles cycle through Python; no per-token
+  host readback). Timed as the slope between a short and a long step
+  segment (sized from `new_tokens`: warm=max(2,N/32), n1=max(4,N/10),
+  n2=the rest) so pipeline-fill and sync overhead cancel; the median of
+  3 independently re-seeded slopes is reported. NOT a lax.scan:
+  compiling any while-loop
+  whose body writes the KV cache (dynamic_update_slice) wedges this
+  environment's TPU-tunnel compiler indefinitely — bisected in
+  tools/debug_generate_hang.py / debug_generate_hang2.py (trivial-body
+  scans, prefill, and a lone decode step all compile; scan-of-decode
+  hangs even at length 4, layer loop scanned or unrolled, cache large
+  or small, and hangs in lower()/compile, not execution)
 - the same pair under Mistral-style sliding-window attention
   (window=1024): the cache stays full-size, but attention reads mask to
   the window
@@ -29,6 +41,7 @@ from __future__ import annotations
 
 import json
 import time
+from functools import partial
 
 from bench_util import (
     detect_tpu,
@@ -120,31 +133,62 @@ def _bench_one(params, config, batch: int, prompt_len: int, new_tokens: int,
     _sync(logits)
     _progress(f"B={batch} window={window}: prefill compiled")
     t_prefill = _median_time(lambda: prefill_j(params, prompt, cache0)[0])
-    if rolling:
-        cache = jax.jit(RollingKVCache.from_prefill,
-                        static_argnums=1)(cache, window)
+    del logits, cache  # measure_decode re-seeds; these are never stepped
 
-    # steady state from the seeded cache; scan length must be static, so
-    # it is closed over rather than passed
-    n = new_tokens
+    # steady state from the seeded cache, driven eagerly (see module
+    # docstring: scan-of-decode wedges this backend's compiler). The
+    # emitted token is computed INSIDE the step jit so the loop is one
+    # dispatch per token; logits and cache are donated so each step
+    # updates its buffers in place instead of copying the cache.
     step_fn = decode_step_rolling if rolling else decode_step
 
-    @jax.jit
-    def decode_n(logits, cache):
-        def step(carry, _):
-            logits, cache = carry
-            tok = jnp.argmax(logits, axis=-1)
-            logits, cache = step_fn(params, tok, cache, cfg)
-            return (logits, cache), ()
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def fused_step(params, logits, cache):
+        tok = jnp.argmax(logits, axis=-1)
+        return step_fn(params, tok, cache, cfg)
 
-        (logits, cache), _ = jax.lax.scan(step, (logits, cache), None,
-                                          length=n)
-        return logits, cache
+    def run_steps(n, logits, cache):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            logits, cache = fused_step(params, logits, cache)
+        _sync(logits)
+        return time.perf_counter() - t0, logits, cache
 
-    out = decode_n(logits, cache)  # compile
-    _sync(out[0])
-    _progress(f"B={batch} window={window}: decode compiled; timing")
-    t_decode = _median_time(lambda: decode_n(logits, cache))
+    # warm the dispatch path, then slope between two segment lengths —
+    # t(N) = fixed + N*per_step, so (t2-t1)/(n2-n1) cancels the fixed
+    # pipeline-fill + final-sync cost. The segments (plus the one compile
+    # step) stay inside the `new_tokens` budget the full cache was sized
+    # for; the ring cache has no budget but uses the same plan.
+    warm = max(2, new_tokens // 32)
+    n1 = max(4, new_tokens // 10)
+    n2 = new_tokens - warm - n1 - 1
+    n = n2 - n1
+    # the slope needs a strictly longer second segment; with the
+    # clamped minimums above that requires new_tokens >= 14
+    assert n > 0, f"new_tokens={new_tokens} too small for slope plan"
+
+    fold_j = jax.jit(RollingKVCache.from_prefill, static_argnums=1)
+
+    def measure_decode():
+        """Seed a fresh cache via prefill, then time the eager slope.
+        Re-seeding matters: fused_step donates, so a measurement consumes
+        its logits/cache and a re-measure cannot reuse them."""
+        logits, cache = prefill_j(params, prompt, cache0)
+        if rolling:
+            cache = fold_j(cache, window)
+        logits, cache = fused_step(params, logits, cache)  # compile
+        _sync(logits)
+        _, logits, cache = run_steps(warm, logits, cache)
+        t1, logits, cache = run_steps(n1, logits, cache)
+        t2, logits, cache = run_steps(n2, logits, cache)
+        return max(t2 - t1, 1e-9)
+
+    # median of 3 independent slopes: one transient stall (tunnel
+    # hiccup, host GC) in a single 400-step segment would otherwise
+    # silently understate tokens/s — and the checkpoint would then
+    # pin the bad number across retries
+    t_decode = sorted(measure_decode() for _ in range(3))[1]
+    _progress(f"B={batch} window={window}: decode timed")
     tok_s = batch * n / t_decode
 
     # bandwidth sanity: each decode step must stream the weights once
@@ -157,7 +201,7 @@ def _bench_one(params, config, batch: int, prompt_len: int, new_tokens: int,
         _progress(f"B={batch}: {tok_s:.0f} tok/s implies "
                   f"{implied_gbps:.0f} GB/s > spec {bw_peak_gbps:.0f}; "
                   "re-measuring")
-        t_decode = _median_time(lambda: decode_n(logits, cache))
+        t_decode = sorted(measure_decode() for _ in range(3))[1]
         tok_s = batch * n / t_decode
         implied_gbps = (param_bytes * (n / t_decode)) / 1e9
         if implied_gbps > 1.2 * bw_peak_gbps:
@@ -169,7 +213,8 @@ def _bench_one(params, config, batch: int, prompt_len: int, new_tokens: int,
         "batch": batch,
         "window": window,
         "prompt_len": prompt_len,
-        "new_tokens": n,
+        "new_tokens": new_tokens,
+        "steps_timed": n,
         "prefill_ms": round(t_prefill * 1e3, 1),
         "decode_step_ms": round(t_decode * 1e3 / n, 3),
         "decode_tokens_per_sec": round(tok_s, 1),
@@ -180,33 +225,32 @@ def _bench_one(params, config, batch: int, prompt_len: int, new_tokens: int,
 
 def _no_cache_baseline(params, config, batch: int, prompt_len: int) -> dict:
     """Tokens/s of generation WITHOUT a KV cache: the full prefix forward
-    re-runs per token (what a naive port ships). Timed as the slope
-    between generating 2 and 4 tokens so the one-off prompt forward
-    cancels."""
+    re-runs per token (what a naive port ships). Driven eagerly — one
+    jitted full-forward step per token, like the cached cells (the
+    scan-wedge precaution, module docstring) — and timed as the slope
+    between generating 2 and 4 tokens so fixed overhead cancels."""
     from yoda_scheduler_tpu.models.llama import llama_forward
 
     prompt = jax.random.randint(jax.random.PRNGKey(2), (batch, prompt_len),
                                 0, config.vocab_size, jnp.int32)
 
-    def gen_n(n):
-        @jax.jit
-        def run(prompt):
-            def step(toks, _):
-                logits = llama_forward(params, toks, config)
-                nxt = jnp.argmax(logits[:, -1], axis=-1)
-                return jnp.concatenate(
-                    [toks[:, 1:], nxt[:, None]], axis=1), ()
+    @partial(jax.jit, donate_argnums=(0,))
+    def nc_step(toks):
+        logits = llama_forward(params, toks, config)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        return jnp.concatenate([toks[:, 1:], nxt[:, None]], axis=1)
 
-            toks, _ = jax.lax.scan(step, prompt, None, length=n)
-            return toks
+    def gen_time(n):
+        toks = prompt + 0  # fresh donatable buffer per measurement
+        t0 = time.perf_counter()
+        for _ in range(n):
+            toks = nc_step(toks)
+        _sync(toks)
+        return time.perf_counter() - t0
 
-        return run
-
-    r2, r4 = gen_n(2), gen_n(4)
-    _sync(r2(prompt))  # compile
-    _sync(r4(prompt))
-    t2 = _median_time(lambda: r2(prompt))
-    t4 = _median_time(lambda: r4(prompt))
+    gen_time(1)  # compile
+    t2 = sorted(gen_time(2) for _ in range(3))[1]
+    t4 = sorted(gen_time(4) for _ in range(3))[1]
     per_tok = max(t4 - t2, 1e-9) / 2
     return {"batch": batch, "prompt_len": prompt_len,
             "tokens_per_sec": round(batch / per_tok, 2),

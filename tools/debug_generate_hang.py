@@ -1,0 +1,109 @@
+"""Diagnose the bench_generate B=1 full-cache stall on the live chip.
+
+Two chip-session attempts hung somewhere after "prefill compiled"
+(tools/tunnel_watchdog.log, 2026-07-31). The suspects, in bench order:
+prefill re-execution (_median_time), decode_step compile, the 512-step
+lax.scan compile, or its first execution. Each stage here logs
+before/after with elapsed time under a hard thread-timer watchdog, so
+one run names the stage that never returns.
+
+Run on the live chip:  python tools/debug_generate_hang.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_util import make_progress, make_sync  # noqa: E402
+
+_progress = make_progress("debug_generate")
+
+HARD_S = float(os.environ.get("DEBUG_HARD_S", "420"))
+
+
+def _watchdog():
+    time.sleep(HARD_S)
+    _progress(f"HARD WATCHDOG {HARD_S}s - a stage hung; see last line")
+    os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+_progress("importing jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+_sync = make_sync(jax, jnp)
+_progress(f"devices: {jax.devices()}")
+
+from yoda_scheduler_tpu.models.generate import (  # noqa: E402
+    KVCache, decode_step, prefill)
+from yoda_scheduler_tpu.models.llama import LlamaConfig, init_llama  # noqa: E402
+
+cfg = LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                  n_kv_heads=16, ffn_dim=5632, max_seq_len=4096)
+B, PROMPT, NEW = 1, 2048, 512
+
+params = init_llama(cfg, jax.random.PRNGKey(0))
+_sync(params["embed"])
+_progress("params ready")
+
+prompt = jax.random.randint(jax.random.PRNGKey(1), (B, PROMPT), 0,
+                            cfg.vocab_size, jnp.int32)
+prefill_j = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))
+cache0 = KVCache.zeros(cfg, B, PROMPT + NEW)
+logits, cache = prefill_j(params, prompt, cache0)
+_sync(logits)
+_progress("stage 1 ok: prefill compile + first run")
+
+for i in range(3):
+    t0 = time.perf_counter()
+    _sync(prefill_j(params, prompt, cache0)[0])
+    _progress(f"stage 2 rep {i}: prefill re-run {time.perf_counter()-t0:.2f}s")
+_progress("stage 2 ok: prefill timing loop")
+
+step_j = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
+tok = jnp.argmax(logits, axis=-1)
+l2, c2 = step_j(params, tok, cache)
+_sync(l2)
+_progress("stage 3 ok: single decode_step compile + run")
+
+t0 = time.perf_counter()
+for i in range(16):
+    l2, c2 = step_j(params, jnp.argmax(l2, axis=-1), c2)
+_sync(l2)
+_progress(f"stage 4 ok: 16 eager decode steps {time.perf_counter()-t0:.2f}s")
+
+
+def make_decode_n(n):
+    @jax.jit
+    def decode_n(logits, cache):
+        def step(carry, _):
+            logits, cache = carry
+            tok = jnp.argmax(logits, axis=-1)
+            logits, cache = decode_step(params, tok, cache, cfg)
+            return (logits, cache), ()
+
+        (logits, cache), _ = jax.lax.scan(step, (logits, cache), None,
+                                          length=n)
+        return logits, cache
+
+    return decode_n
+
+for n in (4, 64, 512):
+    t0 = time.perf_counter()
+    dn = make_decode_n(n)
+    out = dn(logits, cache)
+    _sync(out[0])
+    t1 = time.perf_counter()
+    _progress(f"stage 5 n={n}: scan compile+first run {t1-t0:.2f}s")
+    out = dn(logits, cache)
+    _sync(out[0])
+    _progress(f"stage 5 n={n}: second run {time.perf_counter()-t1:.2f}s")
+
+_progress("ALL STAGES PASSED - no hang at B=1 full cache")

@@ -1,0 +1,164 @@
+"""Bisect the scan(decode_step) hang (see debug_generate_hang.py: a 4-step
+lax.scan around decode_step never returns from compile/first-run, while
+eager decode steps are fine).
+
+Each candidate cause runs as a SEPARATE invocation so a hang in one stage
+cannot shadow the others:
+
+    python tools/debug_generate_hang2.py <stage>
+
+stages:
+  trivial     scan n=4, trivial body over the same 335MB cache carry
+  unrolled    scan n=4, decode body with the LAYER loop python-unrolled
+  smallcache  scan n=4, real decode body, max_len=256 cache
+  compileonly AOT-lower + compile the real decode_n n=4 (no execution)
+  run4        compile+run the real decode_n n=4 (reproduces the hang)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_util import make_progress, make_sync  # noqa: E402
+
+stage = sys.argv[1]
+_progress = make_progress(f"debug2.{stage}")
+HARD_S = float(os.environ.get("DEBUG_HARD_S", "240"))
+
+
+def _watchdog():
+    time.sleep(HARD_S)
+    _progress(f"HARD WATCHDOG {HARD_S}s - stage '{stage}' HUNG")
+    os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+_sync = make_sync(jax, jnp)
+_progress(f"devices: {jax.devices()}")
+
+from yoda_scheduler_tpu.models.generate import (  # noqa: E402
+    KVCache, decode_step, prefill)
+from yoda_scheduler_tpu.models.llama import LlamaConfig, init_llama  # noqa: E402
+from yoda_scheduler_tpu.models.llama import rms_norm, rotary  # noqa: E402
+
+cfg = LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                  n_kv_heads=16, ffn_dim=5632, max_seq_len=4096)
+B, PROMPT, NEW = 1, 2048, 512
+MAXLEN = 256 if stage == "smallcache" else PROMPT + NEW
+
+params = init_llama(cfg, jax.random.PRNGKey(0))
+_sync(params["embed"])
+_progress("params ready")
+
+prompt_len = 128 if stage == "smallcache" else PROMPT
+prompt = jax.random.randint(jax.random.PRNGKey(1), (B, prompt_len), 0,
+                            cfg.vocab_size, jnp.int32)
+prefill_j = jax.jit(lambda p, t, c: prefill(p, t, c, cfg))
+cache0 = KVCache.zeros(cfg, B, MAXLEN)
+logits, cache = prefill_j(params, prompt, cache0)
+_sync(logits)
+_progress("prefill ok")
+
+if stage == "trivial":
+    @jax.jit
+    def loop(logits, cache):
+        def step(carry, _):
+            logits, cache = carry
+            cache = KVCache(k=cache.k * 1.0, v=cache.v * 1.0,
+                            length=cache.length + 1)
+            return (logits * 1.0, cache), ()
+        (logits, cache), _ = jax.lax.scan(step, (logits, cache), None,
+                                          length=4)
+        return logits, cache
+
+    t0 = time.perf_counter()
+    out = loop(logits, cache)
+    _sync(out[0])
+    _progress(f"trivial scan ok {time.perf_counter()-t0:.2f}s")
+
+elif stage == "unrolled":
+    def decode_unrolled(params, token, cache):
+        x = params["embed"][token[:, None]]
+        positions = jnp.broadcast_to(cache.length, (B, 1))
+        new_len = cache.length + 1
+        ks, vs = [], []
+        for l in range(cfg.n_layers):
+            layer = jax.tree.map(lambda a: a[l], params["layers"])
+            k_cache = cache.k[l]
+            v_cache = cache.v[l]
+            b, s, d = x.shape
+            h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+            xn = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+            q = (xn @ layer["wq"]).reshape(b, s, h, hd)
+            k = (xn @ layer["wk"]).reshape(b, s, kvh, hd)
+            v = (xn @ layer["wv"]).reshape(b, s, kvh, hd)
+            q = rotary(q, cfg.rope_theta, positions)
+            k = rotary(k, cfg.rope_theta, positions)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k, (0, cache.length, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v, (0, cache.length, 0, 0))
+            from yoda_scheduler_tpu.models.generate import _cached_attention
+            o = _cached_attention(q, k_cache, v_cache, positions, new_len,
+                                  window=cfg.sliding_window)
+            x = x + o.reshape(b, s, h * hd) @ layer["wo"]
+            from yoda_scheduler_tpu.models.generate import _mlp_block
+            x, _ = _mlp_block(x, layer, cfg)
+            ks.append(k_cache)
+            vs.append(v_cache)
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        lg = (x @ params["lm_head"]).astype(jnp.float32)
+        return lg[:, 0], KVCache(k=jnp.stack(ks), v=jnp.stack(vs),
+                                 length=new_len)
+
+    @jax.jit
+    def loop(logits, cache):
+        def step(carry, _):
+            logits, cache = carry
+            tok = jnp.argmax(logits, axis=-1)
+            logits, cache = decode_unrolled(params, tok, cache)
+            return (logits, cache), ()
+        (logits, cache), _ = jax.lax.scan(step, (logits, cache), None,
+                                          length=4)
+        return logits, cache
+
+    t0 = time.perf_counter()
+    out = loop(logits, cache)
+    _sync(out[0])
+    _progress(f"unrolled-layer scan ok {time.perf_counter()-t0:.2f}s")
+
+else:
+    @jax.jit
+    def loop(logits, cache):
+        def step(carry, _):
+            logits, cache = carry
+            tok = jnp.argmax(logits, axis=-1)
+            logits, cache = decode_step(params, tok, cache, cfg)
+            return (logits, cache), ()
+        (logits, cache), _ = jax.lax.scan(step, (logits, cache), None,
+                                          length=4)
+        return logits, cache
+
+    if stage == "compileonly":
+        t0 = time.perf_counter()
+        lowered = loop.lower(logits, cache)
+        _progress(f"lowered {time.perf_counter()-t0:.2f}s")
+        t0 = time.perf_counter()
+        lowered.compile()
+        _progress(f"compiled {time.perf_counter()-t0:.2f}s")
+    else:  # smallcache / run4
+        t0 = time.perf_counter()
+        out = loop(logits, cache)
+        _sync(out[0])
+        _progress(f"scan n=4 ok {time.perf_counter()-t0:.2f}s")
+
+_progress("STAGE PASSED")
