@@ -377,3 +377,109 @@ class TestScoreMemoMaximaGuard:
         # a (full) left feasibility -> maxima moved -> BOTH remaining
         # nodes rescored by BOTH plugins (no replay): 2 x 2 = 4 calls
         assert counts["n"] == 4, (counts["n"], counts["nodes"])
+
+
+class TestMaximaMemoFastPath:
+    """MaxCollection's incremental walk: a classmate cycle reuses every
+    CLEAN node's cached per-node maxima tuple and pays class_stats only
+    for dirty or newly-surfaced nodes — never a full re-fold (the old
+    carried-maxima design degraded to one on homogeneous clusters where
+    every node ties the max). Pinned from the public scheduler surface
+    via the plugin's own counters (stats_calls / fast_hits) and memo."""
+
+    def _mk(self, max_age=1e9):
+        from yoda_scheduler_tpu.telemetry import make_gpu_node
+
+        store = TelemetryStore()
+        t0 = 1000.0
+        for n in ("n1", "n2"):
+            m = make_tpu_node(n, chips=4)
+            m.heartbeat = t0 + 1e9  # never stale unless a test says so
+            store.put(m)
+        g = make_gpu_node("g1", cards=4)
+        g.heartbeat = t0 + 1e9
+        store.put(g)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster,
+                          SchedulerConfig(telemetry_max_age_s=max_age),
+                          clock=FakeClock(start=t0))
+        maxc = next(p for p in sched.profile.pre_score
+                    if getattr(p, "name", "") == "max-collection")
+        return store, sched, maxc
+
+    def _tpu_pod(self, name):
+        return Pod(name, labels={"scv/number": "1",
+                                 "tpu/accelerator": "tpu"})
+
+    def test_classmate_pays_only_for_the_dirty_node(self):
+        """After p1 binds (dirtying exactly one TPU node), p2's cycle
+        must re-fold ONLY that node — one class_stats call, not a full
+        re-fold of the feasible list. A GPU bind in between (dirtying a
+        node outside the TPU class) must not add to the bill."""
+        store, sched, maxc = self._mk()
+        sched.submit(self._tpu_pod("p1"))  # primes the memo, binds
+        sched.run_until_idle()
+        gp = Pod("gp", labels={"scv/number": "1", "tpu/accelerator": "gpu"})
+        sched.submit(gp)
+        sched.run_until_idle()
+        assert gp.phase == PodPhase.BOUND and gp.node == "g1"
+        before = maxc.stats_calls
+        p2 = self._tpu_pod("p2")
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p2.phase == PodPhase.BOUND
+        assert maxc.stats_calls - before == 1, \
+            "classmate must pay exactly one class_stats (p1's bind node)"
+
+    def test_quiet_classmate_reuses_everything(self):
+        """A cycle with no TPU-side events since the memo stamp makes
+        zero class_stats calls. A bound classmate always dirties its own
+        node, so the quiet case needs a cycle that binds nothing: an
+        unschedulable pod (8 chips never fit a 4-chip node) followed by
+        a classmate — the first cycle dirtied nothing."""
+        store, sched, maxc = self._mk()
+        big = Pod("big", labels={"scv/number": "8",
+                                 "tpu/accelerator": "tpu",
+                                 "scv/priority": "5"})
+        sched.submit(big)  # 8 chips never fit a 4-chip node: unschedulable
+        sched.run_until_idle()
+        assert big.phase != PodPhase.BOUND
+        big2 = Pod("big2", labels={"scv/number": "8",
+                                   "tpu/accelerator": "tpu",
+                                   "scv/priority": "5"})
+        before_stats = maxc.stats_calls
+        sched.submit(big2)
+        sched.run_until_idle()
+        # the unschedulable-class memo may short-circuit before
+        # pre_score; either way the quiet classmate must trigger NO
+        # class_stats re-fold
+        assert maxc.stats_calls == before_stats
+        assert big2.phase != PodPhase.BOUND
+
+    def test_stale_departure_drops_the_contributor(self):
+        """A contributor aging out of feasibility produces NO change-log
+        event; the next classmate's walk simply never visits it, so its
+        tuple must leave the memo (its stale contribution must not keep
+        inflating the cluster maxima)."""
+        store, sched, maxc = self._mk(max_age=60.0)
+        t0 = 1000.0
+        for n in ("n1", "n2"):  # both initially fresh at t0
+            m = store.get(n)
+            m.heartbeat = t0
+            store.put(m)
+        sched.submit(self._tpu_pod("p1"))
+        sched.run_until_idle()
+        # keep n1 publishing via direct mutation (no store.put = no
+        # change-log event), let n2 age out
+        store.get("n1").heartbeat = t0 + 120.0
+        sched.clock.advance(120.0)
+        p2 = self._tpu_pod("p2")
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p2.phase == PodPhase.BOUND and p2.node == "n1"
+        spec_keys = list(maxc._memo)
+        assert spec_keys, "memo must be stamped"
+        _, contribs = maxc._memo[spec_keys[-1]]
+        assert "n2" not in contribs, \
+            "a staleness-departed node must leave the contributor memo"

@@ -94,23 +94,43 @@ print(f"{sys.argv[1]}: value={d['value']} {d.get('unit')} "
 EOF
 }
 
-echo "== bench_mfu (train MFU + kernels) =="
-try_bench bench_mfu.py BENCH_MFU.json
-python - <<'EOF'
+# BENCHES orders (or restricts) the session: when one artifact is
+# already fresh and the tunnel windows are short, run the missing one
+# first, e.g.  BENCHES="generate mfu" tools/chip_session.sh
+BENCHES="${BENCHES:-mfu generate}"
+# validate every token BEFORE running anything: a typo in a later token
+# must not abort the session after an earlier bench already spent the
+# tunnel window
+for b in $BENCHES; do
+    case "$b" in
+    mfu | generate) ;;
+    *) echo "unknown bench '$b' in BENCHES"; exit 2 ;;
+    esac
+done
+for b in $BENCHES; do
+    case "$b" in
+    mfu)
+        echo "== bench_mfu (train MFU + kernels) =="
+        try_bench bench_mfu.py BENCH_MFU.json
+        python - <<'EOF'
 import json
 d = json.load(open("BENCH_MFU.json"))
 for k, v in (d.get("attention") or {}).items():
     print(" ", k, "fwd_speedup:", v.get("fwd_speedup"),
           "fwdbwd:", v.get("fwdbwd_speedup"))
 EOF
-
-echo "== bench_generate (prefill + decode) =="
-try_bench bench_generate.py BENCH_GENERATE.json cells
-python - <<'EOF'
+        ;;
+    generate)
+        echo "== bench_generate (prefill + decode) =="
+        try_bench bench_generate.py BENCH_GENERATE.json cells
+        python - <<'EOF'
 import json
 d = json.load(open("BENCH_GENERATE.json"))
 for c in d.get("cells") or []:
     print(" ", c)
 EOF
+        ;;
+    esac
+done
 
 echo "== done: review the numbers, update PERFORMANCE.md, commit both artifacts =="

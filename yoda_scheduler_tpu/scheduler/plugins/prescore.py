@@ -40,15 +40,26 @@ class MaxCollection(PreScorePlugin):
 
     def __init__(self, allocator: ChipAllocator) -> None:
         self.allocator = allocator
-        # incremental-maxima memo: spec -> (cluster version vector,
-        # {node: maxima tuple}, mv tuple). A classmate cycle folds in
-        # only the nodes the change logs call dirty (or that newly
-        # entered the feasible set). A max can only SHRINK when a node
-        # whose old maxima touched the cached mv changed or left — that
-        # case falls back to the full fold. class_stats' inputs (node
-        # serial, allocator pending version) are both inside the version
-        # vector, so a clean node's maxima cannot have moved.
+        # per-spec contributor memo: spec -> (cluster version vector,
+        # {node: per-node maxima tuple}). A cycle walks its feasible
+        # list once, reusing each CLEAN node's cached tuple and calling
+        # allocator.class_stats only for dirty/new nodes; the 6-wide
+        # cluster maxima are re-folded from the tuples every cycle (a
+        # few int compares per node — carrying the folded maxima across
+        # cycles instead would need departed/dirty-argmax tracking, and
+        # on homogeneous clusters every node ties the max, so that
+        # design degraded to a full class_stats re-fold on every
+        # classmate bind). class_stats' inputs (node serial, allocator
+        # pending version) are both inside the version vector, so a
+        # clean node's tuple cannot have moved; staleness-departed nodes
+        # simply aren't in the feasible walk.
         self._memo: dict = {}
+        # observability, pinned by tests: cycles that reused every tuple
+        # (zero class_stats calls) and the running total of class_stats
+        # calls — a classmate cycle is allowed to pay only for dirty or
+        # newly-surfaced nodes, never a full re-fold
+        self.fast_hits = 0
+        self.stats_calls = 0
 
     def forget_nodes(self, gone: set[str]) -> None:
         self._memo.clear()
@@ -61,43 +72,59 @@ class MaxCollection(PreScorePlugin):
         # later sample would be absorbed (version covers it, data
         # predates it) and changes_since would never report it again
         vers = state.read_or("cycle_versions")
-        contribs = None
-        mv6 = None
+        ccontribs = None
+        dirty = None
         if cb is not None:
             hit = self._memo.get(spec)
             if hit is not None:
-                cvers, ccontribs, cmv = hit
+                cvers, ccontribs = hit
                 _, dirty = cb(cvers)
-                if dirty is not None:
-                    names = {n.name for n in feasible}
-                    suspects = ((set(ccontribs) - names)
-                                | (dirty & set(ccontribs)))
-                    if any(any(v == m for v, m in zip(ccontribs[n], cmv))
-                           for n in suspects):
-                        pass  # a potential argmax moved: full fold below
-                    else:
-                        contribs = {n: t for n, t in ccontribs.items()
-                                    if n in names and n not in dirty}
-                        mv6 = list(cmv)
-        if contribs is None:
-            contribs = {}
-            mv6 = [1, 1, 1, 1, 1, 1]
-        # fold per-node qualifying-chip maxima (memoised per node state +
-        # label class; allocator.ClassStats) for every node not already
-        # carried over from the memo
+                if dirty is None:  # change log trimmed past cvers
+                    ccontribs = None
+        contribs: dict = {}
+        mv6 = [1, 1, 1, 1, 1, 1]
+        fresh = 0
+        _MISS = object()
         for node in feasible:
-            if node.name in contribs or node.metrics is None:
+            if node.metrics is None:
                 continue
-            st = self.allocator.class_stats(node, spec.min_free_mb,
-                                            spec.min_clock_mhz)
-            if st.count == 0:
+            name = node.name
+            t = _MISS
+            if ccontribs is not None and name not in dirty:
+                # clean node: reuse its recorded tuple, including the
+                # None sentinel for "walked before, no qualifying
+                # chips". A clean node genuinely absent from the memo
+                # is possible — the filter scan rotates its start and
+                # caps at `want`, so feasible lists surface different
+                # subsets across cycles without any node event — and
+                # falls through to class_stats like a dirty node.
+                t = ccontribs.get(name, _MISS)
+            if t is _MISS:
+                st = self.allocator.class_stats(node, spec.min_free_mb,
+                                                spec.min_clock_mhz)
+                fresh += 1
+                t = st.maxima if st.count else None
+            contribs[name] = t
+            if t is None:  # no qualifying chips on this node
                 continue
-            t = st.maxima
-            contribs[node.name] = t
-            mv6 = [max(a, b) for a, b in zip(mv6, t)]
+            if t[0] > mv6[0]:
+                mv6[0] = t[0]
+            if t[1] > mv6[1]:
+                mv6[1] = t[1]
+            if t[2] > mv6[2]:
+                mv6[2] = t[2]
+            if t[3] > mv6[3]:
+                mv6[3] = t[3]
+            if t[4] > mv6[4]:
+                mv6[4] = t[4]
+            if t[5] > mv6[5]:
+                mv6[5] = t[5]
+        self.stats_calls += fresh
+        if fresh == 0 and ccontribs is not None:
+            self.fast_hits += 1
         if cb is not None and vers is not None:
             if len(self._memo) > 256:
                 self._memo.clear()
-            self._memo[spec] = (vers, contribs, tuple(mv6))
+            self._memo[spec] = (vers, contribs)
         state.write(MAX_KEY, MaxValue(*mv6))
         return Status.success()
