@@ -292,3 +292,69 @@ def test_random_burst_invariants_concurrent(seed):
             t.join(timeout=5)
 
     _check_invariants(pods, store, seed)
+
+
+# ---------------------------------------------------------------- maxima oracle
+def _brute_maxima(allocator, spec, feasible):
+    """Reference fold for MaxCollection: per-attribute maxima over every
+    feasible node's qualifying chips, derived straight from telemetry +
+    claims — bypassing free_coords/class_stats caches AND the prescore
+    tuple memo, so a bug in any cache layer diverges from this."""
+    mv = [1, 1, 1, 1, 1, 1]
+    for ni in feasible:
+        m = ni.metrics
+        if m is None:
+            continue
+        free = (m.healthy_coords() - ni.assigned_coords()
+                - allocator.pending_on(ni.name))
+        for c in m.healthy_chips():
+            if (c.coords in free and c.hbm_free_mb >= spec.min_free_mb
+                    and c.clock_mhz >= spec.min_clock_mhz):
+                for j, v in enumerate((c.ici_bandwidth_gbps, c.clock_mhz,
+                                       c.core_count, c.hbm_free_mb,
+                                       c.power_w, c.hbm_total_mb)):
+                    if v > mv[j]:
+                        mv[j] = v
+    return tuple(mv)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_incremental_maxima_match_brute_force(seed):
+    """Property: the MaxValue the prescore memo writes every cycle equals
+    the brute-force fold over the same feasible list. Pins the
+    tuple-reuse design (clean nodes' cached tuples + dirty/new re-folds)
+    against silent drift — a stale or leaked tuple shows up as the first
+    mismatching cycle, with the pod and both folds in the failure."""
+    rng = random.Random(10_000 + seed)
+    store = _fleet(rng)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(
+        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=3600.0),
+        clock=HybridClock())
+    maxc = next(p for p in sched.profile.pre_score
+                if getattr(p, "name", "") == "max-collection")
+    mismatches = []
+    orig = maxc.pre_score
+
+    def checked(state, pod, feasible):
+        st = orig(state, pod, feasible)
+        got = state.read("Max")
+        got6 = (got.bandwidth, got.clock, got.core, got.free_memory,
+                got.power, got.total_memory)
+        want6 = _brute_maxima(maxc.allocator, state.read("workload_spec"),
+                              feasible)
+        if got6 != want6:
+            mismatches.append((pod.name, got6, want6))
+        return st
+
+    maxc.pre_score = checked
+    pods = _burst(rng)
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20000)
+    assert not mismatches, f"seed {seed}: first={mismatches[0]} " \
+                           f"({len(mismatches)} mismatching cycles)"
+    # the REUSE path specifically must have fired (every seed does; the
+    # class_stats fallback alone would make the oracle vacuous)
+    assert maxc.fast_hits > 0
