@@ -148,6 +148,8 @@ class WorkloadSpec:
         return h
 
 
+# NOTE: spec_for() builds its memo key as an explicit tuple of exactly
+# these labels in exactly this order — keep the two in sync
 _SPEC_LABELS = (
     NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
     ACCELERATOR_LABEL, GENERATION_LABEL, TOPOLOGY_LABEL,
@@ -208,6 +210,11 @@ def spec_for(pod) -> WorkloadSpec:
     exactly like ``WorkloadSpec.from_labels``; errors are not cached (a
     malformed pod fails its cycle permanently anyway)."""
     labels = pod.labels
-    key = tuple(labels.get(k) for k in _SPEC_LABELS)
+    g = labels.get
+    # explicit tuple of _SPEC_LABELS values: this runs for every bound
+    # pod every cycle, and the genexpr frame was measurable there
+    key = (g(NUMBER_LABEL), g(MEMORY_LABEL), g(CLOCK_LABEL),
+           g(PRIORITY_LABEL), g(ACCELERATOR_LABEL), g(GENERATION_LABEL),
+           g(TOPOLOGY_LABEL), g(GANG_NAME_LABEL), g(GANG_SIZE_LABEL))
     return memo(pod, "_spec_cache", key,
                 lambda: _intern_spec(WorkloadSpec.from_labels(labels)))
