@@ -1,0 +1,41 @@
+"""One-off 5000-node / 25000-pod scale point (5x the bench.py large
+tier), kept OUT of bench.py so the driver's slot stays bounded. Writes
+BENCH_SCALE5K.json at the repo root; cite it from PERFORMANCE.md.
+
+Run:  python tools/scale5k.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import per_pod_ratio, run_scale  # noqa: E402
+
+
+def main() -> None:
+    small = run_scale(125)   # the bench.py large tier as the reference point
+    big = run_scale(625)     # 5000 nodes, 25000 pods
+    ratio = per_pod_ratio(small, big)
+    node_ratio = big["nodes"] / small["nodes"]
+    out = {
+        "metric": "scale5k_compute_per_pod_ratio_vs_1000_nodes",
+        "value": round(ratio, 2),
+        "unit": f"x (node_ratio {round(node_ratio, 2)})",
+        "sublinear": ratio < node_ratio,
+        "large_1000": small,
+        "huge_5000": big,
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SCALE5K.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({k: out[k] for k in ("metric", "value", "unit",
+                                          "sublinear")}))
+
+
+if __name__ == "__main__":
+    main()
