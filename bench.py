@@ -493,13 +493,19 @@ def main():
         "scale": scale,
         "serve_scale": serve_scale,
     }
-    full_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
-    try:
-        with open(full_path, "w") as f:
-            json.dump(full, f, indent=1, sort_keys=True)
-    except OSError:
-        pass
+    # only a FULL, error-free run may overwrite the committed artifact: a
+    # smoke run (YODA_BENCH_NO_SCALE/NO_SERVE, e.g. ci.yaml's
+    # benchmark-smoke step) or a run whose serve bench crashed would
+    # otherwise silently replace it with a partial record (the error
+    # still surfaces in the stdout headline's serve summary)
+    if scale and serve_scale and "error" not in serve_scale:
+        full_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
+        try:
+            with open(full_path, "w") as f:
+                json.dump(full, f, indent=1, sort_keys=True)
+        except OSError:
+            pass
     print(json.dumps({"detail": full}))
 
     def scale_summary(s):
