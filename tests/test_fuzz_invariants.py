@@ -105,18 +105,24 @@ def _chips_of(pod: Pod) -> set[tuple[int, int, int]]:
     return pod.assigned_chips()
 
 
-@pytest.mark.parametrize("seed", range(12))
-def test_random_burst_invariants(seed):
-    rng = random.Random(seed)
+def _make_sched(rng: random.Random):
+    """Shared serial-fuzz rig: random fleet + scheduler on a HybridClock
+    (virtualized backoff waits — bench.py's idiom; with the wall clock,
+    the infeasible tail's 1-10s backoffs would make each seed take
+    minutes). One copy so every serial regime runs the same config."""
     store = _fleet(rng)
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
-    # HybridClock virtualizes backoff waits (bench.py's idiom) — with the
-    # wall clock, the infeasible tail's 1-10s backoffs would make each
-    # seed take minutes
     sched = Scheduler(cluster, SchedulerConfig(
         max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=3600.0),
         clock=HybridClock())
+    return store, sched
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_burst_invariants(seed):
+    rng = random.Random(seed)
+    store, sched = _make_sched(rng)
     pods = _burst(rng)
     for p in pods:
         sched.submit(p)
@@ -326,12 +332,7 @@ def test_incremental_maxima_match_brute_force(seed):
     against silent drift — a stale or leaked tuple shows up as the first
     mismatching cycle, with the pod and both folds in the failure."""
     rng = random.Random(10_000 + seed)
-    store = _fleet(rng)
-    cluster = FakeCluster(store)
-    cluster.add_nodes_from_telemetry()
-    sched = Scheduler(cluster, SchedulerConfig(
-        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=3600.0),
-        clock=HybridClock())
+    store, sched = _make_sched(rng)
     maxc = next(p for p in sched.profile.pre_score
                 if getattr(p, "name", "") == "max-collection")
     mismatches = []
@@ -358,3 +359,24 @@ def test_incremental_maxima_match_brute_force(seed):
     # the REUSE path specifically must have fired (every seed does; the
     # class_stats fallback alone would make the oracle vacuous)
     assert maxc.fast_hits > 0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_burst_invariants_with_preemption(seed):
+    """The serial fuzz with priority labels sprinkled on ~40% of
+    non-gang pods: priority inversions under capacity pressure drive the
+    PostFilter preemption plugin (each of these seeds preempts at least
+    once — asserted, so the regime can't silently go quiet), and every
+    global invariant must survive the evict/requeue churn."""
+    rng = random.Random(90_000 + seed)
+    store, sched = _make_sched(rng)
+    pods = _burst(rng)
+    for p in pods:
+        if rng.random() < 0.4 and "tpu/gang-name" not in p.labels:
+            p.labels["scv/priority"] = str(rng.randint(1, 10))
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20000)
+    assert sched.metrics.counters.get("preemptions_total", 0) > 0, \
+        f"seed {seed}: the preemption regime went quiet"
+    _check_invariants(pods, store, seed)
