@@ -184,3 +184,34 @@ class TestRollingCache:
         prompt = jnp.zeros((1, 8), jnp.int32)
         with pytest.raises(ValueError, match="sliding_window"):
             generate(params, prompt, cfg, 4, rolling=True)
+
+
+class TestEagerDecode:
+    """generate(eager=True): the Python-driven decode loop (one donated
+    jitted dispatch per token — the mode for backends whose compiler
+    cannot handle a KV-writing while-loop, and for per-token serving
+    control) must produce token-identical output to the lax.scan path in
+    every regime."""
+
+    def test_eager_matches_scan_greedy(self, params, prompt):
+        want = generate(params, prompt, CFG, 6)
+        got = generate(params, prompt, CFG, 6, eager=True)
+        assert (got == want).all()
+
+    def test_eager_matches_scan_sampled(self, params, prompt):
+        k = jax.random.PRNGKey(7)
+        want = generate(params, prompt, CFG, 6, temperature=0.8, key=k)
+        got = generate(params, prompt, CFG, 6, temperature=0.8, key=k,
+                       eager=True)
+        assert (got == want).all()
+
+    def test_eager_matches_scan_rolling(self):
+        from dataclasses import replace
+
+        cfg = replace(CFG, sliding_window=8)
+        params = init_llama(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        want = generate(params, prompt, cfg, 6, rolling=True)
+        got = generate(params, prompt, cfg, 6, rolling=True, eager=True)
+        assert (got == want).all()

@@ -242,9 +242,24 @@ def decode_step_rolling(params, token, cache: RollingKVCache,
                                         next_pos=p + 1)
 
 
+@partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(1, 2))
+def _eager_step(params, logits, cache, k, step_fn, config, temperature):
+    """One eager decode dispatch: pick the next token from `logits`,
+    advance the cache. Module-level so the jit cache survives across
+    generate() calls — a per-call closure would recompile the decode
+    step on every serving request."""
+    if temperature > 0.0:
+        tok = jax.random.categorical(k, logits / temperature, axis=-1)
+    else:
+        tok = jnp.argmax(logits, axis=-1)
+    logits, cache = step_fn(params, tok, cache, config)
+    return logits, cache, tok
+
+
 def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
              temperature: float = 0.0, key: jax.Array | None = None,
-             max_len: int | None = None, rolling: bool | None = None):
+             max_len: int | None = None, rolling: bool | None = None,
+             eager: bool = False):
     """Generate `max_new_tokens` continuations of prompt [B, S].
 
     temperature 0 = greedy argmax; > 0 = categorical sampling (requires
@@ -257,6 +272,14 @@ def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
     O(window) decode HBM. Default: auto — rolling whenever the window is
     smaller than prompt + new tokens. The prompt-sized prefill cache is
     temporary either way.
+
+    `eager`: drive the decode loop from Python — one donated jitted
+    dispatch per token instead of one lax.scan program. Identical tokens.
+    For backends whose compiler cannot handle a while-loop that updates
+    the KV cache (this repo's TPU tunnel wedges indefinitely on one —
+    bisect in tools/debug_generate_hang*.py), and for serving loops that
+    need per-token control (streaming, stop sequences). Not jit-able as
+    a whole, by construction.
     """
     b, s = prompt.shape
     max_len = max_len or (s + max_new_tokens)
@@ -282,23 +305,27 @@ def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
         pre = KVCache.zeros(config, b, s)  # prompt-sized, then discarded
         logits, pre = prefill(params, prompt, pre, config)
         cache = RollingKVCache.from_prefill(pre, window)
+        step_fn = decode_step_rolling
+    else:
+        cache = KVCache.zeros(config, b, max_len)
+        logits, cache = prefill(params, prompt, cache, config)
+        step_fn = decode_step
 
-        def step_r(carry, k):
-            logits, cache = carry
-            tok = pick(logits, k)
-            logits, cache = decode_step_rolling(params, tok, cache, config)
-            return (logits, cache), tok
-
-        (_, _), tokens = jax.lax.scan(step_r, (logits, cache), keys)
-        return tokens.T
-
-    cache = KVCache.zeros(config, b, max_len)
-    logits, cache = prefill(params, prompt, cache, config)
+    if eager:
+        toks = []
+        for i in range(max_new_tokens):
+            logits, cache, tok = _eager_step(
+                params, logits, cache, keys[i], step_fn, config,
+                temperature)
+            toks.append(tok)
+        if not toks:  # the scan path returns [B, 0] too
+            return jnp.zeros((b, 0), jnp.int32)
+        return jnp.stack(toks, axis=1)  # [B, max_new_tokens]
 
     def step(carry, k):
         logits, cache = carry
         tok = pick(logits, k)
-        logits, cache = decode_step(params, tok, cache, config)
+        logits, cache = step_fn(params, tok, cache, config)
         return (logits, cache), tok
 
     (_, _), tokens = jax.lax.scan(step, (logits, cache), keys)
