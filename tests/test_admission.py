@@ -594,3 +594,104 @@ class TestMatchFields:
         # a malformed node pin must not be dropped: the term stays
         # unmatchable even though its matchExpressions would pass
         assert not affinity_matches(pod, {"pool": "x"}, "n")
+
+
+class TestNodeUnschedulable:
+    """kubectl cordon (Node spec.unschedulable) — upstream's
+    NodeUnschedulable plugin, which the reference inherited from the
+    embedded kube-scheduler. Checked directly, not only via the
+    auto-added node.kubernetes.io/unschedulable taint (the node
+    controller may lag or be disabled); pods tolerating that taint keep
+    upstream's escape hatch."""
+
+    def test_cordon_excludes_node(self):
+        c = _cluster(["a", "b"])
+        c.set_node_meta("a", unschedulable=True)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        pod = mk_pod("p1")
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "b"
+
+    def test_fully_cordoned_cluster_fails_pod(self):
+        c = _cluster(["a", "b"])
+        c.set_node_meta("a", unschedulable=True)
+        c.set_node_meta("b", unschedulable=True)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        pod = mk_pod("p1")
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.FAILED
+
+    def test_unschedulable_toleration_admits(self):
+        c = _cluster(["a"])
+        c.set_node_meta("a", unschedulable=True)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        pod = mk_pod("daemon", tolerations=[
+            {"key": "node.kubernetes.io/unschedulable",
+             "operator": "Exists", "effect": "NoSchedule", "value": ""}])
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "a"
+
+    def test_uncordon_wakes_pending_pod(self):
+        # cordon state flips through set_node_meta, which bumps the
+        # node's change counter: the unschedulable-class memo must not
+        # serve the stale verdict after the uncordon
+        c = _cluster(["a"])
+        c.set_node_meta("a", unschedulable=True)
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=0))
+        pod = mk_pod("waits")
+        sched.submit(pod)
+        for _ in range(3):
+            sched.run_one()
+        assert pod.phase == PodPhase.PENDING
+        c.set_node_meta("a", unschedulable=False)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND and pod.node == "a"
+
+    def test_preemption_never_plans_victims_on_cordoned_node(self):
+        """Both nodes full of low-priority victims, one node cordoned
+        after they bound: the high-priority pod must preempt on the
+        schedulable node only — evicting on the cordoned node would
+        disrupt a workload for a bind that can never follow."""
+        from yoda_scheduler_tpu.scheduler.core import HybridClock
+
+        c = _cluster(["cord", "ok"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3),
+                          clock=HybridClock())
+        f1 = mk_pod("f1", labels={"scv/number": "4"})
+        f2 = mk_pod("f2", labels={"scv/number": "4"})
+        sched.submit(f1)
+        sched.submit(f2)
+        sched.run_until_idle()
+        by_node = {f1.node: f1, f2.node: f2}
+        c.set_node_meta("cord", unschedulable=True)
+        hp = mk_pod("hp", labels={"scv/number": "1", "scv/priority": "9"})
+        sched.submit(hp)
+        sched.run_until_idle()
+        assert hp.phase == PodPhase.BOUND and hp.node == "ok"
+        assert by_node["cord"].phase == PodPhase.BOUND, \
+            "victim must never come from the cordoned node"
+
+    def test_admissible_helper_respects_cordon(self):
+        from yoda_scheduler_tpu.scheduler.plugins.admission import admissible
+        from yoda_scheduler_tpu.scheduler.framework import NodeInfo
+        pod = mk_pod("hi")
+        assert not admissible(pod, NodeInfo(name="x", metrics=None,
+                                            unschedulable=True))
+        assert admissible(pod, NodeInfo(name="x", metrics=None))
+
+    def test_api_parse_carries_unschedulable(self):
+        from yoda_scheduler_tpu.k8s.client import _node_meta_from_api
+        labels, taints, alloc, unsched = _node_meta_from_api({
+            "metadata": {"name": "n", "labels": {"a": "b"}},
+            "spec": {"unschedulable": True},
+        })
+        assert unsched is True and labels == {"a": "b"}
+        *_, unsched2 = _node_meta_from_api({"metadata": {"name": "n"}})
+        assert unsched2 is False

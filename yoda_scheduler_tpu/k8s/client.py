@@ -567,12 +567,17 @@ def _pod_from_api(item: dict) -> Pod | None:
     return p
 
 
-def _node_meta_from_api(item: dict) -> tuple[dict, tuple, tuple | None]:
+def _node_meta_from_api(item: dict) -> tuple[dict, tuple, tuple | None, bool]:
     """Node object -> (metadata.labels, spec.taints, status.allocatable as
-    (cpu millicores, memory bytes) or None) for the admission plugin
-    (plugins/admission.py). Taints normalised to plain dicts."""
+    (cpu millicores, memory bytes) or None, spec.unschedulable) for the
+    admission plugin (plugins/admission.py). Taints normalised to plain
+    dicts; unschedulable is kubectl cordon's flag (upstream
+    NodeUnschedulable — checked directly, not only via the auto-added
+    node.kubernetes.io/unschedulable taint, which the node controller may
+    lag on or omit)."""
     from ..utils.quantity import parse_cpu_millis, parse_memory_bytes
 
+    spec = item.get("spec", {}) or {}
     labels = dict(item.get("metadata", {}).get("labels", {}) or {})
     taints = tuple(
         {
@@ -580,7 +585,7 @@ def _node_meta_from_api(item: dict) -> tuple[dict, tuple, tuple | None]:
             "value": t.get("value", ""),
             "effect": t.get("effect", ""),
         }
-        for t in item.get("spec", {}).get("taints", []) or []
+        for t in spec.get("taints", []) or []
     )
     alloc_raw = (item.get("status") or {}).get("allocatable")
     alloc = None
@@ -590,7 +595,7 @@ def _node_meta_from_api(item: dict) -> tuple[dict, tuple, tuple | None]:
         if cpu is not None or mem is not None:
             alloc = (cpu if cpu is not None else 1 << 60,
                      mem if mem is not None else 1 << 60)
-    return labels, taints, alloc
+    return labels, taints, alloc, bool(spec.get("unschedulable"))
 
 
 def _rv_of(obj: dict) -> str | None:
@@ -735,7 +740,7 @@ class KubeCluster:
         self.watch_mode = client.can_stream if watch is None else watch
         self._lock = threading.RLock()
         self._nodes: set[str] = set()
-        self._node_meta: dict[str, tuple[dict, tuple]] = {}  # name -> (labels, taints)
+        self._node_meta: dict[str, tuple] = {}  # name -> (labels, taints, allocatable, unschedulable)
         self._pdbs: tuple = ()                   # DisruptionBudget models
         self._namespaces: dict[str, dict] = {}   # ns -> metadata.labels
         # namespace source state: until the first successful LIST, and
@@ -811,7 +816,7 @@ class KubeCluster:
             # a label/taint edit must invalidate the node's cached NodeInfo
             # and filter verdicts even though membership is unchanged
             for n, meta in metas.items():
-                if self._node_meta.get(n, ({}, (), None)) != meta:
+                if self._node_meta.get(n, ({}, (), None, False)) != meta:
                     self._bump(n)
             self._nodes = names
             self._node_meta = metas
@@ -833,7 +838,7 @@ class KubeCluster:
                     self._bump(name)
                 self._nodes.add(name)
                 meta = _node_meta_from_api(obj)
-                if self._node_meta.get(name, ({}, (), None)) != meta:
+                if self._node_meta.get(name, ({}, (), None, False)) != meta:
                     self._node_meta[name] = meta
                     self._bump(name)
 
@@ -1086,7 +1091,7 @@ class KubeCluster:
         """Node-object (metadata.labels, spec.taints) for the admission
         plugin; empty for unknown nodes."""
         with self._lock:
-            return self._node_meta.get(name, ({}, (), None))[:2]
+            return self._node_meta.get(name, ({}, (), None, False))[:2]
 
     def node_allocatable(self, name: str) -> tuple | None:
         """status.allocatable as (cpu millicores, memory bytes), or None
@@ -1094,6 +1099,12 @@ class KubeCluster:
         with self._lock:
             meta = self._node_meta.get(name)
             return meta[2] if meta is not None else None
+
+    def node_unschedulable(self, name: str) -> bool:
+        """Node spec.unschedulable (kubectl cordon)."""
+        with self._lock:
+            meta = self._node_meta.get(name)
+            return bool(meta[3]) if meta is not None else False
 
     def pods_version(self, node: str) -> int:
         with self._lock:

@@ -133,25 +133,32 @@ class FakeCluster:
 
     def set_node_meta(self, name: str, labels: dict[str, str] | None = None,
                       taints: list[dict] | tuple = (),
-                      allocatable: tuple | None = None) -> None:
+                      allocatable: tuple | None = None,
+                      unschedulable: bool = False) -> None:
         """Node-object metadata.labels / spec.taints / status.allocatable
-        as (cpu millicores, memory bytes) (admission plugin inputs). Bumps
-        the node's change counter: an edit must invalidate cached
-        NodeInfos and filter verdicts."""
+        as (cpu millicores, memory bytes) / spec.unschedulable (cordon)
+        (admission plugin inputs). Bumps the node's change counter: an
+        edit must invalidate cached NodeInfos and filter verdicts — and
+        an uncordon must wake pending classmates event-driven."""
         with self._lock:
             self.add_node(name)
             self._meta[name] = (dict(labels or {}), tuple(taints),
-                                allocatable)
+                                allocatable, bool(unschedulable))
             self._bump(name)
 
     def node_meta(self, name: str) -> tuple[dict[str, str], tuple]:
         with self._lock:
-            return self._meta.get(name, ({}, (), None))[:2]
+            return self._meta.get(name, ({}, (), None, False))[:2]
 
     def node_allocatable(self, name: str) -> tuple | None:
         with self._lock:
             meta = self._meta.get(name)
             return meta[2] if meta is not None else None
+
+    def node_unschedulable(self, name: str) -> bool:
+        with self._lock:
+            meta = self._meta.get(name)
+            return bool(meta[3]) if meta is not None else False
 
     # ---------------------------------------------------------------- reading
     def node_names(self) -> list[str]:

@@ -481,6 +481,10 @@ class Scheduler:
                             p.preferred_pod_affinity
                             for n in dirty if n in infos
                             for p in infos[n].pods)
+                    if snap._any_unsched is not None:
+                        fresh._any_unsched = snap._any_unsched or any(
+                            infos[n].unschedulable
+                            for n in dirty if n in infos)
                     self._snap = (fresh, pv, tv, nv0)
                     return fresh
         return self._full_snapshot()
@@ -495,13 +499,16 @@ class Scheduler:
         meta_fn = getattr(cluster, "node_meta", None)
         labels, taints = meta_fn(name) if meta_fn is not None else ({}, ())
         alloc_fn = getattr(cluster, "node_allocatable", None)
+        unsched_fn = getattr(cluster, "node_unschedulable", None)
         if metrics is _UNSET:
             metrics = cluster.telemetry.get(name)
         return NodeInfo(name=name, metrics=metrics,
                         pods=cluster.pods_on(name), labels=labels,
                         taints=taints,
                         allocatable=alloc_fn(name)
-                        if alloc_fn is not None else None)
+                        if alloc_fn is not None else None,
+                        unschedulable=bool(unsched_fn(name))
+                        if unsched_fn is not None else False)
 
     def _full_snapshot(self) -> Snapshot:
         cluster = self.cluster

@@ -132,6 +132,11 @@ class NodeInfo:
     # node reports no allocatable, i.e. no cpu/mem constraint (in-memory
     # fakes and accelerator-only deployments)
     allocatable: tuple | None = None
+    # Node spec.unschedulable (kubectl cordon) — upstream's
+    # NodeUnschedulable plugin, which the reference inherited from the
+    # embedded kube-scheduler; honored in plugins/admission.py with the
+    # standard toleration escape hatch
+    unschedulable: bool = False
     # process-unique identity for version-keyed caches (id() can be reused
     # after GC; the serial never is). A NodeInfo is immutable once built, so
     # serial equality == same telemetry + same bound-pod set.
@@ -224,6 +229,7 @@ class Snapshot:
         self._any_pod_anti: bool | None = None
         self._any_alloc: bool | None = None
         self._any_pref_pod: bool | None = None
+        self._any_unsched: bool | None = None
 
     def get(self, name: str) -> NodeInfo | None:
         return self._node_infos.get(name)
@@ -247,6 +253,15 @@ class Snapshot:
             self._any_taints = any(
                 ni.taints for ni in self._node_infos.values())
         return self._any_taints
+
+    def any_unschedulable(self) -> bool:
+        """True when at least one node is cordoned (spec.unschedulable) —
+        gates the admission cordon check out of the hot loops on the
+        common fully-schedulable cluster, like any_taints."""
+        if self._any_unsched is None:
+            self._any_unsched = any(
+                ni.unschedulable for ni in self._node_infos.values())
+        return self._any_unsched
 
     def any_allocatable(self) -> bool:
         """True when any node reports status.allocatable — without one,

@@ -31,6 +31,16 @@ NO_SCHEDULE = "NoSchedule"
 NO_EXECUTE = "NoExecute"
 PREFER_NO_SCHEDULE = "PreferNoSchedule"
 
+# the taint the node controller adds for a cordoned node; upstream's
+# NodeUnschedulable plugin checks spec.unschedulable directly but admits
+# pods tolerating this taint — same escape hatch here
+UNSCHEDULABLE_TAINT = {"key": "node.kubernetes.io/unschedulable",
+                       "value": "", "effect": NO_SCHEDULE}
+
+
+def _tolerates_cordon(pod: Pod) -> bool:
+    return not untolerated(pod, (UNSCHEDULABLE_TAINT,), (NO_SCHEDULE,))
+
 
 def tolerates(toleration: dict, taint: dict) -> bool:
     """One toleration vs one taint, k8s semantics."""
@@ -249,6 +259,8 @@ def admissible(pod: Pod, node: NodeInfo) -> bool:
         return False
     if node.taints and untolerated(pod, node.taints,
                                    (NO_SCHEDULE, NO_EXECUTE)):
+        return False
+    if node.unschedulable and not _tolerates_cordon(pod):
         return False
     return True
 
@@ -500,6 +512,7 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                 or (bool(pod.cpu_millis or pod.memory_bytes)
                     and snapshot.any_allocatable())
                 or snapshot.any_taints()
+                or snapshot.any_unschedulable()
                 or snapshot.any_pod_anti_affinity())
 
     def score_relevant(self, pod: Pod, snapshot) -> bool:
@@ -514,6 +527,13 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                 or snapshot.any_taints())
 
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
+        # NodeUnschedulable (kubectl cordon): upstream checks
+        # spec.unschedulable itself — relying on the auto-added
+        # unschedulable taint alone would admit pods while the node
+        # controller lags
+        if node.unschedulable and not _tolerates_cordon(pod):
+            return Status.unschedulable(
+                f"{node.name}: node is cordoned (spec.unschedulable)")
         sel = pod.node_selector
         if sel:
             labels = node.labels
