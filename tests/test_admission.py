@@ -695,3 +695,149 @@ class TestNodeUnschedulable:
         assert unsched is True and labels == {"a": "b"}
         *_, unsched2 = _node_meta_from_api({"metadata": {"name": "n"}})
         assert unsched2 is False
+
+
+class TestNodePorts:
+    """Upstream NodePorts plugin parity: container hostPorts are
+    node-exclusive per (port, protocol, overlapping hostIP); the
+    reference inherited this from the embedded kube-scheduler."""
+
+    def _pod(self, name, ports, prio=None):
+        labels = {"scv/number": "1"}
+        if prio is not None:
+            labels["scv/priority"] = str(prio)
+        return Pod(name, labels=labels, host_ports=tuple(ports))
+
+    def test_conflict_routes_to_free_node(self):
+        c = _cluster(["a", "b"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9))
+        p1 = self._pod("p1", [(8080, "TCP", "")])
+        p2 = self._pod("p2", [(8080, "TCP", "")])
+        sched.submit(p1)
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND and p2.phase == PodPhase.BOUND
+        assert p1.node != p2.node
+
+    def test_conflict_fails_when_no_free_node(self):
+        c = _cluster(["a"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        p1 = self._pod("p1", [(443, "TCP", "")])
+        p2 = self._pod("p2", [(443, "TCP", "10.0.0.1")])  # wildcard overlap
+        sched.submit(p1)
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND
+        assert p2.phase == PodPhase.FAILED
+
+    def test_different_protocol_coexists(self):
+        c = _cluster(["a"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        p1 = self._pod("p1", [(53, "TCP", "")])
+        p2 = self._pod("p2", [(53, "UDP", "")])
+        sched.submit(p1)
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND and p2.phase == PodPhase.BOUND
+        assert p1.node == p2.node == "a"
+
+    def test_distinct_ips_coexist(self):
+        c = _cluster(["a"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        p1 = self._pod("p1", [(80, "TCP", "10.0.0.1")])
+        p2 = self._pod("p2", [(80, "TCP", "10.0.0.2")])
+        sched.submit(p1)
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND and p2.phase == PodPhase.BOUND
+
+    def test_preemption_evicts_port_holder(self):
+        from yoda_scheduler_tpu.scheduler.core import HybridClock
+
+        c = _cluster(["a"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=3),
+                          clock=HybridClock())
+        low = self._pod("low", [(9000, "TCP", "")], prio=1)
+        sched.submit(low)
+        sched.run_until_idle()
+        assert low.phase == PodPhase.BOUND
+        hi = self._pod("hi", [(9000, "TCP", "")], prio=9)
+        sched.submit(hi)
+        sched.run_until_idle()
+        assert hi.phase == PodPhase.BOUND and hi.node == "a"
+        assert low.phase != PodPhase.BOUND, \
+            "the conflicting low-priority holder must have been evicted"
+
+    def test_manifest_parse(self):
+        p = Pod.from_manifest({
+            "metadata": {"name": "x"},
+            "spec": {"containers": [
+                {"ports": [{"hostPort": 80, "protocol": "UDP",
+                            "hostIP": "1.2.3.4"},
+                           {"containerPort": 8080}]},
+            ], "initContainers": [{"ports": [{"hostPort": 81}]}]},
+        })
+        assert p.host_ports == ((80, "UDP", "1.2.3.4"), (81, "TCP", ""))
+
+    def test_wildcard_0000_overlaps_specific_ip(self):
+        c = _cluster(["a"])
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=1))
+        p1 = self._pod("p1", [(80, "TCP", "0.0.0.0")])
+        p2 = self._pod("p2", [(80, "TCP", "10.0.0.1")])
+        sched.submit(p1)
+        sched.submit(p2)
+        sched.run_until_idle()
+        assert p1.phase == PodPhase.BOUND
+        assert p2.phase == PodPhase.FAILED, \
+            "0.0.0.0 is the bind-all address and overlaps every hostIP"
+
+    def test_nominated_hold_protects_freed_port(self):
+        """The steal window: a preemption's victim drains GRACEFULLY
+        (real API-server eviction), the victim finally disappears, and a
+        lower-priority port claimant's cycle runs before the nominated
+        preemptor's backoff expires. The freed port must be held for the
+        preemptor — the ports twin of the cpu/mem nominated hold;
+        without it the claimant binds the port and the preemptor must
+        preempt a second time (churn)."""
+        from yoda_scheduler_tpu.scheduler.core import HybridClock
+
+        c = _cluster(["a"])
+
+        # graceful eviction: the victim keeps its binding while draining
+        real_evict = c.evict
+
+        def graceful_evict(pod):
+            pod.terminating = True
+
+        c.evict = graceful_evict
+        sched = Scheduler(c, SchedulerConfig(telemetry_max_age_s=1e9,
+                                             max_attempts=6),
+                          clock=HybridClock())
+        low = self._pod("low", [(9000, "TCP", "")], prio=1)
+        sched.submit(low)
+        sched.run_until_idle()
+        assert low.phase == PodPhase.BOUND
+        hi = self._pod("hi", [(9000, "TCP", "")], prio=9)
+        sched.submit(hi)
+        sched.run_one()      # hi preempts: low starts draining, hi nominated
+        assert low.terminating and low.phase == PodPhase.BOUND
+        sched.run_one()      # hi's retry parks: waiting for victims
+        mid = self._pod("mid", [(9000, "TCP", "")], prio=5)
+        sched.submit(mid)
+        real_evict(low)      # drain completes: the window is open
+        sched.run_one()      # mid's cycle runs first (hi still in backoff)
+        assert mid.phase != PodPhase.BOUND, \
+            "mid must not steal the port held for the nominated preemptor"
+        sched.run_until_idle()
+        assert hi.phase == PodPhase.BOUND and hi.node == "a"
+        assert mid.phase != PodPhase.BOUND
+        # the discriminating assertion: without the hold mid binds inside
+        # the window and a SECOND preemption (of mid) is needed
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 1, \
+            "only the original holder may be evicted; the hold must stop " \
+            "the steal/preempt-again churn"

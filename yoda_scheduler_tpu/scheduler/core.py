@@ -805,7 +805,8 @@ class Scheduler:
                             self.allocator.nominate(
                                 pod.key, nominated, spec.chips, spec.priority,
                                 cpu_millis=pod.cpu_millis,
-                                memory_bytes=pod.memory_bytes)
+                                memory_bytes=pod.memory_bytes,
+                                host_ports=pod.host_ports)
                     self.metrics.inc("preemptions_total")
                     # budget-violating preemptions are legal (best-effort,
                     # upstream semantics) but operators need to SEE them

@@ -148,6 +148,7 @@ class NodeInfo:
     _claimed_hbm: int | None = field(default=None, repr=False, compare=False)
     _assigned: set | None = field(default=None, repr=False, compare=False)
     _req_cpu_mem: tuple | None = field(default=None, repr=False, compare=False)
+    _host_ports: tuple | None = field(default=None, repr=False, compare=False)
 
     def claimed_chips(self) -> int:
         """Chips already claimed by bound pods' labels (allocation view)."""
@@ -192,6 +193,17 @@ class NodeInfo:
                 mem += p.memory_bytes
             self._req_cpu_mem = (cpu, mem)
         return self._req_cpu_mem
+
+    def used_host_ports(self) -> tuple:
+        """(hostPort, protocol, hostIP) triples bound pods hold — upstream
+        NodePorts accounting. Terminating pods count, like cpu/mem above:
+        the port stays bound until the pod is gone. Memoized per NodeInfo."""
+        if self._host_ports is None:
+            out = []
+            for p in self.pods:
+                out.extend(p.host_ports)
+            self._host_ports = tuple(out)
+        return self._host_ports
 
     def assigned_coords(self) -> set[tuple[int, int, int]]:
         """ICI coords claimed by bound pods (from bind-time chip assignment)."""

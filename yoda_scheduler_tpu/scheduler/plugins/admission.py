@@ -42,6 +42,23 @@ def _tolerates_cordon(pod: Pod) -> bool:
     return not untolerated(pod, (UNSCHEDULABLE_TAINT,), (NO_SCHEDULE,))
 
 
+_WILDCARD_IPS = ("", "0.0.0.0")
+
+
+def ports_conflict(a: tuple, b: tuple) -> bool:
+    """Two (hostPort, protocol, hostIP) claims conflict iff the port and
+    protocol match and the host IPs overlap — "" and "0.0.0.0" are both
+    the bind-all address, overlapping everything (upstream NodePorts
+    semantics, DefaultBindAllHostIP)."""
+    return (a[0] == b[0] and a[1] == b[1]
+            and (a[2] == b[2] or a[2] in _WILDCARD_IPS
+                 or b[2] in _WILDCARD_IPS))
+
+
+def _port_conflicts(wanted: tuple, held: tuple) -> bool:
+    return any(ports_conflict(w, h) for w in wanted for h in held)
+
+
 def tolerates(toleration: dict, taint: dict) -> bool:
     """One toleration vs one taint, k8s semantics."""
     effect = toleration.get("effect", "")
@@ -436,6 +453,19 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
     preemption planner so it never churns victims on a node the
     preemptor still couldn't pass (the same contract admissible() gives
     it for node-level admission)."""
+    # NodePorts: a port conflict is curable only when every conflicting
+    # holder can be evicted (terminating holders free it on their own);
+    # the evictions join the plan so the bind actually succeeds
+    port_victims: list[Pod] = []
+    if pod.host_ports:
+        for p in node.pods:
+            if p.host_ports and _port_conflicts(pod.host_ports,
+                                                p.host_ports):
+                if p.terminating:
+                    continue
+                if not evictable_fn(p):
+                    return None
+                port_victims.append(p)
     # NodeResourcesFit: if even evicting every evictable pod leaves too
     # little cpu/mem for the preemptor, the node is uncurable
     if (pod.cpu_millis or pod.memory_bytes) and node.allocatable is not None:
@@ -459,7 +489,7 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
             return None
     if not (pod.pod_affinity or pod.pod_anti_affinity
             or snapshot.any_pod_anti_affinity()):
-        return []
+        return port_victims
     aff, anti, reverse = _pod_affinity_index(state, pod, snapshot)
     labels = node.labels
     for term, domains in aff:
@@ -484,6 +514,8 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
             if not evictable_fn(owner):
                 return None
             must[owner.key] = owner
+    for v in port_victims:
+        must.setdefault(v.key, v)
     return list(must.values())
 
 
@@ -509,6 +541,7 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
                 or bool(pod.pod_anti_affinity)
                 or bool(pod.preferred_pod_affinity)
                 or bool(pod.topology_spread)
+                or bool(pod.host_ports)
                 or (bool(pod.cpu_millis or pod.memory_bytes)
                     and snapshot.any_allocatable())
                 or snapshot.any_taints()
@@ -556,6 +589,21 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
             st = self._filter_spread(state, pod, node, snapshot)
             if not st.ok:
                 return st
+        # NodePorts: a claimed hostPort must not collide with one a bound
+        # pod already holds (wildcard hostIP overlaps everything) — nor
+        # with a port held for a nominated preemptor of outranking
+        # priority (the ports twin of the cpu/mem hold below: a third
+        # pod must not bind the port a preemption just freed)
+        if pod.host_ports:
+            held = node.used_host_ports()
+            if self.allocator is not None:
+                spec = state.read_or("workload_spec")
+                held = held + self.allocator.nominated_ports(
+                    node.name, spec.priority if spec is not None else 0,
+                    pod.key)
+            if _port_conflicts(pod.host_ports, held):
+                return Status.unschedulable(
+                    f"{node.name}: hostPort already in use")
         # NodeResourcesFit: cpu/memory requests vs node allocatable
         # (nodes reporting no allocatable are unconstrained — in-memory
         # fakes and accelerator-only fleets)

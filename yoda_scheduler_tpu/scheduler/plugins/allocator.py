@@ -79,7 +79,7 @@ class ChipAllocator(ReservePlugin):
         # free pool, but pods of lower-or-equal priority must not consume
         # them first (or co-hosted profiles rebind victims into the hole
         # and the preemptor livelocks).
-        self._nominated: dict[str, tuple] = {}  # pod.key -> (node, chips, priority, cpu_millis, memory_bytes)
+        self._nominated: dict[str, tuple] = {}  # pod.key -> (node, chips, priority, cpu_millis, memory_bytes, host_ports)
         # gang-level nominations: a gang that preempted is entitled to
         # `chips_per_host` on EVERY host of its chosen slice until it
         # completes, fails, or the entitlement expires — victims free
@@ -245,10 +245,12 @@ class ChipAllocator(ReservePlugin):
 
     # ---------------------------------------------------------- nominations
     def nominate(self, pod_key: str, node: str, chips: int, priority: int,
-                 cpu_millis: int = 0, memory_bytes: int = 0) -> None:
+                 cpu_millis: int = 0, memory_bytes: int = 0,
+                 host_ports: tuple = ()) -> None:
         with self._lock:
             self._nominated[pod_key] = (node, chips, priority,
-                                        cpu_millis, memory_bytes)
+                                        cpu_millis, memory_bytes,
+                                        host_ports)
             self._changes.record(node)
 
     def unnominate(self, pod_key: str) -> None:
@@ -258,8 +260,8 @@ class ChipAllocator(ReservePlugin):
                 self._changes.record(nom[0])
 
     def nomination_of(self, pod_key: str) -> tuple | None:
-        """(node, chips, priority, cpu_millis, memory_bytes) this pod is
-        entitled to, if any."""
+        """(node, chips, priority, cpu_millis, memory_bytes, host_ports)
+        this pod is entitled to, if any."""
         with self._lock:
             return self._nominated.get(pod_key)
 
@@ -357,6 +359,22 @@ class ChipAllocator(ReservePlugin):
                     cpu += nom[3]
                     mem += nom[4]
             return cpu, mem
+
+    def nominated_ports(self, node: str, priority: int,
+                        exclude_key: str | None = None) -> tuple:
+        """hostPort claims on `node` held for nominated preemptors that
+        outrank (or tie) `priority` — the ports twin of
+        nominated_cpu_mem, so a third pod cannot bind the port a
+        preemption freed during the victims' drain window."""
+        if not self._nominated:
+            return ()
+        with self._lock:
+            out = []
+            for key, nom in self._nominated.items():
+                if nom[0] == node and nom[2] >= priority \
+                        and key != exclude_key:
+                    out.extend(nom[5])
+            return tuple(out)
 
     def holds_for(self, spec: WorkloadSpec, node_info: NodeInfo,
                   pod_key: str | None, now: float | None = None) -> int:

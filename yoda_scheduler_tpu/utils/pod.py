@@ -10,7 +10,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from .memo import memo
-from .quantity import pod_requests
+from .quantity import pod_host_ports, pod_requests
 
 
 class PodPhase(str, Enum):
@@ -321,6 +321,9 @@ class Pod:
     # inputs): cpu in millicores, memory in bytes; 0 = unconstrained
     cpu_millis: int = 0
     memory_bytes: int = 0
+    # container hostPorts (upstream NodePorts plugin inputs): tuple of
+    # (port, protocol, hostIP) — empty hostIP means the wildcard address
+    host_ports: tuple = ()
     created: float = field(default_factory=time.time)
 
     @property
@@ -398,4 +401,5 @@ class Pod:
             topology_spread=_parse_topology_spread(spec),
             cpu_millis=cpu_m,
             memory_bytes=mem_b,
+            host_ports=pod_host_ports(spec),
         )

@@ -100,3 +100,25 @@ def pod_requests(spec) -> tuple[int, int]:
         cpu = max(cpu, c_cpu)
         mem = max(mem, c_mem)
     return cpu, mem
+
+
+def pod_host_ports(spec) -> tuple:
+    """(hostPort, protocol, hostIP) triples a Pod spec claims on its node
+    (upstream NodePorts plugin inputs), across containers and
+    initContainers. Protocol defaults to TCP, hostIP to "" (the wildcard
+    address). Entries without hostPort claim nothing."""
+    if not isinstance(spec, dict):
+        return ()
+    out = []
+    for field in ("containers", "initContainers"):
+        lst = spec.get(field)
+        for c in (lst if isinstance(lst, list) else []):
+            ports = c.get("ports") if isinstance(c, dict) else None
+            for p in (ports if isinstance(ports, list) else []):
+                if not isinstance(p, dict):
+                    continue
+                hp = p.get("hostPort")
+                if isinstance(hp, int) and not isinstance(hp, bool) and hp > 0:
+                    out.append((hp, p.get("protocol") or "TCP",
+                                p.get("hostIP") or ""))
+    return tuple(out)
