@@ -109,12 +109,15 @@ class FakeApiState:
 
     def add_node(self, name: str, labels: dict | None = None,
                  taints: list | None = None,
-                 allocatable: dict | None = None) -> None:
+                 allocatable: dict | None = None,
+                 unschedulable: bool = False) -> None:
         obj: dict = {"metadata": {"name": name}}
         if labels:
             obj["metadata"]["labels"] = dict(labels)
         if taints:
-            obj["spec"] = {"taints": list(taints)}
+            obj.setdefault("spec", {})["taints"] = list(taints)
+        if unschedulable:
+            obj.setdefault("spec", {})["unschedulable"] = True
         if allocatable:
             obj["status"] = {"allocatable": dict(allocatable)}
         self.upsert("nodes", obj)

@@ -230,24 +230,48 @@ class TestPreemptionRespectsAdmission:
             "victim must come from the admissible node only"
 
 
+def _wait_for(cond, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+class _LiveScheduler:
+    """Context manager: run the real serve loop (KubeClient + watch cache
+    over live HTTP) against a FakeApiServer in a daemon thread."""
+
+    def __init__(self, server):
+        import threading
+
+        from yoda_scheduler_tpu.k8s.client import (
+            KubeClient, run_scheduler_against_cluster)
+
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=run_scheduler_against_cluster,
+            args=(KubeClient(server.url), [(SchedulerConfig(), None)]),
+            kwargs={"metrics_port": None, "poll_s": 0.05,
+                    "stop_event": self._stop},
+            daemon=True)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=5.0)
+
+
 class TestLiveTransport:
     def test_meta_flows_through_watch_cache_and_gates_binds(self):
         """Node labels/taints travel API server -> watch cache -> NodeInfo:
         a nodeSelector pod lands on the labeled node and an untolerated
         NoSchedule taint keeps the other node off-limits, over real HTTP."""
-        import threading
-
         from fake_apiserver import FakeApiServer
-        from yoda_scheduler_tpu.k8s.client import (
-            KubeClient, run_scheduler_against_cluster)
-
-        def wait_for(cond, timeout=10.0, step=0.02):
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
-                if cond():
-                    return True
-                time.sleep(step)
-            return False
 
         with FakeApiServer() as server:
             server.state.add_node("gold", labels={"pool": "gold"})
@@ -276,24 +300,44 @@ class TestLiveTransport:
                 "spec": {"schedulerName": "yoda-scheduler"},
                 "status": {"phase": "Pending"},
             })
-            client = KubeClient(server.url)
-            stop = threading.Event()
-            t = threading.Thread(
-                target=run_scheduler_against_cluster,
-                args=(client, [(SchedulerConfig(), None)]),
-                kwargs={"metrics_port": None, "poll_s": 0.05,
-                        "stop_event": stop},
-                daemon=True)
-            t.start()
-            try:
-                assert wait_for(lambda: all(
+            with _LiveScheduler(server):
+                assert _wait_for(lambda: all(
                     (server.state.pod(n) or {}).get("spec", {}).get("nodeName")
                     for n in ("sel", "plain"))), "pods never bound"
                 assert server.state.pod("sel")["spec"]["nodeName"] == "gold"
                 assert server.state.pod("plain")["spec"]["nodeName"] == "gold"
-            finally:
-                stop.set()
-                t.join(timeout=5.0)
+
+    def test_cordon_flows_through_watch_cache(self):
+        """Node spec.unschedulable travels API server -> reflector ->
+        NodeInfo over real HTTP: the cordoned node never receives a
+        bind even though its telemetry is healthy."""
+        from fake_apiserver import FakeApiServer
+
+        with FakeApiServer() as server:
+            server.state.add_node("corded", unschedulable=True)
+            server.state.add_node("open")
+            for n in ("corded", "open"):
+                server.state.put_metrics(make_tpu_node(n, chips=4).to_cr())
+            for i in range(2):
+                server.state.add_pod({
+                    "metadata": {"name": f"w{i}", "namespace": "default",
+                                 "labels": {"scv/number": "1"},
+                                 "ownerReferences": [{
+                                     "kind": "ReplicaSet", "name": "rs",
+                                     "controller": True}]},
+                    "spec": {"schedulerName": "yoda-scheduler"},
+                    "status": {"phase": "Pending"},
+                })
+            with _LiveScheduler(server):
+                # both pods fit the open node; waiting for BOTH means a
+                # late wrong bind cannot slip past the assertion
+                assert _wait_for(lambda: all(
+                    (server.state.pod(f"w{i}") or {})
+                    .get("spec", {}).get("nodeName") for i in range(2))), \
+                    "pods never bound"
+                for i in range(2):
+                    node = server.state.pod(f"w{i}")["spec"]["nodeName"]
+                    assert node == "open", f"w{i} bound {node}"
 
 
 class TestManifestParsing:
