@@ -26,6 +26,18 @@ def prompt():
                               CFG.vocab_size)
 
 
+@pytest.fixture(scope="module")
+def tp_sharded(params):
+    """(mesh, tp/dp-sharded params) — one sharded compile shared by the
+    sharded-decode tests."""
+    from yoda_scheduler_tpu.parallel import llama_shardings, make_mesh
+
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    sharded = jax.tree.map(lambda x, sh: jax.device_put(x, sh), params,
+                           llama_shardings(mesh, CFG))
+    return mesh, sharded
+
+
 def _greedy_reference(params, prompt, n):
     toks = prompt
     out = []
@@ -82,15 +94,10 @@ class TestSampling:
 
 class TestShardedDecode:
     def test_generate_over_tp_mesh_matches_single_device(self, params,
-                                                         prompt):
-        from jax.sharding import NamedSharding
-        from yoda_scheduler_tpu.parallel import llama_shardings, make_mesh
-
+                                                         prompt,
+                                                         tp_sharded):
         single = jax.jit(lambda p, t: generate(p, t, CFG, 6))(params, prompt)
-        mesh = make_mesh({"dp": 2, "tp": 2})
-        sharded_params = jax.tree.map(
-            lambda x, sh: jax.device_put(x, sh), params,
-            llama_shardings(mesh, CFG))
+        _, sharded_params = tp_sharded
         got = jax.jit(lambda p, t: generate(p, t, CFG, 6))(
             sharded_params, prompt)
         # sharded collectives reorder the bf16 reductions, so a late token
@@ -215,3 +222,18 @@ class TestEagerDecode:
         want = generate(params, prompt, cfg, 6, rolling=True)
         got = generate(params, prompt, cfg, 6, rolling=True, eager=True)
         assert (got == want).all()
+
+    def test_eager_over_tp_mesh_matches_scan(self, prompt, tp_sharded):
+        """The serving-relevant combination: eager per-token dispatch
+        with tp-sharded params/caches must produce the same tokens as
+        the scan path under the same sharding. The two sides are
+        differently-compiled programs (one whole-program GSPMD jit vs
+        per-step jits), so bf16 reduction order can flip a near-tie on
+        a late token — only the early tokens must agree exactly, like
+        the single-device comparison above."""
+        _, sharded_params = tp_sharded
+        want = jax.jit(lambda p, t: generate(p, t, CFG, 6))(
+            sharded_params, prompt)
+        got = generate(sharded_params, prompt, CFG, 6, eager=True)
+        assert jnp.array_equal(want[:, :4], got[:, :4])
+        assert got.shape == want.shape
