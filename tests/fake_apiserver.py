@@ -32,7 +32,15 @@ class FakeApiState:
     KINDS = ("pods", "nodes", "metrics", "poddisruptionbudgets")
 
     def __init__(self):
-        self.cond = threading.Condition()
+        _lock = threading.RLock()
+        self.cond = threading.Condition(_lock)
+        # per-kind watcher parking, sharing the SAME lock as self.cond: a
+        # pod event must wake only the pods watch thread — with a single
+        # shared condition every bind MODIFIED woke the node/metrics/pdb
+        # streams too, and at 1000-pod-burst rates those spurious GIL
+        # handoffs were a measurable slice of the server's cost
+        self.kind_conds = {k: threading.Condition(_lock)
+                           for k in self.KINDS}
         self.rv = 0
         self.objects: dict[str, dict[str, dict]] = {k: {} for k in self.KINDS}
         self.events: dict[str, list[tuple[int, str, dict]]] = {
@@ -72,6 +80,7 @@ class FakeApiState:
             typ = typ or ("MODIFIED" if k in self.objects[kind] else "ADDED")
             obj = self._stamp(kind, obj, typ)
             self.objects[kind][k] = obj
+            self.kind_conds[kind].notify_all()
             self.cond.notify_all()
             return obj
 
@@ -80,6 +89,7 @@ class FakeApiState:
             obj = self.objects[kind].pop(key, None)
             if obj is not None:
                 self._stamp(kind, obj, "DELETED")
+                self.kind_conds[kind].notify_all()
                 self.cond.notify_all()
             return obj
 
@@ -89,6 +99,7 @@ class FakeApiState:
         with self.cond:
             self.compact_below[kind] = self.rv
             self.events[kind].clear()
+            self.kind_conds[kind].notify_all()
             self.cond.notify_all()
 
     def fail(self, path_substring: str, status: int, times: int = 1,
@@ -189,7 +200,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._raw_body = self.rfile.read(n) if n else b""
         with s.cond:
             s.requests.append((method, path))
-        fault = self._injected_fault(path, method)
+        # list-emptiness read is GIL-atomic; only take the state lock
+        # again when a test actually armed fault injection (burst traffic
+        # was paying two global-lock round-trips per request)
+        fault = self._injected_fault(path, method) if s.faults else None
         if fault is not None and fault != -1:
             return self._json(fault, {"kind": "Status", "code": fault})
         base, _, query = path.partition("?")
@@ -309,7 +323,9 @@ class _Handler(BaseHTTPRequestHandler):
                 i = bisect.bisect_right(evs, last, key=rv_of)
                 batch = evs[i:]
                 if not batch:
-                    s.cond.wait(timeout=min(0.2, max(
+                    # park on this kind's condition (shared lock with
+                    # s.cond): only events of our own kind wake us
+                    s.kind_conds[kind].wait(timeout=min(0.2, max(
                         deadline - time.monotonic(), 0.01)))
                     evs = s.events[kind]
                     i = bisect.bisect_right(evs, last, key=rv_of)
@@ -340,6 +356,14 @@ class _Handler(BaseHTTPRequestHandler):
                                    f"{pod['spec']['nodeName']}"})
                 s.bindings.append(body)
                 pod.setdefault("spec", {})["nodeName"] = body["target"]["name"]
+                # upstream parity (registry/core/pod assignPod): annotations
+                # carried on the Binding's ObjectMeta are merged into the
+                # pod, so a scheduler can publish its chip assignment in
+                # the SAME write as the bind instead of a follow-up PATCH
+                ann = body.get("metadata", {}).get("annotations")
+                if ann:
+                    pod.setdefault("metadata", {}).setdefault(
+                        "annotations", {}).update(ann)
             s.upsert("pods", pod, "MODIFIED")
             return self._json(201, {})
         if method == "GET":

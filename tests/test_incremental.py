@@ -480,6 +480,6 @@ class TestMaximaMemoFastPath:
         assert p2.phase == PodPhase.BOUND and p2.node == "n1"
         spec_keys = list(maxc._memo)
         assert spec_keys, "memo must be stamped"
-        _, contribs = maxc._memo[spec_keys[-1]]
+        _, contribs, *_ = maxc._memo[spec_keys[-1]]
         assert "n2" not in contribs, \
             "a staleness-departed node must leave the contributor memo"

@@ -95,12 +95,16 @@ def test_pending_pods_visible_after_resync(client):
     assert pending[0].scheduler_name == "yoda-scheduler"
 
 
-def test_bind_posts_binding_and_patches_chips(client, api):
+def test_bind_posts_binding_with_chip_annotation(client, api):
+    """The chip assignment rides the Binding's ObjectMeta (the apiserver
+    merges binding annotations into the pod, upstream assignPod
+    semantics) — one write, no follow-up PATCH round-trip."""
     pod = Pod("p1")
     client.bind(pod, "n1", [(0, 0, 0), (1, 0, 0)])
     assert api.bound[0]["target"]["name"] == "n1"
-    patch = [r for r in api.requests if r[0] == "PATCH"]
-    assert patch and "tpu/assigned-chips" in json.dumps(patch[0][2])
+    assert "tpu/assigned-chips" in json.dumps(
+        api.bound[0]["metadata"].get("annotations", {}))
+    assert not [r for r in api.requests if r[0] == "PATCH"]
 
 
 class _AmbiguousBindTransport:
@@ -136,19 +140,18 @@ class _AmbiguousBindTransport:
         return self.api.transport(method, path, body, timeout)
 
 
-def test_ambiguous_bind_that_landed_still_patches_chips(api):
+def test_ambiguous_bind_that_landed_carries_chips(api):
     """The bind POST was processed but the response was lost: bind() must
-    read the pod back, see it bound to us, and still publish the
-    chip-assignment annotation — raising instead leaves the pod bound on
-    the server with its chips invisible to the allocator (double
-    assignment)."""
+    read the pod back, see it bound to us, and stop — the chip-assignment
+    annotation rode the Binding that landed, so nothing is replayed and
+    the allocator's view stays consistent."""
     t = _AmbiguousBindTransport(api, applies=True)
     c = KubeClient("https://fake", transport=t)
     c.bind(Pod("p1"), "n1", [(0, 0, 0), (1, 0, 0)])
     assert len(api.bound) == 1  # never replayed: the first POST landed
     assert t.post_attempts == 1
-    patch = [r for r in api.requests if r[0] == "PATCH"]
-    assert patch and "tpu/assigned-chips" in json.dumps(patch[0][2])
+    assert "tpu/assigned-chips" in json.dumps(
+        api.bound[0]["metadata"].get("annotations", {}))
 
 
 def test_ambiguous_bind_that_never_landed_replays_once(api):
@@ -159,8 +162,8 @@ def test_ambiguous_bind_that_never_landed_replays_once(api):
     c.bind(Pod("p1"), "n1", [(0, 0, 0)])
     assert t.post_attempts == 2
     assert len(api.bound) == 1
-    patch = [r for r in api.requests if r[0] == "PATCH"]
-    assert patch
+    assert "tpu/assigned-chips" in json.dumps(
+        api.bound[0]["metadata"].get("annotations", {}))
 
 
 def test_ambiguous_bind_unbound_after_replay_raises(api):

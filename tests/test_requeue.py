@@ -1,0 +1,279 @@
+"""Event-driven requeue: per-plugin queueing hints move parked pods from
+backoff to the active queue the moment a matching cluster event lands,
+while non-matching events (and SKIP hints) leave backoff intact — no
+thundering herd, no pod ever lost between the parked map and the active
+queue. These contracts are what turned the 1s-initial-backoff wall into
+event latency, so they get pinned at both the queue and engine level.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.scheduler.framework import (
+    ClusterEvent,
+    GANG_MEMBER_ARRIVED,
+    NODE_ADDED,
+    NODE_TELEMETRY_UPDATED,
+    POD_DELETED,
+    QUEUE,
+    SKIP,
+)
+from yoda_scheduler_tpu.scheduler.queue import SchedulingQueue
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore,
+    make_tpu_node,
+    make_v4_slice,
+)
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.obs import Metrics
+
+
+def fifo_queue(metrics=None, **kw):
+    # the timer stretch is opt-in (config default off): these tests opt
+    # in so both the event wakes AND the stretched safety net are pinned
+    kw.setdefault("hinted_backoff_s", 30.0)
+    return SchedulingQueue(lambda a, b: False, metrics=metrics, **kw)
+
+
+def park(q, name, rejected_by, now=0.0):
+    """Add + pop + requeue_backoff: the way a real pod enters the lot."""
+    q.add(Pod(name), now=now)
+    info = q.pop(now=now)
+    q.requeue_backoff(info, now=now, rejected_by=rejected_by)
+    return info
+
+
+class TestQueueingHints:
+    def test_matching_event_activates_before_backoff_deadline(self):
+        q = fifo_queue()
+        q.register_hint("chips", (POD_DELETED,), lambda ev, pod: QUEUE)
+        info = park(q, "starved", ("chips",))
+        assert q.pop(now=1.0) is None  # backing off (and hint-stretched)
+        assert q.on_event(ClusterEvent(POD_DELETED, node="n1"), now=1.0) == 1
+        woken = q.pop(now=1.0)
+        assert woken is info
+        assert 1.0 < info.not_before  # well before the timer would have
+
+    def test_non_registered_event_kind_is_not_consulted(self):
+        hits = []
+        q = fifo_queue()
+        q.register_hint("chips", (POD_DELETED,),
+                        lambda ev, pod: hits.append(ev) or QUEUE)
+        park(q, "starved", ("chips",))
+        # NodeAdded is not in the plugin's registered kinds: the hint must
+        # not even run, and the pod must stay parked
+        assert q.on_event(ClusterEvent(NODE_ADDED, node="n9"), now=0.5) == 0
+        assert hits == []
+        assert q.pop(now=0.5) is None
+
+    def test_skip_hint_leaves_backoff_intact(self):
+        m = Metrics()
+        q = fifo_queue(metrics=m)
+        q.register_hint("telemetry", (NODE_TELEMETRY_UPDATED,),
+                        lambda ev, pod: SKIP)
+        info = park(q, "p", ("telemetry",))
+        assert q.on_event(ClusterEvent(NODE_TELEMETRY_UPDATED, node="n1"),
+                          now=0.1) == 0
+        assert m.counters["requeue_hint_skips_total"] == 1
+        assert q.pop(now=0.1) is None
+        # the timer fallback still works exactly as before
+        got = q.pop(now=info.not_before + 0.01)
+        assert got is info
+
+    def test_hintless_rejector_wakes_on_any_event(self):
+        q = fifo_queue()
+        # "mystery-plugin" never registered hints: conservative upstream
+        # behaviour — any cluster event may help its pods
+        info = park(q, "p", ("mystery-plugin",))
+        assert info.not_before <= 10.0  # classic cadence, no hint stretch
+        assert q.on_event(ClusterEvent(NODE_ADDED, node="n1"), now=0.2) == 1
+        assert q.pop(now=0.2) is info
+
+    def test_full_hint_coverage_stretches_the_blind_timer(self):
+        q = fifo_queue(initial_backoff_s=1.0, max_backoff_s=10.0,
+                       hinted_backoff_s=30.0)
+        q.register_hint("chips", (POD_DELETED,), lambda ev, pod: QUEUE)
+        hinted = park(q, "hinted", ("chips",), now=0.0)
+        assert hinted.not_before == 30.0  # events are the retry trigger
+        blind = park(q, "blind", ("mystery",), now=0.0)
+        assert blind.not_before == 1.0  # hint-less rejector: classic 1s
+
+    def test_any_rejectors_queue_verdict_wins(self):
+        q = fifo_queue()
+        q.register_hint("says-skip", (POD_DELETED,), lambda ev, pod: SKIP)
+        q.register_hint("says-queue", (POD_DELETED,), lambda ev, pod: QUEUE)
+        park(q, "p", ("says-skip", "says-queue"))
+        assert q.on_event(ClusterEvent(POD_DELETED, node="n"), now=0.1) == 1
+
+    def test_backoff_wait_histogram_records_actual_wait(self):
+        m = Metrics()
+        q = fifo_queue(metrics=m)
+        q.register_hint("chips", (POD_DELETED,), lambda ev, pod: QUEUE)
+        park(q, "p", ("chips",), now=0.0)
+        q.on_event(ClusterEvent(POD_DELETED, node="n"), now=0.25)
+        h = m.histograms["backoff_wait_ms"]
+        assert h.n == 1
+        assert 200.0 <= h.quantile(0.5) <= 300.0  # ~250ms actually waited
+
+
+def mk_sched(chips=4, nodes=("n1",), slices=(), **cfg):
+    store = TelemetryStore()
+    now = time.time()
+    metrics = [make_tpu_node(n, chips=chips) for n in nodes]
+    for s in slices:  # 4-host v4-32 slices for gang workloads
+        metrics += make_v4_slice(s, "2x2x4")
+    for m in metrics:
+        m.heartbeat = now + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg.setdefault("pod_hinted_backoff_s", 30.0)  # opt into the stretch
+    sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9, **cfg),
+                      clock=FakeClock(start=now))
+    return cluster, store, sched
+
+
+class TestEngineEventWakes:
+    def test_evict_wakes_chip_starved_pod_before_backoff_deadline(self):
+        cluster, store, sched = mk_sched(chips=4)
+        a = Pod("a", labels={"scv/number": "4", "tpu/accelerator": "tpu"})
+        b = Pod("b", labels={"scv/number": "4", "tpu/accelerator": "tpu"})
+        sched.submit(a)
+        sched.run_until_idle(max_cycles=10)
+        assert a.phase == PodPhase.BOUND
+        sched.submit(b)
+        assert sched.run_one() == "unschedulable"
+        assert sched.run_one() is None  # parked: nothing ready
+        deadline = sched.next_wake_at()
+        assert deadline is not None and deadline > sched.clock.time() + 1.0
+        # the exact event that blocked b: chips freed by a's departure
+        cluster.evict(a)
+        assert sched.next_wake_at() == 0.0  # undrained event = wake NOW
+        assert sched.run_one() == "bound"
+        assert b.phase == PodPhase.BOUND
+        # the clock never reached the backoff deadline: the event did it
+        assert sched.clock.time() < deadline
+        assert sched.metrics.counters.get("requeue_wakeups_total", 0) == 1
+
+    def test_unchanged_telemetry_republish_skips_parked_pod(self):
+        cluster, store, sched = mk_sched(chips=4)
+        a = Pod("a", labels={"scv/number": "4", "tpu/accelerator": "tpu"})
+        b = Pod("b", labels={"scv/number": "4", "tpu/accelerator": "tpu"})
+        sched.submit(a)
+        sched.run_until_idle(max_cycles=10)
+        sched.submit(b)
+        assert sched.run_one() == "unschedulable"
+        # a sniffer republish with identical capacity must NOT thundering-
+        # herd b back into the filter chain
+        m = make_tpu_node("n1", chips=4)
+        m.heartbeat = store.get("n1").heartbeat + 1.0
+        store.put(m)
+        assert sched.run_one() is None  # event drained, hint said SKIP
+        assert b.phase == PodPhase.PENDING
+        assert sched.metrics.counters.get("requeue_hint_skips_total", 0) >= 1
+        assert sched.metrics.counters.get("requeue_wakeups_total", 0) == 0
+
+    def test_gang_arrival_wakes_parked_sibling(self):
+        cluster, store, sched = mk_sched(nodes=(), slices=("s1",),
+                                         gang_timeout_s=5.0)
+        labels = {"tpu/gang-name": "g", "tpu/gang-size": "2",
+                  "scv/number": "1", "tpu/accelerator": "tpu"}
+        m1 = Pod("m1", labels=dict(labels))
+        sched.submit(m1)
+        sched.run_one()  # parks at Permit waiting for its sibling
+        assert m1.phase == PodPhase.PENDING
+        sched.clock.advance(6.0)  # assembly times out -> backoff
+        assert sched.run_one() is None
+        deadline = sched.next_wake_at()
+        assert deadline is not None
+        # the sibling (re)arrives: GangMemberArrived must wake m1 NOW
+        m2 = Pod("m2", labels=dict(labels))
+        sched.submit(m2)
+        sched.run_until_idle(max_cycles=20)
+        assert m1.phase == PodPhase.BOUND and m2.phase == PodPhase.BOUND
+        assert sched.clock.time() < deadline  # not the timer's doing
+
+    def test_other_gangs_arrival_leaves_sibling_parked(self):
+        cluster, store, sched = mk_sched(nodes=(), slices=("s1",),
+                                         gang_timeout_s=5.0)
+        m1 = Pod("m1", labels={"tpu/gang-name": "g", "tpu/gang-size": "2",
+                               "scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(m1)
+        sched.run_one()
+        sched.clock.advance(6.0)
+        assert sched.run_one() is None  # m1 now in backoff
+        other = Pod("o1", labels={"tpu/gang-name": "other",
+                                  "tpu/gang-size": "2", "scv/number": "1",
+                                  "tpu/accelerator": "tpu"})
+        sched.submit(other)
+        sched.run_one()  # other's cycle; its arrival event is drained too
+        assert m1.phase == PodPhase.PENDING
+        assert sched.metrics.counters.get("requeue_wakeups_total", 0) == 0
+
+
+class TestNoPodLost:
+    def test_fuzz_conservation_between_parked_map_and_active_queue(self):
+        """Random add/pop/park/event/remove storm: every pod is always
+        either active, parked, bound, or removed — never dropped, never
+        duplicated — and every parked pod is eventually poppable."""
+        rng = random.Random(0xE7E)
+        kinds = (POD_DELETED, NODE_ADDED, NODE_TELEMETRY_UPDATED,
+                 GANG_MEMBER_ARRIVED)
+        plugins = {
+            "always-queue": ((POD_DELETED, NODE_ADDED), lambda e, p: QUEUE),
+            "always-skip": ((NODE_TELEMETRY_UPDATED,), lambda e, p: SKIP),
+            "coin": ((GANG_MEMBER_ARRIVED, POD_DELETED),
+                     lambda e, p: QUEUE if hash(p.name) % 2 else SKIP),
+        }
+        q = fifo_queue(hinted_backoff_s=30.0)
+        for name, (ks, fn) in plugins.items():
+            q.register_hint(name, ks, fn)
+        rejector_pool = list(plugins) + ["hintless"]
+        now = 0.0
+        inside: set[str] = set()   # pods the queue must account for
+        done: set[str] = set()     # bound or removed
+        seq = 0
+        for _ in range(3000):
+            now += rng.random() * 0.5
+            op = rng.random()
+            if op < 0.35:
+                name = f"f{seq}"
+                seq += 1
+                q.add(Pod(name), now=now)
+                inside.add(name)
+            elif op < 0.70:
+                info = q.pop(now=now)
+                if info is not None:
+                    if rng.random() < 0.5:  # "bound"
+                        inside.discard(info.pod.key.split("/", 1)[1])
+                        done.add(info.pod.key)
+                    else:  # unschedulable again
+                        rej = tuple(rng.sample(
+                            rejector_pool, rng.randint(0, 3)))
+                        q.requeue_backoff(info, now=now, rejected_by=rej)
+            elif op < 0.95:
+                q.on_event(ClusterEvent(rng.choice(kinds), node="n"),
+                           now=now)
+            elif inside:
+                name = rng.choice(sorted(inside))
+                removed = q.remove(f"default/{name}")
+                if removed:
+                    inside.discard(name)
+                    done.add(f"default/{name}")
+        # drain: far-future pops must surface EVERY remaining pod exactly
+        # once, empty the queue, and agree with contains()
+        drained = []
+        while True:
+            info = q.pop(now=now + 1e6)
+            if info is None:
+                break
+            drained.append(info.pod.key.split("/", 1)[1])
+        assert sorted(drained) == sorted(inside)
+        assert len(set(drained)) == len(drained)  # no duplicates
+        assert len(q) == 0
+        for name in drained:
+            assert not q.contains(f"default/{name}")

@@ -172,9 +172,10 @@ class TestGangLive:
             server.state.compact("pods")
             server.state.add_pod(gang_pod("w2"))
             server.state.add_pod(gang_pod("w3"))
-            # wait for the bind AND the chip-assignment annotation — they
-            # are separate API calls (binding POST, then PATCH), so
-            # checking nodeName alone races the annotation assert below
+            # wait for the bind AND the chip-assignment annotation (it
+            # rides the Binding's metadata and the server merges it into
+            # the pod in the same write, but the watch delivery of that
+            # write still races a bare nodeName check)
             ok = wait_for(lambda: all(
                 (server.state.pod(f"w{i}") or {}).get("spec", {}).get(
                     "nodeName")
@@ -515,9 +516,10 @@ class TestAsyncBinding:
             assert posts() <= 2  # initial + at most one recovered retry
             assert len(server.state.bindings) == 1
             # the chip-assignment annotation must survive the lost
-            # response: bind() resolves the ambiguity by reading the pod
-            # back and proceeds to the PATCH — without it the allocator
-            # re-offers this pod's chips (the r5 review's double-assign)
+            # response: it rode the Binding POST that actually landed, so
+            # the read-back recovery finds the pod bound WITH its chips —
+            # without them the allocator re-offers this pod's chips (the
+            # r5 review's double-assign)
             ann = (server.state.pod("p1") or {}).get(
                 "metadata", {}).get("annotations", {})
             assert "tpu/assigned-chips" in ann

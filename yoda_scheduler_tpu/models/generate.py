@@ -242,13 +242,17 @@ def decode_step_rolling(params, token, cache: RollingKVCache,
                                         next_pos=p + 1)
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(1, 2))
-def _eager_step(params, logits, cache, k, step_fn, config, temperature):
+@partial(jax.jit, static_argnums=(4, 5, 7), donate_argnums=(1, 2))
+def _eager_step(params, logits, cache, k, step_fn, config, temperature,
+                sample):
     """One eager decode dispatch: pick the next token from `logits`,
     advance the cache. Module-level so the jit cache survives across
     generate() calls — a per-call closure would recompile the decode
-    step on every serving request."""
-    if temperature > 0.0:
+    step on every serving request. Only the greedy-vs-sampling CHOICE
+    (`sample`) is static; `temperature` is traced, so serving requests
+    with per-request temperatures share one compiled program instead of
+    recompiling the decode step for every distinct value."""
+    if sample:
         tok = jax.random.categorical(k, logits / temperature, axis=-1)
     else:
         tok = jnp.argmax(logits, axis=-1)
@@ -316,7 +320,7 @@ def generate(params, prompt, config: LlamaConfig, max_new_tokens: int,
         for i in range(max_new_tokens):
             logits, cache, tok = _eager_step(
                 params, logits, cache, keys[i], step_fn, config,
-                temperature)
+                jnp.asarray(temperature, jnp.float32), temperature > 0.0)
             toks.append(tok)
         if not toks:  # the scan path returns [B, 0] too
             return jnp.zeros((b, 0), jnp.int32)

@@ -16,6 +16,13 @@ from __future__ import annotations
 import threading
 from typing import Protocol
 
+from .framework import (
+    ClusterEvent,
+    NODE_ADDED,
+    NODE_SPEC_CHANGED,
+    POD_BOUND,
+    POD_DELETED,
+)
 from ..telemetry.store import TelemetryStore
 from ..utils.changelog import ChangeLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
@@ -53,12 +60,28 @@ class FakeCluster:
         # snapshots and the unschedulable-class memo
         self._changes = ChangeLog()
         self._nodes_ver = 0
+        # event subscribers (scheduler engines): called OUTSIDE the lock
+        # with a framework.ClusterEvent per mutation, feeding the queues'
+        # event-driven requeue (list append/iteration are GIL-atomic)
+        self._subscribers: list = []
 
-    def _bump(self, node: str) -> None:
+    def subscribe(self, cb) -> None:
+        """Register a cluster-event callback (cb(ClusterEvent)). Callbacks
+        must be cheap and thread-safe — they run on whichever thread
+        mutated the cluster."""
+        self._subscribers.append(cb)
+
+    def _publish(self, event: ClusterEvent) -> None:
+        for cb in list(self._subscribers):
+            cb(event)
+
+    def _bump(self, node: str, grew: bool = True) -> None:
         # callers hold self._lock; every mutation of a node's bound-pod set
-        # MUST bump, or cross-cycle snapshot reuse serves stale NodeInfos
+        # MUST bump, or cross-cycle snapshot reuse serves stale NodeInfos.
+        # grew=False marks capacity-consuming changes (a bind): repair
+        # paths then skip hunting that node for NEW feasibility.
         self._pods_ver[node] = self._pods_ver.get(node, 0) + 1
-        self._changes.record(node)
+        self._changes.record(node, grew=grew)
 
     @property
     def nodes_version(self) -> int:
@@ -77,13 +100,23 @@ class FakeCluster:
         with self._lock:
             return self._changes.changes_since(version)
 
+    def changes_since_directed(self, version: int):
+        """changes_since plus the grew subset (changelog docstring): a
+        node only in dirty (all its changes were binds/claims) cannot
+        have gained capacity since `version`."""
+        with self._lock:
+            return self._changes.changes_since_directed(version)
+
     # ------------------------------------------------------------- node admin
     def add_node(self, name: str) -> None:
         with self._lock:
-            if name not in self._nodes:
+            fresh = name not in self._nodes
+            if fresh:
                 self._nodes_ver += 1
             self._nodes.add(name)
             self._bound.setdefault(name, [])
+        if fresh:
+            self._publish(ClusterEvent(NODE_ADDED, node=name))
 
     def add_nodes_from_telemetry(self) -> None:
         for m in self.telemetry.list():
@@ -145,6 +178,7 @@ class FakeCluster:
             self._meta[name] = (dict(labels or {}), tuple(taints),
                                 allocatable, bool(unschedulable))
             self._bump(name)
+        self._publish(ClusterEvent(NODE_SPEC_CHANGED, node=name))
 
     def node_meta(self, name: str) -> tuple[dict[str, str], tuple]:
         with self._lock:
@@ -184,13 +218,26 @@ class FakeCluster:
             if assigned_chips is not None:
                 pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(assigned_chips)
             self._bound[node].append(pod)
-            self._bump(node)
+            self._bump(node, grew=False)  # a bind only consumes capacity
+        self._publish(ClusterEvent(POD_BOUND, node=node))
 
     def evict(self, pod: Pod) -> None:
+        node = pod.node
+        removed = False
         with self._lock:
             if pod.node and pod.node in self._bound:
-                self._bound[pod.node] = [p for p in self._bound[pod.node] if p.uid != pod.uid]
+                before = self._bound[pod.node]
+                after = [p for p in before if p.uid != pod.uid]
+                removed = len(after) != len(before)
+                self._bound[pod.node] = after
                 self._bump(pod.node)
         pod.node = None
         pod.phase = PodPhase.PENDING
         pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+        if removed:
+            # only a REAL departure frees capacity; evicting a pod that
+            # was never bound (or already gone) must not wake every
+            # capacity-parked pod for a doomed retry (mirrors
+            # KubeCluster._pod_event, which emits POD_DELETED only for
+            # cached pods with a node)
+            self._publish(ClusterEvent(POD_DELETED, node=node))

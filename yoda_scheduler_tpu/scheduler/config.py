@@ -48,6 +48,15 @@ class SchedulerConfig:
     # pod retry backoff, reference deploy/yoda-scheduler.yaml:19-20
     pod_initial_backoff_s: float = 1.0
     pod_max_backoff_s: float = 10.0
+    # timer safety net for pods whose EVERY rejecting plugin has queueing
+    # hints registered: such pods are woken by matching cluster events, so
+    # the blind-retry timer MAY stretch to this (upstream kube-scheduler's
+    # podMaxInUnschedulablePodsDuration analogue, there 5min). Opt-in: any
+    # value <= pod_max_backoff_s (the default) disables the stretch and
+    # every pod keeps the classic 1s->10s cadence — event wakes still fire
+    # either way, the stretch only trades doomed-retry compute for a
+    # longer worst case when an event channel is missing.
+    pod_hinted_backoff_s: float = 0.0
     weights: ScoreWeights = field(default_factory=ScoreWeights)
     # telemetry older than this is treated as unschedulable (no reference
     # equivalent — its cache served arbitrarily stale data)
@@ -104,6 +113,8 @@ class SchedulerConfig:
                 "descheduleIntervalSeconds", defaults.deschedule_interval_s)),
             async_binding=bool(args.get("asyncBinding",
                                         defaults.async_binding)),
+            pod_hinted_backoff_s=float(args.get(
+                "podHintedBackoffSeconds", defaults.pod_hinted_backoff_s)),
         )
 
 

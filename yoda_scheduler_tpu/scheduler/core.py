@@ -33,14 +33,20 @@ from .config import SchedulerConfig
 from .framework import (
     BindPlugin,
     CANDIDATE_NODES_KEY,
+    ClusterEvent,
     Code,
     CycleState,
     FilterPlugin,
+    GANG_MEMBER_ARRIVED,
+    NODE_TELEMETRY_UPDATED,
     NodeInfo,
+    POD_DELETED,
+    POD_PENDING_ARRIVED,
     PermitPlugin,
     PostFilterPlugin,
     PreFilterPlugin,
     PreScorePlugin,
+    QUEUE,
     QueuedPodInfo,
     QueueSortPlugin,
     ReservePlugin,
@@ -210,15 +216,42 @@ class Scheduler:
             )
         self.profile = profile
         self.clock = clock or Clock()
+        self.metrics = Metrics()
         self.queue = SchedulingQueue(
             profile.queue_sort.less,
             initial_backoff_s=self.config.pod_initial_backoff_s,
             max_backoff_s=self.config.pod_max_backoff_s,
             key=getattr(profile.queue_sort, "key", None),
+            metrics=self.metrics,
+            hinted_backoff_s=self.config.pod_hinted_backoff_s,
         )
+        # event-driven requeue: register every plugin's EnqueueExtensions
+        # (queueing hints) with the queue's event index, plus the engine's
+        # own hint for pods waiting on preemption victims to drain
+        seen_plugins: set[str] = set()
+        for plugins in (profile.pre_filter, profile.filter,
+                        profile.post_filter, profile.pre_score,
+                        profile.score, profile.reserve, profile.permit):
+            for p in plugins:
+                if p.name not in seen_plugins:
+                    seen_plugins.add(p.name)
+                    self.queue.register_plugin(p)
+        self.queue.register_hint("victim-drain", (POD_DELETED,),
+                                 lambda ev, pod: QUEUE)
+        # cluster events land in the queue's inbox from ANY thread
+        # (reflector, binder, test driver); the next pop() routes them
+        # through the queueing hints on the engine thread. `wake` lets a
+        # serve loop sleep until an event or submission arrives instead
+        # of polling.
+        self.wake = threading.Event()
+        sub = getattr(cluster, "subscribe", None)
+        if sub is not None:
+            sub(self.notify_event)
+        wc = getattr(cluster.telemetry, "watch_changes", None)
+        if wc is not None:
+            wc(self._on_telemetry_change)
         self.waiting: dict[str, _WaitingPod] = {}
         self.failed: dict[str, str] = {}  # pod.key -> permanent failure reason
-        self.metrics = Metrics()
         self.traces = TraceLog()
         self.rng = random.Random(self.config.rng_seed)
         self._filter_start = 0  # rotating offset for percentageOfNodesToScore
@@ -245,6 +278,15 @@ class Scheduler:
         # _schedule_one_locked and _repair_feasible for the soundness
         # envelope.
         self._feas_memo: dict = {}
+        # per-cycle dirty-set memo for _changes_since_vers: in steady
+        # state every class memo (feasible, unschedulable, score, slice
+        # usage) stores the SAME previous version vector, so one cycle
+        # asks for the same (cvers -> now) delta several times — each a
+        # walk of three change logs under the cluster lock. Keyed by
+        # (cvers, current vector) so a mid-cycle version bump (a Reserve
+        # write, a concurrent reflector apply) self-invalidates; cleared
+        # each cycle. Returned sets are shared — callers must not mutate.
+        self._csv_memo: dict = {}
         # score-CLASS memo: memo_key -> (cluster versions, MaxValue
         # tuple, slice-usage map, scorer names, {plugin: {node: raw}}).
         # Classmate cycles rescore only dirty nodes; see the score
@@ -284,11 +326,28 @@ class Scheduler:
         gang = pod.labels.get(GANG_NAME_LABEL)
         if gang:
             # a (re)submitted member can complete the gang again; the
-            # engine thread applies the revival (run_one drains this)
+            # engine thread applies the revival (run_one drains this) —
+            # and parked siblings in backoff wake on the arrival event
             self._gang_revivals.append(gang)
+            self.notify_event(ClusterEvent(GANG_MEMBER_ARRIVED, gang=gang))
         self.queue.add(pod, now=self.clock.time())
         self.metrics.inc("pods_submitted_total")
+        self.wake.set()
         return True
+
+    def notify_event(self, event: ClusterEvent) -> None:
+        """Accept a cluster event from any thread; the queue routes it
+        through its queueing hints at the next pop on the engine thread.
+        Intake signals (PodPendingArrived) only wake the serve loop — a
+        pending pod's arrival cannot cure a parked pod's rejection, so it
+        never enters the hint path."""
+        if event.kind != POD_PENDING_ARRIVED:
+            self.queue.notify(event)
+        self.wake.set()
+
+    def _on_telemetry_change(self, node: str, old, new) -> None:
+        self.notify_event(ClusterEvent(NODE_TELEMETRY_UPDATED, node=node,
+                                       old=old, new=new))
 
     def tracks(self, pod_key: str) -> bool:
         """Is this pod currently in our hands (queued, backing off, or parked
@@ -344,21 +403,56 @@ class Scheduler:
         must rebuild from scratch. Exposed to plugins through the cycle
         state as ``changes_since_fn`` so per-cycle aggregations (slice
         usage, feasible lists) can repair instead of rescanning."""
+        vers, dirty, _ = self._changes_since_directed(cvers)
+        return vers, dirty
+
+    def _changes_since_directed(self, cvers):
+        """(current vector, dirty | None, grew | None): like
+        _changes_since_vers plus the GREW subset — names with at least
+        one capacity-releasing (or direction-unknown) change. A name only
+        in dirty was touched exclusively by binds/claims, which within
+        the memo path's per-node-predicate envelope cannot flip it
+        infeasible -> feasible (changelog docstring). Backends without
+        direction support contribute their whole dirty set to grew
+        (conservative)."""
         vers = self._cluster_versions()
         if vers is None or cvers is None or vers[2] != cvers[2]:
-            return vers, None
+            return vers, None, None
+        key = (cvers, vers)
+        hit = self._csv_memo.get(key)
+        if hit is not None:
+            return vers, hit[0], hit[1]
         csince = getattr(self.cluster, "changes_since", None)
         tsince = getattr(self.cluster.telemetry, "changes_since", None)
         if csince is None or tsince is None or self.allocator is None:
-            return vers, None
-        _, pdirty = csince(cvers[0])
+            return vers, None, None
+        cdir = getattr(self.cluster, "changes_since_directed", None)
+        if cdir is not None:
+            _, pdirty, pgrew = cdir(cvers[0])
+        else:
+            _, pdirty = csince(cvers[0])
+            pgrew = pdirty
         _, tdirty = tsince(cvers[1])
-        _, adirty = self.allocator.changes_since(cvers[3])
-        if pdirty is None or tdirty is None or adirty is None:
-            return vers, None
-        if "*" in adirty:
-            return vers, None
-        return vers, pdirty | tdirty | adirty
+        _, adirty, agrew = self.allocator.changes_since_directed(cvers[3])
+        if (pdirty is None or tdirty is None or adirty is None
+                or "*" in adirty):
+            dirty = grew = None
+        else:
+            dirty = pdirty | tdirty | adirty
+            # telemetry updates are direction-unknown: all grew
+            grew = pgrew | tdirty | agrew
+        self._csv_memo[key] = (dirty, grew)
+        return vers, dirty, grew
+
+    @staticmethod
+    def _feas_entry(vers, feasible):
+        """Feasible-class memo record: (version vector, NodeInfo tuple,
+        name frozenset, name -> position index). The set and index let
+        _repair_feasible patch the cached list in O(|dirty|) instead of
+        walking every entry."""
+        names = tuple(n.name for n in feasible)
+        return (vers, tuple(feasible), frozenset(names),
+                {n: i for i, n in enumerate(names)})
 
     def _repair_feasible(self, hit, vers, now, state, pod, snapshot,
                          filters, want):
@@ -386,26 +480,68 @@ class Scheduler:
         unchecked — the class keeps scoring the same candidate set until
         one of its nodes changes, which the rotating full-scan start then
         re-diversifies."""
-        cvers, names = hit
-        _, dirty = self._changes_since_vers(cvers)
+        cvers, cached, cached_names, cached_index = hit
+        _, dirty, grew = self._changes_since_directed(cvers)
         if dirty is None:
             return None
         max_age = self.config.telemetry_max_age_s
         check_stale = any(getattr(p, "time_dependent", False)
                           for p in filters)
-        repaired = []
-        for name in names:
-            if name in dirty:
-                continue  # re-checked below so ordering is stable-ish
-            node = snapshot.get(name)
-            if node is None:
-                continue
-            if check_stale and (
-                    node.metrics is None
-                    or node.metrics.stale(now=now, max_age_s=max_age)):
-                continue
-            repaired.append(node)
-        for name in sorted(dirty):
+        if check_stale:
+            # O(1) short-circuit: when even the OLDEST stored heartbeat is
+            # fresh, no node can be stale — skip the per-name re-checks
+            # (the floor is conservative; see TelemetryStore.heartbeat_floor)
+            floor_fn = getattr(self.cluster.telemetry, "heartbeat_floor",
+                               None)
+            if floor_fn is not None:
+                floor = floor_fn()
+                if floor is not None and (now - floor) <= max_age:
+                    check_stale = False
+        # the memo holds NodeInfo objects, not names: when `dirty` is
+        # attributable every unchanged node's cached info is content-valid
+        # (membership changes force dirty=None above), so the common path
+        # touches no snapshot lookup at all — only dirty names re-resolve.
+        # The hot path (no staleness gate) copies the cached list and
+        # deletes the few dirty positions via the stored index — walking
+        # all `want` entries per cycle was a measurable slice of
+        # bind-cycle cost in the 1000-node drain. Dirty nodes that still
+        # pass re-enter at the END (same as the original walk), which the
+        # score tie-break order depends on — an in-place variant measured
+        # 34 fewer binds at the 1000-node tier.
+        if not check_stale:
+            repaired = list(cached)
+            bad = dirty & cached_names
+            if bad:
+                for i in sorted((cached_index[n] for n in bad),
+                                reverse=True):
+                    del repaired[i]  # re-checked below, appended if ok
+            # gap-fill candidates: the whole dirty set. Restricting to
+            # GREW-dirtied nodes here is tempting but wrong-in-effect:
+            # a cached list below `want` means the original early-exit
+            # scan never checked some nodes, and a shrink-dirtied
+            # UNCHECKED node may be feasible all along — skipping it
+            # measurably shrank exploration (53 fewer binds at the
+            # 1000-node tier). _repair_unsched CAN restrict to grew:
+            # there the failing scan verified every node infeasible.
+            fill = dirty
+        else:
+            repaired = []
+            for node in cached:
+                if node.name in dirty:
+                    continue  # re-checked below so ordering is stable-ish
+                if (node.metrics is None
+                        or node.metrics.stale(now=now, max_age_s=max_age)):
+                    continue
+                repaired.append(node)
+            fill = dirty
+        for name in sorted(fill):
+            if len(repaired) >= want:
+                # identical to filtering everything then truncating
+                # [:want]: any further passer would land past `want` and
+                # be cut — so don't pay its predicate chain at all (the
+                # dirty set holds every OTHER class's latest bound nodes
+                # too, and re-filtering them was most of repair cost)
+                break
             node = snapshot.get(name)
             if node is None:
                 continue
@@ -421,6 +557,47 @@ class Scheduler:
         if not repaired:
             return None
         return repaired[:want]
+
+    def _repair_unsched(self, hit, state, pod, snapshot, filters, trace):
+        """The failure-path twin of _repair_feasible: bridge a classmate's
+        no-feasible-node verdict to the current version vector by
+        re-filtering ONLY the dirty nodes. A failing scan checked EVERY
+        node, and under the feas_ok gate no predicate flips
+        infeasible->feasible without a recorded change (staleness only
+        moves the other way), so clean nodes stay infeasible by
+        construction. Returns None when the change logs cannot attribute
+        the delta (caller runs the full scan), else
+        (passing NodeInfos, extra rejector plugin names, dirty names).
+
+        Only GREW-dirtied nodes are re-filtered: a node touched solely by
+        binds/claims cannot have become feasible under the envelope above.
+        The returned dirty set stays FULL — the caller's restricted
+        preemption re-plan needs shrink-dirtied nodes too (a fresh
+        lower-priority bind is exactly what creates a victim)."""
+        _, dirty, grew = self._changes_since_directed(hit[0])
+        if dirty is None:
+            return None
+        passing = []
+        rejectors: set[str] = set()
+        for name in sorted(grew):
+            node = snapshot.get(name)
+            if node is None:
+                continue
+            st = Status.success()
+            rej = None
+            for p in filters:
+                st = p.filter(state, pod, node)
+                if not st.ok:
+                    rej = p.name
+                    break
+            trace.filter_verdicts[name] = "ok" if st.ok else st.message
+            if st.code == Code.ERROR:
+                return None  # surface errors via the full scan
+            if st.ok:
+                passing.append(node)
+            elif rej is not None:
+                rejectors.add(rej)
+        return passing, rejectors, dirty
 
     # --------------------------------------------------------------- snapshot
     def snapshot(self) -> Snapshot:
@@ -445,8 +622,24 @@ class Scheduler:
                     if not dirty:
                         self._snap = (snap, pv, tv, nv0)
                         return snap
-                    infos = dict(snap._node_infos)
+                    # SHARED dict, mutated in place: the superseded
+                    # snapshot is never read for pre-mutation content
+                    # after this cycle starts (memo_ok's `prev` checks run
+                    # before snapshot()), and copying 1000 entries per
+                    # cycle was a measurable slice of the drain at scale.
+                    # The fresh Snapshot wrapper still gets its own
+                    # identity + lazily-recomputed list()/flags.
+                    infos = snap._node_infos
                     pods_version = getattr(cluster, "pods_version", None)
+                    # pre-mutation fact needed below: did any dirty node
+                    # flip unschedulable True -> False? Must be captured
+                    # BEFORE infos[name] is overwritten (the dict is
+                    # shared, so reading it after would see the NEW info)
+                    uncordoned = False
+                    if snap._any_unsched:
+                        uncordoned = any(
+                            n in infos and infos[n].unschedulable
+                            for n in dirty)
                     for name in dirty:
                         if name not in infos:
                             continue  # telemetry for a non-member node
@@ -482,9 +675,24 @@ class Scheduler:
                             for n in dirty if n in infos
                             for p in infos[n].pods)
                     if snap._any_unsched is not None:
-                        fresh._any_unsched = snap._any_unsched or any(
-                            infos[n].unschedulable
-                            for n in dirty if n in infos)
+                        if uncordoned:
+                            # a dirty node WAS unschedulable before this
+                            # rebuild (captured pre-mutation above): it
+                            # may just have been uncordoned — recompute
+                            # exactly (an uncordon of the LAST cordoned
+                            # node must drop the admission filter out of
+                            # the hot path, not pin it there until the
+                            # next full snapshot). O(nodes), but only on
+                            # cycles that touched a cordoned node. This
+                            # is what makes NodeSpecChanged requeue hints
+                            # worth taking: the woken pod's retry runs
+                            # against the cheap path again.
+                            fresh._any_unsched = any(
+                                ni.unschedulable for ni in infos.values())
+                        else:
+                            fresh._any_unsched = snap._any_unsched or any(
+                                infos[n].unschedulable
+                                for n in dirty if n in infos)
                     self._snap = (fresh, pv, tv, nv0)
                     return fresh
         return self._full_snapshot()
@@ -582,6 +790,7 @@ class Scheduler:
         trace = CycleTrace(pod=pod.key, started=now)
         state = CycleState()
         state.write("now", now)
+        self._csv_memo.clear()  # per-cycle dirty-set cache
 
         try:
             spec = spec_for(pod)
@@ -617,28 +826,37 @@ class Scheduler:
                    and (prev is None or not prev.any_pod_anti_affinity())
                    and (self.allocator is None
                         or self.allocator.nomination_of(pod.key) is None))
-        if (pod.node_selector or pod.tolerations or pod.node_affinity
-                or pod.pod_affinity or pod.pod_anti_affinity
-                or pod.topology_spread or pod.cpu_millis
-                or pod.memory_bytes):
-            memo_key = (spec, frozenset(pod.node_selector.items()),
-                        tuple((t.get("key", ""), t.get("operator", "Equal"),
-                               t.get("value", ""), t.get("effect", ""))
-                              for t in pod.tolerations),
-                        pod.node_affinity, pod.pod_affinity,
-                        pod.pod_anti_affinity, pod.topology_spread,
-                        pod.cpu_millis, pod.memory_bytes, pod.namespace)
-        else:
-            # namespace is part of even the plain class: a bound pod's
-            # anti-affinity (symmetry rule) can repel pods of one
-            # namespace and not another with identical labels
-            memo_key = (spec, pod.namespace)
+        # every memo-key input is fixed at pod creation (labels/selectors
+        # are immutable while the pod is pending), so retries reuse the
+        # key built on the first attempt — the tuple/frozenset build was
+        # measurable across a 5000-pod burst's retry cycles
+        memo_key = pod.__dict__.get("_memo_key")
+        if memo_key is None:
+            if (pod.node_selector or pod.tolerations or pod.node_affinity
+                    or pod.pod_affinity or pod.pod_anti_affinity
+                    or pod.topology_spread or pod.cpu_millis
+                    or pod.memory_bytes):
+                memo_key = (spec, frozenset(pod.node_selector.items()),
+                            tuple((t.get("key", ""),
+                                   t.get("operator", "Equal"),
+                                   t.get("value", ""), t.get("effect", ""))
+                                  for t in pod.tolerations),
+                            pod.node_affinity, pod.pod_affinity,
+                            pod.pod_anti_affinity, pod.topology_spread,
+                            pod.cpu_millis, pod.memory_bytes, pod.namespace)
+            else:
+                # namespace is part of even the plain class: a bound pod's
+                # anti-affinity (symmetry rule) can repel pods of one
+                # namespace and not another with identical labels
+                memo_key = (spec, pod.namespace)
+            pod.__dict__["_memo_key"] = memo_key
         vers = self._cluster_versions()
         if memo_ok and vers is not None:
             hit = self._unsched_memo.get(memo_key)
             if hit is not None and hit[0] == vers:
                 self.metrics.inc("unsched_memo_hits_total")
-                return self._unschedulable(info, trace, hit[1])
+                return self._unschedulable(info, trace, hit[1],
+                                           rejected_by=hit[2])
 
         snapshot = self.snapshot()
         state.write("snapshot", snapshot)
@@ -654,7 +872,8 @@ class Scheduler:
         for p in self.profile.pre_filter:
             st = p.pre_filter(state, pod, snapshot)
             if st.code == Code.UNSCHEDULABLE:
-                return self._unschedulable(info, trace, st.message)
+                return self._unschedulable(info, trace, st.message,
+                                           rejected_by=(p.name,))
             if st.code == Code.ERROR:
                 return self._cycle_error(info, trace, st.message)
 
@@ -690,6 +909,7 @@ class Scheduler:
                    and not pod.pod_affinity and not pod.pod_anti_affinity
                    and not snapshot.any_pod_anti_affinity())
         feasible: list[NodeInfo] | None = None
+        rejectors: set[str] = set()
         if feas_ok:
             hit = self._feas_memo.get(memo_key)
             if hit is not None:
@@ -697,10 +917,65 @@ class Scheduler:
                     hit, vers, now, state, pod, snapshot, filters, want)
                 if feasible is not None:
                     self.metrics.inc("feas_memo_hits_total")
-                    # refresh versions + names so the next classmate's
+                    # refresh versions + infos so the next classmate's
                     # dirty set stays small
-                    self._feas_memo[memo_key] = (
-                        vers, tuple(n.name for n in feasible))
+                    self._feas_memo[memo_key] = self._feas_entry(
+                        vers, feasible)
+
+        if feasible is None and feas_ok:
+            # unschedulable-class REPAIR: the classmate's "no feasible
+            # node" verdict was recorded under an older version vector. A
+            # failing scan checked EVERY node, and no predicate under the
+            # feas_ok gate flips infeasible->feasible without a recorded
+            # change, so only the DIRTY nodes can have become feasible —
+            # re-filter just those instead of rescanning the cluster (the
+            # retry storms this replaces were the round-5 backoff wall's
+            # main compute cost: each one a full scan plus a preemption
+            # re-plan).
+            hit = self._unsched_memo.get(memo_key)
+            if hit is not None and hit[0] != vers:
+                rep = self._repair_unsched(hit, state, pod, snapshot,
+                                           filters, trace)
+                if rep is not None:
+                    passing, extra_rej, dirty = rep
+                    if passing:
+                        self.metrics.inc("unsched_memo_repairs_total")
+                        feasible = passing[:want]
+                        # the class is schedulable again: retire the
+                        # unschedulable entry and seed the feasible memo
+                        # so the next classmate repairs from here
+                        del self._unsched_memo[memo_key]
+                        self._feas_memo[memo_key] = self._feas_entry(
+                            vers, feasible)
+                    else:
+                        combined = hit[2] | extra_rej
+                        self._unsched_memo[memo_key] = (vers, hit[1],
+                                                        combined)
+                        self.metrics.inc("unsched_memo_repairs_total")
+                        # preemption could have become viable only on a
+                        # dirty node (e.g. a fresh lower-priority bind):
+                        # run the planner restricted to those. Falls back
+                        # to the full scan when a post-filter plugin can't
+                        # restrict or PDB accounting couples the verdicts
+                        # cluster-wide.
+                        if self.profile.post_filter:
+                            if snapshot.budgets or not all(
+                                    getattr(p, "supports_restricted", False)
+                                    for p in self.profile.post_filter):
+                                feasible = None  # full scan decides
+                            else:
+                                out = self._run_post_filter(
+                                    info, trace, state, pod, spec,
+                                    snapshot, now, only_nodes=dirty)
+                                if out is not None:
+                                    return out
+                                return self._unschedulable(
+                                    info, trace, hit[1],
+                                    rejected_by=tuple(combined))
+                        else:
+                            return self._unschedulable(
+                                info, trace, hit[1],
+                                rejected_by=tuple(combined))
 
         if feasible is None:
             order = [(self._filter_start + i) % len(nodes)
@@ -723,9 +998,11 @@ class Scheduler:
                     continue
                 checked += 1
                 st = Status.success()
+                rej = None
                 for p in filters:
                     st = p.filter(state, pod, node)
                     if not st.ok:
+                        rej = p.name
                         break
                 trace.filter_verdicts[node.name] = ("ok" if st.ok
                                                     else st.message)
@@ -735,13 +1012,14 @@ class Scheduler:
                     feasible.append(node)
                     if len(feasible) >= want:
                         break
+                elif rej is not None:
+                    rejectors.add(rej)
             self._filter_start = ((self._filter_start + checked)
                                   % max(len(nodes), 1))
             if feas_ok and feasible:
                 if len(self._feas_memo) > 256:
                     self._feas_memo.clear()
-                self._feas_memo[memo_key] = (
-                    vers, tuple(n.name for n in feasible))
+                self._feas_memo[memo_key] = self._feas_entry(vers, feasible)
 
         if not feasible:
             # a nominated preemptor whose victims are still in graceful
@@ -753,7 +1031,8 @@ class Scheduler:
                     p.terminating for p in self.cluster.pods_on(nom[0])):
                 return self._unschedulable(
                     info, trace,
-                    f"waiting for victims on {nom[0]} to terminate")
+                    f"waiting for victims on {nom[0]} to terminate",
+                    rejected_by=("victim-drain",))
             # same for a gang holding a slice-level entitlement: while its
             # victims drain anywhere on the nominated slice, wait
             if spec.is_gang and self.allocator is not None:
@@ -766,57 +1045,13 @@ class Scheduler:
                         for p in ni.pods):
                     return self._unschedulable(
                         info, trace,
-                        f"waiting for victims on slice {gnom[0]} to terminate")
+                        f"waiting for victims on slice {gnom[0]} to "
+                        "terminate", rejected_by=("victim-drain",))
             # PostFilter: preemption — the plugin plans, the engine evicts
-            for p in self.profile.post_filter:
-                nominated, victims, st = p.post_filter(state, pod, snapshot, trace.filter_verdicts)
-                if st.ok and nominated is not None:
-                    # on a real API server evict() is a DELETE: the victim's
-                    # controller recreates it as a new incarnation which the
-                    # serve loop submits — requeueing the dead object locally
-                    # would race it (same contract as Descheduler.run_once)
-                    local = getattr(self.cluster, "supports_local_requeue", False)
-                    for victim in victims:
-                        self.cluster.evict(victim)
-                        self.metrics.inc("pods_evicted_total")
-                        if local:
-                            router = self.victim_router or self.submit
-                            if not router(victim):
-                                self.metrics.inc("preempt_victims_unrouted_total")
-                    if self.allocator is not None:
-                        # hold the freed capacity until the preemptor binds
-                        # or fails — otherwise requeued victims (or co-hosted
-                        # profiles) refill the hole and the preemptor
-                        # livelocks. A gang holds its whole SLICE (per-host
-                        # chips, bounded by an expiry so an abandoned gang
-                        # can't block the slice forever).
-                        if spec.is_gang:
-                            ni = snapshot.get(nominated)
-                            slice_id = (ni.metrics.slice_id
-                                        if ni is not None and ni.metrics
-                                        else "")
-                            self.allocator.nominate_gang(
-                                spec.gang_name, slice_id, spec.chips,
-                                spec.priority,
-                                expires_at=now + 2 * self.config.gang_timeout_s,
-                                cpu_millis=pod.cpu_millis,
-                                memory_bytes=pod.memory_bytes)
-                        else:
-                            self.allocator.nominate(
-                                pod.key, nominated, spec.chips, spec.priority,
-                                cpu_millis=pod.cpu_millis,
-                                memory_bytes=pod.memory_bytes,
-                                host_ports=pod.host_ports)
-                    self.metrics.inc("preemptions_total")
-                    # budget-violating preemptions are legal (best-effort,
-                    # upstream semantics) but operators need to SEE them
-                    viol = state.read_or("preempt_pdb_violations", 0)
-                    if viol:
-                        self.metrics.inc("preempt_pdb_violations_total", viol)
-                    info.last_failure = f"preempting on {nominated}"
-                    self.queue.requeue_immediate(info)
-                    self._finish(trace, "preempting", reason=info.last_failure)
-                    return "preempting"
+            out = self._run_post_filter(info, trace, state, pod, spec,
+                                        snapshot, now)
+            if out is not None:
+                return out
             # build the diagnostic bounded: at 1000 nodes a full join of
             # every failure verdict costs more than the whole cycle
             parts: list[str] = []
@@ -831,13 +1066,25 @@ class Scheduler:
                     break
             reason = "no feasible node: " + "; ".join(parts)[:500]
             if memo_ok and vers is not None:
-                # classmates fail in O(1) until any cluster event
+                # classmates fail in O(1) until any cluster event; the
+                # rejecting-plugin set rides along so their queueing hints
+                # apply to O(1) failures too
                 if len(self._unsched_memo) > 256:
                     self._unsched_memo.clear()
-                self._unsched_memo[memo_key] = (vers, reason)
-            return self._unschedulable(info, trace, reason)
+                self._unsched_memo[memo_key] = (vers, reason,
+                                                frozenset(rejectors))
+            return self._unschedulable(info, trace, reason,
+                                       rejected_by=tuple(rejectors))
 
-        # PreScore
+        # PreScore. When the candidate set came off the feasible-class
+        # memo, hand prescore plugins its name frozenset so they can key
+        # their own incremental folds on set identity (MaxCollection
+        # re-folds only touched components when the set is unchanged).
+        if feas_ok:
+            fent = self._feas_memo.get(memo_key)
+            if (fent is not None and fent[0] == vers
+                    and len(fent[1]) == len(feasible)):
+                state.write("feasible_names", fent[2])
         for p in self.profile.pre_score:
             st = p.pre_score(state, pod, feasible)
             if st.code == Code.ERROR:
@@ -924,7 +1171,9 @@ class Scheduler:
             if not st.ok:
                 for r in reversed(reserved):
                     r.unreserve(state, pod, chosen)
-                return self._unschedulable(info, trace, f"reserve: {st.message}")
+                return self._unschedulable(info, trace,
+                                           f"reserve: {st.message}",
+                                           rejected_by=(p.name,))
             reserved.append(p)
 
         # Permit
@@ -938,7 +1187,9 @@ class Scheduler:
             if not st.ok:
                 for r in reversed(reserved):
                     r.unreserve(state, pod, chosen)
-                return self._unschedulable(info, trace, f"permit: {st.message}")
+                return self._unschedulable(info, trace,
+                                           f"permit: {st.message}",
+                                           rejected_by=(p.name,))
 
         # Bind this pod, then any gang peers its admission released
         if not self._bind(info, chosen, trace):
@@ -968,6 +1219,73 @@ class Scheduler:
         return "bound"
 
     # ------------------------------------------------------------ sub-steps
+    def _run_post_filter(self, info: QueuedPodInfo, trace: CycleTrace,
+                         state: CycleState, pod: Pod, spec, snapshot,
+                         now: float, only_nodes: set | None = None
+                         ) -> str | None:
+        """PostFilter (preemption): the plugin plans, the engine evicts.
+        Returns "preempting" when a plan was executed, None when no plugin
+        produced one. `only_nodes` restricts planning to the named nodes
+        (the unschedulable-class repair path: only dirty nodes can have
+        become curable) — callers pass it only when every post-filter
+        plugin advertises `supports_restricted`."""
+        for p in self.profile.post_filter:
+            if only_nodes is not None:
+                nominated, victims, st = p.post_filter(
+                    state, pod, snapshot, trace.filter_verdicts,
+                    only_nodes=only_nodes)
+            else:
+                nominated, victims, st = p.post_filter(
+                    state, pod, snapshot, trace.filter_verdicts)
+            if st.ok and nominated is not None:
+                # on a real API server evict() is a DELETE: the victim's
+                # controller recreates it as a new incarnation which the
+                # serve loop submits — requeueing the dead object locally
+                # would race it (same contract as Descheduler.run_once)
+                local = getattr(self.cluster, "supports_local_requeue", False)
+                for victim in victims:
+                    self.cluster.evict(victim)
+                    self.metrics.inc("pods_evicted_total")
+                    if local:
+                        router = self.victim_router or self.submit
+                        if not router(victim):
+                            self.metrics.inc("preempt_victims_unrouted_total")
+                if self.allocator is not None:
+                    # hold the freed capacity until the preemptor binds
+                    # or fails — otherwise requeued victims (or co-hosted
+                    # profiles) refill the hole and the preemptor
+                    # livelocks. A gang holds its whole SLICE (per-host
+                    # chips, bounded by an expiry so an abandoned gang
+                    # can't block the slice forever).
+                    if spec.is_gang:
+                        ni = snapshot.get(nominated)
+                        slice_id = (ni.metrics.slice_id
+                                    if ni is not None and ni.metrics
+                                    else "")
+                        self.allocator.nominate_gang(
+                            spec.gang_name, slice_id, spec.chips,
+                            spec.priority,
+                            expires_at=now + 2 * self.config.gang_timeout_s,
+                            cpu_millis=pod.cpu_millis,
+                            memory_bytes=pod.memory_bytes)
+                    else:
+                        self.allocator.nominate(
+                            pod.key, nominated, spec.chips, spec.priority,
+                            cpu_millis=pod.cpu_millis,
+                            memory_bytes=pod.memory_bytes,
+                            host_ports=pod.host_ports)
+                self.metrics.inc("preemptions_total")
+                # budget-violating preemptions are legal (best-effort,
+                # upstream semantics) but operators need to SEE them
+                viol = state.read_or("preempt_pdb_violations", 0)
+                if viol:
+                    self.metrics.inc("preempt_pdb_violations_total", viol)
+                info.last_failure = f"preempting on {nominated}"
+                self.queue.requeue_immediate(info)
+                self._finish(trace, "preempting", reason=info.last_failure)
+                return "preempting"
+        return None
+
     def _bind(self, info: QueuedPodInfo, node: str, trace: CycleTrace) -> bool:
         """Bind through the configured binder. On failure (API outage
         outlasting the client's retry budget, pod deleted, bound elsewhere)
@@ -1024,6 +1342,9 @@ class Scheduler:
                 # release the pending reservation; keep any nomination (a
                 # preemptor's entitlement survives a transient bind failure)
                 self.allocator.unreserve(CycleState(), pod, node)
+                # freed reservation = capacity event for OTHER parked pods
+                self.notify_event(ClusterEvent(POD_DELETED, node=node,
+                                               origin=pod.key))
             self.metrics.inc("bind_errors_total")
             self._unschedulable(info, trace, f"bind failed: {e}",
                                 outcome="bind-error")
@@ -1070,6 +1391,11 @@ class Scheduler:
             pod.node = None
             pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
             self.metrics.inc("bind_errors_total")
+            # the cache rollback freed the optimistically-claimed chips:
+            # a capacity event for OTHER parked pods (the backend's own
+            # DELETED event never fires — the bind never landed)
+            self.notify_event(ClusterEvent(POD_DELETED, node=node,
+                                           origin=pod.key))
             trace = CycleTrace(pod=pod.key, started=self.clock.time())
             # the dispatch-time success was already counted in
             # pods_scheduled_total/latency; the error counter plus the
@@ -1078,7 +1404,8 @@ class Scheduler:
                                 outcome="bind-error")
 
     def _unschedulable(self, info: QueuedPodInfo, trace: CycleTrace, reason: str,
-                       outcome: str = "unschedulable") -> str:
+                       outcome: str = "unschedulable",
+                       rejected_by: tuple = ()) -> str:
         info.last_failure = reason
         if self.allocator is not None:
             nom = self.allocator.nomination_of(info.pod.key)
@@ -1119,7 +1446,8 @@ class Scheduler:
                 self._doom_parked_members(spec.gang_name, doom)
             self._fail_permanently(info, reason, trace=trace)
             return "failed"
-        self.queue.requeue_backoff(info, now=self.clock.time())
+        self.queue.requeue_backoff(info, now=self.clock.time(),
+                                   rejected_by=tuple(rejected_by))
         self.metrics.inc("pods_unschedulable_total")
         self._finish(trace, outcome, reason=reason)
         return outcome
@@ -1195,13 +1523,30 @@ class Scheduler:
             pass
         for p in reversed(self.profile.reserve):
             p.unreserve(state, w.info.pod, w.node)
+        # the rollback returned reserved chips to the free pool — to a
+        # parked capacity-starved pod that is indistinguishable from a
+        # pod leaving the node, so publish it as one (no cluster backend
+        # sees allocator-only changes, hence no event would fire). origin
+        # keeps the rolled-back pod itself from riding its own event out
+        # of backoff (park -> timeout -> self-wake livelock)
+        self.notify_event(ClusterEvent(POD_DELETED, node=w.node,
+                                       origin=w.info.pod.key))
 
     def _rollback_waiting(self, key: str) -> None:
         w = self.waiting.pop(key, None)
         if w is None:
             return
         self._unreserve_waiting(w)
-        self.queue.requeue_backoff(w.info, now=self.clock.time())
+        # a gang member rolled back at Permit (assembly timeout) is parked
+        # on the gang plugin: a sibling's (re)arrival — or freed capacity —
+        # is what can complete assembly next time, so route those events
+        # to its queueing hints instead of leaving only the blind timer
+        rejected_by = ()
+        if self.gang_permit is not None \
+                and self.gang_permit.gang_of(w.info.pod):
+            rejected_by = (self.gang_permit.name,)
+        self.queue.requeue_backoff(w.info, now=self.clock.time(),
+                                   rejected_by=rejected_by)
 
     def forget(self, pod_key: str) -> None:
         """The pod vanished from the cluster (external DELETE while queued
@@ -1234,9 +1579,11 @@ class Scheduler:
         pod, schedule it. Returns the cycle outcome, or None when nothing
         is ready (queue empty, everyone backing off, or parked at Permit) —
         callers decide how to wait (next_wake_at)."""
-        self.check_waiting()
-        self._drain_bind_failures()
-        while True:  # revivals recorded by submit() on any thread
+        if self.waiting:
+            self.check_waiting()
+        if self._bind_failures:
+            self._drain_bind_failures()
+        while self._gang_revivals:  # recorded by submit() on any thread
             try:
                 self.doomed_gangs.pop(self._gang_revivals.popleft(), None)
             except IndexError:
@@ -1252,7 +1599,10 @@ class Scheduler:
 
     def next_wake_at(self) -> float | None:
         """Earliest future instant at which run_one could make progress:
-        the nearest gang-permit deadline or backoff expiry. None = idle."""
+        the nearest gang-permit deadline or backoff expiry — or now, when
+        undrained cluster events could activate a parked pod (the queue's
+        next_ready_at reads 0.0 while its inbox is non-empty). None =
+        idle."""
         wakes = []
         if self.waiting:
             wakes.append(min(w.deadline for w in self.waiting.values()))

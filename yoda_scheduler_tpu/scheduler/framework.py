@@ -242,6 +242,12 @@ class Snapshot:
         self._any_alloc: bool | None = None
         self._any_pref_pod: bool | None = None
         self._any_unsched: bool | None = None
+        # list() result, computed once: the cycle walks the node list
+        # several times (filter order, pre-score, preemption) and a fresh
+        # 1000-element list per call was measurable at scale. Snapshots
+        # are replaced (not mutated) after construction, so the cache
+        # never goes stale within one snapshot's lifetime.
+        self._list: "list[NodeInfo] | None" = None
 
     def get(self, name: str) -> NodeInfo | None:
         return self._node_infos.get(name)
@@ -255,7 +261,9 @@ class Snapshot:
         return self._namespaces.get(ns, {})
 
     def list(self) -> list[NodeInfo]:
-        return list(self._node_infos.values())
+        if self._list is None:
+            self._list = list(self._node_infos.values())
+        return self._list
 
     def any_taints(self) -> bool:
         """True when at least one node carries a taint. On an untainted
@@ -319,6 +327,72 @@ class QueuedPodInfo:
     attempts: int = 0
     last_failure: str = ""
     not_before: float = 0.0  # backoff gate
+    # plugins whose rejection made the pod unschedulable this attempt —
+    # the queue's event index routes cluster events to exactly these
+    # plugins' queueing hints (upstream QueuedPodInfo.UnschedulablePlugins)
+    rejected_by: tuple = ()
+    # when the pod entered backoff (backoff-wait histogram input)
+    backoff_started: float = 0.0
+
+
+# --------------------------------------------------------------------------
+# Cluster events + queueing hints (upstream EventsToRegister/QueueingHint
+# analogue). A plugin that rejects pods declares which cluster events could
+# make such a pod schedulable again; the queue then wakes a parked pod the
+# moment a matching event arrives instead of letting it sleep out its
+# backoff, and leaves it sleeping on non-matching events (no thundering
+# herd of re-filtering).
+# --------------------------------------------------------------------------
+POD_BOUND = "PodBound"                        # a pod bound somewhere
+POD_DELETED = "PodDeleted"                    # a bound pod left (evict/delete)
+# intake signal, not a capacity event: a new unbound pod appeared in the
+# watch cache. Wakes a sleeping serve loop so intake runs NOW instead of
+# at the next poll tick; never routed through queueing hints (a pending
+# pod's arrival cannot cure anyone's rejection)
+POD_PENDING_ARRIVED = "PodPendingArrived"
+NODE_ADDED = "NodeAdded"                      # node joined the cluster
+NODE_TELEMETRY_UPDATED = "NodeTelemetryUpdated"  # telemetry CR changed
+NODE_SPEC_CHANGED = "NodeSpecChanged"         # labels/taints/cordon edited
+GANG_MEMBER_ARRIVED = "GangMemberArrived"     # a gang member (re)submitted
+
+# hint verdicts
+QUEUE = "QUEUE"   # the event can help: move the pod to the active queue
+SKIP = "SKIP"     # the event cannot help: leave the pod in backoff
+
+
+@dataclass(frozen=True)
+class ClusterEvent:
+    """One cluster state change, as published to the queue's event index.
+    `node` is the node the event touched (when attributable); telemetry
+    events carry the old and new metrics so hints can judge whether the
+    change could free capacity (upstream hints receive old/new objects
+    the same way). `origin` names the pending pod whose own rollback
+    produced the event (reservation/permit unwind): that pod must NOT be
+    woken by it — the "freed" capacity is its own, and self-waking would
+    bypass its backoff in a park/timeout/repark livelock."""
+
+    kind: str
+    node: str | None = None
+    gang: str | None = None
+    old: Any = None
+    new: Any = None
+    origin: str | None = None
+
+
+class EnqueueExtensions:
+    """Mixin for plugins that reject pods: declare the cluster events a
+    rejected pod should wake on, plus a per-(event, pod) hint. A rejecting
+    plugin that does NOT implement this is treated conservatively — any
+    event wakes its pods (upstream's behaviour for hint-less plugins)."""
+
+    def events_to_register(self) -> tuple:
+        """Event kinds that could make a pod this plugin rejected
+        schedulable. Empty = no event can (pods wait out their backoff)."""
+        return ()
+
+    def queueing_hint(self, event: ClusterEvent, pod: Pod) -> str:
+        """QUEUE to activate the pod now, SKIP to leave it in backoff."""
+        return QUEUE
 
 
 # --------------------------------------------------------------------------

@@ -66,10 +66,15 @@ class MultiProfileScheduler:
             self.engines[cfg.scheduler_name] = Scheduler(
                 cluster, cfg, profile=profile, clock=self.clock,
                 cycle_lock=self._cycle_lock)
+        # one shared wake event across engines: the serve loop sleeps on it
+        # between passes instead of blind-polling — any submission or
+        # cluster event (on any engine) sets it
+        self.wake = threading.Event()
         for engine in self.engines.values():
             # preemption victims re-route by THEIR schedulerName, not the
             # preemptor's profile (core.py preemption block)
             engine.victim_router = self.submit
+            engine.wake = self.wake
 
     # ------------------------------------------------------------------ intake
     def submit(self, pod: Pod) -> bool:

@@ -24,7 +24,20 @@ matches every effect.
 
 from __future__ import annotations
 
-from ..framework import CycleState, FilterPlugin, NodeInfo, ScorePlugin, Status
+from ..framework import (
+    ClusterEvent,
+    CycleState,
+    EnqueueExtensions,
+    FilterPlugin,
+    NODE_ADDED,
+    NODE_SPEC_CHANGED,
+    NodeInfo,
+    POD_DELETED,
+    QUEUE,
+    ScorePlugin,
+    SKIP,
+    Status,
+)
 from ...utils.pod import NODE_NAME_FIELD, Pod
 
 NO_SCHEDULE = "NoSchedule"
@@ -443,7 +456,8 @@ def _node_passes_pod_node_affinity(pod: Pod, ni: NodeInfo) -> bool:
 
 
 def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
-                         snapshot, evictable_fn) -> list[Pod] | None:
+                         snapshot, evictable_fn, allocator=None,
+                         priority: int = 0) -> list[Pod] | None:
     """Can eviction make this node pass the pod's inter-pod constraints?
 
     Returns None when it cannot (required podAffinity needs a matching
@@ -458,6 +472,16 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
     # the evictions join the plan so the bind actually succeeds
     port_victims: list[Pod] = []
     if pod.host_ports:
+        if allocator is not None:
+            # a port held for an outranking nominated preemptor is NOT
+            # cured by eviction — the holder is a pending pod, not a
+            # bound one, so planning victims here only churns evictions
+            # while the NodeAdmission filter keeps rejecting the bind
+            nom_fn = getattr(allocator, "nominated_ports", None)
+            held = (nom_fn(node.name, priority, exclude_key=pod.key)
+                    if nom_fn is not None else ())
+            if held and _port_conflicts(pod.host_ports, held):
+                return None
         for p in node.pods:
             if p.host_ports and _port_conflicts(pod.host_ports,
                                                 p.host_ports):
@@ -519,7 +543,7 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
     return list(must.values())
 
 
-class NodeAdmission(FilterPlugin, ScorePlugin):
+class NodeAdmission(FilterPlugin, ScorePlugin, EnqueueExtensions):
     name = "node-admission"
     weight = 1
 
@@ -528,6 +552,25 @@ class NodeAdmission(FilterPlugin, ScorePlugin):
         # holds, so a third pod can't steal resources a preemption freed
         # while the victims drain
         self.allocator = allocator
+
+    # --------------------------------------------------- queueing hints
+    def events_to_register(self) -> tuple:
+        """Admission rejections cure on a node spec edit (label added,
+        taint removed, uncordon), a node join, or — for the pod-shaped
+        predicates (anti-affinity, hostPorts, cpu/mem, spread) — a pod
+        leaving."""
+        return (NODE_SPEC_CHANGED, NODE_ADDED, POD_DELETED)
+
+    def queueing_hint(self, event: ClusterEvent, pod: Pod) -> str:
+        if event.kind == POD_DELETED:
+            # a departure can only cure predicates that counted pods;
+            # nodeSelector/taint/cordon rejections stay parked
+            if (pod.host_ports or pod.cpu_millis or pod.memory_bytes
+                    or pod.pod_anti_affinity or pod.pod_affinity
+                    or pod.topology_spread):
+                return QUEUE
+            return SKIP
+        return QUEUE
 
     def relevant(self, pod: Pod, snapshot) -> bool:
         """Hot-loop gate (core.py): on an untainted cluster a pod without

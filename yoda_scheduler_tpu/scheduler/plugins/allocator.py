@@ -13,7 +13,17 @@ from __future__ import annotations
 
 import threading
 
-from ..framework import CycleState, NodeInfo, ReservePlugin, Status
+from ..framework import (
+    CycleState,
+    EnqueueExtensions,
+    NODE_ADDED,
+    NODE_TELEMETRY_UPDATED,
+    NodeInfo,
+    POD_DELETED,
+    QUEUE,
+    ReservePlugin,
+    Status,
+)
 from ...telemetry.schema import TpuNodeMetrics
 from ...utils.changelog import ChangeLog
 from ...topology.torus import Coord, best_fit_block, fits_shape, parse_topology
@@ -47,8 +57,18 @@ class ClassStats:
 _ZERO6 = (0, 0, 0, 0, 0, 0)
 
 
-class ChipAllocator(ReservePlugin):
+class ChipAllocator(ReservePlugin, EnqueueExtensions):
     name = "chip-allocator"
+
+    # Reserve rejections ("reserve: no qualifying chips...") are rare
+    # races against a concurrent claim; anything that returns or adds
+    # capacity can cure them. Rare enough that a blanket QUEUE cannot
+    # thundering-herd.
+    def events_to_register(self) -> tuple:
+        return (POD_DELETED, NODE_ADDED, NODE_TELEMETRY_UPDATED)
+
+    def queueing_hint(self, event, pod) -> str:
+        return QUEUE
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -102,9 +122,15 @@ class ChipAllocator(ReservePlugin):
     def changes_since(self, version: int):
         return self._changes.changes_since(version)
 
-    def _bump(self, node: str) -> None:
+    def changes_since_directed(self, version: int):
+        return self._changes.changes_since_directed(version)
+
+    def _bump(self, node: str, grew: bool = True) -> None:
+        # grew=False marks capacity-consuming changes (a fresh claim, a
+        # reservation confirmed into a bind): repair paths then skip
+        # hunting the node for NEW feasibility (changelog docstring)
         self._pending_ver[node] = self._pending_ver.get(node, 0) + 1
-        self._changes.record(node)
+        self._changes.record(node, grew=grew)
 
     def forget_nodes(self, gone: set[str]) -> None:
         """Drop cached per-node state for nodes that left the cluster
@@ -251,7 +277,7 @@ class ChipAllocator(ReservePlugin):
             self._nominated[pod_key] = (node, chips, priority,
                                         cpu_millis, memory_bytes,
                                         host_ports)
-            self._changes.record(node)
+            self._changes.record(node, grew=False)  # a hold only consumes
 
     def unnominate(self, pod_key: str) -> None:
         with self._lock:
@@ -428,7 +454,7 @@ class ChipAllocator(ReservePlugin):
             return Status.unschedulable(f"{node}: chips vanished before reserve")
         with self._lock:
             self._pending[pod.key] = (node, coords)
-            self._bump(node)
+            self._bump(node, grew=False)  # a claim only consumes
         return Status.success()
 
     def unreserve(self, state: CycleState, pod: Pod, node: str) -> None:
@@ -442,7 +468,9 @@ class ChipAllocator(ReservePlugin):
         with self._lock:
             entry = self._pending.pop(pod.key, None)
             if entry is not None:
-                self._bump(entry[0])
+                # the reservation becomes a bound assignment in the same
+                # cycle: the node's free set never grows through this
+                self._bump(entry[0], grew=False)
         return entry[1] if entry else None
 
 

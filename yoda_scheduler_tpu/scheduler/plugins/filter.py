@@ -22,14 +22,26 @@ from __future__ import annotations
 
 import time
 
-from ..framework import CycleState, FilterPlugin, NodeInfo, Status
+from ..framework import (
+    ClusterEvent,
+    CycleState,
+    EnqueueExtensions,
+    FilterPlugin,
+    NODE_ADDED,
+    NODE_TELEMETRY_UPDATED,
+    NodeInfo,
+    POD_DELETED,
+    QUEUE,
+    SKIP,
+    Status,
+)
 from ...topology.torus import fits_shape, parse_topology, best_fit_block
 from ...utils.labels import WorkloadSpec
 from .allocator import ChipAllocator, _node_shape
 from .gang import GangCoordinator, bound_gang_members
 
 
-class TelemetryFilter(FilterPlugin):
+class TelemetryFilter(FilterPlugin, EnqueueExtensions):
     name = "telemetry-filter"
     # advertises a verdict input that moves with TIME rather than with any
     # cluster version counter (telemetry staleness): the feasible-class
@@ -57,6 +69,48 @@ class TelemetryFilter(FilterPlugin):
     def forget_nodes(self, gone: set[str]) -> None:
         for n in gone:
             self._verdict_cache.pop(n, None)
+
+    # ------------------------------------------------- queueing hints
+    def events_to_register(self) -> tuple:
+        """Events that can cure a capacity/staleness rejection: chips
+        freed by a departing pod, a node joining, or a telemetry update.
+        Deliberately NOT PodBound — binds only consume capacity, so a
+        bind storm must not thundering-herd chip-starved pods back into
+        the filter chain."""
+        return (POD_DELETED, NODE_ADDED, NODE_TELEMETRY_UPDATED)
+
+    def queueing_hint(self, event: ClusterEvent, pod) -> str:
+        if event.kind != NODE_TELEMETRY_UPDATED:
+            return QUEUE  # freed chips / a fresh node can always help
+        old, new = event.old, event.new
+        if new is None:
+            return SKIP  # telemetry deletion never frees capacity
+        if old is None:
+            return QUEUE  # first report for this node = new capacity
+        # a periodic republish with unchanged capacity must SKIP — the
+        # sniffer fleet re-puts every few seconds, and waking every
+        # parked pod each time would reintroduce the retry storm the
+        # backoff existed to prevent. QUEUE only when the update could
+        # flip a verdict this plugin produces:
+        if (new.accelerator != old.accelerator
+                or new.tpu_generation != old.tpu_generation
+                or new.slice_id != old.slice_id
+                or new.num_hosts != old.num_hosts):
+            return QUEUE  # partition / slice-shape change
+        if new.heartbeat - old.heartbeat > self.max_age:
+            # the node skipped at least one max_age window: a pod may
+            # have been rejected on staleness that this report cures
+            return QUEUE
+        nh, oh = new.healthy_chips(), old.healthy_chips()
+        if len(nh) > len(oh):
+            return QUEUE  # chips recovered
+        if (max((c.hbm_free_mb for c in nh), default=0)
+                > max((c.hbm_free_mb for c in oh), default=0)):
+            return QUEUE  # freed HBM can cure a memory-class rejection
+        if (max((c.clock_mhz for c in nh), default=0)
+                > max((c.clock_mhz for c in oh), default=0)):
+            return QUEUE
+        return SKIP
 
     def filter(self, state: CycleState, pod, node: NodeInfo) -> Status:
         spec: WorkloadSpec = state.read("workload_spec")

@@ -19,10 +19,17 @@ import threading
 
 from ..framework import (
     CANDIDATE_NODES_KEY,
+    ClusterEvent,
     CycleState,
+    EnqueueExtensions,
+    GANG_MEMBER_ARRIVED,
+    NODE_TELEMETRY_UPDATED,
     PermitPlugin,
+    POD_DELETED,
     PreFilterPlugin,
+    QUEUE,
     ReservePlugin,
+    SKIP,
     Status,
 )
 from ...utils.labels import GANG_NAME_LABEL, WorkloadSpec, spec_for
@@ -148,8 +155,26 @@ class GangCoordinator:
             return members
 
 
-class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin):
+class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
+                 EnqueueExtensions):
     name = "gang-permit"
+
+    # --------------------------------------------------- queueing hints
+    def events_to_register(self) -> tuple:
+        """A parked gang member becomes schedulable when a sibling
+        (re)arrives (assembly can complete / a doomed gang revives), or
+        when slice capacity frees up (a departing pod or recovered chips
+        can make a big-enough slice appear)."""
+        return (GANG_MEMBER_ARRIVED, POD_DELETED, NODE_TELEMETRY_UPDATED)
+
+    def queueing_hint(self, event: ClusterEvent, pod) -> str:
+        if event.kind == GANG_MEMBER_ARRIVED:
+            # only the arriving member's OWN gang benefits — other gangs'
+            # members stay parked (their assembly state is unchanged)
+            if event.gang and event.gang == pod.labels.get(GANG_NAME_LABEL):
+                return QUEUE
+            return SKIP
+        return QUEUE  # capacity events: a slice may now fit the gang
 
     def __init__(self, gangs: GangCoordinator, timeout_s: float = 30.0,
                  allocator=None) -> None:

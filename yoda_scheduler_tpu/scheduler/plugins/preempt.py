@@ -50,13 +50,20 @@ def _evictable(pod: Pod) -> bool:
 
 class PriorityPreemption(PostFilterPlugin):
     name = "priority-preemption"
+    # the planner's per-node verdicts are independent (absent PDBs, which
+    # the engine gates on): restricting the scan to a caller-supplied node
+    # set yields exactly the full scan's verdicts for those nodes, so the
+    # unschedulable-class repair path may re-plan only the dirty nodes
+    supports_restricted = True
 
     def __init__(self, allocator: ChipAllocator, gangs=None) -> None:
         self.allocator = allocator
         self.gangs = gangs  # GangCoordinator: chosen-slice pin for gangs
 
     def post_filter(self, state: CycleState, pod: Pod, snapshot: Snapshot,
-                    failures: dict[str, str]) -> tuple[str | None, list[Pod], Status]:
+                    failures: dict[str, str],
+                    only_nodes: set | None = None
+                    ) -> tuple[str | None, list[Pod], Status]:
         spec: WorkloadSpec = state.read("workload_spec")
         now = state.read_or("now")
         my_prio = _priority(pod)
@@ -78,6 +85,8 @@ class PriorityPreemption(PostFilterPlugin):
             return _priority(p) < my_prio and _evictable(p)
 
         for node in snapshot.list():
+            if only_nodes is not None and node.name not in only_nodes:
+                continue
             m = node.metrics
             if m is None or (now is not None and m.stale(now=now)):
                 continue
@@ -92,7 +101,9 @@ class PriorityPreemption(PostFilterPlugin):
             # (required podAffinity, or an unevictable conflicting pod);
             # otherwise the conflicting pods join the victim plan
             obstacles = preemption_obstacles(state, pod, node, snapshot,
-                                             evictable_victim)
+                                             evictable_victim,
+                                             allocator=self.allocator,
+                                             priority=my_prio)
             if obstacles is None:
                 continue
             victims = self._plan_node(spec, my_prio, node, pod_key=pod.key,
@@ -160,7 +171,9 @@ class PriorityPreemption(PostFilterPlugin):
             if not admissible(pod, node):
                 continue
             if preemption_obstacles(state, pod, node, snapshot,
-                                    lambda p: False) != []:
+                                    lambda p: False,
+                                    allocator=self.allocator,
+                                    priority=my_prio) != []:
                 continue
             if m.num_hosts < spec.gang_size:
                 continue

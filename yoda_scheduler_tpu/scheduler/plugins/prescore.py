@@ -72,15 +72,34 @@ class MaxCollection(PreScorePlugin):
         # later sample would be absorbed (version covers it, data
         # predates it) and changes_since would never report it again
         vers = state.read_or("cycle_versions")
+        names = state.read_or("feasible_names")
         ccontribs = None
         dirty = None
+        cnames = cmv6 = None
         if cb is not None:
             hit = self._memo.get(spec)
             if hit is not None:
-                cvers, ccontribs = hit
+                cvers, ccontribs, cnames, cmv6 = hit
                 _, dirty = cb(cvers)
                 if dirty is None:  # change log trimmed past cvers
                     ccontribs = None
+        if (ccontribs is not None and cmv6 is not None
+                and names is not None and names == cnames):
+            # incremental fold: the feasible NAME SET is unchanged, so
+            # the cluster maxima can only move through the touched
+            # (dirty ∩ feasible) nodes — recompute exactly those tuples,
+            # raise any component the new value reaches, and re-fold a
+            # component only when its previous max CONTRIBUTOR shrank
+            # below the recorded max. Identical maxima to the full walk
+            # by construction; any doubt (missing tuple, node gone)
+            # falls through to the full walk.
+            out = self._fold_incremental(state, spec, names, ccontribs,
+                                         cmv6, dirty & names)
+            if out is not None:
+                if vers is not None:
+                    self._memo[spec] = (vers, ccontribs, cnames, out)
+                state.write(MAX_KEY, MaxValue(*out))
+                return Status.success()
         contribs: dict = {}
         mv6 = [1, 1, 1, 1, 1, 1]
         fresh = 0
@@ -125,6 +144,58 @@ class MaxCollection(PreScorePlugin):
         if cb is not None and vers is not None:
             if len(self._memo) > 256:
                 self._memo.clear()
-            self._memo[spec] = (vers, contribs)
+            # record the name set + folded maxima so the NEXT classmate
+            # with the same candidate set folds incrementally
+            self._memo[spec] = (vers, contribs, names, tuple(mv6))
         state.write(MAX_KEY, MaxValue(*mv6))
         return Status.success()
+
+    _MISS = object()
+
+    def _fold_incremental(self, state, spec, names, ccontribs, cmv6,
+                          touched):
+        """Exact incremental maxima update for an unchanged feasible name
+        set. Returns the new 6-tuple, or None when anything prevents an
+        exact answer (the caller runs the full walk). Mutates ccontribs
+        in place with the touched nodes' fresh tuples."""
+        if not touched:
+            self.fast_hits += 1
+            return cmv6
+        snapshot = state.read_or("snapshot")
+        if snapshot is None:
+            return None
+        _MISS = self._MISS
+        mv6 = list(cmv6)
+        refold = 0
+        for name in touched:
+            old = ccontribs.get(name, _MISS)
+            if old is _MISS:
+                return None  # never walked: can't diff against it
+            node = snapshot.get(name)
+            if node is None or node.metrics is None:
+                return None
+            st = self.allocator.class_stats(node, spec.min_free_mb,
+                                            spec.min_clock_mhz)
+            self.stats_calls += 1
+            t = st.maxima if st.count else None
+            ccontribs[name] = t
+            for j in range(6):
+                nv = t[j] if t is not None else 0
+                ov = old[j] if old is not None else 0
+                if nv >= mv6[j]:
+                    mv6[j] = nv
+                elif ov >= mv6[j]:
+                    refold |= 1 << j  # previous max contributor shrank
+        if refold:
+            for j in range(6):
+                if refold & (1 << j):
+                    m = 1
+                    for nm in names:
+                        t = ccontribs.get(nm)
+                        if t is not None and t[j] > m:
+                            m = t[j]
+                    mv6[j] = m
+        for j in range(6):
+            if mv6[j] < 1:
+                mv6[j] = 1  # normalisation floor, same as the full walk
+        return tuple(mv6)

@@ -17,7 +17,17 @@ sets its strength against the telemetry score.
 
 from __future__ import annotations
 
-from ..framework import CycleState, NodeInfo, PreScorePlugin, ScorePlugin, Status, min_max_normalize
+from ..framework import (
+    CycleState,
+    EnqueueExtensions,
+    NodeInfo,
+    POD_DELETED,
+    PreScorePlugin,
+    QUEUE,
+    ScorePlugin,
+    Status,
+    min_max_normalize,
+)
 from ...utils.labels import WorkloadSpec
 from .allocator import ChipAllocator, _node_shape
 from .prescore import SPEC_KEY
@@ -25,13 +35,23 @@ from .prescore import SPEC_KEY
 SLICE_USE_KEY = "slice_usage"
 
 
-class TopologyScore(ScorePlugin, PreScorePlugin):
+class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
     name = "topology-score"
     # score-memo contract: a node's raw score additionally depends on its
     # SLICE's usage entry (the packing term) — the engine rescures a
     # clean node whenever its slice's usage entry moved (a bind anywhere
     # on the slice dents it)
     score_inputs = "node+slice_usage"
+
+    # Scoring never rejects, so this plugin rarely appears in a pod's
+    # rejecting set — but topology-shaped Reserve failures routed to it
+    # (no contiguous block left after a racing claim) wake on departures,
+    # the one event that de-fragments a torus.
+    def events_to_register(self) -> tuple:
+        return (POD_DELETED,)
+
+    def queueing_hint(self, event, pod) -> str:
+        return QUEUE
 
     def __init__(self, allocator: ChipAllocator, weight: int = 2,
                  contiguity_frac: float = 0.5) -> None:

@@ -1,10 +1,22 @@
-"""Active queue + backoff for pending pods.
+"""Active queue + backoff for pending pods, with event-driven requeue.
 
 The upstream engine the reference embeds provides the priority queue and the
 unschedulable-pod backoff (configured 1s initial / 10s max in reference
 deploy/yoda-scheduler.yaml:19-20); the plugin only supplies the comparator
 (reference pkg/yoda/sort/sort.go:8-10). This module is the native
 equivalent: a comparator-ordered active queue plus a backoff parking lot.
+
+Event-driven requeue (upstream QueueingHints/EventsToRegister analogue):
+a pod entering backoff records WHICH plugins rejected it; the engine
+publishes cluster events (binds, deletions, telemetry updates, node spec
+changes, gang arrivals) into `on_event`, which consults exactly the
+rejecting plugins' queueing hints. A QUEUE verdict moves the pod to the
+active queue immediately — it does not sleep out the rest of its backoff —
+while SKIP (and events no rejecting plugin registered for) leave it
+parked, so a bind storm cannot thundering-herd every parked pod back into
+the filter chain. The backoff deadline stays as the timer fallback, so a
+pod whose rejecting plugins have no hint coverage behaves exactly as
+before.
 """
 
 from __future__ import annotations
@@ -12,9 +24,10 @@ from __future__ import annotations
 import heapq
 import itertools
 import time
+from collections import deque
 from typing import Callable
 
-from .framework import QueuedPodInfo
+from .framework import ClusterEvent, EnqueueExtensions, QUEUE, QueuedPodInfo
 from ..utils.pod import Pod
 
 LessFn = Callable[[QueuedPodInfo, QueuedPodInfo], bool]
@@ -22,7 +35,8 @@ LessFn = Callable[[QueuedPodInfo, QueuedPodInfo], bool]
 
 class SchedulingQueue:
     def __init__(self, less: LessFn, initial_backoff_s: float = 1.0,
-                 max_backoff_s: float = 10.0, key=None):
+                 max_backoff_s: float = 10.0, key=None, metrics=None,
+                 hinted_backoff_s: float = 0.0):
         """`less` is the framework comparator contract. When the queue-sort
         plugin also provides an equivalent `key(info)` (PrioritySort does),
         the active queue is a heap — O(log n) pops instead of an O(n)
@@ -33,19 +47,73 @@ class SchedulingQueue:
         whatever `key`/`less` reads (e.g. the scv/priority label) must be
         immutable while the pod sits in the active queue. Kubernetes
         enforces the same invariant upstream: pod priority is set from the
-        PriorityClass at admission and is immutable thereafter."""
+        PriorityClass at admission and is immutable thereafter.
+
+        `metrics` (utils.obs.Metrics, optional): requeue_events_total /
+        requeue_wakeups_total / requeue_hint_skips_total counters and the
+        backoff_wait_ms histogram (how long pods actually sat in backoff
+        before activation — the number event-driven requeue shrinks)."""
         self._less = less
         self._key = key
         self._seq = itertools.count()  # heap tie-break; preserves FIFO
         self._initial = initial_backoff_s
         self._max = max_backoff_s
+        # optional backoff stretch: a pod whose EVERY rejecting plugin
+        # registered queueing hints does not need to retry blind — a
+        # matching event is its retry trigger, so the timer MAY stretch
+        # to this safety net (upstream podMaxInUnschedulablePodsDuration).
+        # Opt-in: any value <= max_backoff_s disables it, keeping the
+        # classic 1s->10s cadence (event wakes fire either way). Pods
+        # with a hint-less rejector always keep the classic cadence,
+        # because nothing else would ever retry them.
+        self._hinted = (hinted_backoff_s
+                        if hinted_backoff_s > max_backoff_s else 0.0)
+        self._metrics = metrics
         self._active: list = []  # infos, or (key, seq, info) heap entries
-        self._backoff: list[QueuedPodInfo] = []
+        # backoff lot: a deadline-ordered heap of (not_before, seq, info).
+        # Entries go stale when their pod is activated by an event or
+        # removed — detected at pop time by not_before mismatch / absence
+        # from the parked map (the round-5 backoff list was rescanned
+        # O(parked) on every pop, which dominated retry-heavy bursts).
+        self._backoff: list = []
+        # parked map: id(info) -> info for every pod currently in backoff
+        self._parked: dict[int, QueuedPodInfo] = {}
+        # event index: event kind -> {id(info): info} for parked pods whose
+        # rejecting plugins registered that kind; "*" holds pods rejected
+        # by a plugin without hint support (any event may help them)
+        self._by_kind: dict[str, dict[int, QueuedPodInfo]] = {}
+        # plugin name -> (registered kinds, hint callable); populated by
+        # register_plugin from the profile's EnqueueExtensions plugins
+        self._hints: dict[str, tuple[frozenset, Callable]] = {}
+        # cross-thread event inbox: notify() appends from ANY thread
+        # (reflector, binder, test driver — deque append is GIL-atomic);
+        # pop()/next_ready_at() drain it on the thread that owns the
+        # queue, so hints and the parked map never race
+        self._inbox: deque = deque()
         # pod-key membership counts: contains() is called once per PENDING
         # pod per serve pass (k8s/client._serve intake), so it must be
         # O(1), not a queue scan — at 1000 pending pods the scan made the
         # serve loop O(n^2) per pass
         self._key_counts: dict[str, int] = {}
+
+    # --------------------------------------------------------- hint registry
+    def register_plugin(self, plugin) -> None:
+        """Register a plugin's EnqueueExtensions (name, events, hint). A
+        plugin registering an EMPTY kind set declares "no event can cure
+        my rejections": its pods are filed under no event bucket (they
+        wait out their backoff timer) instead of the conservative
+        any-event wildcard that covers plugins with no EnqueueExtensions
+        at all."""
+        if not isinstance(plugin, EnqueueExtensions):
+            return
+        kinds = frozenset(plugin.events_to_register())
+        self._hints[plugin.name] = (kinds, plugin.queueing_hint)
+
+    def register_hint(self, name: str, kinds, hint: Callable) -> None:
+        """Register a bare (non-plugin) hint source — the engine uses this
+        for its own rejections (e.g. waiting-for-victims-to-terminate wakes
+        on PodDeleted)."""
+        self._hints[name] = (frozenset(kinds), hint)
 
     def _inc(self, key: str) -> None:
         self._key_counts[key] = self._key_counts.get(key, 0) + 1
@@ -77,17 +145,113 @@ class SchedulingQueue:
         self._inc(pod.key)
 
     def __len__(self) -> int:
-        return len(self._active) + len(self._backoff)
+        return len(self._active) + len(self._parked)
 
     def pending(self) -> int:
         return len(self)
 
+    # ------------------------------------------------------------ parked lot
+    def _park(self, info: QueuedPodInfo) -> None:
+        heapq.heappush(self._backoff,
+                       (info.not_before, next(self._seq), info))
+        self._parked[id(info)] = info
+        kinds: set[str] = set()
+        for name in info.rejected_by:
+            reg = self._hints.get(name)
+            if reg is None:
+                kinds.add("*")  # hint-less rejector: any event may help
+            else:
+                kinds.update(reg[0])
+        for kind in kinds:
+            self._by_kind.setdefault(kind, {})[id(info)] = info
+
+    def _unpark(self, info: QueuedPodInfo) -> None:
+        """Drop a pod from the parked map and event index (its heap entry
+        goes stale and is skipped at pop time)."""
+        self._parked.pop(id(info), None)
+        for bucket in self._by_kind.values():
+            bucket.pop(id(info), None)
+
+    def _activate(self, info: QueuedPodInfo, now: float) -> None:
+        self._unpark(info)
+        # every parked pod came through requeue_backoff, which stamped
+        # backoff_started (0.0 is a legitimate FakeClock epoch)
+        if self._metrics is not None:
+            self._metrics.observe("backoff_wait_ms",
+                                  (now - info.backoff_started) * 1e3)
+        self._push_active(info)
+
     def _flush_backoff(self, now: float) -> None:
-        ready = [q for q in self._backoff if q.not_before <= now]
-        if ready:
-            self._backoff = [q for q in self._backoff if q.not_before > now]
-            for q in ready:
-                self._push_active(q)
+        heap = self._backoff
+        while heap:
+            nb, _, info = heap[0]
+            if self._parked.get(id(info)) is not info \
+                    or info.not_before != nb:
+                heapq.heappop(heap)  # stale: activated by event or removed
+                continue
+            if nb > now:
+                return
+            heapq.heappop(heap)
+            self._activate(info, now)
+
+    def notify(self, event: ClusterEvent) -> None:
+        """Accept a cluster event from any thread; the next pop() (or an
+        explicit drain via on_event) routes it through the queueing hints
+        on the queue owner's thread."""
+        self._inbox.append(event)
+
+    def has_undrained_events(self) -> bool:
+        return bool(self._inbox)
+
+    def _drain_inbox(self, now: float) -> None:
+        while True:
+            try:
+                ev = self._inbox.popleft()
+            except IndexError:
+                return
+            self.on_event(ev, now=now)
+
+    def on_event(self, event: ClusterEvent, now: float | None = None) -> int:
+        """Route one cluster event through the parked pods' queueing hints;
+        returns how many pods were activated. Only pods whose rejecting
+        plugins registered this event kind are consulted (plus pods with a
+        hint-less rejector); a QUEUE verdict from any such plugin moves the
+        pod to the active queue immediately, SKIP leaves its backoff
+        intact."""
+        if self._metrics is not None:
+            self._metrics.inc("requeue_events_total")
+        bucket = self._by_kind.get(event.kind)
+        wild = self._by_kind.get("*")
+        if not bucket and not wild:
+            return 0
+        now = time.time() if now is None else now
+        woken = 0
+        candidates = list(bucket.values()) if bucket else []
+        if wild:
+            seen = {id(i) for i in candidates}
+            candidates.extend(i for i in wild.values()
+                              if id(i) not in seen)
+        for info in candidates:
+            if event.origin is not None and info.pod.key == event.origin:
+                continue  # a pod's own rollback never wakes itself
+            verdict = None
+            for name in info.rejected_by:
+                reg = self._hints.get(name)
+                if reg is None:
+                    verdict = QUEUE  # hint-less rejector: conservative
+                    break
+                kinds, hint = reg
+                if event.kind in kinds and hint(event, info.pod) == QUEUE:
+                    verdict = QUEUE
+                    break
+            if verdict == QUEUE:
+                self._activate(info, now)
+                woken += 1
+            elif self._metrics is not None:
+                self._metrics.inc("requeue_hint_skips_total")
+        if woken and self._metrics is not None:
+            self._metrics.inc("requeue_wakeups_total", woken)
+        return woken
 
     def pop(self, now: float | None = None) -> QueuedPodInfo | None:
         """Pop the highest-priority ready pod (None if all are backing off).
@@ -96,6 +260,8 @@ class SchedulingQueue:
         comparator selection scan (the framework contract only guarantees a
         strict weak order via `less`)."""
         now = time.time() if now is None else now
+        if self._inbox:
+            self._drain_inbox(now)
         self._flush_backoff(now)
         if not self._active:
             return None
@@ -111,8 +277,12 @@ class SchedulingQueue:
         self._dec(info.pod.key)
         return info
 
-    def requeue_backoff(self, info: QueuedPodInfo, now: float | None = None) -> None:
-        """Return an unschedulable pod with exponential backoff 1s -> 10s."""
+    def requeue_backoff(self, info: QueuedPodInfo, now: float | None = None,
+                        rejected_by: tuple = ()) -> None:
+        """Return an unschedulable pod with exponential backoff 1s -> 10s.
+        `rejected_by` names the plugins whose rejection parked it — the
+        event index wakes the pod early when one of them hints QUEUE for a
+        later cluster event."""
         now = time.time() if now is None else now
         info.attempts += 1
         # cap the exponent: a permanently-unschedulable pod with
@@ -120,8 +290,19 @@ class SchedulingQueue:
         # past ~1024 attempts
         delay = min(self._initial * (2 ** min(info.attempts - 1, 32)),
                     self._max)
+        if self._hinted and rejected_by and all(
+                self._hints.get(name, (None,))[0]
+                for name in rejected_by):
+            # full hint coverage: every way this pod can become
+            # schedulable maps to a registered event, so blind timer
+            # retries only burn cycles — stretch the timer to the
+            # safety-net duration (events wake the pod the moment one
+            # matches)
+            delay = max(delay, self._hinted)
         info.not_before = now + delay
-        self._backoff.append(info)
+        info.backoff_started = now
+        info.rejected_by = tuple(rejected_by)
+        self._park(info)
         self._inc(info.pod.key)
 
     def requeue_immediate(self, info: QueuedPodInfo) -> None:
@@ -150,10 +331,10 @@ class SchedulingQueue:
             for q in self._active:
                 (removed if q.pod.key == pod_key else keep).append(q)
             self._active = keep
-        for q in self._backoff:
-            if q.pod.key == pod_key:
-                removed.append(q)
-        self._backoff = [q for q in self._backoff if q.pod.key != pod_key]
+        for info in [i for i in self._parked.values()
+                     if i.pod.key == pod_key]:
+            self._unpark(info)  # heap entry goes stale; skipped at pop
+            removed.append(info)
         for _ in removed:
             self._dec(pod_key)
         return removed
@@ -162,9 +343,18 @@ class SchedulingQueue:
         return pod_key in self._key_counts
 
     def next_ready_at(self) -> float | None:
-        """Earliest not_before among parked pods (None if active non-empty)."""
-        if self._active:
+        """Earliest not_before among parked pods (None if active non-empty).
+        O(1) amortised: stale heap heads are discarded as encountered.
+        An undrained event inbox reads as ready NOW — the next pop may
+        activate a parked pod."""
+        if self._active or self._inbox:
             return 0.0
-        if not self._backoff:
-            return None
-        return min(q.not_before for q in self._backoff)
+        heap = self._backoff
+        while heap:
+            nb, _, info = heap[0]
+            if self._parked.get(id(info)) is not info \
+                    or info.not_before != nb:
+                heapq.heappop(heap)
+                continue
+            return nb
+        return None

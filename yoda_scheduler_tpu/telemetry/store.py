@@ -31,24 +31,66 @@ class TelemetryStore:
         self._lock = threading.RLock()
         self._by_node: dict[str, TpuNodeMetrics] = {}
         self._watchers: list[WatchCallback] = []
+        # change watchers get (node, old, new) — old/new object pairs feed
+        # the scheduler queue's telemetry queueing hints (a hint must judge
+        # whether the update could free capacity, which needs the diff)
+        self._change_watchers: list = []
         self._changes = ChangeLog()
+        # conservative lower bound over stored heartbeats: lets the
+        # scheduler's feasible-list repair skip its per-node staleness
+        # re-checks outright when even the oldest heartbeat is fresh (the
+        # overwhelmingly common case — sniffers republish every few
+        # seconds). Only lowered incrementally; recomputed exactly once
+        # per full put round so refreshed heartbeats eventually raise it.
+        self._hb_floor: float | None = None
+        self._floor_puts = 0
 
     # ------------------------------------------------------------- publisher
     def put(self, metrics: TpuNodeMetrics) -> None:
         with self._lock:
+            old = self._by_node.get(metrics.node)
+            if old is metrics:
+                # in-place republish (the caller mutated the stored object
+                # and put it again): no pre-change state exists to diff
+                # against, so hand hints old=None — the conservative
+                # "first report" verdict — rather than a no-op diff that
+                # would SKIP a genuine change (e.g. a heartbeat revival)
+                old = None
             metrics.generation = self._changes.record(metrics.node)
             self._by_node[metrics.node] = metrics
+            hb = metrics.heartbeat
+            if self._hb_floor is None or hb < self._hb_floor:
+                self._hb_floor = hb
+            self._floor_puts += 1
+            if self._floor_puts > len(self._by_node):
+                self._floor_puts = 0
+                self._hb_floor = min(
+                    (m.heartbeat for m in self._by_node.values()),
+                    default=None)
             watchers = list(self._watchers)
+            changed = list(self._change_watchers)
         for cb in watchers:
             cb(metrics.node, metrics)
+        for cb in changed:
+            cb(metrics.node, old, metrics)
 
     def delete(self, node: str) -> None:
         with self._lock:
-            self._by_node.pop(node, None)
+            old = self._by_node.pop(node, None)
             self._changes.record(node)
+            # removal can only raise the true minimum; the floor stays a
+            # valid (conservative) lower bound
             watchers = list(self._watchers)
+            changed = list(self._change_watchers)
         for cb in watchers:
             cb(node, None)
+        for cb in changed:
+            cb(node, old, None)
+
+    def heartbeat_floor(self) -> float | None:
+        """Lower bound over every stored heartbeat (None when empty).
+        GIL-atomic single read; see __init__ for the maintenance rule."""
+        return self._hb_floor
 
     def changes_since(self, version: int) -> tuple[int, set[str] | None]:
         """(current version, nodes changed after `version`) — None for the
@@ -84,6 +126,19 @@ class TelemetryStore:
             with self._lock:
                 if cb in self._watchers:
                     self._watchers.remove(cb)
+
+        return cancel
+
+    def watch_changes(self, cb) -> Callable[[], None]:
+        """Register a diff callback (cb(node, old, new)); returns an
+        unsubscribe function. new=None means deletion."""
+        with self._lock:
+            self._change_watchers.append(cb)
+
+        def cancel() -> None:
+            with self._lock:
+                if cb in self._change_watchers:
+                    self._change_watchers.remove(cb)
 
         return cancel
 
