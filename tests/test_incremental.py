@@ -362,7 +362,13 @@ class TestScoreMemoMaximaGuard:
             store.put(m)
         cluster = FakeCluster(store)
         cluster.add_nodes_from_telemetry()
-        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+        # columnar off (this test pins the SCALAR score-memo mechanics —
+        # per-node score() call counts; the batch path recomputes all
+        # candidates each cycle, so no replay can go stale there) and
+        # fragmentation off (a third scorer would shift the call counts)
+        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9,
+                                                   columnar=False,
+                                                   fragmentation_weight=0),
                           clock=FakeClock(start=now))
         pods = [Pod(f"p{i}", labels={"scv/number": "4",
                                      "tpu/accelerator": "tpu"})
@@ -401,8 +407,12 @@ class TestMaximaMemoFastPath:
         store.put(g)
         cluster = FakeCluster(store)
         cluster.add_nodes_from_telemetry()
+        # columnar off: these tests pin the SCALAR contributor-memo fold
+        # (class_stats call counts); the columnar path computes the same
+        # maxima as masked array folds without touching class_stats
         sched = Scheduler(cluster,
-                          SchedulerConfig(telemetry_max_age_s=max_age),
+                          SchedulerConfig(telemetry_max_age_s=max_age,
+                                          columnar=False),
                           clock=FakeClock(start=t0))
         maxc = next(p for p in sched.profile.pre_score
                     if getattr(p, "name", "") == "max-collection")

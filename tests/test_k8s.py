@@ -510,3 +510,157 @@ def test_nonidempotent_post_not_silently_replayed():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+class TestTlsVerification:
+    """https certificate handling (VERDICT r5 #5): verified by DEFAULT —
+    against a given CA file, the in-cluster service-account CA, or the
+    system trust store — with --insecure-skip-tls-verify as the explicit
+    lab-cluster escape hatch."""
+
+    def test_https_default_verifies_against_system_roots(self):
+        import ssl
+
+        c = KubeClient("https://apiserver.invalid:6443")
+        assert c._ctx is not None
+        assert c._ctx.verify_mode == ssl.CERT_REQUIRED
+        assert c._ctx.check_hostname
+
+    def test_insecure_flag_disables_verification(self):
+        import ssl
+
+        c = KubeClient("https://apiserver.invalid:6443",
+                       insecure_skip_tls_verify=True)
+        assert c._ctx is not None
+        assert c._ctx.verify_mode == ssl.CERT_NONE
+        assert not c._ctx.check_hostname
+
+    def test_http_has_no_tls_context(self):
+        c = KubeClient("http://apiserver.invalid:8080")
+        assert c._ctx is None
+
+    def test_explicit_ca_file_is_loaded(self, tmp_path, monkeypatch):
+        import ssl as ssl_mod
+
+        seen = {}
+        real = ssl_mod.create_default_context
+
+        def spy(*a, **kw):
+            seen.update(kw)
+            return real()  # cafile omitted: the spy only records it
+
+        monkeypatch.setattr(ssl_mod, "create_default_context", spy)
+        ca = tmp_path / "ca.crt"
+        ca.write_text("pem")
+        KubeClient("https://apiserver.invalid:6443", ca_file=str(ca))
+        assert seen.get("cafile") == str(ca)
+
+    def test_in_cluster_ca_picked_up_when_present(self, tmp_path,
+                                                  monkeypatch):
+        import ssl as ssl_mod
+
+        from yoda_scheduler_tpu.k8s import client as client_mod
+
+        ca = tmp_path / "ca.crt"
+        ca.write_text("pem")
+        monkeypatch.setattr(client_mod, "_IN_CLUSTER_CA", str(ca))
+        seen = {}
+        real = ssl_mod.create_default_context
+
+        def spy(*a, **kw):
+            seen.update(kw)
+            return real()
+
+        monkeypatch.setattr(ssl_mod, "create_default_context", spy)
+        KubeClient("https://apiserver.invalid:6443")
+        assert seen.get("cafile") == str(ca)
+
+    def test_kubeconfig_candidates_carry_tls_settings(self, tmp_path,
+                                                      monkeypatch):
+        import ssl as ssl_mod
+
+        seen = {}
+        real = ssl_mod.create_default_context
+
+        def spy(*a, **kw):
+            seen.update(kw)
+            return real()  # the spy records cafile; no real PEM needed
+
+        monkeypatch.setattr(ssl_mod, "create_default_context", spy)
+        ca = tmp_path / "kube-ca.crt"
+        ca.write_text("pem")
+        cfg = tmp_path / "config"
+        cfg.write_text(
+            "clusters:\n"
+            "- cluster:\n"
+            f"    server: https://kube.invalid:6443\n"
+            f"    certificate-authority: {ca}\n"
+            "  name: c\n")
+        cands = KubeClient._candidates_from_env(kubeconfig=str(cfg))
+        assert len(cands) == 1
+        assert cands[0].base_url == "https://kube.invalid:6443"
+        assert seen.get("cafile") == str(ca)
+
+        cfg.write_text(
+            "clusters:\n"
+            "- cluster:\n"
+            "    server: https://kube.invalid:6443\n"
+            "    insecure-skip-tls-verify: true\n"
+            "  name: c\n")
+        cands = KubeClient._candidates_from_env(kubeconfig=str(cfg))
+        assert cands[0]._ctx.verify_mode == ssl_mod.CERT_NONE
+
+    def test_kubeconfig_inline_ca_data_is_decoded(self, tmp_path,
+                                                  monkeypatch):
+        import base64
+        import ssl as ssl_mod
+
+        seen = {}
+        real = ssl_mod.create_default_context
+
+        def spy(*a, **kw):
+            seen.update(kw)
+            return real()
+
+        monkeypatch.setattr(ssl_mod, "create_default_context", spy)
+        pem = "-----BEGIN CERTIFICATE-----\nabc\n-----END CERTIFICATE-----\n"
+        cfg = tmp_path / "config"
+        cfg.write_text(
+            "clusters:\n"
+            "- cluster:\n"
+            "    server: https://kube.invalid:6443\n"
+            f"    certificate-authority-data: "
+            f"{base64.b64encode(pem.encode()).decode()}\n"
+            "  name: c\n")
+        cands = KubeClient._candidates_from_env(kubeconfig=str(cfg))
+        assert len(cands) == 1
+        assert seen.get("cadata") == pem
+
+    def test_kubeconfig_relative_ca_resolves_against_config_dir(
+            self, tmp_path, monkeypatch):
+        import ssl as ssl_mod
+
+        seen = {}
+        real = ssl_mod.create_default_context
+
+        def spy(*a, **kw):
+            seen.update(kw)
+            return real()
+
+        monkeypatch.setattr(ssl_mod, "create_default_context", spy)
+        (tmp_path / "ca.crt").write_text("pem")
+        cfg = tmp_path / "config"
+        cfg.write_text(
+            "clusters:\n"
+            "- cluster:\n"
+            "    server: https://kube.invalid:6443\n"
+            "    certificate-authority: ca.crt\n"
+            "  name: c\n")
+        cands = KubeClient._candidates_from_env(kubeconfig=str(cfg))
+        assert len(cands) == 1
+        assert seen.get("cafile") == str(tmp_path / "ca.crt")
+
+    def test_missing_explicit_ca_fails_loudly(self):
+        with pytest.raises(Exception):
+            KubeClient("https://apiserver.invalid:6443",
+                       ca_file="/nonexistent/ca.crt")

@@ -164,7 +164,9 @@ def cmd_sniff(args) -> int:
     from .k8s.client import KubeClient
     from .telemetry.publisher import run_publisher
 
-    client = KubeClient.from_env(args.kubeconfig, args.apiserver)
+    client = KubeClient.from_env(
+        args.kubeconfig, args.apiserver,
+        insecure_skip_tls_verify=args.insecure_skip_tls_verify)
     if client is None:
         log.error("no reachable Kubernetes API server to publish to")
         return 2
@@ -622,7 +624,9 @@ def cmd_serve(args) -> int:
     profiles = load_profiles(args.config)
     from .k8s.client import KubeClient, run_scheduler_against_cluster
 
-    client = KubeClient.from_env(args.kubeconfig, args.apiserver)
+    client = KubeClient.from_env(
+        args.kubeconfig, args.apiserver,
+        insecure_skip_tls_verify=args.insecure_skip_tls_verify)
     if client is None:
         log.error("no reachable Kubernetes API server; use `simulate` for "
                   "the in-memory cluster")
@@ -663,6 +667,9 @@ def main(argv=None) -> int:
                     help="publish a single snapshot and exit (with --publish)")
     sn.add_argument("--kubeconfig", default=None)
     sn.add_argument("--apiserver", default=None)
+    sn.add_argument("--insecure-skip-tls-verify", action="store_true",
+                    help="skip API server certificate verification "
+                         "(lab clusters with self-signed certs)")
     sn.set_defaults(fn=cmd_sniff)
 
     val = sub.add_parser(
@@ -674,6 +681,9 @@ def main(argv=None) -> int:
     srv.add_argument("--config", default=None)
     srv.add_argument("--kubeconfig", default=None)
     srv.add_argument("--apiserver", default=None)
+    srv.add_argument("--insecure-skip-tls-verify", action="store_true",
+                    help="skip API server certificate verification "
+                         "(lab clusters with self-signed certs)")
     srv.add_argument("--metrics-port", type=int, default=10251)
     srv.add_argument("--leader-elect", action="store_true")
     srv.set_defaults(fn=cmd_serve)

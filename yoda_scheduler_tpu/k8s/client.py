@@ -82,11 +82,17 @@ class WatchExpired(Exception):
     must re-list and start a fresh watch."""
 
 
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+_IN_CLUSTER_CA = f"{_SA_DIR}/ca.crt"
+
+
 class KubeClient:
     def __init__(self, base_url: str, token: str | None = None,
                  ca_file: str | None = None, transport=None,
                  stream_transport=None, max_retries: int = 4,
-                 retry_backoff_s: float = 0.25) -> None:
+                 retry_backoff_s: float = 0.25,
+                 insecure_skip_tls_verify: bool = False,
+                 ca_data: str | None = None) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.max_retries = max_retries
@@ -107,10 +113,31 @@ class KubeClient:
             # injected fakes stream only if they provide the stream side
             self._stream = stream_transport
         else:
-            if ca_file and os.path.exists(ca_file):
-                self._ctx = ssl.create_default_context(cafile=ca_file)
-            elif base_url.startswith("https"):
-                self._ctx = ssl._create_unverified_context()  # lab clusters
+            if base_url.startswith("https"):
+                # VERIFY by default: an https API server is authenticated
+                # against the given CA bundle, the in-cluster service-
+                # account CA when present, or the system trust store —
+                # never silently skipped (the old unverified default let
+                # any MITM read the Bearer token). The explicit
+                # --insecure-skip-tls-verify escape hatch remains for lab
+                # clusters with self-signed certs and no CA at hand.
+                if insecure_skip_tls_verify:
+                    self._ctx = ssl._create_unverified_context()
+                elif ca_data:
+                    # kubeconfig certificate-authority-data (PEM, already
+                    # base64-decoded by the caller)
+                    self._ctx = ssl.create_default_context(cadata=ca_data)
+                elif ca_file:
+                    # an EXPLICIT CA that can't be loaded must fail loudly
+                    # (kubectl behavior) — silently falling back to a
+                    # different trust store would verify against a CA the
+                    # operator never chose
+                    self._ctx = ssl.create_default_context(cafile=ca_file)
+                elif os.path.exists(_IN_CLUSTER_CA):
+                    self._ctx = ssl.create_default_context(
+                        cafile=_IN_CLUSTER_CA)
+                else:
+                    self._ctx = ssl.create_default_context()  # system roots
             self._transport = self._urllib_transport
             self._stream = stream_transport or self._urllib_stream
 
@@ -370,35 +397,82 @@ class KubeClient:
 
     # ------------------------------------------------------------ finding us
     @classmethod
-    def from_env(cls, kubeconfig: str | None = None,
-                 apiserver: str | None = None) -> "KubeClient | None":
-        """In-cluster service account, explicit --apiserver, or kubeconfig;
-        None when nothing is reachable."""
-        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+    def _candidates_from_env(cls, kubeconfig: str | None = None,
+                             apiserver: str | None = None,
+                             insecure_skip_tls_verify: bool = False
+                             ) -> "list[KubeClient]":
+        """Candidate clients in probe order: explicit --apiserver,
+        in-cluster service account (token + mounted CA), kubeconfig
+        (honouring its certificate-authority path and
+        insecure-skip-tls-verify flag). Split from from_env so the
+        construction — TLS wiring included — is unit-testable without a
+        reachable cluster."""
         candidates: list[KubeClient] = []
         if apiserver:
-            candidates.append(cls(apiserver))
-        if os.path.exists(f"{sa}/token"):
+            candidates.append(cls(
+                apiserver,
+                insecure_skip_tls_verify=insecure_skip_tls_verify))
+        if os.path.exists(f"{_SA_DIR}/token"):
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
             if host:
-                with open(f"{sa}/token") as f:
+                with open(f"{_SA_DIR}/token") as f:
                     token = f.read()
-                candidates.append(cls(f"https://{host}:{port}", token=token,
-                                      ca_file=f"{sa}/ca.crt"))
+                candidates.append(cls(
+                    f"https://{host}:{port}", token=token,
+                    # the SA CA is a DISCOVERED default, not an operator
+                    # choice: absent (token-only mounts) falls through to
+                    # the system roots instead of raising
+                    ca_file=(_IN_CLUSTER_CA
+                             if os.path.exists(_IN_CLUSTER_CA) else None),
+                    insecure_skip_tls_verify=insecure_skip_tls_verify))
         cfg_path = kubeconfig or os.environ.get(
             "KUBECONFIG", os.path.expanduser("~/.kube/config"))
         if os.path.exists(cfg_path):
             try:
+                import base64
+
                 import yaml
 
                 with open(cfg_path) as f:
                     doc = yaml.safe_load(f)
-                server = doc["clusters"][0]["cluster"]["server"]
-                candidates.append(cls(server))
-            except Exception:
-                pass
-        for c in candidates:
+                cl = doc["clusters"][0]["cluster"]
+                # inline CA (kind/minikube/GKE kubeconfigs embed the PEM
+                # as base64 certificate-authority-data)
+                ca_data = cl.get("certificate-authority-data")
+                if ca_data:
+                    ca_data = base64.b64decode(ca_data).decode()
+                # a relative certificate-authority path resolves against
+                # the kubeconfig's own directory, as kubectl does
+                ca_file = cl.get("certificate-authority")
+                if ca_file and not os.path.isabs(ca_file):
+                    ca_file = os.path.join(
+                        os.path.dirname(os.path.abspath(cfg_path)), ca_file)
+                candidates.append(cls(
+                    cl["server"],
+                    ca_file=ca_file,
+                    ca_data=ca_data,
+                    insecure_skip_tls_verify=(
+                        insecure_skip_tls_verify
+                        or bool(cl.get("insecure-skip-tls-verify")))))
+            except Exception as e:
+                # a malformed kubeconfig (or an unloadable explicit CA)
+                # drops this candidate — say why instead of leaving only
+                # a generic "no reachable API server" downstream
+                log.warning("kubeconfig %s unusable: %s", cfg_path, e)
+        return candidates
+
+    @classmethod
+    def from_env(cls, kubeconfig: str | None = None,
+                 apiserver: str | None = None,
+                 insecure_skip_tls_verify: bool = False
+                 ) -> "KubeClient | None":
+        """In-cluster service account, explicit --apiserver, or kubeconfig;
+        None when nothing is reachable. https endpoints are certificate-
+        verified (CA file / in-cluster CA / system roots) unless
+        `insecure_skip_tls_verify` opts out."""
+        for c in cls._candidates_from_env(kubeconfig, apiserver,
+                                          insecure_skip_tls_verify):
             try:
                 c.request("GET", "/version", timeout=3.0, retries=0)
                 return c
@@ -631,6 +705,13 @@ class Reflector:
         self.backoff_s = backoff_s
         self.max_backoff_s = max_backoff_s
         self.last_list_at = 0.0
+        # per-phase ingest attribution (serve_scale bench): time blocked
+        # on the watch stream (socket read + JSON decode, the generator
+        # pull) vs time applying events to the cache. Plain int adds on
+        # the reflector's own thread; readers tolerate torn reads.
+        self.read_ns = 0
+        self.apply_ns = 0
+        self.events = 0
         # optional resources (namespaces without RBAC, API groups the
         # control plane lacks): a 403/404 LIST counts as synced-empty
         # instead of blocking wait_synced forever; retried on the relist
@@ -680,16 +761,23 @@ class Reflector:
                         break  # periodic full resync
                     got_any = False
                     relist_due = False
+                    t_mark = time.perf_counter_ns()
                     for ev in self.client.watch(
                             self.path, rv, timeout_s=self.watch_timeout_s):
+                        t_now = time.perf_counter_ns()
+                        self.read_ns += t_now - t_mark
+                        self.events += 1
                         got_any = True
                         obj = ev.get("object", {})
                         new_rv = _rv_of(obj)
                         if new_rv is not None:
                             rv = new_rv
                         if ev.get("type") == "BOOKMARK":
+                            t_mark = time.perf_counter_ns()
                             continue
                         self.on_event(ev.get("type", ""), obj)
+                        t_mark = time.perf_counter_ns()
+                        self.apply_ns += t_mark - t_now
                         # a stream that always yields within its rotation
                         # must not defer the safety-net re-list forever:
                         # check the deadline per event, not per stream
@@ -771,6 +859,16 @@ class KubeCluster:
         # (list append/iteration are GIL-atomic — same contract as
         # FakeCluster.subscribe)
         self._subscribers: list = []
+        # serve-path attribution (ingest_stats): GC pause accounting via
+        # gc callbacks (a collection stops EVERY thread — engine, binder
+        # pool, reflectors — so its pauses explain ingest/bind tail
+        # latency no per-phase timer can), and binder wire time
+        self._gc_pauses = 0
+        self._gc_pause_ns = 0
+        self._gc_t0 = 0
+        self._gc_cb_installed = False
+        self.bind_wire_ns = 0
+        self.bind_wire_n = 0
         # async binder state (see bind_async)
         self._bind_q: deque = deque()
         self._bind_event = threading.Event()
@@ -1089,7 +1187,41 @@ class KubeCluster:
             self._namespace_absent(False)
             self._replace_namespaces(ns_doc.get("items", []))
 
+    def _gc_cb(self, phase: str, info: dict) -> None:
+        if phase == "start":
+            self._gc_t0 = time.perf_counter_ns()
+        elif self._gc_t0:
+            self._gc_pauses += 1
+            self._gc_pause_ns += time.perf_counter_ns() - self._gc_t0
+            self._gc_t0 = 0
+
+    def ingest_stats(self) -> dict:
+        """Per-phase serve-path attribution: watch-stream read (socket +
+        JSON decode) vs cache apply per reflector, binder wire time, and
+        GC pauses — the data that explains a watch-ingest or binds/s gap
+        between hosts (serve_scale bench emits this)."""
+        import gc as _gc
+
+        out: dict = {"reflectors": {}}
+        for r in self._reflectors:
+            out["reflectors"][r.path] = {
+                "events": r.events,
+                "read_ms": round(r.read_ns / 1e6, 2),
+                "apply_ms": round(r.apply_ns / 1e6, 2),
+            }
+        out["bind_wire_ms"] = round(self.bind_wire_ns / 1e6, 2)
+        out["bind_wire_n"] = self.bind_wire_n
+        out["gc_pauses"] = self._gc_pauses
+        out["gc_pause_ms"] = round(self._gc_pause_ns / 1e6, 2)
+        out["gc_enabled"] = _gc.isenabled()
+        return out
+
     def start(self) -> None:
+        import gc as _gc
+
+        if not self._gc_cb_installed:
+            self._gc_cb_installed = True
+            _gc.callbacks.append(self._gc_cb)
         if self.watch_mode:
             # seeding is asynchronous (each reflector's first LIST runs on
             # its own thread); callers that need a populated cache block on
@@ -1128,6 +1260,14 @@ class KubeCluster:
         return False
 
     def stop(self) -> None:
+        if self._gc_cb_installed:
+            import gc as _gc
+
+            self._gc_cb_installed = False
+            try:
+                _gc.callbacks.remove(self._gc_cb)
+            except ValueError:
+                pass
         # drain in-flight binds before tearing the transport down: a
         # dispatched bind the server never saw would strand its pod
         # Pending until its backoff retry or the next scheduler instance
@@ -1267,7 +1407,10 @@ class KubeCluster:
                         self._bind_q.popleft()
                 try:
                     try:
+                        t0 = time.perf_counter_ns()
                         self.client.bind(pod, node, chips)
+                        self.bind_wire_ns += time.perf_counter_ns() - t0
+                        self.bind_wire_n += 1
                         if on_success is not None:
                             try:
                                 on_success(pod, node)

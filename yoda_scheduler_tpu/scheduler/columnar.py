@@ -1,0 +1,294 @@
+"""Columnar scheduling data plane: the cluster as parallel numpy arrays.
+
+The paper's core loop — score every node from telemetry each cycle — is
+the shape columnar batch evaluation accelerates (Tesserae and Gavel both
+formulate placement as matrix operations over the full node set,
+PAPERS.md). This module packs the per-cycle filter/score inputs into
+parallel arrays with a stable node→row index:
+
+- node columns: telemetry validity, heartbeat, accelerator/generation
+  ids (interned strings), cordon flag, node-label class id, free-chip
+  count, HBM free/total sums, label-claimed HBM;
+- chip columns (2-D, padded to the widest node): free mask (healthy,
+  unclaimed, unreserved), per-chip HBM free/total, clock, ICI bandwidth,
+  core count, power, duty cycle.
+
+The table is maintained INCREMENTALLY from the same directed change logs
+(utils/changelog.py) the class memos consume: a bind updates one row,
+never rebuilds the table. Row order mirrors ``snapshot.list()`` so the
+engine's rotating-offset early-stop scan (percentageOfNodesToScore) is
+reproduced index-for-index — the vectorized path must pick the SAME
+candidates the scalar path would, in the same order (the scalar path
+stays wired in as the fallback and ground truth; the parity fuzz in
+tests/test_columnar.py pins agreement, same pattern as native/
+placement.cc ↔ topology/native.py).
+
+Plugins opt in per pod through ``filter_batch``/``score_batch``
+(framework.py): anything the columns cannot express — gang slice state,
+contiguous-block search, nominated-capacity holds, inter-pod affinity —
+returns None and the pod takes the scalar path unchanged.
+"""
+
+from __future__ import annotations
+
+try:  # numpy ships with the jax toolchain this image bakes in, but the
+    import numpy as np  # scheduler must degrade to the scalar path without it
+
+    HAVE_NUMPY = True
+except Exception:  # pragma: no cover - exercised only on stripped images
+    np = None
+    HAVE_NUMPY = False
+
+from ..telemetry.schema import HEALTHY
+
+
+class ColumnarTable:
+    """Parallel-array snapshot of the cluster, row-aligned with the
+    engine's object snapshot (``snapshot.list()`` order)."""
+
+    def __init__(self, allocator) -> None:
+        self.allocator = allocator
+        self._vers: tuple | None = None
+        self._names: list[str] = []
+        self.index: dict[str, int] = {}
+        # string interning for accelerator/generation equality masks; -1
+        # never appears in a column, so unknown spec strings match nothing
+        self._intern: dict[str, int] = {}
+        # node-label classes: distinct labels dicts interned to small ids
+        # so nodeSelector matching is one fancy-index over the id column
+        self._label_classes: list[dict] = []
+        self._label_key: dict[tuple, int] = {}
+        self._sel_cache: dict = {}
+        # per-(min_free, min_clock) qualifying-chip masks, invalidated by
+        # sync serial (any row change)
+        self._qual_cache: dict = {}
+        self._serial = 0
+        self._width = 1
+        # observability (tests + bench)
+        self.rebuilds = 0
+        self.row_updates = 0
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    # ------------------------------------------------------------- interning
+    def _intern_id(self, s: str) -> int:
+        hit = self._intern.get(s)
+        if hit is None:
+            hit = len(self._intern)
+            self._intern[s] = hit
+        return hit
+
+    def intern_of(self, s: str) -> int:
+        """Id of an already-seen string; -1 (matches no row) otherwise."""
+        return self._intern.get(s, -1)
+
+    def _label_id(self, labels: dict) -> int:
+        key = tuple(sorted(labels.items()))
+        hit = self._label_key.get(key)
+        if hit is None:
+            hit = len(self._label_classes)
+            self._label_key[key] = hit
+            self._label_classes.append(dict(labels))
+        return hit
+
+    def selector_mask(self, selector: dict, rows=None):
+        """Rows whose node labels satisfy an exact-match nodeSelector.
+        Label classes are few, so the per-class check is done once and the
+        verdict broadcast through the class-id column (whole table, or
+        the given row subset)."""
+        key = (tuple(sorted(selector.items())), len(self._label_classes))
+        by_class = self._sel_cache.get(key)
+        if by_class is None:
+            by_class = np.fromiter(
+                (all(ls.get(k) == v for k, v in selector.items())
+                 for ls in self._label_classes),
+                dtype=bool, count=len(self._label_classes))
+            if len(self._sel_cache) > 64:
+                self._sel_cache.clear()
+            self._sel_cache[key] = by_class
+        lc = self.label_class if rows is None else self.label_class[rows]
+        return by_class[lc]
+
+    def new_true(self):
+        return np.ones(len(self._names), dtype=bool)
+
+    # ------------------------------------------------------------ allocation
+    def _alloc(self, n: int, width: int) -> None:
+        self._width = width
+        # per-row telemetry identity: (id(metrics), generation). Chip
+        # attribute columns move only on telemetry updates; binds and
+        # reservations only flip the free mask — so a bind-dirtied row
+        # re-fills the dynamic columns and skips the per-chip attribute
+        # writes entirely (the hot path at drain time).
+        self._row_gen: list = [None] * n
+        self._row_chips: list = [()] * n  # (healthy, coords) per chip
+        self.valid = np.zeros(n, dtype=bool)
+        self.heartbeat = np.zeros(n, dtype=np.float64)
+        self.accel = np.full(n, -2, dtype=np.int64)
+        self.gen = np.full(n, -2, dtype=np.int64)
+        self.unsched = np.zeros(n, dtype=bool)
+        self.label_class = np.zeros(n, dtype=np.int64)
+        self.free_count = np.zeros(n, dtype=np.int64)
+        self.hbm_total_sum = np.zeros(n, dtype=np.int64)
+        self.hbm_free_sum = np.zeros(n, dtype=np.int64)
+        self.claimed_hbm = np.zeros(n, dtype=np.int64)
+        self.chip_free = np.zeros((n, width), dtype=bool)
+        self.chip_hbm_free = np.zeros((n, width), dtype=np.int64)
+        self.chip_hbm_total = np.zeros((n, width), dtype=np.int64)
+        self.chip_clock = np.zeros((n, width), dtype=np.int64)
+        self.chip_bw = np.zeros((n, width), dtype=np.int64)
+        self.chip_core = np.zeros((n, width), dtype=np.int64)
+        self.chip_power = np.zeros((n, width), dtype=np.int64)
+        self.chip_duty = np.zeros((n, width), dtype=np.float64)
+
+    def _fill_row(self, i: int, ni) -> bool:
+        """Recompute one row from a NodeInfo + the allocator's free set.
+        The chip ATTRIBUTE columns are re-written only when the node's
+        telemetry identity (object, generation) moved; bind/claim dirt
+        touches only the dynamic columns (free mask, counts, claimed
+        HBM). False = the row no longer fits the table shape (a node
+        grew more chips than the padding width): caller rebuilds."""
+        self.unsched[i] = ni.unschedulable
+        self.label_class[i] = self._label_id(ni.labels)
+        m = ni.metrics
+        if m is None:
+            if self._row_gen[i] is not None:
+                self._row_gen[i] = None
+                self._row_chips[i] = ()
+                self.valid[i] = False
+                self.heartbeat[i] = 0.0
+                self.accel[i] = -2
+                self.gen[i] = -2
+                self.hbm_total_sum[i] = 0
+                self.hbm_free_sum[i] = 0
+                self.chip_free[i, :] = False
+                self.chip_hbm_free[i, :] = 0
+                self.chip_hbm_total[i, :] = 0
+                self.chip_clock[i, :] = 0
+                self.chip_bw[i, :] = 0
+                self.chip_core[i, :] = 0
+                self.chip_power[i, :] = 0
+                self.chip_duty[i, :] = 0.0
+            self.free_count[i] = 0
+            self.claimed_hbm[i] = 0
+            return True
+        chips = m.chips
+        if len(chips) > self._width:
+            return False
+        gen_key = (id(m), m.generation, len(chips))
+        if self._row_gen[i] != gen_key:
+            self._row_gen[i] = gen_key
+            self._row_chips[i] = tuple(
+                (c.health == HEALTHY, c.coords) for c in chips)
+            k = len(chips)
+            w = self._width
+            self.valid[i] = True
+            self.heartbeat[i] = m.heartbeat
+            self.accel[i] = self._intern_id(m.accelerator)
+            self.gen[i] = self._intern_id(m.tpu_generation)
+            self.hbm_total_sum[i] = m.hbm_total_sum
+            self.hbm_free_sum[i] = m.hbm_free_sum
+            self.chip_hbm_free[i, :k] = [c.hbm_free_mb for c in chips]
+            self.chip_hbm_total[i, :k] = [c.hbm_total_mb for c in chips]
+            self.chip_clock[i, :k] = [c.clock_mhz for c in chips]
+            self.chip_bw[i, :k] = [c.ici_bandwidth_gbps for c in chips]
+            self.chip_core[i, :k] = [c.core_count for c in chips]
+            self.chip_power[i, :k] = [c.power_w for c in chips]
+            self.chip_duty[i, :k] = [c.duty_cycle_pct for c in chips]
+            if k < w:
+                self.chip_hbm_free[i, k:] = 0
+                self.chip_hbm_total[i, k:] = 0
+                self.chip_clock[i, k:] = 0
+                self.chip_bw[i, k:] = 0
+                self.chip_core[i, k:] = 0
+                self.chip_power[i, k:] = 0
+                self.chip_duty[i, k:] = 0.0
+        free = self.allocator.free_coords(ni)
+        self.free_count[i] = len(free)
+        self.claimed_hbm[i] = ni.claimed_hbm_mb()
+        k = len(chips)
+        self.chip_free[i, :k] = [h and (co in free)
+                                 for h, co in self._row_chips[i]]
+        if k < self._width:
+            self.chip_free[i, k:] = False
+        return True
+
+    # ----------------------------------------------------------------- sync
+    def sync(self, snapshot, vers, changes_since_fn) -> bool:
+        """Bring the table to the cycle's version vector. Dirty rows from
+        the change logs are re-filled in place; membership changes, a
+        trimmed log, or an unattributable allocator change ("*") rebuild
+        from scratch. False = the backend exposes no version counters, so
+        the table cannot be maintained (callers use the scalar path)."""
+        if not HAVE_NUMPY or vers is None:
+            return False
+        if self._vers == vers:
+            return len(self._names) == len(snapshot)
+        if self._vers is None or vers[2] != self._vers[2] \
+                or len(snapshot) != len(self._names):
+            return self._rebuild(snapshot, vers)
+        _, dirty = changes_since_fn(self._vers)
+        if dirty is None:
+            return self._rebuild(snapshot, vers)
+        for name in dirty:
+            i = self.index.get(name)
+            if i is None:
+                # telemetry for a non-member node: no row to update (the
+                # object snapshot skips these identically)
+                continue
+            ni = snapshot.get(name)
+            if ni is None or not self._fill_row(i, ni):
+                return self._rebuild(snapshot, vers)
+            self.row_updates += 1
+        if dirty:
+            self._serial += 1
+            self._qual_cache.clear()
+        self._vers = vers
+        return True
+
+    def _rebuild(self, snapshot, vers) -> bool:
+        nodes = snapshot.list()
+        width = 1
+        for ni in nodes:
+            if ni.metrics is not None and len(ni.metrics.chips) > width:
+                width = len(ni.metrics.chips)
+        self._alloc(len(nodes), width)
+        self._names = [ni.name for ni in nodes]
+        self.index = {name: i for i, name in enumerate(self._names)}
+        for i, ni in enumerate(nodes):
+            self._fill_row(i, ni)
+        self._vers = vers
+        self._serial += 1
+        self._qual_cache.clear()
+        self.rebuilds += 1
+        return True
+
+    # ----------------------------------------------------------------- views
+    def qual(self, min_free_mb: int, min_clock_mhz: int):
+        """(2-D qualifying-chip mask, per-row qualifying count) for one
+        workload class: free chips meeting the class's HBM/clock floors —
+        the columnar twin of allocator.class_stats, cached per class until
+        any row changes."""
+        key = (min_free_mb, min_clock_mhz)
+        hit = self._qual_cache.get(key)
+        if hit is not None:
+            return hit
+        q = (self.chip_free
+             & (self.chip_hbm_free >= min_free_mb)
+             & (self.chip_clock >= min_clock_mhz))
+        qc = q.sum(axis=1)
+        if len(self._qual_cache) > 16:
+            self._qual_cache.clear()
+        self._qual_cache[key] = (q, qc)
+        return q, qc
+
+    def rows_for(self, infos):
+        """Row indices for a list of NodeInfos; None when any name is
+        unknown to the table (callers fall back to the scalar path)."""
+        idx = self.index
+        try:
+            return np.fromiter((idx[ni.name] for ni in infos),
+                               dtype=np.int64, count=len(infos))
+        except KeyError:
+            return None

@@ -10,7 +10,16 @@ the same YAML shape (see deploy/yoda-tpu-scheduler.yaml).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field, replace
+
+
+def _columnar_default() -> bool:
+    """Opt-out knob for the columnar data plane (scheduler/columnar.py).
+    YODA_COLUMNAR=0 restores the per-node scalar path end-to-end — CI
+    runs the tier-1 suite under both values."""
+    return os.environ.get("YODA_COLUMNAR", "1").lower() not in (
+        "0", "false", "off")
 
 
 @dataclass(frozen=True)
@@ -79,6 +88,17 @@ class SchedulerConfig:
     # periodic slice-defragmentation pass (scheduler/deschedule.py);
     # 0 disables. Victim protection + budget use the descheduler defaults.
     deschedule_interval_s: float = 0.0
+    # columnar data plane: evaluate the vectorizable filter predicates and
+    # score terms over the whole node table in one numpy call per cycle
+    # (scheduler/columnar.py). The scalar per-node path remains wired in
+    # as the fallback (non-vectorizable plugins/pods) and ground truth;
+    # False — or env YODA_COLUMNAR=0 — restores it end-to-end.
+    columnar: bool = field(default_factory=_columnar_default)
+    # fragmentation-aware packing weight (plugins/score.py
+    # FragmentationScore): steer 1-chip pods away from nodes whose free
+    # set is down to its LAST pair, so 2-chip jobs keep finding pairs
+    # deep into a drain. 0 disables.
+    fragmentation_weight: int = 1
     # dispatch the bind POST on a binder worker (upstream kube-scheduler's
     # binding-cycle goroutine) when the cluster backend supports it
     # (KubeCluster.bind_async); the in-memory FakeCluster always binds
@@ -115,6 +135,9 @@ class SchedulerConfig:
                                         defaults.async_binding)),
             pod_hinted_backoff_s=float(args.get(
                 "podHintedBackoffSeconds", defaults.pod_hinted_backoff_s)),
+            columnar=bool(args.get("columnar", defaults.columnar)),
+            fragmentation_weight=int(args.get(
+                "fragmentationWeight", defaults.fragmentation_weight)),
         )
 
 

@@ -29,6 +29,7 @@ import time
 from collections import deque
 
 from .cluster import FakeCluster
+from .columnar import ColumnarTable, HAVE_NUMPY, np
 from .config import SchedulerConfig
 from .framework import (
     BindPlugin,
@@ -57,6 +58,7 @@ from .framework import (
 from .queue import SchedulingQueue
 from .plugins import (
     ChipAllocator,
+    FragmentationScore,
     GangCoordinator,
     GangPermit,
     MaxCollection,
@@ -177,6 +179,9 @@ def default_profile(config: SchedulerConfig,
         score=[
             TelemetryScore(allocator, config.weights, weight=1),
             *([topo] if config.topology_weight > 0 else []),
+            *([FragmentationScore(allocator,
+                                  weight=config.fragmentation_weight)]
+              if config.fragmentation_weight > 0 else []),
             admission,
         ],
         reserve=[allocator, gang_permit],
@@ -307,6 +312,15 @@ class Scheduler:
         # without cross-thread mutation of the dict.
         self.doomed_gangs: dict[str, str] = {}
         self._gang_revivals: deque = deque()
+        # columnar data plane (scheduler/columnar.py): parallel-array twin
+        # of the object snapshot, maintained from the same change logs.
+        # None when numpy is unavailable, the knob is off, or there is no
+        # allocator to source free sets from — every consumer then takes
+        # the scalar path (its ground truth) unconditionally.
+        self._columnar: ColumnarTable | None = (
+            ColumnarTable(self.allocator)
+            if HAVE_NUMPY and self.config.columnar
+            and self.allocator is not None else None)
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
@@ -534,7 +548,19 @@ class Scheduler:
                     continue
                 repaired.append(node)
             fill = dirty
-        for name in sorted(fill):
+        # dirty-node verdicts: via the columnar table's subset masks when
+        # every active filter vectorizes for this pod (the same booleans
+        # the scalar chain yields — repair never reads the messages), the
+        # per-node plugin chain otherwise. Thresholded: for a couple of
+        # bind-dirtied names the scalar chain beats the table's sync +
+        # gather overhead; the mask pays off on the big dirty sets event
+        # storms and diverse-class drains produce.
+        fill_names = sorted(fill)
+        verdicts = (self._columnar_subset_ok(state, pod, snapshot, vers,
+                                             filters, fill_names)
+                    if len(fill_names) >= 6 and self._columnar is not None
+                    else None)
+        for name in fill_names:
             if len(repaired) >= want:
                 # identical to filtering everything then truncating
                 # [:want]: any further passer would land past `want` and
@@ -544,6 +570,10 @@ class Scheduler:
                 break
             node = snapshot.get(name)
             if node is None:
+                continue
+            if verdicts is not None:
+                if verdicts.get(name):
+                    repaired.append(node)
                 continue
             st = Status.success()
             for p in filters:
@@ -598,6 +628,89 @@ class Scheduler:
             elif rej is not None:
                 rejectors.add(rej)
         return passing, rejectors, dirty
+
+    def _columnar_subset_ok(self, state, pod, snapshot, vers, filters,
+                            names):
+        """Combined filter verdicts for a SUBSET of nodes via the
+        columnar table's row-aligned masks: {name: bool}, or None when
+        the table can't serve this pod (unversioned backend, a
+        non-vectorizable plugin, names outside the table). Serves the
+        class-memo repair paths, whose gap-fill re-filters a handful of
+        dirty nodes per cycle — the verdicts are the same booleans the
+        scalar chain would produce (parity-fuzzed), minus the message
+        strings the repair paths never read."""
+        table = self._columnar
+        if table is None or vers is None:
+            return None
+        if not table.sync(snapshot, vers, self._changes_since_vers):
+            return None
+        idx = table.index
+        rows = []
+        known = []
+        for n in names:
+            i = idx.get(n)
+            if i is not None:
+                rows.append(i)
+                known.append(n)
+        if not rows:
+            return {}
+        rows = np.asarray(rows, dtype=np.int64)
+        ok = None
+        for p in filters:
+            fb = getattr(p, "filter_batch", None)
+            bm = fb(state, pod, table, rows) if fb is not None else None
+            if bm is None:
+                return None
+            ok = bm if ok is None else (ok & bm)
+        if ok is None:
+            return dict.fromkeys(known, True)
+        return dict(zip(known, ok.tolist()))
+
+    def _columnar_filter(self, state, pod, filters, snapshot, vers, nodes,
+                         want, trace):
+        """Vectorized full-scan filter: every active plugin contributes a
+        boolean row mask (filter_batch), the masks AND together, and the
+        rotating-offset early-stop scan is replayed over the combined
+        mask by index — the SAME candidates, in the same order, as the
+        per-node scalar scan would produce. Returns the feasible list, or
+        None when any plugin/pod can't vectorize OR no node passed: the
+        zero-pass case falls back to the scalar scan untouched (it owns
+        the per-node failure diagnostics the preemption planner and the
+        unschedulable-class memo need), with _filter_start deliberately
+        left unadvanced so the fallback scan starts where this one did."""
+        table = self._columnar
+        if not table.sync(snapshot, vers, self._changes_since_vers):
+            return None
+        if len(table) != len(nodes):
+            return None
+        allmask = None
+        for p in filters:
+            fb = getattr(p, "filter_batch", None)
+            bm = fb(state, pod, table) if fb is not None else None
+            if bm is None:
+                return None
+            allmask = bm if allmask is None else (allmask & bm)
+        if allmask is None:  # no active filters: everything passes
+            allmask = table.new_true()
+        n = len(nodes)
+        start = self._filter_start % n
+        order = (np.arange(n) if not start else
+                 np.concatenate((np.arange(start, n), np.arange(start))))
+        pass_pos = np.flatnonzero(allmask[order])
+        if pass_pos.size == 0:
+            return None
+        if pass_pos.size >= want:
+            checked = int(pass_pos[want - 1]) + 1
+            sel = order[pass_pos[:want]]
+        else:
+            checked = n
+            sel = order[pass_pos]
+        self._filter_start = (start + checked) % n
+        feasible = [nodes[i] for i in sel.tolist()]
+        for ni in feasible:
+            trace.filter_verdicts[ni.name] = "ok"
+        self.metrics.inc("columnar_filter_cycles_total")
+        return feasible
 
     # --------------------------------------------------------------- snapshot
     def snapshot(self) -> Snapshot:
@@ -977,6 +1090,23 @@ class Scheduler:
                                 info, trace, hit[1],
                                 rejected_by=tuple(combined))
 
+        # columnar full scan: when every active filter can express this
+        # pod's predicates over the node table, the whole cluster is
+        # evaluated in a handful of numpy calls instead of a per-(pod,
+        # node) Python loop. Gated to pods whose cycle carries no state
+        # the columns can't see (nomination ordering, PreFilter candidate
+        # narrowing, gang membership); zero-pass and every bail-out fall
+        # through to the scalar scan below, which remains ground truth.
+        if (feasible is None and self._columnar is not None
+                and vers is not None and nom is None and not spec.is_gang
+                and nodes and state.read_or(CANDIDATE_NODES_KEY) is None):
+            feasible = self._columnar_filter(state, pod, filters, snapshot,
+                                            vers, nodes, want, trace)
+            if feas_ok and feasible:
+                if len(self._feas_memo) > 256:
+                    self._feas_memo.clear()
+                self._feas_memo[memo_key] = self._feas_entry(vers, feasible)
+
         if feasible is None:
             order = [(self._filter_start + i) % len(nodes)
                      for i in range(len(nodes))]
@@ -1126,9 +1256,41 @@ class Scheduler:
         if hit is not None and hit[1] == mv_t and hit[3] == names_set:
             _, dirty_s = self._changes_since_vers(hit[0])
         cached_usage = hit[2] if hit is not None else {}
+        # columnar batch scoring: on memo-MISS cycles (first of a class,
+        # maxima moved, candidate set changed) plugins exposing
+        # score_batch evaluate ALL candidates in one array expression
+        # (normalize then becomes one broadcast over the raw vector).
+        # When the score-class memo replay is available it stays
+        # preferred — replaying ~want cached floats beats recomputing
+        # them, vectorized or not; plugins without a batch form
+        # (topology sub-block search, admission preferences) keep the
+        # scalar loop either way.
+        # candidate row-index array, resolved lazily on the first
+        # memo-miss cycle that can use batch scoring (sync is idempotent
+        # per version vector — the repair path usually already paid it)
+        col_rows = None
+        if (dirty_s is None and self._columnar is not None
+                and vers is not None and scorers):
+            if self._columnar.sync(snapshot, vers, self._changes_since_vers):
+                col_rows = self._columnar.rows_for(feasible)
         raws: dict[str, dict[str, float]] = {}
         for p in scorers:
             raw: dict[str, float] = {}
+            if col_rows is not None:
+                sb = getattr(p, "score_batch", None)
+                arr = sb(state, pod, self._columnar, col_rows) \
+                    if sb is not None else None
+                if arr is not None:
+                    for i, node in enumerate(feasible):
+                        raw[node.name] = float(arr[i])
+                    self.metrics.inc("columnar_score_batches_total")
+                    raws[p.name] = raw
+                    nraw = dict(raw)
+                    p.normalize(state, pod, nraw)
+                    w = getattr(p, "weight", 1)
+                    for name, s in nraw.items():
+                        totals[name] += w * s
+                    continue
             cached = hit[4].get(p.name, {}) if dirty_s is not None else {}
             slice_coupled = (getattr(p, "score_inputs", None)
                              == "node+slice_usage")

@@ -425,6 +425,20 @@ class FilterPlugin(Plugin):
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
         raise NotImplementedError
 
+    def filter_batch(self, state: CycleState, pod: Pod, table, rows=None):
+        """Vectorized capability hook (columnar data plane): return a
+        boolean mask over `table` (scheduler/columnar.py) — the whole
+        table when `rows` is None, else aligned with the given row-index
+        array — with one verdict per node, True exactly where `filter`
+        would return SUCCESS. Return None when this plugin/pod
+        combination cannot be expressed over the columns (gang state,
+        contiguous-block search, nominated holds, inter-pod terms): the
+        WHOLE pod then takes the per-node scalar path, which stays the
+        ground truth (parity pinned by tests/test_columnar.py). The
+        subset form serves the class-memo repair paths, which re-filter
+        only dirty nodes."""
+        return None
+
 
 class PostFilterPlugin(Plugin):
     """Runs when no node passed Filter — the preemption hook (what PostFilter
@@ -447,6 +461,14 @@ class ScorePlugin(Plugin):
 
     def score(self, state: CycleState, pod: Pod, node: NodeInfo) -> tuple[float, Status]:
         raise NotImplementedError
+
+    def score_batch(self, state: CycleState, pod: Pod, table, rows):
+        """Vectorized capability hook (columnar data plane): return a
+        float array of RAW scores aligned with `rows` (row indices into
+        `table`, one per feasible candidate) — bit-identical to calling
+        `score` per node — or None to keep the scalar loop. Normalize and
+        the weighted sum still run on the full raw vector either way."""
+        return None
 
     def normalize(self, state: CycleState, pod: Pod, scores: dict[str, float]) -> None:
         """Optional ScoreExtensions.NormalizeScore analogue; mutate in place."""

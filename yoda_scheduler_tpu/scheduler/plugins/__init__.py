@@ -2,7 +2,7 @@ from .sort import PrioritySort
 from .admission import NodeAdmission
 from .filter import TelemetryFilter
 from .prescore import MaxCollection, MAX_KEY, SPEC_KEY
-from .score import TelemetryScore
+from .score import FragmentationScore, TelemetryScore
 from .topology import TopologyScore
 from .allocator import ChipAllocator
 from .gang import GangCoordinator, GangPermit
@@ -12,6 +12,7 @@ __all__ = [
     "PrioritySort",
     "NodeAdmission",
     "TelemetryFilter",
+    "FragmentationScore",
     "MaxCollection",
     "TelemetryScore",
     "TopologyScore",

@@ -38,6 +38,7 @@ from ..framework import (
     SKIP,
     Status,
 )
+from ..columnar import np as _np
 from ...utils.pod import NODE_NAME_FIELD, Pod
 
 NO_SCHEDULE = "NoSchedule"
@@ -601,6 +602,31 @@ class NodeAdmission(FilterPlugin, ScorePlugin, EnqueueExtensions):
                 or bool(pod.preferred_pod_affinity)
                 or snapshot.any_preferred_pod_affinity()
                 or snapshot.any_taints())
+
+    def filter_batch(self, state: CycleState, pod: Pod, table, rows=None):
+        """Columnar verdicts for the admission FAST checks — cordon flag
+        and exact-match nodeSelector, the two predicates expressible over
+        the cordon-bit and label-class-id columns. Bails (None) whenever
+        any other admission predicate could fire for this pod on this
+        snapshot (affinity, spread, ports, cpu/mem vs allocatable,
+        taints, the anti-affinity symmetry rule): those need the object
+        snapshot, so the whole pod takes the scalar path."""
+        snapshot = state.read_or("snapshot")
+        if snapshot is None:
+            return None
+        if (pod.node_affinity or pod.pod_affinity or pod.pod_anti_affinity
+                or pod.topology_spread or pod.host_ports
+                or ((pod.cpu_millis or pod.memory_bytes)
+                    and snapshot.any_allocatable())
+                or snapshot.any_taints()
+                or snapshot.any_pod_anti_affinity()):
+            return None
+        ok = _np.ones(len(table) if rows is None else len(rows), dtype=bool)
+        if not _tolerates_cordon(pod):
+            ok &= ~(table.unsched if rows is None else table.unsched[rows])
+        if pod.node_selector:
+            ok &= table.selector_mask(pod.node_selector, rows)
+        return ok
 
     def filter(self, state: CycleState, pod: Pod, node: NodeInfo) -> Status:
         # NodeUnschedulable (kubectl cordon): upstream checks

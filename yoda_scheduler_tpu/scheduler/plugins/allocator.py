@@ -288,6 +288,8 @@ class ChipAllocator(ReservePlugin, EnqueueExtensions):
     def nomination_of(self, pod_key: str) -> tuple | None:
         """(node, chips, priority, cpu_millis, memory_bytes, host_ports)
         this pod is entitled to, if any."""
+        if not self._nominated:
+            return None  # fast path: checked every cycle (GIL-atomic read)
         with self._lock:
             return self._nominated.get(pod_key)
 
@@ -401,6 +403,12 @@ class ChipAllocator(ReservePlugin, EnqueueExtensions):
                         and key != exclude_key:
                     out.extend(nom[5])
             return tuple(out)
+
+    def has_holds(self) -> bool:
+        """Any nominated capacity outstanding (per-pod or gang-slice).
+        The columnar filter masks don't model holds — their presence
+        sends pods down the scalar path (GIL-atomic dict reads)."""
+        return bool(self._nominated or self._gang_nominated)
 
     def holds_for(self, spec: WorkloadSpec, node_info: NodeInfo,
                   pod_key: str | None, now: float | None = None) -> int:

@@ -112,6 +112,50 @@ class TelemetryFilter(FilterPlugin, EnqueueExtensions):
             return QUEUE
         return SKIP
 
+    def filter_batch(self, state: CycleState, pod, table, rows=None):
+        """Columnar verdicts for the capacity/staleness predicates —
+        one boolean per node (whole table, or the `rows` subset the
+        memo-repair paths re-filter), all in a handful of numpy calls.
+        Bails (None) for everything the columns don't express: gang
+        slice state, exact-topology / contiguity block search, and
+        nominated-capacity holds. Predicate-for-predicate identical to
+        `filter` for the pods it accepts (the checks are
+        order-independent: a node passes iff it passes every one)."""
+        spec: WorkloadSpec = state.read("workload_spec")
+        if spec.is_gang or spec.topology is not None:
+            return None
+        if self.require_contiguous and spec.chips > 1:
+            return None
+        if self.allocator.has_holds():
+            return None
+        now = state.read_or("now")
+        if now is None:
+            now = time.time()
+        if rows is None:
+            valid, hb = table.valid, table.heartbeat
+            accel, gen, fc = table.accel, table.gen, table.free_count
+            _, qcount = table.qual(spec.min_free_mb, spec.min_clock_mhz)
+        else:
+            valid, hb = table.valid[rows], table.heartbeat[rows]
+            accel, gen = table.accel[rows], table.gen[rows]
+            fc = table.free_count[rows]
+            q = (table.chip_free[rows]
+                 & (table.chip_hbm_free[rows] >= spec.min_free_mb)
+                 & (table.chip_clock[rows] >= spec.min_clock_mhz))
+            qcount = q.sum(axis=1)
+        # telemetry present + fresh (schema.stale: age > max_age)
+        ok = valid & ((now - hb) <= self.max_age)
+        if spec.accelerator is not None:
+            ok &= accel == table.intern_of(spec.accelerator)
+        if spec.tpu_generation is not None:
+            ok &= gen == table.intern_of(spec.tpu_generation)
+        # unclaimed-healthy-chip count, then the per-chip HBM/clock class
+        # floors (allocator.class_stats' columnar twin); holds are zero
+        # by the gate above
+        ok &= fc >= spec.chips
+        ok &= qcount >= spec.chips
+        return ok
+
     def filter(self, state: CycleState, pod, node: NodeInfo) -> Status:
         spec: WorkloadSpec = state.read("workload_spec")
         m = node.metrics

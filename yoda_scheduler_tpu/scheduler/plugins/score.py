@@ -23,6 +23,7 @@ Normalisation to [0,100] follows the reference's min-max NormalizeScore
 
 from __future__ import annotations
 
+from ..columnar import np
 from ..config import ScoreWeights
 from ..framework import CycleState, NodeInfo, ScorePlugin, Status, min_max_normalize
 from ...utils.labels import WorkloadSpec
@@ -145,5 +146,101 @@ class TelemetryScore(ScorePlugin):
             self._basic_cache[node.name] = (bkey, basic)
         return basic + aa, Status.success()
 
+    def score_batch(self, state: CycleState, pod, table, rows):
+        """Columnar raw scores: basic + allocate + actual for every
+        candidate row in one set of array ops. Arithmetic is written in
+        the SAME operation order as the scalar path (the integer chip
+        sums are exact in both, so the float expressions then agree
+        bit-for-bit — the parity fuzz depends on that). Bails (None)
+        when the duty-cycle penalty is enabled: numpy's pairwise float
+        summation can differ from the scalar fold in the last ulp."""
+        if self.weights.duty_cycle:
+            return None
+        mv: MaxValue = state.read_or(MAX_KEY)
+        if mv is None:
+            return None
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        q, qcount = table.qual(spec.min_free_mb, spec.min_clock_mhz)
+        q = q[rows]
+        w = self.weights
+        sbw = (table.chip_bw[rows] * q).sum(axis=1)
+        sck = (table.chip_clock[rows] * q).sum(axis=1)
+        sco = (table.chip_core[rows] * q).sum(axis=1)
+        sfm = (table.chip_hbm_free[rows] * q).sum(axis=1)
+        spw = (table.chip_power[rows] * q).sum(axis=1)
+        stm = (table.chip_hbm_total[rows] * q).sum(axis=1)
+        basic = (
+            100.0 * sbw / mv.bandwidth * w.bandwidth
+            + 100.0 * sck / mv.clock * w.clock
+            + 100.0 * sco / mv.core * w.core
+            + 100.0 * spw / mv.power * w.power
+            + 100.0 * sfm / mv.free_memory * w.free_memory
+            + 100.0 * stm / mv.total_memory * w.total_memory
+        )
+        # count==0 rows: every sum is 0 so basic is already exactly 0.0,
+        # matching the scalar early return
+        tot = table.hbm_total_sum[rows]
+        cl = table.claimed_hbm[rows]
+        fr = table.hbm_free_sum[rows]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            alloc = 100.0 * (tot - cl) / tot * w.allocate
+            act = 100.0 * fr / tot * w.actual
+        alloc = np.where((tot == 0) | (cl > tot), 0.0, alloc)
+        act = np.where(tot == 0, 0.0, act)
+        return basic + (alloc + act)
+
     def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
         min_max_normalize(scores)
+
+
+class FragmentationScore(ScorePlugin):
+    """Fragmentation-aware packing term (columnar column: free-chip
+    count). Steers SINGLE-chip pods away from nodes whose free set is
+    down to its last pair (exactly 2 free chips): taking one of those
+    chips removes the node from the 2-chip-capable pool, and deep into a
+    drain that pool is what decides whether 2-chip jobs bind or strand
+    against a cluster of lone free chips (the tpu-2c vs tpu-1c failure
+    gap at the 1000-node tier, VERDICT r5 #3).
+
+    An absolute penalty, not min-max normalized: it must only tip a
+    choice when comparable alternatives exist — when the 2-free node is
+    the ONLY feasible one, the pod still binds there (capacity is never
+    sacrificed to the preference)."""
+
+    name = "fragmentation-score"
+    # score-memo contract: the raw score is a pure function of the node's
+    # free-chip count (serial + pending version) and the pod's label class
+    score_inputs = "node"
+
+    def __init__(self, allocator: ChipAllocator, weight: int = 1) -> None:
+        self.allocator = allocator
+        self.weight = weight
+
+    def score_relevant(self, pod, snapshot) -> bool:
+        """Hot-loop gate (core.py): the term only moves for SINGLE-chip
+        pods, so multi-chip classes drop the plugin from the per-node
+        score loop entirely instead of paying a no-op call per node."""
+        from ...utils.labels import LabelError, spec_for
+
+        try:
+            return spec_for(pod).chips == 1
+        except LabelError:
+            return True  # malformed pods never reach scoring anyway
+
+    def score(self, state: CycleState, pod, node: NodeInfo) -> tuple[float, Status]:
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        m = node.metrics
+        if m is None or spec.chips != 1:
+            return 0.0, Status.success()
+        free = len(self.allocator.free_coords(node))
+        return (-100.0 if free == 2 else 0.0), Status.success()
+
+    def score_batch(self, state: CycleState, pod, table, rows):
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        if spec.chips != 1:
+            return np.zeros(len(rows), dtype=np.float64)
+        return np.where(table.valid[rows] & (table.free_count[rows] == 2),
+                        -100.0, 0.0)
+
+    def normalize(self, state: CycleState, pod, scores: dict[str, float]) -> None:
+        return None  # absolute semantics, like the topology scorer

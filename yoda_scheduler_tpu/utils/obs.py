@@ -102,11 +102,19 @@ class Metrics:
             self.gauges[name] = value
 
     def observe(self, name: str, value: float) -> None:
-        with self._lock:
-            h = self.histograms.setdefault(name, Histogram())
+        # plain get first: setdefault(name, Histogram()) would construct
+        # (and discard) a fresh Histogram — counts list + sample deque —
+        # on EVERY observation; this runs once per scheduling cycle
+        h = self.histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self.histograms.setdefault(name, Histogram())
         h.observe(value)
 
     def histogram(self, name: str) -> Histogram:
+        h = self.histograms.get(name)
+        if h is not None:
+            return h
         with self._lock:
             return self.histograms.setdefault(name, Histogram())
 
@@ -140,8 +148,11 @@ class TraceLog:
         self._lock = threading.Lock()
 
     def add(self, t: CycleTrace) -> None:
-        with self._lock:
-            self._buf.append(t)
+        # lock-free: deque.append with maxlen is GIL-atomic, and recent()
+        # snapshots via list(...) which is likewise atomic — the lock
+        # only guards the (rare) reader-side slicing. One add runs per
+        # scheduling cycle, so the acquire was measurable at drain scale.
+        self._buf.append(t)
 
     def recent(self, n: int = 50) -> list[CycleTrace]:
         with self._lock:
