@@ -1,0 +1,478 @@
+"""Batch scheduling cycles: parity, conflict fallback, and queue/plumbing.
+
+The batch commit loop (core.schedule_batch/_commit_batch) claims EXACT
+per-pod semantics on conflict-free traces: a drain scheduled with
+batchMaxPods=N must bind the same pods to the same nodes AND the same
+chips as batchMaxPods=1 (the per-pod path stays wired in as fallback and
+ground truth). The parity fuzz here pins that over 200+ randomized
+workloads; the conflict tests inject mid-batch binds/cordons and assert
+the fallback path loses and double-books nothing.
+
+Workload shape note: the gather pops classmates in FIFO order from
+anywhere in the head's priority band, so a batched run of an INTERLEAVED
+submission order legitimately reorders equal-priority pods (bounded by
+batchMaxPods — queue.py module docstring). Parity is therefore fuzzed on
+grouped, drain-shaped traces (runs of identical pods — the workload the
+tentpole exists for), where gather order == pop order and placement must
+be bit-identical. Interleaved orders are covered by the invariant fuzz
+(tests/test_fuzz_invariants.py) plus the conflict tests here.
+"""
+
+import random
+import time
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock, HybridClock
+from yoda_scheduler_tpu.scheduler.framework import NO_BATCH
+from yoda_scheduler_tpu.scheduler.queue import SchedulingQueue
+from yoda_scheduler_tpu.scheduler.plugins.sort import PrioritySort
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore, make_gpu_node, make_tpu_node, make_v4_slice)
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+N_SEEDS = 50          # x4 class-run templates per seed = 200 workloads
+PODS_PER_RUN = (1, 8)
+
+
+def _fleet(rng: random.Random) -> TelemetryStore:
+    store = TelemetryStore()
+    now = time.time()
+    metrics = []
+    if rng.random() < 0.5:
+        metrics.extend(make_v4_slice("s0", "2x2x4"))
+    for i in range(rng.randint(2, 5)):
+        metrics.append(make_tpu_node(
+            f"t{i}", chips=rng.choice((2, 4, 8)),
+            generation=rng.choice(("v4", "v5e")),
+            unhealthy=rng.choice((0, 0, 1))))
+    for i in range(rng.randint(0, 2)):
+        metrics.append(make_gpu_node(f"g{i}", cards=4))
+    for m in metrics:
+        m.heartbeat = now + 1e8
+        store.put(m)
+    return store
+
+
+def _class_labels(rng: random.Random) -> dict:
+    """One random scheduling class, weighted toward batchable shapes but
+    including gang/topology/selector classes so the gating code runs."""
+    roll = rng.random()
+    if roll < 0.35:
+        return {"tpu/accelerator": "tpu",
+                "scv/number": str(rng.choice((1, 1, 2, 4)))}
+    if roll < 0.55:
+        return {"tpu/accelerator": "tpu", "scv/number": "1",
+                "scv/memory": str(rng.choice((4000, 16000, 40000)))}
+    if roll < 0.70:
+        return {"tpu/accelerator": "gpu", "scv/number": "1"}
+    if roll < 0.80:
+        return {"tpu/accelerator": "tpu", "scv/number": "1",
+                "tpu/generation": rng.choice(("v4", "v5e")),
+                "scv/priority": str(rng.choice((0, 2)))}
+    if roll < 0.90:
+        return {"tpu/accelerator": "tpu", "tpu/topology": "1x2",
+                "scv/number": "2"}
+    return {"scv/memory": "1000"}
+
+
+def _grouped_burst(rng: random.Random) -> list[Pod]:
+    """Drain-shaped trace: consecutive runs of identical pods, occasional
+    gangs — the equivalence-class structure batching exists for. Each
+    class appears as ONE contiguous run (a tiny scv/clock floor per run
+    disambiguates colliding label rolls without changing any verdict —
+    every chip clocks in the GHz range): the gather advances classmates
+    past other classes within a priority band, so a class split across
+    two runs would legally reorder against the pods between them
+    (module docstring) — parity is exact on one-run-per-class traces."""
+    pods = []
+    i = 0
+    for run in range(4):
+        if rng.random() < 0.12:
+            size = rng.choice((2, 3))
+            for m in range(size):
+                i += 1
+                pods.append(Pod(f"p{i}", labels={
+                    "tpu/accelerator": "tpu", "scv/number": "4",
+                    "tpu/gang-name": f"bz{run}",
+                    "tpu/gang-size": str(size)}))
+            continue
+        labels = _class_labels(rng)
+        labels.setdefault("scv/clock", str(run + 1))
+        for _ in range(rng.randint(*PODS_PER_RUN)):
+            i += 1
+            pods.append(Pod(f"p{i}", labels=dict(labels)))
+    return pods
+
+
+def _run(store_seed: int, batch: int):
+    rng = random.Random(store_seed)
+    store = _fleet(rng)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(
+        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=3600.0,
+        batch_max_pods=batch), clock=HybridClock())
+    pods = _grouped_burst(rng)
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20000)
+    result = {p.name: (p.phase.name, p.node, frozenset(p.assigned_chips()))
+              for p in pods}
+    return sched, pods, result
+
+
+class TestBatchedVsPerPodParity:
+    @pytest.mark.parametrize("seed", range(N_SEEDS))
+    def test_identical_placements(self, seed):
+        """>=200 randomized workloads (N_SEEDS seeds x 4 class runs per
+        burst): batched and per-pod schedules of the same conflict-free
+        trace must agree on every pod's phase, node, AND chip set."""
+        _, _, per_pod = _run(seed, batch=1)
+        sched_b, _, batched = _run(seed, batch=8)
+        diffs = {k: (per_pod[k], batched[k])
+                 for k in per_pod if per_pod[k] != batched[k]}
+        assert not diffs, f"seed {seed}: {dict(list(diffs.items())[:4])}"
+        # the conflict-fallback path must not have fired on a
+        # conflict-free single-threaded trace
+        assert sched_b.metrics.counters.get(
+            "batch_conflict_fallbacks_total", 0) == 0
+
+    def test_batching_actually_happens(self):
+        """The parity above is vacuous if batches never form: across the
+        fuzz seeds a healthy share of binds must go through the batch
+        commit loop."""
+        batched_binds = 0
+        total_bound = 0
+        for seed in range(10):
+            sched, pods, _ = _run(seed, batch=8)
+            batched_binds += sched.metrics.counters.get(
+                "batched_binds_total", 0)
+            total_bound += sum(1 for p in pods
+                               if p.phase == PodPhase.BOUND)
+        assert batched_binds > 0
+        assert total_bound > 0
+        # grouped bursts with runs up to 8: a meaningful fraction of all
+        # binds should ride the shared pass
+        assert batched_binds >= total_bound * 0.15, (
+            batched_binds, total_bound)
+
+
+class TestConflictFallback:
+    def _sched(self, batch=8, mutate=None):
+        store = TelemetryStore()
+        now = time.time()
+        for i in range(6):
+            m = make_tpu_node(f"n{i}", chips=4)
+            m.heartbeat = now + 1e8
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        if mutate is not None:
+            orig_bind = cluster.bind
+            count = [0]
+
+            def chaos_bind(pod, node, chips=None):
+                orig_bind(pod, node, chips)
+                count[0] += 1
+                mutate(cluster, count[0])
+
+            cluster.bind = chaos_bind
+        sched = Scheduler(cluster, SchedulerConfig(
+            max_attempts=4, telemetry_max_age_s=3600.0,
+            batch_max_pods=batch), clock=HybridClock())
+        return cluster, sched
+
+    def test_mid_batch_cordon_falls_back_and_loses_nothing(self):
+        """Every other bind cordons a random node — the version vector
+        moves under the batch, the commit loop must fall back, and no pod
+        may be lost, double-booked, or bound to a cordoned-at-bind-time
+        node's phantom capacity."""
+        rng = random.Random(7)
+
+        def mutate(cluster, n):
+            if n % 2 == 0:
+                name = rng.choice(cluster.node_names())
+                cluster.set_node_meta(name, unschedulable=True)
+
+        cluster, sched = self._sched(mutate=mutate)
+        pods = [Pod(f"c{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(20)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=20000)
+        assert all(p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                   for p in pods), [(p.name, p.phase) for p in pods]
+        owners: dict = {}
+        for p in pods:
+            if p.phase != PodPhase.BOUND:
+                assert not p.assigned_chips()
+                continue
+            for c in p.assigned_chips():
+                key = (p.node, c)
+                assert key not in owners, (key, owners[key], p.name)
+                owners[key] = p.name
+        assert sched.metrics.counters.get(
+            "batch_conflict_fallbacks_total", 0) >= 1
+
+    def test_mid_batch_foreign_bind_falls_back(self):
+        """A foreign controller binds its own pod mid-batch: the next
+        member's version check must catch it and the batch must not
+        double-book the chips the foreign pod consumed."""
+        state = {"n": 0}
+
+        def mutate(cluster, n):
+            if n == 2 and state["n"] == 0:
+                state["n"] = 1
+                foreign = Pod("foreign", labels={"scv/number": "2",
+                                                 "tpu/accelerator": "tpu"})
+                target = cluster.node_names()[0]
+                m = cluster.telemetry.get(target)
+                coords = sorted(c.coords for c in m.chips)[:2]
+                cluster.bind(foreign, target, coords)
+                state["pod"] = foreign
+
+        cluster, sched = self._sched(mutate=mutate)
+        pods = [Pod(f"f{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(16)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=20000)
+        assert all(p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                   for p in pods)
+        owners: dict = {}
+        everyone = pods + ([state["pod"]] if "pod" in state else [])
+        for p in everyone:
+            if p.phase != PodPhase.BOUND:
+                continue
+            for c in p.assigned_chips():
+                key = (p.node, c)
+                assert key not in owners, (key, owners[key], p.name)
+                owners[key] = p.name
+
+
+class TestEquivalenceKeys:
+    def _sched(self):
+        store = TelemetryStore()
+        m = make_tpu_node("n0", chips=4)
+        m.heartbeat = time.time() + 1e8
+        store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        return Scheduler(cluster, SchedulerConfig(batch_max_pods=8))
+
+    def test_classmates_share_keys(self):
+        sched = self._sched()
+        a = Pod("a", labels={"scv/number": "2", "tpu/accelerator": "tpu"})
+        b = Pod("b", labels={"scv/number": "2", "tpu/accelerator": "tpu"})
+        assert sched._batch_key(a) is not None
+        assert sched._batch_key(a) == sched._batch_key(b)
+
+    def test_different_shapes_split_keys(self):
+        sched = self._sched()
+        a = Pod("a", labels={"scv/number": "2", "tpu/accelerator": "tpu"})
+        for labels in ({"scv/number": "1", "tpu/accelerator": "tpu"},
+                       {"scv/number": "2", "tpu/accelerator": "tpu",
+                        "scv/memory": "8000"},
+                       {"scv/number": "2", "tpu/accelerator": "tpu",
+                        "scv/priority": "3"}):
+            other = Pod("o", labels=labels)
+            assert sched._batch_key(other) != sched._batch_key(a)
+
+    def test_pod_specific_features_never_batch(self):
+        sched = self._sched()
+        gang = Pod("g", labels={"scv/number": "4", "tpu/gang-name": "gg",
+                                "tpu/gang-size": "2",
+                                "tpu/accelerator": "tpu"})
+        topo = Pod("t", labels={"scv/number": "4", "tpu/topology": "2x2",
+                                "tpu/accelerator": "tpu"})
+        anti = Pod("x", labels={"scv/number": "1"})
+        anti.pod_anti_affinity = (("app", "x", "zone"),)
+        ports = Pod("h", labels={"scv/number": "1"})
+        ports.host_ports = ((8080, "TCP", ""),)
+        malformed = Pod("m", labels={"scv/number": "nope"})
+        for pod in (gang, topo, anti, ports, malformed):
+            assert sched._batch_key(pod) is None, pod.name
+
+    def test_selector_pods_key_on_selector(self):
+        sched = self._sched()
+        a = Pod("a", labels={"scv/number": "1"})
+        a.node_selector = {"zone": "a"}
+        b = Pod("b", labels={"scv/number": "1"})
+        b.node_selector = {"zone": "b"}
+        c = Pod("c", labels={"scv/number": "1"})
+        c.node_selector = {"zone": "a"}
+        ka, kb, kc = (sched._batch_key(p) for p in (a, b, c))
+        assert ka is not None and ka == kc and ka != kb
+
+    def test_default_plugin_vote_is_no_batch(self):
+        """An un-audited plugin must veto batching (framework contract)."""
+        from yoda_scheduler_tpu.scheduler.framework import Plugin
+
+        assert Plugin().equivalence_key(Pod("p")) is NO_BATCH
+
+
+class TestQueueBatchPop:
+    def _queue(self, key_fn):
+        sort = PrioritySort()
+        q = SchedulingQueue(sort.less, key=sort.key)
+        q.set_batch_key_fn(key_fn)
+        return q
+
+    def test_gathers_class_in_fifo_order_within_band(self):
+        q = self._queue(lambda pod: pod.labels.get("k"))
+        for i, k in enumerate(("a", "b", "a", "a", "b", "a")):
+            q.add(Pod(f"p{i}", labels={"k": k}), now=float(i))
+        batch = q.pop_batch(now=10.0, max_pods=4)
+        assert [i.pod.name for i in batch] == ["p0", "p2", "p3", "p5"]
+        batch = q.pop_batch(now=10.0, max_pods=4)
+        assert [i.pod.name for i in batch] == ["p1", "p4"]
+        assert q.pop_batch(now=10.0, max_pods=4) == []
+        assert len(q) == 0
+
+    def test_never_crosses_a_priority_boundary(self):
+        q = self._queue(lambda pod: pod.labels.get("k"))
+        q.add(Pod("lo1", labels={"k": "a"}), now=0.0)
+        q.add(Pod("hi", labels={"k": "b", "scv/priority": "9"}), now=1.0)
+        q.add(Pod("lo2", labels={"k": "a"}), now=2.0)
+        batch = q.pop_batch(now=10.0, max_pods=8)
+        # the head is the highest-priority pod; nothing of another class
+        # rides along, and the low-priority classmates stay queued
+        assert [i.pod.name for i in batch] == ["hi"]
+        batch = q.pop_batch(now=10.0, max_pods=8)
+        assert [i.pod.name for i in batch] == ["lo1", "lo2"]
+
+    def test_backoff_pods_are_not_gathered(self):
+        q = self._queue(lambda pod: pod.labels.get("k"))
+        q.add(Pod("p0", labels={"k": "a"}), now=0.0)
+        q.add(Pod("p1", labels={"k": "a"}), now=1.0)
+        info = q.pop(now=10.0)
+        q.requeue_backoff(info, now=10.0)  # p0 parked
+        batch = q.pop_batch(now=10.0, max_pods=8)
+        assert [i.pod.name for i in batch] == ["p1"]
+        assert len(q) == 1  # p0 still parked
+
+    def test_removed_pods_are_not_gathered(self):
+        q = self._queue(lambda pod: pod.labels.get("k"))
+        pods = [Pod(f"p{i}", labels={"k": "a"}) for i in range(3)]
+        for i, p in enumerate(pods):
+            q.add(p, now=float(i))
+        assert len(q.remove(pods[1].key)) == 1
+        assert not q.contains(pods[1].key)
+        batch = q.pop_batch(now=10.0, max_pods=8)
+        assert [i.pod.name for i in batch] == ["p0", "p2"]
+        assert len(q) == 0
+
+    def test_gathered_then_requeued_pod_delivers_exactly_once(self):
+        """A gathered classmate leaves a stale MAIN-heap entry behind;
+        when the same info object later returns from backoff it gets a
+        fresh entry, so TWO heap entries reference one live pod. Liveness
+        is per activation STINT, so exactly one delivers — and the pod
+        keeps its original-enqueued FIFO position (backoff never changes
+        its enqueue time), with no duplicate pop through the other
+        entry."""
+        q = self._queue(lambda pod: pod.labels.get("k"))
+        q.add(Pod("A", labels={"k": "a"}), now=0.0)
+        q.add(Pod("B", labels={"k": "a"}), now=1.0)
+        batch = q.pop_batch(now=5.0, max_pods=8)  # gathers A + B
+        assert [i.pod.name for i in batch] == ["A", "B"]
+        b = batch[1]
+        q.requeue_backoff(b, now=10.0)  # B failed mid-batch: 1s backoff
+        q.add(Pod("E", labels={"k": "a"}), now=10.5)
+        q.add(Pod("F", labels={"k": "a"}), now=10.6)
+        order = []
+        while True:
+            info = q.pop(now=20.0)
+            if info is None:
+                break
+            order.append(info.pod.name)
+        # B's enqueued (1.0) predates E/F, so FIFO puts it first — ONCE
+        assert order == ["B", "E", "F"], order
+        assert len(q) == 0 and not q._by_bkey and not q._bkey_live
+
+    def test_unbatchable_head_pops_alone(self):
+        q = self._queue(lambda pod: None)
+        q.add(Pod("p0", labels={"k": "a"}), now=0.0)
+        q.add(Pod("p1", labels={"k": "a"}), now=1.0)
+        assert [i.pod.name
+                for i in q.pop_batch(now=10.0, max_pods=8)] == ["p0"]
+
+
+class TestKnobs:
+    def test_yoda_batch_env_disables(self, monkeypatch):
+        monkeypatch.setenv("YODA_BATCH", "0")
+        assert SchedulerConfig().batch_max_pods == 1
+        monkeypatch.setenv("YODA_BATCH", "off")
+        assert SchedulerConfig().batch_max_pods == 1
+        # any non-integer value an operator sets must DISABLE, never
+        # silently batch at full size
+        monkeypatch.setenv("YODA_BATCH", "no")
+        assert SchedulerConfig().batch_max_pods == 1
+        monkeypatch.setenv("YODA_BATCH", "12")
+        assert SchedulerConfig().batch_max_pods == 12
+        monkeypatch.delenv("YODA_BATCH")
+        assert SchedulerConfig().batch_max_pods == 32
+
+    def test_profile_knob(self):
+        cfg = SchedulerConfig.from_profile({
+            "schedulerName": "x",
+            "pluginConfig": [{"name": "yoda-tpu",
+                              "args": {"batchMaxPods": 4}}]})
+        assert cfg.batch_max_pods == 4
+
+    def test_batch_off_restores_per_pod_counters(self):
+        store = TelemetryStore()
+        m = make_tpu_node("n0", chips=8)
+        m.heartbeat = time.time() + 1e8
+        store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster, SchedulerConfig(
+            batch_max_pods=1, telemetry_max_age_s=1e9),
+            clock=HybridClock())
+        for i in range(4):
+            sched.submit(Pod(f"p{i}", labels={"scv/number": "1",
+                                              "tpu/accelerator": "tpu"}))
+        sched.run_until_idle()
+        assert sched.metrics.counters.get("batch_cycles_total", 0) == 0
+        assert sched.metrics.counters.get("batched_binds_total", 0) == 0
+
+
+class TestColumnarRowRefresh:
+    def test_refresh_row_matches_sync(self):
+        """The batch commit's in-place row refresh must leave the table
+        exactly where an ordinary changes_since sync would."""
+        pytest.importorskip("numpy")
+        store = TelemetryStore()
+        now = time.time()
+        for i in range(4):
+            m = make_tpu_node(f"n{i}", chips=4)
+            m.heartbeat = now + 1e8
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+                          clock=FakeClock(start=now))
+        table = sched._columnar
+        assert table is not None
+        snap = sched.snapshot()
+        vers0 = sched._cluster_versions()
+        assert table.sync(snap, vers0, sched._changes_since_vers)
+        free0 = table.free_count.copy()
+        # bind a pod onto n1 outside the engine, then refresh that row
+        pod = Pod("x", labels={"scv/number": "2", "tpu/accelerator": "tpu"})
+        m = store.get("n1")
+        coords = sorted(c.coords for c in m.chips)[:2]
+        cluster.bind(pod, "n1", coords)
+        vers1 = sched._cluster_versions()
+        snap1 = sched.snapshot()
+        assert table.refresh_row("n1", snap1.get("n1"), vers0, vers1)
+        i = table.index["n1"]
+        assert table.free_count[i] == free0[i] - 2
+        # a sync at the same vector is now a no-op (versions adopted)
+        assert table.sync(snap1, vers1, sched._changes_since_vers)
+        # refresh from a mismatched starting version refuses
+        assert not table.refresh_row("n1", snap1.get("n1"), vers0, vers1)

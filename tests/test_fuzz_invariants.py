@@ -130,6 +130,41 @@ def test_random_burst_invariants(seed):
     _check_invariants(pods, store, seed)
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_random_burst_invariants_unbatched(seed):
+    """The per-pod path stays wired in as the batch commit loop's
+    fallback and ground truth: the same random interleaved bursts must
+    hold every invariant with batching forced off (the default engine
+    above runs batched — tier-1 covers that side on every test)."""
+    rng = random.Random(seed)
+    store, sched = _make_sched(rng)
+    sched = Scheduler(sched.cluster, sched.config.with_(batch_max_pods=1),
+                      clock=HybridClock())
+    pods = _burst(rng)
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20000)
+    _check_invariants(pods, store, seed)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_burst_invariants_batched_interleaved(seed):
+    """INTERLEAVED submission under a small batch cap: the gather may
+    legally advance classmates past equal-priority pods (bounded
+    fairness trade, queue.py), but every global invariant — nothing
+    lost, nothing double-booked, gangs atomic — must hold exactly."""
+    rng = random.Random(70_000 + seed)
+    store, sched = _make_sched(rng)
+    sched = Scheduler(sched.cluster, sched.config.with_(batch_max_pods=5),
+                      clock=HybridClock())
+    pods = _burst(rng)
+    rng.shuffle(pods)  # maximally interleaved classes
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20000)
+    _check_invariants(pods, store, seed)
+
+
 def _check_invariants(pods, store, seed):
     """The global invariants every fleet/workload combination must satisfy
     after the engine drains — shared by the serial and concurrent fuzz so

@@ -308,7 +308,11 @@ class TestScoreClassMemo:
             store.put(m)
         cluster = FakeCluster(store)
         cluster.add_nodes_from_telemetry()
-        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+        # batch off: this test pins the PER-POD score-memo replay (which
+        # nodes rescore on the classmate's own cycle); a batch would
+        # place all three pods in run_one #1 (parity pinned elsewhere)
+        sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9,
+                                                   batch_max_pods=1),
                           clock=FakeClock(start=now))
         pods = [Pod(f"p{i}", labels={"scv/number": "1",
                                      "tpu/accelerator": "tpu"})
@@ -368,7 +372,8 @@ class TestScoreMemoMaximaGuard:
         # fragmentation off (a third scorer would shift the call counts)
         sched = Scheduler(cluster, SchedulerConfig(telemetry_max_age_s=1e9,
                                                    columnar=False,
-                                                   fragmentation_weight=0),
+                                                   fragmentation_weight=0,
+                                                   batch_max_pods=1),
                           clock=FakeClock(start=now))
         pods = [Pod(f"p{i}", labels={"scv/number": "4",
                                      "tpu/accelerator": "tpu"})

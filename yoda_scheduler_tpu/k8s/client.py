@@ -1529,11 +1529,16 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
 
 
 def _serve(client: KubeClient, cluster: KubeCluster, profiles,
-           metrics_port, poll_s: float, stop: threading.Event) -> int:
+           metrics_port, poll_s: float, stop: threading.Event,
+           out: dict | None = None) -> int:
     from ..scheduler.multi import MultiProfileScheduler
 
     cluster.wait_synced()
     sched = MultiProfileScheduler(cluster, profiles)
+    if out is not None:
+        # harnesses (bench.run_serve_scale) read engine metrics —
+        # batched_binds_total et al. — after the drain
+        out["sched"] = sched
 
     if metrics_port is not None:
         from ..utils.httpserv import serve
@@ -1615,7 +1620,11 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
             # cycles per intake pass: the intake bookkeeping above is
             # O(pending), so one-cycle-per-pass made a 1000-pod burst
             # O(pending^2) — new arrivals wait at most one batch, well
-            # under the poll interval they'd wait anyway
+            # under the poll interval they'd wait anyway. Each run_one is
+            # itself a BATCH cycle when the queue head has same-class
+            # company (core.schedule_batch): wire-paced arrivals of one
+            # equivalence class coalesce into a shared pass whenever the
+            # intake let the queue deepen, reported as batched_binds_total
             idle = False
             for _ in range(64):
                 outcomes = []

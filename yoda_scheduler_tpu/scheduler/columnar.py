@@ -247,6 +247,38 @@ class ColumnarTable:
         self._vers = vers
         return True
 
+    def refresh_row(self, name: str, ni, old_vers, new_vers) -> bool:
+        """In-place single-row refresh for the batch commit loop
+        (core._commit_batch): the caller has PROVEN — via change-log
+        attribution — that every cluster change between `old_vers` and
+        `new_vers` is on `name`, so re-filling that one row from the
+        freshly-rebuilt NodeInfo brings the whole table to `new_vers`
+        without a changes_since walk. Filling from the NodeInfo (rather
+        than applying just the bind's chip delta) keeps the row correct
+        even when something ELSE also moved on that node inside the bind
+        window — a telemetry publish, a cordon, an async-bind rollback
+        all attribute to the same name and are absorbed by the refill.
+        The common case (bind only, telemetry identity unchanged) skips
+        the chip-attribute columns and rewrites only the free mask and
+        counts — the in-place decrement, by way of _fill_row's
+        dynamic-column path. No-ops (False) unless the table currently
+        sits exactly at `old_vers`; the ordinary sync() then repairs from
+        the change logs later, so a refused refresh costs nothing but the
+        skipped shortcut."""
+        if not HAVE_NUMPY or self._vers is None or self._vers != old_vers \
+                or new_vers is None:
+            return False
+        i = self.index.get(name)
+        if i is None:
+            return False
+        if not self._fill_row(i, ni):
+            return False  # shape outgrew the padding: next sync rebuilds
+        self.row_updates += 1
+        self._serial += 1
+        self._qual_cache.clear()
+        self._vers = new_vers
+        return True
+
     def _rebuild(self, snapshot, vers) -> bool:
         nodes = snapshot.list()
         width = 1

@@ -14,6 +14,24 @@ import os
 from dataclasses import dataclass, field, replace
 
 
+def _batch_default() -> int:
+    """Default for batch scheduling cycles (core.schedule_batch).
+    YODA_BATCH=0 — or any non-integer string ("off", "false", …) —
+    restores the strict per-pod cycle end-to-end (CI runs tier-1 under
+    both); a positive integer overrides the batch size ceiling; unset
+    keeps the built-in 32."""
+    raw = os.environ.get("YODA_BATCH", "")
+    if not raw:
+        return 32
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        # any non-integer string ("off", "no", a typo) disables: an
+        # operator setting the variable at all is steering the knob, and
+        # silently batching at full size would defeat their per-pod repro
+        return 1
+
+
 def _columnar_default() -> bool:
     """Opt-out knob for the columnar data plane (scheduler/columnar.py).
     YODA_COLUMNAR=0 restores the per-node scalar path end-to-end — CI
@@ -99,6 +117,14 @@ class SchedulerConfig:
     # set is down to its LAST pair, so 2-chip jobs keep finding pairs
     # deep into a drain. 0 disables.
     fragmentation_weight: int = 1
+    # batch scheduling cycles: extend the queue head to up to this many
+    # pods sharing one scheduling equivalence class and place them with
+    # ONE shared filter+score pass plus an incremental greedy commit
+    # (core.schedule_batch). 1 disables (strict per-pod cycles, the
+    # upstream scheduleOne cadence); env YODA_BATCH=0 forces 1. Gang,
+    # topology, affinity, nominated, and hold-affected pods always take
+    # the per-pod cycle regardless of this knob.
+    batch_max_pods: int = field(default_factory=_batch_default)
     # dispatch the bind POST on a binder worker (upstream kube-scheduler's
     # binding-cycle goroutine) when the cluster backend supports it
     # (KubeCluster.bind_async); the in-memory FakeCluster always binds
@@ -138,6 +164,8 @@ class SchedulerConfig:
             columnar=bool(args.get("columnar", defaults.columnar)),
             fragmentation_weight=int(args.get(
                 "fragmentationWeight", defaults.fragmentation_weight)),
+            batch_max_pods=max(int(args.get(
+                "batchMaxPods", defaults.batch_max_pods)), 1),
         )
 
 

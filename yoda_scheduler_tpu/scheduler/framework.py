@@ -407,8 +407,34 @@ class EnqueueExtensions:
 CANDIDATE_NODES_KEY = "candidate_nodes"
 
 
+# Sentinel a plugin's equivalence_key returns to declare "pods like this
+# are NOT interchangeable under my verdicts" — the engine then never
+# extends the queue head to a batch containing such a pod.
+NO_BATCH = object()
+
+
 class Plugin:
     name: str = "plugin"
+
+    def equivalence_key(self, pod: Pod):
+        """Scheduling-equivalence contribution (upstream equivalence-cache
+        analogue, batch scheduling cycles): a hashable description of every
+        POD-SPECIFIC input this plugin's behaviour depends on beyond the
+        parsed WorkloadSpec and live cluster state. Two pods whose specs
+        and every plugin's equivalence keys agree are interchangeable for
+        one scheduling pass — the engine may pop them as one batch and
+        share the filter/score work.
+
+        Returning a key is a CONTRACT, not a hint: it asserts that for
+        such a pod this plugin's Filter/Score verdicts are a pure function
+        of (key, spec, cluster state), and that its PreFilter/Permit hooks
+        are no-ops. Return framework.NO_BATCH for pods that carry state
+        the key cannot capture (gang membership, inter-pod terms, exact
+        topology shapes). The conservative DEFAULT is NO_BATCH — a plugin
+        that never audited itself for interchangeability must not silently
+        vouch for it, so profiles containing un-audited plugins simply
+        never batch."""
+        return NO_BATCH
 
 
 class QueueSortPlugin(Plugin):
@@ -455,9 +481,39 @@ class PreScorePlugin(Plugin):
     def pre_score(self, state: CycleState, pod: Pod, feasible: list[NodeInfo]) -> Status:
         raise NotImplementedError
 
+    # Batch-commit capability hook. None = this plugin cannot update its
+    # pre_score outputs incrementally, so the engine never arms the batch
+    # commit loop for profiles containing it (each classmate then runs
+    # the ordinary per-pod cycle). Implementations take
+    # (state, pod, node_info, names) -> bool: one classmate just bound on
+    # `node_info` (freshly rebuilt post-bind); `names` is the repaired
+    # candidate name frozenset; bring this plugin's pre_score outputs in
+    # `state` (and its own memos) to the cycle's new `cycle_versions`, or
+    # return False when an exact update is impossible (the engine then
+    # falls back to per-pod cycles for the rest of the batch). MUST leave
+    # everything exactly as a fresh pre_score call at the new version
+    # vector would — the batched-vs-per-pod parity fuzz pins this. See
+    # plugins/prescore.py and plugins/topology.py for the two
+    # implementations.
+    pre_score_update = None
+
 
 class ScorePlugin(Plugin):
     weight: int = 1
+    # Declared shape of `normalize` so the engine can fuse normalization
+    # into the weighted sum without the per-cycle dict copy (and replay it
+    # vectorized in the batch commit loop):
+    #   "identity" — normalize leaves scores untouched (the base default,
+    #                and plugins whose scores are already absolute);
+    #   "minmax"   — normalize is exactly min_max_normalize(scores) with
+    #                the default [0, 100] bounds;
+    #   None       — undeclared: the engine calls `normalize` on a dict
+    #                copy, the pre-existing generic path (a plugin that
+    #                does not override `normalize` at all is detected as
+    #                identity without a declaration).
+    # The fused paths are written op-for-op like the declared shape, so
+    # floats agree bit-for-bit (parity-fuzzed in tests/test_batch.py).
+    normalize_kind: str | None = None
 
     def score(self, state: CycleState, pod: Pod, node: NodeInfo) -> tuple[float, Status]:
         raise NotImplementedError
@@ -497,7 +553,15 @@ class BindPlugin(Plugin):
 def min_max_normalize(scores: dict[str, float], lo: float = 0.0, hi: float = 100.0) -> None:
     """The reference's NormalizeScore rescales raw sums to [0,100] via
     min-max (reference pkg/yoda/scheduler.go:132-157, including a `lowest--`
-    divide-by-zero guard). Same math, standard guard."""
+    divide-by-zero guard). Same math, standard guard.
+
+    EDIT IN LOCKSTEP: plugins declaring ``normalize_kind = "minmax"``
+    promise exactly this arithmetic with the default bounds, and two
+    fused replicas depend on it bit-for-bit — the scalar fold in
+    core.Scheduler._fold_scores and the vectorized fold in
+    core.Scheduler._commit_batch. Changing the ops here without mirroring
+    both silently diverges batched vs per-pod placements on score ties
+    (the parity fuzz in tests/test_batch.py is the tripwire)."""
     if not scores:
         return
     lowest = min(scores.values())

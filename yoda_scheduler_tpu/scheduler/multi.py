@@ -14,8 +14,12 @@ per profile, all over the SAME cluster and — critically — the same
 ChipAllocator and GangCoordinator. Pending chip reservations and gang
 state are process-wide, so two profiles can never double-book chips
 between Reserve and Bind (upstream shares one scheduler cache the same
-way). The run loop drains engines round-robin, one pod per turn, which is
-upstream's one-pod-at-a-time scheduling cycle across profiles.
+way). The run loop drains engines round-robin, one scheduling cycle per
+turn — and a cycle is a BATCH cycle whenever the engine's queue head has
+equivalence-class company (core.schedule_batch), so a profile with a
+same-shape backlog drains whole batches per turn while still yielding to
+its co-hosted profiles between cycles (the shared cycle lock serializes
+the cycles themselves, exactly as before).
 """
 
 from __future__ import annotations
@@ -95,10 +99,11 @@ class MultiProfileScheduler:
 
     # ------------------------------------------------------------------- drive
     def run_until_idle(self, max_cycles: int = 10_000) -> int:
-        """Drain all engines round-robin, one scheduling cycle per turn;
-        when nobody can progress, sleep the shared clock to the earliest
-        gang deadline / backoff expiry across engines. Returns total cycles
-        executed."""
+        """Drain all engines round-robin, one scheduling cycle per turn
+        (a turn is a whole batch when the engine's queue head pops an
+        equivalence-class batch); when nobody can progress, sleep the
+        shared clock to the earliest gang deadline / backoff expiry across
+        engines. Returns total cycles executed."""
         total = 0
         while total < max_cycles:
             progressed = False

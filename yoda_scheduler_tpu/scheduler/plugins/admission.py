@@ -29,6 +29,7 @@ from ..framework import (
     CycleState,
     EnqueueExtensions,
     FilterPlugin,
+    NO_BATCH,
     NODE_ADDED,
     NODE_SPEC_CHANGED,
     NodeInfo,
@@ -547,6 +548,9 @@ def preemption_obstacles(state: CycleState, pod: Pod, node: NodeInfo,
 class NodeAdmission(FilterPlugin, ScorePlugin, EnqueueExtensions):
     name = "node-admission"
     weight = 1
+    # normalize below is exactly min_max_normalize with default bounds
+    # (framework.ScorePlugin.normalize_kind fusion contract)
+    normalize_kind = "minmax"
 
     def __init__(self, allocator=None) -> None:
         # ChipAllocator (optional): source of nominated-preemptor cpu/mem
@@ -572,6 +576,30 @@ class NodeAdmission(FilterPlugin, ScorePlugin, EnqueueExtensions):
                 return QUEUE
             return SKIP
         return QUEUE
+
+    def equivalence_key(self, pod: Pod):
+        """Batch-cycle contract: admission verdicts read several
+        POD-SPECIFIC inputs beyond the WorkloadSpec. The per-node ones
+        (selector, tolerations, node affinity incl. preferences, cpu/mem
+        requests) are pure functions of the pod fields below, so they go
+        INTO the key — classmates must carry identical values. The
+        pod-shaped predicates (inter-pod terms, spread, hostPorts) couple
+        a verdict to OTHER pods' placement mid-batch, so such pods never
+        batch at all."""
+        if (pod.pod_affinity or pod.pod_anti_affinity
+                or pod.preferred_pod_affinity or pod.topology_spread
+                or pod.host_ports):
+            return NO_BATCH
+        if not (pod.node_selector or pod.tolerations or pod.node_affinity
+                or pod.preferred_affinity or pod.cpu_millis
+                or pod.memory_bytes):
+            return ()
+        return (frozenset(pod.node_selector.items()),
+                tuple((t.get("key", ""), t.get("operator", "Equal"),
+                       t.get("value", ""), t.get("effect", ""))
+                      for t in pod.tolerations),
+                pod.node_affinity, pod.preferred_affinity,
+                pod.cpu_millis, pod.memory_bytes)
 
     def relevant(self, pod: Pod, snapshot) -> bool:
         """Hot-loop gate (core.py): on an untainted cluster a pod without

@@ -70,6 +70,16 @@ class ChipAllocator(ReservePlugin, EnqueueExtensions):
     def queueing_hint(self, event, pod) -> str:
         return QUEUE
 
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: chip picking is a pure function of the
+        WorkloadSpec and live node/ledger state, so classmates are
+        interchangeable. Nominated-capacity holds ARE pod-specific, but
+        the engine disables batching outright while any hold exists
+        (core.run_one), and the batch commit loop drives Reserve/complete
+        through the ordinary ledger hooks — every claim lands in the
+        change log exactly as a per-pod cycle's would."""
+        return ()
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._pending: dict[str, tuple[str, list[Coord]]] = {}  # pod.key -> (node, coords)

@@ -70,6 +70,14 @@ class TelemetryFilter(FilterPlugin, EnqueueExtensions):
         for n in gone:
             self._verdict_cache.pop(n, None)
 
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: every predicate here reads the parsed
+        WorkloadSpec (chips / HBM / clock / accelerator / generation —
+        all inside the engine's memo key) against node state; gang slice
+        checks never apply because gang pods are excluded upstream by
+        GangPermit's NO_BATCH vote."""
+        return ()
+
     # ------------------------------------------------- queueing hints
     def events_to_register(self) -> tuple:
         """Events that can cure a capacity/staleness rejection: chips

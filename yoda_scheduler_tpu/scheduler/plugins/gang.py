@@ -23,6 +23,7 @@ from ..framework import (
     CycleState,
     EnqueueExtensions,
     GANG_MEMBER_ARRIVED,
+    NO_BATCH,
     NODE_TELEMETRY_UPDATED,
     PermitPlugin,
     POD_DELETED,
@@ -181,6 +182,15 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
         self.gangs = gangs
         self.timeout_s = timeout_s
         self.allocator = allocator  # ChipAllocator, for multi-slice planning
+
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: gang members carry cross-pod assembly
+        state (chosen slice, plan quotas, Permit parking) and NEVER batch;
+        for everything else this plugin's PreFilter/Reserve/Permit hooks
+        are immediate no-op successes."""
+        if GANG_NAME_LABEL in pod.labels:
+            return NO_BATCH
+        return ()
 
     # PreFilter: when no single slice can host the whole gang, partition it
     # across slices (VERDICT r2 item 5) — fewest slices, largest chunks

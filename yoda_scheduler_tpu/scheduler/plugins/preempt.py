@@ -50,6 +50,13 @@ def _evictable(pod: Pod) -> bool:
 
 class PriorityPreemption(PostFilterPlugin):
     name = "priority-preemption"
+
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: PostFilter only runs when a pod found no
+        feasible node, and the batch commit loop never handles that case —
+        a member with exhausted candidates falls back to the full per-pod
+        cycle, which runs this plugin exactly as before."""
+        return ()
     # the planner's per-node verdicts are independent (absent PDBs, which
     # the engine gates on): restricting the scan to a caller-supplied node
     # set yields exactly the full scan's verdicts for those nodes, so the

@@ -39,6 +39,10 @@ class TelemetryScore(ScorePlugin):
     # the cycle's MaxValue — all covered by the engine's dirty-set +
     # maxima checks, so clean nodes' scores may be replayed verbatim.
     score_inputs = "node"
+    # normalize is exactly min_max_normalize with default bounds — the
+    # engine fuses it into the weighted sum (and the batch commit loop
+    # replays it vectorized) without the per-cycle dict copy
+    normalize_kind = "minmax"
 
     def __init__(self, allocator: ChipAllocator, weights: ScoreWeights | None = None,
                  weight: int = 1) -> None:
@@ -61,6 +65,18 @@ class TelemetryScore(ScorePlugin):
         # MaxValue is mutable-by-construction, so the key carries its
         # field tuple, never the object.
         self._basic_cache: dict[str, tuple[tuple, float]] = {}
+        # preallocated score_batch buffers, keyed by the candidate-row
+        # matrix shape: the six per-attribute masked sums each built two
+        # throwaway arrays per cycle at 1000-node scale (the issue's
+        # measured 170 us/bind floor names this replay cost) — np.take/
+        # np.multiply/sum into reused storage keeps the values
+        # bit-identical while dropping the allocator churn
+        self._bufs: tuple | None = None
+
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: raw scores read only the WorkloadSpec's
+        HBM/clock floors plus node/ledger state (score_inputs above)."""
+        return ()
 
     def forget_nodes(self, gone: set[str]) -> None:
         for n in gone:
@@ -160,15 +176,28 @@ class TelemetryScore(ScorePlugin):
         if mv is None:
             return None
         spec: WorkloadSpec = state.read(SPEC_KEY)
-        q, qcount = table.qual(spec.min_free_mb, spec.min_clock_mhz)
+        q, _qcount = table.qual(spec.min_free_mb, spec.min_clock_mhz)
         q = q[rows]
         w = self.weights
-        sbw = (table.chip_bw[rows] * q).sum(axis=1)
-        sck = (table.chip_clock[rows] * q).sum(axis=1)
-        sco = (table.chip_core[rows] * q).sum(axis=1)
-        sfm = (table.chip_hbm_free[rows] * q).sum(axis=1)
-        spw = (table.chip_power[rows] * q).sum(axis=1)
-        stm = (table.chip_hbm_total[rows] * q).sum(axis=1)
+        # masked per-attribute sums through preallocated buffers (see
+        # _bufs): np.take + in-place multiply + sum produce exactly the
+        # integers `(col[rows] * q).sum(axis=1)` would, without the two
+        # temporaries per attribute per cycle
+        n_rows, width = q.shape
+        bufs = self._bufs
+        if bufs is None or bufs[0] != (n_rows, width):
+            bufs = ((n_rows, width),
+                    np.empty((n_rows, width), dtype=np.int64),
+                    np.empty((6, n_rows), dtype=np.int64))
+            self._bufs = bufs
+        _, tmp, sums = bufs
+        for j, col in enumerate((table.chip_bw, table.chip_clock,
+                                 table.chip_core, table.chip_hbm_free,
+                                 table.chip_power, table.chip_hbm_total)):
+            np.take(col, rows, axis=0, out=tmp)
+            np.multiply(tmp, q, out=tmp)
+            tmp.sum(axis=1, out=sums[j])
+        sbw, sck, sco, sfm, spw, stm = sums
         basic = (
             100.0 * sbw / mv.bandwidth * w.bandwidth
             + 100.0 * sck / mv.clock * w.clock
@@ -211,6 +240,13 @@ class FragmentationScore(ScorePlugin):
     # score-memo contract: the raw score is a pure function of the node's
     # free-chip count (serial + pending version) and the pod's label class
     score_inputs = "node"
+    # normalize below deliberately returns None (absolute semantics)
+    normalize_kind = "identity"
+
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: the penalty reads only spec.chips and
+        the node's free count."""
+        return ()
 
     def __init__(self, allocator: ChipAllocator, weight: int = 1) -> None:
         self.allocator = allocator

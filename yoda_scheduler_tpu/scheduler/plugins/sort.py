@@ -50,6 +50,12 @@ def constraint_rank(info: QueuedPodInfo) -> int:
 class PrioritySort(QueueSortPlugin):
     name = "priority-sort"
 
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: ordering reads only the priority and
+        constraint labels, all inside the WorkloadSpec the engine's memo
+        key already carries — classmates sort identically."""
+        return ()
+
     def less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
         pa, pb = pod_priority(a), pod_priority(b)
         if pa != pb:

@@ -42,6 +42,14 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
     # clean node whenever its slice's usage entry moved (a bind anywhere
     # on the slice dents it)
     score_inputs = "node+slice_usage"
+    # normalize below deliberately returns None (absolute 0..100 scale)
+    normalize_kind = "identity"
+
+    def equivalence_key(self, pod):
+        """Batch-cycle contract: contiguity/packing read only spec.chips,
+        spec.is_gang (always False for batchable pods — GangPermit votes
+        NO_BATCH for gangs), and node/slice state."""
+        return ()
 
     # Scoring never rejects, so this plugin rarely appears in a pod's
     # rejecting set — but topology-shaped Reserve failures routed to it
@@ -99,15 +107,7 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
                     contrib = dict(contrib)
                     for name in dirty:
                         node = snapshot.get(name) if snapshot else None
-                        old = contrib.pop(name, None)
-                        if old is not None:
-                            u, t = usage.get(old[0], (0, 0))
-                            usage[old[0]] = (u - old[1], t - old[2])
-                        new = self._contribution(node)
-                        if new is not None:
-                            contrib[name] = new
-                            u, t = usage.get(new[0], (0, 0))
-                            usage[new[0]] = (u + new[1], t + new[2])
+                        self._patch(usage, contrib, name, node)
                 self._usage_state = (vers, usage, contrib)
                 state.write(SLICE_USE_KEY, usage)
                 return Status.success()
@@ -124,6 +124,42 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
             self._usage_state = (vers, usage, contrib)
         state.write(SLICE_USE_KEY, usage)
         return Status.success()
+
+    def _patch(self, usage: dict, contrib: dict, name: str,
+               node: NodeInfo | None) -> None:
+        """Replace one node's contribution in the slice-usage map (shared
+        by pre_score's incremental branch and the batch-commit hook —
+        the two must stay arithmetic-identical or batched and per-pod
+        usage maps diverge)."""
+        old = contrib.pop(name, None)
+        if old is not None:
+            u, t = usage.get(old[0], (0, 0))
+            usage[old[0]] = (u - old[1], t - old[2])
+        new = self._contribution(node)
+        if new is not None:
+            contrib[name] = new
+            u, t = usage.get(new[0], (0, 0))
+            usage[new[0]] = (u + new[1], t + new[2])
+
+    def pre_score_update(self, state: CycleState, pod, node_info,
+                         names) -> bool:
+        """Batch-commit hook (framework.PreScorePlugin): one classmate
+        just bound on `node_info`; patch its contribution in the slice
+        usage map — the same arithmetic pre_score's incremental branch
+        runs for a single dirty node — and advance the plugin memo to the
+        cycle's new version vector."""
+        if self._usage_state is None:
+            return False
+        vers = state.read_or("cycle_versions")
+        if vers is None:
+            return False
+        _, usage, contrib = self._usage_state
+        usage = dict(usage)
+        contrib = dict(contrib)
+        self._patch(usage, contrib, node_info.name, node_info)
+        self._usage_state = (vers, usage, contrib)
+        state.write(SLICE_USE_KEY, usage)
+        return True
 
     def _contribution(self, node: NodeInfo | None) -> tuple | None:
         """(slice_id, used chips, total chips) this node adds to the

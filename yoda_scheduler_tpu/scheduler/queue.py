@@ -17,6 +17,20 @@ parked, so a bind storm cannot thundering-herd every parked pod back into
 the filter chain. The backoff deadline stays as the timer fallback, so a
 pod whose rejecting plugins have no hint coverage behaves exactly as
 before.
+
+Equivalence-class batch pop (batch scheduling cycles): when the engine
+registers a batch-key function (set_batch_key_fn), pop_batch extends the
+ordinary head pop to up to `max_pods` ACTIVE pods sharing the head's
+scheduling-equivalence key, so one filter+score pass can place the whole
+batch. Ordering contract: the head is still the exact pod pop() would
+return; classmates are gathered in (enqueued, seq) FIFO order from a
+per-key index. Classmates necessarily share the head's priority and
+constraint rank (both are functions of the labels the key covers), so a
+batch never overtakes a higher-priority pod — it can only advance
+classmates past EQUAL-priority pods of other classes, bounded by
+`max_pods` (the documented fairness trade; batchMaxPods=1 restores strict
+FIFO). Pods in backoff are never gathered — only an event or their timer
+moves them to the active queue, exactly as before.
 """
 
 from __future__ import annotations
@@ -95,6 +109,30 @@ class SchedulingQueue:
         # O(1), not a queue scan — at 1000 pending pods the scan made the
         # serve loop O(n^2) per pass
         self._key_counts: dict[str, int] = {}
+        # ---- equivalence-class batch pop state ----
+        # batch-key function (engine-provided); None disables batching.
+        self._bkey_fn: Callable | None = None
+        # live membership of the ACTIVE queue: id(info) -> the seq of its
+        # CURRENT activation stint. Gathering a classmate from the per-key
+        # index (or a lazy removal) deletes the id, and both heaps skip
+        # entries whose recorded seq is not the live stint's at pop time —
+        # the same lazy-staleness pattern the backoff heap uses. Keying on
+        # the stint seq (not bare identity) matters: a gathered-then-
+        # requeued info re-enters with a FRESH seq, and its old heap entry
+        # must stay dead or the pod would ride the old entry's position
+        # ahead of equal-priority pods enqueued during its backoff.
+        # _n_active is the live count — heap list lengths over-count once
+        # lazy removals exist.
+        self._active_ids: dict[int, int] = {}
+        self._n_active = 0
+        # batch key -> heap of (enqueued, seq, info): FIFO within a class,
+        # matching the main heap's intra-band order. _bkey_live counts the
+        # LIVE entries per key: when a class's last active pod leaves (by
+        # any route — pop, batch gather, removal), its whole heap is
+        # dropped, so classes that never recur cannot accumulate dead
+        # entries in a long-running serve daemon.
+        self._by_bkey: dict = {}
+        self._bkey_live: dict = {}
 
     # --------------------------------------------------------- hint registry
     def register_plugin(self, plugin) -> None:
@@ -125,16 +163,33 @@ class SchedulingQueue:
         else:
             self._key_counts[key] = n
 
+    def set_batch_key_fn(self, fn: Callable | None) -> None:
+        """Install the engine's scheduling-equivalence key function
+        (pod -> hashable | None). Must be set before the first add(); the
+        function must be pure per pod (the engine memoises it on the pod)."""
+        self._bkey_fn = fn
+
     def _push_active(self, info: QueuedPodInfo) -> None:
+        stint = next(self._seq)  # tie-break AND this activation's epoch
+        self._active_ids[id(info)] = stint
+        self._n_active += 1
         if self._key is not None:
             heapq.heappush(self._active,
-                           (self._key(info), next(self._seq), info))
+                           (self._key(info), stint, info))
+            if self._bkey_fn is not None:
+                k = self._bkey_fn(info.pod)
+                if k is not None:
+                    heapq.heappush(
+                        self._by_bkey.setdefault(k, []),
+                        (info.enqueued, stint, info))
+                    self._bkey_live[k] = self._bkey_live.get(k, 0) + 1
         else:
             self._active.append(info)
 
     def _active_infos(self):
         if self._key is not None:
-            return (entry[2] for entry in self._active)
+            return (e[2] for e in self._active
+                    if self._active_ids.get(id(e[2])) == e[1])
         return iter(self._active)
 
     def add(self, pod: Pod, now: float | None = None) -> None:
@@ -145,7 +200,7 @@ class SchedulingQueue:
         self._inc(pod.key)
 
     def __len__(self) -> int:
-        return len(self._active) + len(self._parked)
+        return self._n_active + len(self._parked)
 
     def pending(self) -> int:
         return len(self)
@@ -263,19 +318,72 @@ class SchedulingQueue:
         if self._inbox:
             self._drain_inbox(now)
         self._flush_backoff(now)
-        if not self._active:
+        if not self._n_active:
+            if self._active:
+                del self._active[:]  # no live entries: all stale
             return None
         if self._key is not None:
-            info = heapq.heappop(self._active)[2]
-            self._dec(info.pod.key)
-            return info
+            while self._active:
+                _, stint, info = heapq.heappop(self._active)
+                if self._active_ids.get(id(info)) != stint:
+                    continue  # gathered/removed, or a PREVIOUS stint's
+                    # entry for a since-requeued pod: stale either way
+                self._consume_active(info)
+                return info
+            return None
         best_i = 0
         for i in range(1, len(self._active)):
             if self._less(self._active[i], self._active[best_i]):
                 best_i = i
         info = self._active.pop(best_i)
-        self._dec(info.pod.key)
+        self._consume_active(info)
         return info
+
+    def _consume_active(self, info: QueuedPodInfo) -> None:
+        self._active_ids.pop(id(info), None)
+        self._n_active -= 1
+        self._dec(info.pod.key)
+        if self._bkey_fn is not None:
+            k = self._bkey_fn(info.pod)
+            if k is not None:
+                n = self._bkey_live.get(k, 0) - 1
+                if n <= 0:
+                    self._bkey_live.pop(k, None)
+                    self._by_bkey.pop(k, None)
+                else:
+                    self._bkey_live[k] = n
+
+    def pop_batch(self, now: float | None = None,
+                  max_pods: int = 1) -> list[QueuedPodInfo]:
+        """Pop the head plus up to max_pods-1 ACTIVE pods sharing its
+        scheduling-equivalence key (module docstring: same-class gather in
+        FIFO order, never across a priority boundary). Degrades to a
+        single-pod pop when batching is off, the head's class is
+        unbatchable, or the sort plugin provides no heap key (the
+        comparator-scan mode has no cheap per-key index)."""
+        now = time.time() if now is None else now
+        head = self.pop(now)
+        if head is None:
+            return []
+        if (max_pods <= 1 or self._bkey_fn is None
+                or self._key is None):
+            return [head]
+        k = self._bkey_fn(head.pod)
+        if k is None:
+            return [head]
+        heap = self._by_bkey.get(k)
+        batch = [head]
+        while heap and len(batch) < max_pods:
+            _, stint, info = heap[0]
+            if self._active_ids.get(id(info)) != stint:
+                heapq.heappop(heap)  # stale: popped/removed/requeued
+                continue
+            heapq.heappop(heap)
+            self._consume_active(info)
+            batch.append(info)
+        if not heap:
+            self._by_bkey.pop(k, None)
+        return batch
 
     def requeue_backoff(self, info: QueuedPodInfo, now: float | None = None,
                         rejected_by: tuple = ()) -> None:
@@ -320,22 +428,30 @@ class SchedulingQueue:
         removed)."""
         removed: list[QueuedPodInfo] = []
         if self._key is not None:
-            keep = []
+            # lazy removal: _consume_active drops the live id (and the
+            # per-batch-key live count) and the heaps skip the stale
+            # entries at pop time — rebuilding + re-heapifying the whole
+            # active heap per removal was O(n log n) against churny
+            # serve loops
             for e in self._active:
-                (removed if e[2].pod.key == pod_key else keep).append(e)
-            self._active = keep
-            heapq.heapify(self._active)
-            removed = [e[2] for e in removed]
+                info = e[2]
+                if info.pod.key == pod_key \
+                        and id(info) in self._active_ids:
+                    self._consume_active(info)
+                    removed.append(info)
         else:
             keep = []
             for q in self._active:
                 (removed if q.pod.key == pod_key else keep).append(q)
             self._active = keep
+            self._n_active -= len(removed)
+            for info in removed:
+                self._active_ids.pop(id(info), None)
+                self._dec(pod_key)
         for info in [i for i in self._parked.values()
                      if i.pod.key == pod_key]:
             self._unpark(info)  # heap entry goes stale; skipped at pop
             removed.append(info)
-        for _ in removed:
             self._dec(pod_key)
         return removed
 
@@ -347,7 +463,7 @@ class SchedulingQueue:
         O(1) amortised: stale heap heads are discarded as encountered.
         An undrained event inbox reads as ready NOW — the next pop may
         activate a parked pod."""
-        if self._active or self._inbox:
+        if self._n_active or self._inbox:
             return 0.0
         heap = self._backoff
         while heap:
