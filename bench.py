@@ -167,6 +167,7 @@ def run_burst(profile_kind: str):
         "cycles": cycles,
         **batch_stats(sched),
         **requeue_stats(sched),
+        **resilience_stats(sched),
     }
 
 
@@ -188,6 +189,28 @@ def requeue_stats(sched) -> dict:
                                 if hb is not None and hb.n else None),
         "backoff_wait_p99_ms": (round(hb.quantile(0.99), 2)
                                 if hb is not None and hb.n else None),
+    }
+
+
+def resilience_stats(sched) -> dict:
+    """Self-healing observability: every recovery path the chaos work
+    added increments one of these (crash containment, quarantine, the
+    apiserver circuit breaker, blackout degraded mode, lost-response
+    bind adoption, restart reconciliation, event-storm flushes) — a
+    clean run reports zeros, a survived outage reports WHICH recovery
+    carried it."""
+    c = sched.metrics.counters
+    return {
+        "cycle_crashes": c.get("cycle_crashes_total", 0),
+        "pods_quarantined": c.get("pods_quarantined_total", 0),
+        "breaker_opens": c.get("breaker_opens_total", 0),
+        "breaker_parked_cycles": c.get("breaker_parked_cycles_total", 0),
+        "degraded_cycles": c.get("degraded_cycles_total", 0),
+        "ambiguous_bind_recoveries": c.get(
+            "ambiguous_bind_recoveries_total", 0),
+        "reconcile_adopted": c.get("reconcile_adopted_total", 0),
+        "reconcile_requeued": c.get("reconcile_requeued_total", 0),
+        "requeue_events_dropped": c.get("requeue_events_dropped_total", 0),
     }
 
 
@@ -233,7 +256,7 @@ def batch_stats(sched) -> dict:
 
 def run_scale(units: int, pct: int = 0, pods_per_node: int = 5,
               diverse: bool = False, columnar: bool | None = None,
-              batch: bool | None = None):
+              batch: bool | None = None, blackout: bool = False):
     """Scale stress (VERDICT r2 item 7): a large-cluster burst measuring
     whether cycle compute stays sub-linear in node count. pct=0 keeps
     kube-scheduler's adaptive percentageOfNodesToScore (scores ~42% of
@@ -250,19 +273,29 @@ def run_scale(units: int, pct: int = 0, pods_per_node: int = 5,
     gc.disable()
     try:
         return _run_scale_nogc(units, pct, pods_per_node, diverse, columnar,
-                               batch)
+                               batch, blackout)
     finally:
         gc.enable()
 
 
 def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
                     diverse: bool = False, columnar: bool | None = None,
-                    batch: bool | None = None):
+                    batch: bool | None = None, blackout: bool = False):
     store = build_scale_nodes(units)
+    if blackout:
+        # telemetry-blackout leg: the WHOLE feed died long before the
+        # burst (every heartbeat ancient, staleness gate live at 60s).
+        # Without degraded mode this binds ZERO pods — every node is
+        # stale-infeasible; with it the engine schedules off last-known
+        # capacity and reports degraded_cycles (resilience_stats).
+        from yoda_scheduler_tpu.chaos import blackout as chaos_blackout
+
+        chaos_blackout(store, time.time(), 60.0)
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
     n_nodes = len(cluster.node_names())
-    config = SchedulerConfig(max_attempts=8, telemetry_max_age_s=1e9,
+    config = SchedulerConfig(max_attempts=8,
+                             telemetry_max_age_s=60.0 if blackout else 1e9,
                              percentage_of_nodes_to_score=pct,
                              # production posture for the requeue
                              # subsystem: fully-hint-covered pods retry on
@@ -346,6 +379,7 @@ def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
             "columnar_score_batches_total", 0),
         **batch_stats(sched),
         **requeue_stats(sched),
+        **resilience_stats(sched),
     }
 
 
@@ -493,10 +527,13 @@ def _run_serve_scale_nogc(n_nodes: int, n_pods: int):
         # arrivals that coalesced into shared cycles whenever the queue
         # deepened past one pod between intake passes
         batched = 0
+        recovery: dict = {}
         sched = serve_box.get("sched")
         if sched is not None:
             for e in sched.engines.values():
                 batched += e.metrics.counters.get("batched_binds_total", 0)
+                for k, v in resilience_stats(e).items():
+                    recovery[k] = recovery.get(k, 0) + (v or 0)
         return {
             "nodes": n_nodes,
             "pods": n_pods,
@@ -514,6 +551,9 @@ def _run_serve_scale_nogc(n_nodes: int, n_pods: int):
             # driver-vs-local gap becomes explainable with data instead
             # of a shrug
             "ingest_phases": ingest_phases,
+            # self-healing counters (all-zero on a healthy serve run;
+            # non-zero names the recovery path a survived outage took)
+            "recovery": recovery,
         }
 
 

@@ -17,3 +17,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full chaos-fuzz matrix seeds (CI chaos job); tier-1 runs "
+        "-m 'not slow' and keeps only the smoke subset")

@@ -49,8 +49,15 @@ class FakeApiState:
         self.leases: dict[str, dict] = {}
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self.bindings: list[dict] = []
-        # fault injection: list of [path_substring, status, remaining_count]
+        # fault injection: list of [path_substring, status, remaining_count,
+        # method]; remaining_count None = until clear_faults() (scripted
+        # error STORMS rather than a fixed number of failures)
         self.faults: list[list] = []
+        # watch-stream cut epochs: cut_watches(kind) bumps the kind's
+        # epoch and every in-flight watch of that kind ends its stream
+        # (clean close — the client re-watches from its resourceVersion;
+        # pair with compact() to force the 410 re-list path instead)
+        self.watch_epochs: dict[str, int] = {k: 0 for k in self.KINDS}
         self.uid_seq = 0
         # graceful deletion: DELETE sets metadata.deletionTimestamp and
         # emits MODIFIED (the pod keeps running with its nodeName, as a real
@@ -102,12 +109,34 @@ class FakeApiState:
             self.kind_conds[kind].notify_all()
             self.cond.notify_all()
 
-    def fail(self, path_substring: str, status: int, times: int = 1,
-             method: str | None = None) -> None:
+    def fail(self, path_substring: str, status: int,
+             times: int | None = 1, method: str | None = None) -> None:
         """Inject `status` for the next `times` requests whose path contains
-        `path_substring` (optionally only for one HTTP method)."""
+        `path_substring` (optionally only for one HTTP method).
+        times=None keeps the fault active until clear_faults() — an
+        error storm with a scripted end instead of a request budget."""
         with self.cond:
             self.faults.append([path_substring, status, times, method])
+
+    def clear_faults(self, path_substring: str | None = None) -> None:
+        """End injected faults (all of them, or those registered for
+        `path_substring`) — the storm-recovery edge chaos tests script."""
+        with self.cond:
+            if path_substring is None:
+                self.faults.clear()
+            else:
+                self.faults[:] = [f for f in self.faults
+                                  if f[0] != path_substring]
+
+    def cut_watches(self, kind: str | None = None) -> None:
+        """Force every in-flight watch stream of `kind` (default: all) to
+        end — the mid-stream connection cut a flapping LB or restarting
+        apiserver produces. Clients see a clean stream end and re-watch."""
+        with self.cond:
+            for k in (self.KINDS if kind is None else (kind,)):
+                self.watch_epochs[k] += 1
+                self.kind_conds[k].notify_all()
+            self.cond.notify_all()
 
     # ------------------------------------------------------------- helpers
     def add_pdb(self, name: str, match_labels: dict, min_available: int,
@@ -184,9 +213,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _injected_fault(self, path: str, method: str) -> int | None:
         with self.state.cond:
             for f in self.state.faults:
-                if (f[0] in path and f[2] > 0
+                if (f[0] in path and (f[2] is None or f[2] > 0)
                         and (len(f) < 4 or f[3] is None or f[3] == method)):
-                    f[2] -= 1
+                    if f[2] is not None:
+                        f[2] -= 1
                     return f[1]
         return None
 
@@ -310,6 +340,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "message": "too old resource version"}}) + "\n"
                 self.wfile.write(line.encode())
                 return
+            epoch0 = s.watch_epochs[kind]
         last = from_rv
         # events are rv-ascending: bisect to the first undelivered one
         # instead of rescanning the whole log per wake-up (the rescan was
@@ -319,6 +350,8 @@ class _Handler(BaseHTTPRequestHandler):
         rv_of = lambda e: e[0]  # noqa: E731
         while time.monotonic() < deadline:
             with s.cond:
+                if s.watch_epochs[kind] != epoch0:
+                    return  # scripted stream cut: end mid-watch
                 evs = s.events[kind]
                 i = bisect.bisect_right(evs, last, key=rv_of)
                 batch = evs[i:]
@@ -327,6 +360,9 @@ class _Handler(BaseHTTPRequestHandler):
                     # s.cond): only events of our own kind wake us
                     s.kind_conds[kind].wait(timeout=min(0.2, max(
                         deadline - time.monotonic(), 0.01)))
+                    if s.watch_epochs[kind] != epoch0:
+                        return  # cut fired while parked: die BEFORE
+                        # delivering events published after the cut
                     evs = s.events[kind]
                     i = bisect.bisect_right(evs, last, key=rv_of)
                     batch = evs[i:]

@@ -126,8 +126,15 @@ class TestRetryAndRecovery:
         assert second.phase == PodPhase.BOUND
 
     def test_stale_telemetry_blocks_until_heartbeat(self):
+        # degraded_mode off: on a ONE-node cluster a stale sniffer is
+        # indistinguishable from a whole-feed blackout, which the default
+        # degraded mode deliberately keeps scheduling through
+        # (tests/test_chaos.py covers that posture); this test pins the
+        # classic per-node staleness fence
         sched, _, clock = mk_sched(
-            make_tpu_node("n1"), config=SchedulerConfig(telemetry_max_age_s=5.0)
+            make_tpu_node("n1"),
+            config=SchedulerConfig(telemetry_max_age_s=5.0,
+                                   degraded_mode=False)
         )
         clock.advance(60.0)  # sniffer silent for a minute
         pod = Pod("p")

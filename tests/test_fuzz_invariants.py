@@ -113,8 +113,14 @@ def _make_sched(rng: random.Random):
     store = _fleet(rng)
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
+    # degraded_mode off: this fuzz pins the per-node staleness fence and
+    # placement invariants; heartbeats are published once at setup, so a
+    # retry-heavy seed whose VIRTUAL clock outruns max_age would look
+    # like a blackout and flip semantics mid-drain. Blackout behaviour
+    # has its own seeded fuzz in tests/test_chaos.py.
     sched = Scheduler(cluster, SchedulerConfig(
-        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=3600.0),
+        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=3600.0,
+        degraded_mode=False),
         clock=HybridClock())
     return store, sched
 
@@ -329,8 +335,13 @@ def test_random_burst_invariants_concurrent(seed):
     store = _fleet(rng)
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
+    # degraded_mode off: the publisher thread stops before the rig's
+    # final single-threaded drain, which reads as a cluster-wide
+    # blackout and would waive the very staleness fence this regime
+    # exists to race (blackout semantics: tests/test_chaos.py)
     sched = Scheduler(cluster, SchedulerConfig(
-        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=0.4))
+        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=0.4,
+        degraded_mode=False))
     pods = _burst(rng)
     churn_done = threading.Event()
 
@@ -452,8 +463,10 @@ def test_random_burst_invariants_concurrent_preemption(seed):
     store = _fleet(rng)
     cluster = FakeCluster(store)
     cluster.add_nodes_from_telemetry()
+    # degraded_mode off, same reason as the non-preempting racy regime
     sched = Scheduler(cluster, SchedulerConfig(
-        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=0.4))
+        max_attempts=3, gang_timeout_s=0.5, telemetry_max_age_s=0.4,
+        degraded_mode=False))
     pods = _burst(rng)
     for p in pods:
         if rng.random() < 0.4 and "tpu/gang-name" not in p.labels:

@@ -454,6 +454,45 @@ def test_reflector_absent_skips_replace():
     assert calls["absent"] == [True]
 
 
+def test_reflector_storm_backoff_jittered_capped_and_counted():
+    """Satellite (chaos PR): a reflector riding out an apiserver outage
+    backs off with JITTER (replicas must not re-list in lockstep on
+    recovery), never exceeds its cap, and counts the storm in Metrics
+    instead of leaving it to log lines."""
+    import random as _random
+
+    from yoda_scheduler_tpu.k8s.client import Reflector
+    from yoda_scheduler_tpu.utils.obs import Metrics
+
+    def down(method, path, body, timeout):
+        raise ConnectionError("storm")
+
+    client = KubeClient("https://fake", transport=down, max_retries=0)
+    metrics = Metrics()
+    waits: list[float] = []
+
+    class RecordingStop(threading.Event):
+        def wait(self, timeout=None):
+            if timeout is not None:
+                waits.append(timeout)
+            if len(waits) >= 8:
+                self.set()
+            return self.is_set()
+
+    stop = RecordingStop()
+    r = Reflector(client, "/api/v1/pods", lambda items: None,
+                  lambda t, o: None, backoff_s=0.5, max_backoff_s=2.0,
+                  metrics=metrics, rng=_random.Random(7))
+    r.run(stop)
+    assert metrics.counters["reflector_watch_errors_total"] >= 8
+    # every wait within the cap, and the jitter actually decorrelates
+    # (not all identical even after the exponent saturates)
+    assert all(w <= 2.0 for w in waits), waits
+    assert len({round(w, 4) for w in waits}) > 2, waits
+    # the list attempts themselves are counted (storm visibility)
+    assert metrics.counters["reflector_relists_total"] >= 8
+
+
 def test_nonidempotent_post_not_silently_replayed():
     """ADVICE r4: an ambiguous connection failure (RemoteDisconnected
     after the request was written) must NOT silently replay a POST — the

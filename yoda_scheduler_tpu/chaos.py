@@ -1,0 +1,279 @@
+"""Deterministic chaos injection: seeded fault plans + a chaos cluster.
+
+The scheduler's two single points of failure are the apiserver connection
+and the telemetry feed (the paper's placement quality is worthless if the
+control loop wedges or double-places when either goes away). This module
+scripts those outages DETERMINISTICALLY — a seed fully determines which
+faults fire and when, on the engine's injectable clock — so the invariant
+fuzz in tests/test_chaos.py can replay hundreds of distinct outage
+scenarios and every failure reproduces from its seed alone.
+
+Fault kinds:
+
+- APISERVER_STORM    bind requests fail with wire errors (5xx storm /
+                     connection refused); nothing is applied.
+- BIND_LOST          the bind IS applied server-side, then the response is
+                     lost (fake_apiserver fault ``-1`` / KubeClient
+                     AmbiguousRequestError analogue) — the ambiguous
+                     failure the adoption path must resolve without a
+                     duplicate bind.
+- TELEMETRY_BLACKOUT every sniffer heartbeat stops: the whole feed ages
+                     out and the engine must degrade to capacity-only
+                     scheduling instead of rejecting every node as stale.
+- PLUGIN_ERROR       a plugin RAISES mid-cycle (filter/score/reserve);
+                     the engine must contain the crash to the pod.
+- ENGINE_CRASH       the scheduler process dies mid-drain; the test driver
+                     builds a fresh engine against the same cluster and
+                     reconciles in-flight state from cluster truth.
+
+The plan is pure data: the driver (test/bench) owns applying the
+clock-keyed transitions that cannot be expressed as call-site injection
+(telemetry blackout, engine crash); ChaosCluster injects the bind-surface
+faults at the exact call the real apiserver would fail.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from .scheduler.cluster import FakeCluster
+from .scheduler.framework import (
+    FilterPlugin,
+    ReservePlugin,
+    ScorePlugin,
+    Status,
+)
+
+APISERVER_STORM = "ApiServerStorm"
+BIND_LOST = "BindLost"
+TELEMETRY_BLACKOUT = "TelemetryBlackout"
+PLUGIN_ERROR = "PluginError"
+ENGINE_CRASH = "EngineCrash"
+
+ALL_KINDS = (APISERVER_STORM, BIND_LOST, TELEMETRY_BLACKOUT, PLUGIN_ERROR,
+             ENGINE_CRASH)
+
+
+class LostResponseError(ConnectionError):
+    """The mutation was applied; the response never arrived (the
+    fake-apiserver ``-1`` fault / k8s AmbiguousRequestError analogue)."""
+
+
+@dataclass(frozen=True)
+class FaultWindow:
+    kind: str
+    start: float
+    end: float
+
+    def active(self, now: float) -> bool:
+        return self.start <= now < self.end
+
+
+class FaultPlan:
+    """A seeded schedule of fault windows on a virtual clock. The same
+    (seed, horizon, kinds) always yields the same windows — the whole
+    point: a failing chaos scenario replays from its seed."""
+
+    def __init__(self, seed: int, horizon_s: float = 20.0,
+                 kinds: tuple = ALL_KINDS, max_windows: int = 3) -> None:
+        rng = random.Random(seed)
+        self.seed = seed
+        self.horizon_s = horizon_s
+        self.windows: list[FaultWindow] = []
+        for _ in range(rng.randint(1, max_windows)):
+            kind = rng.choice(kinds)
+            start = rng.uniform(0.5, horizon_s * 0.6)
+            if kind == ENGINE_CRASH:
+                # a crash is an instant, not an interval; the driver fires
+                # it once when the clock first passes `start`
+                self.windows.append(FaultWindow(kind, start, start))
+                continue
+            dur = rng.uniform(1.0, horizon_s * 0.4)
+            self.windows.append(
+                FaultWindow(kind, start, min(start + dur, horizon_s)))
+        self.windows.sort(key=lambda w: (w.start, w.kind))
+
+    def active(self, kind: str, now: float) -> bool:
+        return any(w.kind == kind and w.active(now) for w in self.windows)
+
+    def kinds(self) -> set:
+        return {w.kind for w in self.windows}
+
+    def windows_of(self, kind: str) -> list[FaultWindow]:
+        return [w for w in self.windows if w.kind == kind]
+
+    def fault_end(self) -> float:
+        """Instant after which no fault is active (convergence must be
+        reached some time after this)."""
+        return max((w.end for w in self.windows), default=0.0)
+
+
+class ChaosCluster(FakeCluster):
+    """FakeCluster whose binding surface fails on the plan's schedule —
+    the in-memory analogue of fault-injecting the apiserver.
+
+    `bind_script` additionally maps absolute bind-call indices (0-based,
+    counted across the cluster's lifetime) to fault kinds, for tests that
+    need "exactly the Nth bind fails" rather than a time window."""
+
+    def __init__(self, telemetry=None, plan: FaultPlan | None = None,
+                 clock=None, bind_script: dict[int, str] | None = None
+                 ) -> None:
+        super().__init__(telemetry)
+        self.plan = plan
+        self.clock = clock
+        self.bind_script = dict(bind_script or {})
+        self.bind_calls = 0
+        self.injected: dict[str, int] = {}
+
+    def _now(self) -> float:
+        return self.clock.time() if self.clock is not None else 0.0
+
+    def _bind_fault(self) -> str | None:
+        idx = self.bind_calls
+        self.bind_calls += 1
+        scripted = self.bind_script.get(idx)
+        if scripted is not None:
+            return scripted
+        if self.plan is None:
+            return None
+        now = self._now()
+        for kind in (APISERVER_STORM, BIND_LOST):
+            if self.plan.active(kind, now):
+                return kind
+        return None
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def bind(self, pod, node, assigned_chips=None) -> None:
+        fault = self._bind_fault()
+        if fault == APISERVER_STORM:
+            self._count(fault)
+            raise ConnectionError("chaos: apiserver unavailable (storm)")
+        if fault == BIND_LOST:
+            # the mutation lands, the response does not — the caller sees
+            # an error for a bind the cluster already holds
+            super().bind(pod, node, assigned_chips)
+            self._count(fault)
+            raise LostResponseError("chaos: bind applied, response lost")
+        super().bind(pod, node, assigned_chips)
+
+
+class AsyncChaosCluster(ChaosCluster):
+    """ChaosCluster that also speaks the bind_async contract, executing
+    the "wire" synchronously inline so the engine's async recovery path
+    (_drain_bind_failures) is exercised deterministically: a storm fault
+    reports on_fail without applying (the KubeCluster binder's rollback
+    already ran by the time on_fail fires there — here nothing was
+    applied, which is the same post-rollback state); a lost-response
+    fault APPLIES the bind and then reports on_fail."""
+
+    def bind_async(self, pod, node, assigned_chips=None,
+                   on_fail=None, on_success=None) -> None:
+        fault = self._bind_fault()
+        if fault == APISERVER_STORM:
+            self._count(fault)
+            if on_fail is not None:
+                on_fail(pod, node,
+                        ConnectionError("chaos: apiserver storm (async)"))
+            return
+        if fault == BIND_LOST:
+            super(ChaosCluster, self).bind(pod, node, assigned_chips)
+            self._count(fault)
+            if on_fail is not None:
+                on_fail(pod, node,
+                        LostResponseError("chaos: async bind applied, "
+                                          "response lost"))
+            return
+        super(ChaosCluster, self).bind(pod, node, assigned_chips)
+        if on_success is not None:
+            on_success(pod, node)
+
+
+def blackout(store, now: float, max_age_s: float) -> None:
+    """Start a telemetry blackout: every stored heartbeat ages past the
+    staleness gate at once (the whole sniffer fleet went dark long
+    enough ago that nothing is fresh). Publishes COPIES so the store can
+    see the old heartbeat and keep its ceiling (the engine's blackout
+    detector) exact."""
+    import dataclasses
+
+    for m in store.list():
+        store.put(dataclasses.replace(
+            m, heartbeat=now - (max_age_s + 1.0)))
+
+
+def revive(store, now: float) -> None:
+    """End a blackout: the sniffer fleet republishes fresh heartbeats."""
+    import dataclasses
+
+    for m in store.list():
+        store.put(dataclasses.replace(m, heartbeat=now))
+
+
+class _CrashWindow:
+    """Shared crash condition for the chaos plugins below: raise during
+    the plan's PLUGIN_ERROR windows (all pods, or the seeded subset
+    `match` selects), or always when armed without a plan."""
+
+    def __init__(self, plan: FaultPlan | None = None, clock=None,
+                 match=None) -> None:
+        self.plan = plan
+        self.clock = clock
+        self.match = match  # pod -> bool; None = every pod
+        self.crashes = 0
+
+    def should_crash(self, pod) -> bool:
+        if self.plan is not None:
+            now = self.clock.time() if self.clock is not None else 0.0
+            if not self.plan.active(PLUGIN_ERROR, now):
+                return False
+        if self.match is not None and not self.match(pod):
+            return False
+        self.crashes += 1
+        return True
+
+
+class CrashingFilter(FilterPlugin, _CrashWindow):
+    """A filter plugin that raises (not: returns ERROR) on schedule — the
+    exact misbehaviour cycle-level containment exists for."""
+
+    name = "chaos-crash-filter"
+
+    def __init__(self, plan=None, clock=None, match=None) -> None:
+        _CrashWindow.__init__(self, plan, clock, match)
+
+    def filter(self, state, pod, node) -> Status:
+        if self.should_crash(pod):
+            raise RuntimeError(f"chaos: filter crash for {pod.key}")
+        return Status.success()
+
+
+class CrashingScore(ScorePlugin, _CrashWindow):
+    name = "chaos-crash-score"
+    weight = 0  # never influences placement when it does not crash
+
+    def __init__(self, plan=None, clock=None, match=None) -> None:
+        _CrashWindow.__init__(self, plan, clock, match)
+
+    def score(self, state, pod, node):
+        if self.should_crash(pod):
+            raise RuntimeError(f"chaos: score crash for {pod.key}")
+        return 0.0, Status.success()
+
+
+class CrashingReserve(ReservePlugin, _CrashWindow):
+    name = "chaos-crash-reserve"
+
+    def __init__(self, plan=None, clock=None, match=None) -> None:
+        _CrashWindow.__init__(self, plan, clock, match)
+
+    def reserve(self, state, pod, node) -> Status:
+        if self.should_crash(pod):
+            raise RuntimeError(f"chaos: reserve crash for {pod.key}")
+        return Status.success()
+
+    def unreserve(self, state, pod, node) -> None:
+        return None

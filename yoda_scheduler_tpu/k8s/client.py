@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import ssl
 import sys
 import threading
@@ -47,6 +48,7 @@ from ..scheduler.framework import (
 )
 from ..telemetry.schema import CRD_GROUP, CRD_PLURAL, CRD_VERSION, TpuNodeMetrics
 from ..telemetry.store import TelemetryStore
+from ..utils.obs import Metrics
 from ..utils.changelog import ChangeLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
@@ -588,7 +590,26 @@ class KubeClient:
                                             AmbiguousRequestError))
                 if e.status != 409 and not ambiguous:
                     raise
-                live = self.get_pod(pod.namespace, pod.name)
+                # the confirm GET is the ONE read standing between an
+                # ambiguous bind and a duplicate-bind window, so it gets
+                # extra storm tolerance beyond get_pod's own retry
+                # budget: if it still fails, the raise reaches the
+                # engine, whose bound_node_of adoption resolves the pod
+                # once the watch cache catches up
+                live = None
+                for confirm_try in range(3):
+                    try:
+                        live = self.get_pod(pod.namespace, pod.name)
+                        break
+                    except ApiError as ge:
+                        # only WIRE-class failures (status 0) and server
+                        # brownouts are worth re-probing; a returned 4xx
+                        # is deterministic (e.g. RBAC) and re-sleeping on
+                        # it would stall the binder for nothing
+                        if confirm_try == 2 or ge.status not in (
+                                0, 429, 500, 502, 503, 504):
+                            raise
+                        time.sleep(self.retry_backoff_s * (2 ** confirm_try))
                 bound_to = (live or {}).get("spec", {}).get("nodeName")
                 if bound_to == node:
                     log.info("bind %s -> %s: %s but already ours", pod.key,
@@ -690,11 +711,22 @@ class Reflector:
     def __init__(self, client: KubeClient, path: str, on_replace, on_event,
                  relist_s: float = 300.0, watch_timeout_s: float = 60.0,
                  backoff_s: float = 0.5, max_backoff_s: float = 15.0,
-                 optional: bool = False, on_absent=None) -> None:
+                 optional: bool = False, on_absent=None, metrics=None,
+                 rng=None) -> None:
         self.client = client
         self.path = path
         self.on_replace = on_replace
         self.on_event = on_event
+        # storm observability (utils.obs.Metrics, optional): re-lists,
+        # 410 expiries, and watch errors as counters — an apiserver storm
+        # shows up as a counter slope instead of staying silent in logs
+        self.metrics = metrics
+        # jitter source for the error/410 backoffs: N reflector replicas
+        # (multi-profile deployments, restarts after an outage) must not
+        # re-list in lockstep the instant the server recovers — the
+        # synchronized stampede is its own second outage. Injectable for
+        # deterministic tests.
+        self._rng = rng or random.Random()
         # on_absent(bool): notified when an optional resource transitions
         # between served and denied/missing, so the cache owner can expose
         # "absent" (unknown) rather than "empty" (known) — the two have
@@ -719,7 +751,18 @@ class Reflector:
         self.optional = optional
         self.absent = False
 
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    def _jittered(self, delay: float) -> float:
+        """Spread a backoff wait over [0.5, 1.5)x, capped at
+        max_backoff_s — decorrelates replicas without ever exceeding the
+        configured ceiling."""
+        return min(delay * (0.5 + self._rng.random()), self.max_backoff_s)
+
     def list_once(self) -> str | None:
+        self._inc("reflector_relists_total")
         try:
             doc = self.client.list_all(self.path)
         except ApiError as e:
@@ -795,20 +838,22 @@ class Reflector:
             except WatchExpired:
                 # re-list, but back off on a persistent 410 pathology so a
                 # misbehaving server doesn't eat back-to-back full LISTs
-                # (client-go rate-limits this path the same way)
+                # (client-go rate-limits this path the same way); jittered
+                # so restarted replicas don't re-list in lockstep
+                self._inc("reflector_watch_expired_total")
                 expired_streak += 1
                 log.info("watch %s expired (410): re-listing", self.path)
                 if expired_streak > 1:
-                    stop.wait(min(
-                        self.backoff_s * (2 ** min(expired_streak - 2, 32)),
-                        self.max_backoff_s))
+                    stop.wait(self._jittered(
+                        self.backoff_s * (2 ** min(expired_streak - 2, 32))))
                 continue
             except Exception as e:
                 if stop.is_set():
                     return  # shutdown closed our stream: not an error
-                log.warning("watch %s failed: %s; retrying in %.1fs",
+                self._inc("reflector_watch_errors_total")
+                log.warning("watch %s failed: %s; retrying in ~%.1fs",
                             self.path, e, backoff)
-                stop.wait(backoff)
+                stop.wait(self._jittered(backoff))
                 backoff = min(backoff * 2, self.max_backoff_s)
 
 
@@ -825,9 +870,14 @@ class KubeCluster:
 
     def __init__(self, client: KubeClient, telemetry: TelemetryStore,
                  resync_s: float = 2.0, watch: bool | None = None,
-                 relist_s: float = 300.0) -> None:
+                 relist_s: float = 300.0, metrics: Metrics | None = None
+                 ) -> None:
         self.client = client
         self.telemetry = telemetry
+        # ingest observability shared by the reflectors: relists/410s/
+        # watch errors land here so apiserver storms are visible as
+        # counter slopes (ingest_stats surfaces them)
+        self.metrics = metrics or Metrics()
         self.resync_s = resync_s
         self.watch_mode = client.can_stream if watch is None else watch
         self._lock = threading.RLock()
@@ -878,20 +928,21 @@ class KubeCluster:
             self._reflectors = [
                 Reflector(client, "/api/v1/nodes",
                           self._replace_nodes, self._node_event,
-                          relist_s=relist_s),
+                          relist_s=relist_s, metrics=self.metrics),
                 Reflector(client, "/api/v1/pods",
                           self._replace_pods, self._pod_event,
-                          relist_s=relist_s),
+                          relist_s=relist_s, metrics=self.metrics),
                 Reflector(client, METRICS_PATH,
                           self._replace_metrics, self._metrics_event,
-                          relist_s=relist_s),
+                          relist_s=relist_s, metrics=self.metrics),
                 Reflector(client, PDB_PATH,
                           self._replace_pdbs, self._pdb_event,
-                          relist_s=relist_s),
+                          relist_s=relist_s, metrics=self.metrics),
                 Reflector(client, "/api/v1/namespaces",
                           self._replace_namespaces, self._namespace_event,
                           relist_s=relist_s, optional=True,
-                          on_absent=self._namespace_absent),
+                          on_absent=self._namespace_absent,
+                          metrics=self.metrics),
             ]
 
     # --------------------------------------------------------- cluster events
@@ -1211,6 +1262,15 @@ class KubeCluster:
             }
         out["bind_wire_ms"] = round(self.bind_wire_ns / 1e6, 2)
         out["bind_wire_n"] = self.bind_wire_n
+        # reflector storm counters (relists / 410 expiries / watch
+        # errors): a brownout that only logged before now reads as a
+        # slope an operator (and the serve bench) can see
+        out["reflector_relists"] = self.metrics.counters.get(
+            "reflector_relists_total", 0)
+        out["reflector_watch_expired"] = self.metrics.counters.get(
+            "reflector_watch_expired_total", 0)
+        out["reflector_watch_errors"] = self.metrics.counters.get(
+            "reflector_watch_errors_total", 0)
         out["gc_pauses"] = self._gc_pauses
         out["gc_pause_ms"] = round(self._gc_pause_ns / 1e6, 2)
         out["gc_enabled"] = _gc.isenabled()
@@ -1331,6 +1391,17 @@ class KubeCluster:
         with self._lock:
             p = self._pods.get(key)
             return p is not None and p.node is not None
+
+    def bound_node_of(self, key: str) -> str | None:
+        """Node the cache holds `key` bound to, or None — the engine's
+        ambiguous-bind adoption / restart reconciliation read (same
+        contract as FakeCluster.bound_node_of). Cache truth here: by the
+        time the engine asks (bind-failure drain, reconcile), the binder
+        rollback or the confirming watch event has already settled the
+        entry either way."""
+        with self._lock:
+            p = self._pods.get(key)
+            return p.node if p is not None else None
 
     def known_pod_keys(self) -> set[str]:
         """Every pod key in the cache (any phase) — the serve loop checks

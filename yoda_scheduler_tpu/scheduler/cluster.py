@@ -207,6 +207,19 @@ class FakeCluster:
         with self._lock:
             return [p for pods in self._bound.values() for p in pods]
 
+    def bound_node_of(self, key: str) -> str | None:
+        """Node holding pod `key`, or None — the cluster-truth read the
+        engine's ambiguous-bind adoption and restart reconciliation use
+        (annotation present in the cluster => adopt; absent => requeue).
+        O(bound pods); called only on bind failures and restarts, never
+        on the scheduling hot path."""
+        with self._lock:
+            for node, pods in self._bound.items():
+                for p in pods:
+                    if p.key == key:
+                        return node
+        return None
+
     # ---------------------------------------------------------------- binding
     def bind(self, pod: Pod, node: str,
              assigned_chips: list[tuple[int, int, int]] | None = None) -> None:

@@ -130,6 +130,28 @@ class SchedulerConfig:
     # (KubeCluster.bind_async); the in-memory FakeCluster always binds
     # synchronously. Wire failures roll back and requeue with backoff.
     async_binding: bool = True
+    # telemetry-blackout degraded mode: when the NEWEST stored heartbeat
+    # is older than telemetry_max_age_s (the whole feed is dark, not one
+    # node's sniffer), keep scheduling off last-known capacity — the
+    # staleness gate is waived and telemetry-dependent scorers drop out —
+    # instead of marking every node stale-infeasible and binding nothing.
+    # Cycles run this way increment degraded_cycles_total and flip the
+    # `degraded` gauge; recovery is automatic when fresh telemetry lands.
+    degraded_mode: bool = True
+    # cycle-level exception containment: a plugin RAISING (not returning
+    # ERROR) fails the pod's cycle, never the engine thread. After this
+    # many crashing cycles the pod is quarantined (permanently failed,
+    # pods_quarantined_total) so one poison pod cannot monopolise the
+    # engine with crash-requeue loops. 0 = never quarantine (crashes
+    # keep requeueing with backoff forever).
+    quarantine_threshold: int = 5
+    # apiserver circuit breaker: after this many CONSECUTIVE bind wire
+    # failures, park scheduling for breaker_cooldown_s (doubling per
+    # re-open, capped at 8x) instead of burning every queued pod's
+    # attempts against a dead server; a post-cooldown probe bind closes
+    # the breaker on success. 0 disables.
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 5.0
 
     def with_(self, **kw) -> "SchedulerConfig":
         return replace(self, **kw)
@@ -166,6 +188,14 @@ class SchedulerConfig:
                 "fragmentationWeight", defaults.fragmentation_weight)),
             batch_max_pods=max(int(args.get(
                 "batchMaxPods", defaults.batch_max_pods)), 1),
+            degraded_mode=bool(args.get("degradedMode",
+                                        defaults.degraded_mode)),
+            quarantine_threshold=int(args.get(
+                "quarantineThreshold", defaults.quarantine_threshold)),
+            breaker_threshold=int(args.get(
+                "breakerThreshold", defaults.breaker_threshold)),
+            breaker_cooldown_s=float(args.get(
+                "breakerCooldownSeconds", defaults.breaker_cooldown_s)),
         )
 
 

@@ -302,6 +302,40 @@ class Scheduler:
             wc(self._on_telemetry_change)
         self.waiting: dict[str, _WaitingPod] = {}
         self.failed: dict[str, str] = {}  # pod.key -> permanent failure reason
+        # pods permanently failed by cycle-level crash containment (a
+        # plugin RAISED quarantine_threshold times for them) — a subset
+        # of `failed`, kept separately so operators can tell poison pods
+        # from ordinary unschedulability. Bounded like doomed_gangs.
+        self.quarantined: dict[str, str] = {}
+        # apiserver circuit breaker (self-healing): consecutive bind wire
+        # failures open it, parking scheduling until the cooldown passes;
+        # a post-cooldown probe bind closes it on success. All breaker
+        # state is engine-thread-only; binder threads report outcomes in
+        # ARRIVAL ORDER through the _bind_results deque, so a success is
+        # folded before/after the failures exactly as it happened on the
+        # wire (a bare boolean could not order a stale pre-storm success
+        # against newer failures, or vice versa).
+        self._breaker_failures = 0
+        self._breaker_until = 0.0
+        self._breaker_cooldown = self.config.breaker_cooldown_s
+        # telemetry-blackout degraded mode: previous cycle's regime, so a
+        # flip can clear the class memos (staleness verdicts change
+        # without any version bump — exactly the invalidation the version
+        # vectors cannot see)
+        self._degraded = False
+        # _commit_batch's current member, for crash attribution when a
+        # plugin raises inside the batch commit loop
+        self._batch_cursor: QueuedPodInfo | None = None
+        # poison-vs-systemic discriminator for quarantine: a crash is
+        # SYSTEMIC when, since the last crash, no cycle completed
+        # cleanly AND the last crash was a DIFFERENT pod — i.e. the
+        # engine is crashing across the board (corrupt snapshot input, a
+        # bug in shared engine code), in which case permanently failing
+        # pods would convert an engine-wide fault into mass data loss.
+        # A pod re-crashing its OWN cycles (even back-to-back, with no
+        # neighbours left to interleave) stays poison and quarantines.
+        self._ok_since_crash = True
+        self._last_crash_key: str | None = None
         self.traces = TraceLog()
         self.rng = random.Random(self.config.rng_seed)
         self._filter_start = 0  # rotating offset for percentageOfNodesToScore
@@ -342,10 +376,12 @@ class Scheduler:
         # Classmate cycles rescore only dirty nodes; see the score
         # section of _schedule_one_locked for the soundness envelope.
         self._score_memo: dict = {}
-        # failed async-bind recoveries, appended by binder threads and
-        # drained by run_one on the engine thread (the queue is
-        # engine-thread-only; deque.append/popleft are GIL-atomic)
-        self._bind_failures: deque = deque()
+        # async-bind wire outcomes in ARRIVAL order, appended by binder
+        # threads and drained by run_one on the engine thread (the queue
+        # is engine-thread-only; deque.append/popleft are GIL-atomic).
+        # Entries: None = a wire success (breaker signal only);
+        # (info, node, err) = a failure needing recovery.
+        self._bind_results: deque = deque()
         # gang -> reason: a member permanently failed during assembly, so
         # the gang can never reach its size with the current incarnations.
         # Parked peers are failed at doom time; peers sitting in backoff
@@ -613,8 +649,11 @@ class Scheduler:
         if dirty is None:
             return None
         max_age = self.config.telemetry_max_age_s
-        check_stale = any(getattr(p, "time_dependent", False)
-                          for p in filters)
+        # degraded (blackout) mode waives the staleness gate wholesale,
+        # so repaired lists must not re-impose it per node
+        check_stale = (not state.read_or("degraded")
+                       and any(getattr(p, "time_dependent", False)
+                               for p in filters))
         if check_stale:
             # O(1) short-circuit: when even the OLDEST stored heartbeat is
             # fresh, no node can be stale — skip the per-name re-checks
@@ -1009,7 +1048,12 @@ class Scheduler:
         double-book chips (upstream kube-scheduler likewise runs ONE
         scheduleOne loop across all profiles)."""
         with self.cycle_lock:
-            return self._schedule_one_locked(info)
+            try:
+                return self._schedule_one_locked(info)
+            except Exception as e:
+                # cycle-level exception containment: a raising plugin
+                # fails the POD, never the engine thread
+                return self._contain_crash(info, e)
 
     def schedule_batch(self, infos: list[QueuedPodInfo]) -> str:
         """One shared scheduling cycle over an equivalence-class batch
@@ -1027,14 +1071,44 @@ class Scheduler:
             return self.schedule_one(infos[0])
         with self.cycle_lock:
             ctx = _BatchCtx()
-            first = self._schedule_one_locked(infos[0], batch_ctx=ctx)
+            try:
+                first = self._schedule_one_locked(infos[0], batch_ctx=ctx)
+            except Exception as e:
+                first = self._contain_crash(infos[0], e)
             rest = infos[1:]
             done = 0
             if first == "bound" and ctx.armed:
                 self.metrics.inc("batch_cycles_total")
-                done = self._commit_batch(ctx, rest)
-            for info in rest[done:]:
-                self._schedule_one_locked(info)
+                self._batch_cursor = None
+                try:
+                    done = self._commit_batch(ctx, rest)
+                except Exception as e:
+                    # attribute the crash to the member the commit loop
+                    # was processing (every earlier member completed);
+                    # the rest fall back to per-pod cycles below
+                    cur = self._batch_cursor
+                    if cur is not None and cur in rest:
+                        done = rest.index(cur) + 1
+                        self._contain_crash(cur, e)
+                    else:
+                        done = 0
+                        self.metrics.inc("cycle_crashes_total")
+                finally:
+                    self._batch_cursor = None
+            leftover = rest[done:]
+            for i, info in enumerate(leftover):
+                if self.clock.time() < self._breaker_until:
+                    # the circuit breaker opened mid-batch (a storm is
+                    # failing every bind): park the remaining members
+                    # back on the active queue with no attempt burned —
+                    # run_one's gate holds them until the cooldown
+                    for parked in leftover[i:]:
+                        self.queue.requeue_immediate(parked)
+                    break
+                try:
+                    self._schedule_one_locked(info)
+                except Exception as e:
+                    self._contain_crash(info, e)
             return first
 
     def _commit_batch(self, ctx: _BatchCtx, infos: list[QueuedPodInfo]) -> int:
@@ -1076,6 +1150,7 @@ class Scheduler:
                   self._normalize_kind(p), getattr(p, "weight", 1))
                  for p in scorers]
         for info in infos:
+            self._batch_cursor = info  # crash attribution (schedule_batch)
             pod = info.pod
             now = self.clock.time()
             # conflict detection by ATTRIBUTION, not by version equality:
@@ -1109,7 +1184,10 @@ class Scheduler:
             filters = [p for p in self.profile.filter
                        if getattr(p, "relevant", None) is None
                        or p.relevant(pod, snapshot)]
-            if any(getattr(p, "time_dependent", False) for p in filters):
+            if any(getattr(p, "time_dependent", False) for p in filters) \
+                    and not state.read_or("degraded"):
+                # (degraded mode waives staleness entirely, so the
+                # aged-out-heartbeat bail below would only thrash)
                 floor = floor_fn() if floor_fn is not None else None
                 if floor is None or (now - floor) > max_age:
                     # some heartbeat may have aged out mid-batch: only the
@@ -1236,7 +1314,12 @@ class Scheduler:
             reserved: list[ReservePlugin] = []
             st = Status.success()
             for p in self.profile.reserve:
-                st = p.reserve(state, pod, chosen)
+                try:
+                    st = p.reserve(state, pod, chosen)
+                except Exception:
+                    # crash surfaces through schedule_batch's containment
+                    self._unwind_reserved(reserved, state, pod, chosen)
+                    raise
                 if not st.ok:
                     for r in reversed(reserved):
                         r.unreserve(state, pod, chosen)
@@ -1257,7 +1340,11 @@ class Scheduler:
             # surface through the ordinary rollback, not silently
             permit_ok = True
             for p in self.profile.permit:
-                pst, _timeout = p.permit(state, pod, chosen)
+                try:
+                    pst, _timeout = p.permit(state, pod, chosen)
+                except Exception:
+                    self._unwind_reserved(reserved, state, pod, chosen)
+                    raise
                 if not pst.ok:
                     for r in reversed(reserved):
                         r.unreserve(state, pod, chosen)
@@ -1323,6 +1410,33 @@ class Scheduler:
             self._fail_permanently(info, doom, trace=trace)
             return "failed"
         state.write("workload_spec", spec)
+
+        # telemetry-blackout degraded mode: when even the NEWEST stored
+        # heartbeat is past the staleness gate, the whole feed is dark —
+        # one node's dead sniffer never trips this — and the engine keeps
+        # scheduling off last-known capacity (TelemetryFilter waives its
+        # staleness gate, telemetry-dependent scorers drop out) instead
+        # of marking every node stale-infeasible. Detected per cycle; a
+        # regime flip clears the class memos, because staleness verdicts
+        # change with TIME and no version vector records the transition.
+        degraded = False
+        if self.config.degraded_mode:
+            ceil_fn = getattr(self.cluster.telemetry, "heartbeat_ceiling",
+                              None)
+            if ceil_fn is not None:
+                ceil = ceil_fn()
+                degraded = (ceil is not None and
+                            (now - ceil) > self.config.telemetry_max_age_s)
+        if degraded != self._degraded:
+            self._degraded = degraded
+            self._unsched_memo.clear()
+            self._feas_memo.clear()
+            self._score_memo.clear()
+            self.metrics.set_gauge("degraded", 1.0 if degraded else 0.0)
+            self.metrics.inc("degraded_transitions_total")
+        if degraded:
+            state.write("degraded", True)
+            self.metrics.inc("degraded_cycles_total")
 
         # unschedulable-class fast path (see _unsched_memo). Gang pods and
         # nominated preemptors carry state outside the version vector.
@@ -1606,6 +1720,11 @@ class Scheduler:
         totals: dict[str, float] = {n.name: 0.0 for n in feasible}
         scorers = []
         for p in self.profile.score:
+            if degraded and getattr(p, "telemetry_dependent", False):
+                # blackout degraded mode: stale quality numbers would
+                # rank nodes on noise — capacity/topology scorers carry
+                # the placement until the feed recovers
+                continue
             gate = getattr(p, "score_relevant", None)
             if gate is None:
                 gate = getattr(p, "relevant", None)
@@ -1720,7 +1839,14 @@ class Scheduler:
         # Reserve
         reserved: list[ReservePlugin] = []
         for p in self.profile.reserve:
-            st = p.reserve(state, pod, chosen)
+            try:
+                st = p.reserve(state, pod, chosen)
+            except Exception:
+                # a RAISING reserve plugin must not leak the partial
+                # reservation chain; the engine's containment then
+                # quarantine-tracks the crash
+                self._unwind_reserved(reserved, state, pod, chosen)
+                raise
             if not st.ok:
                 for r in reversed(reserved):
                     r.unreserve(state, pod, chosen)
@@ -1731,7 +1857,11 @@ class Scheduler:
 
         # Permit
         for p in self.profile.permit:
-            st, timeout = p.permit(state, pod, chosen)
+            try:
+                st, timeout = p.permit(state, pod, chosen)
+            except Exception:
+                self._unwind_reserved(reserved, state, pod, chosen)
+                raise
             if st.code == Code.WAIT:
                 self.waiting[pod.key] = _WaitingPod(info, chosen, now + timeout)
                 self.metrics.inc("pods_waiting_total")
@@ -1772,6 +1902,22 @@ class Scheduler:
         return "bound"
 
     # ------------------------------------------------------------ sub-steps
+    @staticmethod
+    def _unwind_reserved(reserved, state, pod, node) -> None:
+        """Best-effort rollback of a partial reservation chain after a
+        RAISING reserve/permit plugin, with REAL cycle state (gang plan
+        decrements need the snapshot + chosen node — _contain_crash's
+        bare backstop sweep cannot reconstruct them). Swallows unreserve
+        errors: the original crash must reach the engine's containment,
+        not be masked by a secondary failure. Shared by the per-pod and
+        batch-commit reserve/permit loops so the unwind contract has one
+        definition."""
+        for r in reversed(reserved):
+            try:
+                r.unreserve(state, pod, node)
+            except Exception:
+                pass
+
     @staticmethod
     def _normalize_kind(p) -> str | None:
         """Resolve a score plugin's declared normalize shape
@@ -1923,30 +2069,52 @@ class Scheduler:
                     # same as the sync failure path below); the callbacks
                     # touch only thread-safe state — queue recovery is
                     # marshalled back onto the engine thread via
-                    # _bind_failures (the queue itself is engine-thread
+                    # _bind_results (the queue itself is engine-thread
                     # only).
                     dispatched_async = True
                     bind_async(
                         pod, node, coords,
                         on_fail=lambda p, n, e, _info=info:
-                            self._bind_failures.append((_info, n, e)),
-                        on_success=lambda p, n:
-                            self.allocator.unnominate(p.key)
-                            if self.allocator is not None else None)
+                            self._bind_results.append((_info, n, e)),
+                        on_success=self._async_bind_succeeded)
                 else:
                     self.cluster.bind(pod, node, coords)
         except Exception as e:
-            if self.allocator is not None:
-                # release the pending reservation; keep any nomination (a
-                # preemptor's entitlement survives a transient bind failure)
-                self.allocator.unreserve(CycleState(), pod, node)
-                # freed reservation = capacity event for OTHER parked pods
-                self.notify_event(ClusterEvent(POD_DELETED, node=node,
-                                               origin=pod.key))
-            self.metrics.inc("bind_errors_total")
-            self._unschedulable(info, trace, f"bind failed: {e}",
-                                outcome="bind-error")
-            return False
+            # lost-response recovery (satellite of the chaos work): before
+            # rolling back, ask the cluster whether the bind actually
+            # LANDED — a failure after the server applied the mutation
+            # (fake fault -1, KubeClient AmbiguousRequestError whose
+            # confirm GET also failed) must be ADOPTED, not replayed:
+            # requeueing a bound pod is the duplicate-bind window.
+            bound_to = None
+            bn = getattr(self.cluster, "bound_node_of", None)
+            if bn is not None:
+                try:
+                    bound_to = bn(pod.key)
+                except Exception:
+                    bound_to = None
+            if bound_to != node:
+                self._breaker_failure(e)
+                if self.allocator is not None:
+                    # release the pending reservation; keep any nomination (a
+                    # preemptor's entitlement survives a transient bind failure)
+                    self.allocator.unreserve(CycleState(), pod, node)
+                    # freed reservation = capacity event for OTHER parked pods
+                    self.notify_event(ClusterEvent(POD_DELETED, node=node,
+                                                   origin=pod.key))
+                self.metrics.inc("bind_errors_total")
+                self._unschedulable(info, trace, f"bind failed: {e}",
+                                    outcome="bind-error")
+                return False
+            # the cluster shows OUR bind: the wire failed, the mutation
+            # did not — fall through to the ordinary success tail
+            self.metrics.inc("ambiguous_bind_recoveries_total")
+            self._breaker_success()
+        else:
+            if not dispatched_async:
+                # a synchronous wire success is the breaker's probe signal
+                # (async successes report in order via _bind_results)
+                self._breaker_success()
         if self.allocator is not None:
             self.allocator.complete(pod)  # reservation consumed
             if not dispatched_async:
@@ -1968,23 +2136,112 @@ class Scheduler:
         self._finish(trace, "bound", node=node)
         return True
 
+    def _async_bind_succeeded(self, pod, node) -> None:
+        """on_success callback for dispatched binds, run on a BINDER
+        thread: consume the preemptor entitlement (wire success is when
+        the nomination is provably spent) and record the wire-healthy
+        signal IN ORDER with any failures — the engine folds the deque
+        sequentially, so a success only resets the breaker streak for
+        failures that actually preceded it (the breaker counters
+        themselves stay engine-thread-only)."""
+        if self.allocator is not None:
+            self.allocator.unnominate(pod.key)
+        self._bind_results.append(None)
+
+    @staticmethod
+    def _is_wire_failure(e: Exception) -> bool:
+        """Only WIRE-class bind failures feed the breaker: connection
+        drops, timeouts, and transport errors surfaced with status 0
+        (k8s ApiError wrapping an ambiguous/connection failure). A
+        server-RETURNED status (409 conflict, 404 pod gone) is proof the
+        apiserver is alive — counting those would park scheduling on a
+        healthy-but-contended cluster."""
+        status = getattr(e, "status", None)
+        if status is not None:
+            return status == 0
+        return isinstance(e, (ConnectionError, TimeoutError, OSError))
+
+    def _breaker_failure(self, e: Exception) -> None:
+        """One more consecutive bind WIRE failure (engine thread only;
+        non-wire errors are ignored — see _is_wire_failure). At the
+        threshold the breaker OPENS: run_one parks scheduling until the
+        cooldown passes, so an apiserver error storm stops burning every
+        queued pod's attempts/backoff against a dead server. Re-opening
+        after a failed post-cooldown probe doubles the cooldown
+        (capped), the classic half-open escalation."""
+        if self.config.breaker_threshold <= 0:
+            return
+        if not self._is_wire_failure(e):
+            return
+        self._breaker_failures += 1
+        if self._breaker_failures < self.config.breaker_threshold:
+            return
+        now = self.clock.time()
+        if now >= self._breaker_until:
+            self._breaker_until = now + self._breaker_cooldown
+            self._breaker_cooldown = min(
+                self._breaker_cooldown * 2,
+                8 * self.config.breaker_cooldown_s)
+            self.metrics.inc("breaker_opens_total")
+            self.metrics.set_gauge("breaker_open", 1.0)
+
+    def _breaker_success(self) -> None:
+        """A bind reached the server: reset the failure streak and close
+        an open breaker (engine thread only)."""
+        if not self._breaker_failures and not self._breaker_until:
+            return
+        was_open = self._breaker_until > 0.0
+        self._breaker_failures = 0
+        self._breaker_until = 0.0
+        self._breaker_cooldown = self.config.breaker_cooldown_s
+        self.metrics.set_gauge("breaker_open", 0.0)
+        if was_open:
+            self.metrics.inc("breaker_closes_total")
+
     def _drain_bind_failures(self) -> None:
-        """Recover pods whose dispatched binds never reached the server.
-        Binder workers only APPEND to the thread-safe _bind_failures
-        deque; the requeue itself runs HERE, on the engine thread (the
-        SchedulingQueue has no internal lock — a binder-thread mutation
-        would race pop()'s backoff flush and could drop the entry)."""
+        """Fold async wire outcomes and recover pods whose dispatched
+        binds never reached the server. Binder workers only APPEND to
+        the thread-safe _bind_results deque; the requeue itself runs
+        HERE, on the engine thread (the SchedulingQueue has no internal
+        lock — a binder-thread mutation would race pop()'s backoff flush
+        and could drop the entry). Success markers are interleaved in
+        arrival order, so the breaker streak resets exactly when the
+        wire actually recovered — a stale pre-storm success cannot wipe
+        newer failures, and a post-storm success closes an open breaker."""
         while True:
             try:
-                info, node, err = self._bind_failures.popleft()
+                item = self._bind_results.popleft()
             except IndexError:
                 return
+            if item is None:
+                self._breaker_success()  # wire success, in sequence
+                continue
+            info, node, err = item
             pod = info.pod
             if self.tracks(pod.key):
                 # the serve loop's intake raced the rollback and already
                 # resubmitted the reverted pod: a second queue entry
                 # would double-bind
                 continue
+            # lost-response adoption, the async twin of _bind's: when the
+            # cluster ALREADY shows this pod bound to the reported node,
+            # the POST landed and only the response died — the
+            # dispatch-time optimistic accounting is correct as it
+            # stands, so consume the nomination and move on instead of
+            # requeueing a bound pod into a duplicate-bind loop
+            bn = getattr(self.cluster, "bound_node_of", None)
+            if bn is not None:
+                try:
+                    bound_to = bn(pod.key)
+                except Exception:
+                    bound_to = None
+                if bound_to == node:
+                    if self.allocator is not None:
+                        self.allocator.unnominate(pod.key)
+                    self.metrics.inc("ambiguous_bind_recoveries_total")
+                    self._breaker_success()
+                    continue
+            self._breaker_failure(err)
             pod.phase = PodPhase.PENDING
             pod.node = None
             pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
@@ -2019,29 +2276,7 @@ class Scheduler:
                 # is the whole point of nominatedNodeName semantics.
                 self.allocator.unnominate(info.pod.key)
         if self.config.max_attempts and info.attempts + 1 >= self.config.max_attempts:
-            try:
-                spec = spec_for(info.pod)
-            except LabelError:
-                spec = None
-            if spec is not None and spec.is_gang:
-                # a permanently-failed member dooms the gang: the remaining
-                # members can never reach gang-size with the current
-                # incarnations, so give the slice entitlement back and fail
-                # the peers too — parked ones NOW, backoff ones at their
-                # next cycle (their park->timeout->requeue loop counts no
-                # attempts, so they would otherwise never resolve)
-                if self.allocator is not None:
-                    self.allocator.unnominate_gang(spec.gang_name)
-                doom = (f"gang {spec.gang_name}: member {info.pod.key} "
-                        f"permanently failed: {reason}")
-                self.doomed_gangs[spec.gang_name] = doom
-                while len(self.doomed_gangs) > 1024:
-                    # never-resubmitted doomed gangs would otherwise
-                    # accumulate forever; oldest doom evicts first (a
-                    # revived-then-stale entry only costs the evicted
-                    # gang's members one extra park/timeout round)
-                    self.doomed_gangs.pop(next(iter(self.doomed_gangs)))
-                self._doom_parked_members(spec.gang_name, doom)
+            self._doom_gang_of(info, reason)
             self._fail_permanently(info, reason, trace=trace)
             return "failed"
         self.queue.requeue_backoff(info, now=self.clock.time(),
@@ -2049,6 +2284,92 @@ class Scheduler:
         self.metrics.inc("pods_unschedulable_total")
         self._finish(trace, outcome, reason=reason)
         return outcome
+
+    def _doom_gang_of(self, info: QueuedPodInfo, reason: str) -> None:
+        """A permanently-failed member dooms its gang: the remaining
+        members can never reach gang-size with the current incarnations,
+        so give the slice entitlement back and fail the peers too —
+        parked ones NOW, backoff ones at their next cycle (their
+        park->timeout->requeue loop counts no attempts, so they would
+        otherwise never resolve). Shared by the max-attempts branch and
+        crash quarantine; no-op for non-gang pods."""
+        try:
+            spec = spec_for(info.pod)
+        except LabelError:
+            return
+        if not spec.is_gang:
+            return
+        if self.allocator is not None:
+            self.allocator.unnominate_gang(spec.gang_name)
+        doom = (f"gang {spec.gang_name}: member {info.pod.key} "
+                f"permanently failed: {reason}")
+        self.doomed_gangs[spec.gang_name] = doom
+        while len(self.doomed_gangs) > 1024:
+            # never-resubmitted doomed gangs would otherwise accumulate
+            # forever; oldest doom evicts first (a revived-then-stale
+            # entry only costs the evicted gang's members one extra
+            # park/timeout round)
+            self.doomed_gangs.pop(next(iter(self.doomed_gangs)))
+        self._doom_parked_members(spec.gang_name, doom)
+
+    def _contain_crash(self, info: QueuedPodInfo, e: Exception) -> str:
+        """Cycle-level exception containment: a plugin RAISED somewhere in
+        this pod's cycle. The engine thread survives unconditionally —
+        the pod pays: its (possibly partial) reservation is defensively
+        rolled back, the crash is counted, and the pod requeues with
+        backoff until quarantine_threshold crashes mark it poison and
+        fail it permanently (one malformed pod must not monopolise the
+        engine with crash-requeue loops forever)."""
+        pod = info.pod
+        state = CycleState()
+        try:
+            state.write("workload_spec", spec_for(pod))
+        except LabelError:
+            pass
+        # did the crashed cycle leave a pending reservation? (the in-loop
+        # unwinds in the reserve/permit paths normally clear it with real
+        # state; this backstop covers raise sites between them)
+        entry = (self.allocator.assignment_of(pod)
+                 if self.allocator is not None else None)
+        for p in reversed(self.profile.reserve):
+            # idempotent sweep: unreserve keys on the pod and tolerates
+            # never-reserved pods, so crashes before Reserve cost nothing
+            try:
+                p.unreserve(state, pod, "")
+            except Exception:
+                pass
+        if entry is not None:
+            # the sweep freed reserved chips: a capacity event for OTHER
+            # hint-parked pods, exactly like the bind-failure rollback
+            # (origin keeps the crashed pod off its own event)
+            self.notify_event(ClusterEvent(POD_DELETED, node=entry[0],
+                                           origin=pod.key))
+        info.crashes += 1
+        self.metrics.inc("cycle_crashes_total")
+        trace = CycleTrace(pod=pod.key, started=self.clock.time())
+        reason = f"cycle crash: {type(e).__name__}: {e}"
+        thresh = self.config.quarantine_threshold
+        # quarantine only POISON pods; see __init__'s discriminator — an
+        # engine-wide fault crashing pod after pod must not permanently
+        # fail the whole pending workload
+        systemic = (not self._ok_since_crash
+                    and self._last_crash_key is not None
+                    and self._last_crash_key != pod.key)
+        self._ok_since_crash = False
+        self._last_crash_key = pod.key
+        if thresh and info.crashes >= thresh and not systemic:
+            reason = (f"quarantined after {info.crashes} crashing cycles "
+                      f"({type(e).__name__}: {e})")
+            self.quarantined[pod.key] = reason
+            while len(self.quarantined) > 1024:
+                self.quarantined.pop(next(iter(self.quarantined)))
+            self.metrics.inc("pods_quarantined_total")
+            self._doom_gang_of(info, reason)
+            self._fail_permanently(info, reason, trace=trace)
+            return "quarantined"
+        self.queue.requeue_backoff(info, now=self.clock.time())
+        self._finish(trace, "crash", reason=reason)
+        return "crash"
 
     def _cycle_error(self, info: QueuedPodInfo, trace: CycleTrace, reason: str) -> str:
         self.queue.requeue_backoff(info, now=self.clock.time())
@@ -2170,6 +2491,38 @@ class Scheduler:
         if self.allocator is not None:
             self.allocator.unnominate(pod_key)
         self.failed.pop(pod_key, None)
+        self.quarantined.pop(pod_key, None)
+
+    def reconcile(self, pods) -> tuple[int, int]:
+        """Restart reconciliation: rebuild assumed/in-flight bind state
+        from CLUSTER truth after a scheduler crash. For each candidate
+        pod (the previous incarnation's workload, as recovered from the
+        apiserver or the test driver): binding present in the cluster =>
+        ADOPT it — the chip-assignment annotation rode the Binding, so
+        allocation accounting follows from cluster state alone; absent =>
+        the pod never made it past the wire (a crash between Reserve and
+        Bind left only engine-local state, which died with the engine) —
+        scrub any stale assignment annotation and REQUEUE it. No pod is
+        lost, none is double-bound. Returns (adopted, requeued)."""
+        adopted = requeued = 0
+        bn = getattr(self.cluster, "bound_node_of", None)
+        for pod in pods:
+            if self.tracks(pod.key) or pod.key in self.failed:
+                continue
+            node = bn(pod.key) if bn is not None else None
+            if node is not None:
+                pod.node = node
+                pod.phase = PodPhase.BOUND
+                adopted += 1
+                self.metrics.inc("reconcile_adopted_total")
+                continue
+            pod.node = None
+            pod.phase = PodPhase.PENDING
+            pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+            if self.submit(pod):
+                requeued += 1
+                self.metrics.inc("reconcile_requeued_total")
+        return adopted, requeued
 
     # -------------------------------------------------------------- main loop
     def run_one(self) -> str | None:
@@ -2179,13 +2532,20 @@ class Scheduler:
         callers decide how to wait (next_wake_at)."""
         if self.waiting:
             self.check_waiting()
-        if self._bind_failures:
+        if self._bind_results:
             self._drain_bind_failures()
         while self._gang_revivals:  # recorded by submit() on any thread
             try:
                 self.doomed_gangs.pop(self._gang_revivals.popleft(), None)
             except IndexError:
                 break
+        if self.clock.time() < self._breaker_until:
+            # circuit open (apiserver error storm): park scheduling — the
+            # queue keeps its order and nobody's attempts burn; resumes
+            # cleanly when the cooldown passes (next_wake_at floors the
+            # queue wake at the breaker deadline)
+            self.metrics.inc("breaker_parked_cycles_total")
+            return None
         maxp = self.config.batch_max_pods
         if maxp > 1:
             if self.allocator is None or self.allocator.has_holds():
@@ -2207,6 +2567,10 @@ class Scheduler:
                 return None
             started = self.clock.time()
             outcome = self.schedule_one(info)
+        if outcome not in ("crash", "quarantined"):
+            # a cycle completed without crashing: the next crash is a
+            # per-pod (poison) signal again, not a systemic one
+            self._ok_since_crash = True
         self.metrics.observe("cycle_latency_ms",
                              (self.clock.time() - started) * 1e3)
         return outcome
@@ -2222,7 +2586,9 @@ class Scheduler:
             wakes.append(min(w.deadline for w in self.waiting.values()))
         nxt = self.queue.next_ready_at()
         if nxt is not None:
-            wakes.append(nxt)
+            # an open circuit breaker defers queue work (but never permit
+            # deadlines — check_waiting still runs while parked)
+            wakes.append(max(nxt, self._breaker_until))
         return min(wakes) if wakes else None
 
     def run_until_idle(self, max_cycles: int = 100_000) -> int:

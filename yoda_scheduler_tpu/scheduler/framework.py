@@ -333,6 +333,10 @@ class QueuedPodInfo:
     rejected_by: tuple = ()
     # when the pod entered backoff (backoff-wait histogram input)
     backoff_started: float = 0.0
+    # cycles this pod CRASHED (a plugin raised; distinct from `attempts`,
+    # which counts orderly unschedulable verdicts) — the engine
+    # quarantines the pod past SchedulerConfig.quarantine_threshold
+    crashes: int = 0
 
 
 # --------------------------------------------------------------------------

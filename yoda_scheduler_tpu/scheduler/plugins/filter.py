@@ -151,8 +151,12 @@ class TelemetryFilter(FilterPlugin, EnqueueExtensions):
                  & (table.chip_hbm_free[rows] >= spec.min_free_mb)
                  & (table.chip_clock[rows] >= spec.min_clock_mhz))
             qcount = q.sum(axis=1)
-        # telemetry present + fresh (schema.stale: age > max_age)
-        ok = valid & ((now - hb) <= self.max_age)
+        # telemetry present + fresh (schema.stale: age > max_age);
+        # blackout degraded mode waives freshness, same as `filter`
+        if state.read_or("degraded"):
+            ok = valid.copy()
+        else:
+            ok = valid & ((now - hb) <= self.max_age)
         if spec.accelerator is not None:
             ok &= accel == table.intern_of(spec.accelerator)
         if spec.tpu_generation is not None:
@@ -171,7 +175,13 @@ class TelemetryFilter(FilterPlugin, EnqueueExtensions):
         # on cache miss (pkg/yoda/scheduler.go:80-84)
         if m is None:
             return Status.unschedulable(f"{node.name}: no accelerator telemetry")
-        if m.stale(now=state.read_or("now", time.time()), max_age_s=self.max_age):
+        # degraded mode (engine-detected telemetry blackout): the WHOLE
+        # feed is dark, so "stale" carries no per-node signal — waive the
+        # gate and schedule off last-known capacity (the capacity
+        # predicates below still apply) instead of rejecting every node
+        if m.stale(now=state.read_or("now", time.time()),
+                   max_age_s=self.max_age) \
+                and not state.read_or("degraded"):
             return Status.unschedulable(f"{node.name}: telemetry stale")
         if spec.is_gang:
             return self._filter_checked(state, spec, pod, node, m)

@@ -232,7 +232,10 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
             m = ni.metrics
             if m is None or not m.slice_id:
                 continue
-            if now is not None and m.stale(now=now):
+            if (now is not None and m.stale(now=now)
+                    and not state.read_or("degraded")):
+                # blackout degraded mode: last-known slice capacity is
+                # the best (only) planning input available
                 continue
             if spec.accelerator is not None and m.accelerator != spec.accelerator:
                 continue

@@ -33,6 +33,11 @@ from .prescore import MAX_KEY, SPEC_KEY, MaxValue
 
 class TelemetryScore(ScorePlugin):
     name = "telemetry-score"
+    # dropped from the scorer set while the engine runs telemetry-blackout
+    # degraded mode: stale quality numbers (clock/bandwidth/duty) would
+    # steer placement on noise, while the capacity scorers (topology,
+    # fragmentation) still read the last-known inventory soundly
+    telemetry_dependent = True
     # score-memo contract (core._schedule_one_locked score section): this
     # plugin's raw score for a node is a pure function of the node's
     # serial, the allocator pending version, the pod's label class, and

@@ -102,8 +102,18 @@ class SchedulingQueue:
         # cross-thread event inbox: notify() appends from ANY thread
         # (reflector, binder, test driver — deque append is GIL-atomic);
         # pop()/next_ready_at() drain it on the thread that owns the
-        # queue, so hints and the parked map never race
+        # queue, so hints and the parked map never race. Bounded: past
+        # _INBOX_CAP undrained events (an apiserver event storm
+        # outrunning the engine) notify() DROPS the event and counts it.
+        # Dropping is safe because events are a latency optimization,
+        # never the correctness mechanism: every parked pod keeps its
+        # backoff deadline, so a dropped cure event only delays that
+        # pod's retry to its timer. The alternative — flushing every
+        # parked pod awake — would burn attempts of pods whose hints
+        # would have said SKIP, permanently failing them under a
+        # sustained storm (max_attempts posture).
         self._inbox: deque = deque()
+        self._dropped_events = 0
         # pod-key membership counts: contains() is called once per PENDING
         # pod per serve pass (k8s/client._serve intake), so it must be
         # O(1), not a queue scan — at 1000 pending pods the scan made the
@@ -249,10 +259,19 @@ class SchedulingQueue:
             heapq.heappop(heap)
             self._activate(info, now)
 
+    _INBOX_CAP = 4096
+
     def notify(self, event: ClusterEvent) -> None:
         """Accept a cluster event from any thread; the next pop() (or an
         explicit drain via on_event) routes it through the queueing hints
-        on the queue owner's thread."""
+        on the queue owner's thread. Storm protection: past _INBOX_CAP
+        undrained events the event is DROPPED and counted — parked pods
+        fall back to their backoff timers (see __init__)."""
+        if len(self._inbox) >= self._INBOX_CAP:
+            self._dropped_events += 1  # plain int add: GIL-atomic enough
+            if self._metrics is not None:
+                self._metrics.inc("requeue_events_dropped_total")
+            return
         self._inbox.append(event)
 
     def has_undrained_events(self) -> bool:

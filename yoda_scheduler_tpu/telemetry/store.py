@@ -44,6 +44,20 @@ class TelemetryStore:
         # per full put round so refreshed heartbeats eventually raise it.
         self._hb_floor: float | None = None
         self._floor_puts = 0
+        # conservative upper bound over stored heartbeats: the engine's
+        # blackout detector (degraded mode) asks "is even the NEWEST
+        # heartbeat stale?" — a cluster-wide feed outage, as opposed to
+        # one node's sniffer dying (which the floor/staleness gate
+        # handles per node). Raised on put; recomputed exactly on delete
+        # (deletes are rare; a stale-high ceiling would mask a blackout).
+        self._hb_ceil: float | None = None
+
+    def _recompute_ceil_locked(self) -> None:
+        """Exact heartbeat-ceiling recompute (caller holds the lock) —
+        the single definition the put/delete/re-anchor paths share, so
+        the blackout detector can't desynchronize between them."""
+        self._hb_ceil = max(
+            (m.heartbeat for m in self._by_node.values()), default=None)
 
     # ------------------------------------------------------------- publisher
     def put(self, metrics: TpuNodeMetrics) -> None:
@@ -61,12 +75,27 @@ class TelemetryStore:
             hb = metrics.heartbeat
             if self._hb_floor is None or hb < self._hb_floor:
                 self._hb_floor = hb
+            if self._hb_ceil is None or hb > self._hb_ceil:
+                self._hb_ceil = hb
+            elif (old is not None and hb < old.heartbeat
+                    and old.heartbeat >= self._hb_ceil):
+                # the (possible) ceiling holder moved DOWN — e.g. a
+                # restore-from-backup replay, or a scripted blackout: an
+                # exact recompute keeps the blackout detector live (a
+                # stuck-high ceiling would mask a dead feed forever).
+                # In-place republishes (old unavailable) are covered by
+                # the periodic re-anchor below.
+                self._recompute_ceil_locked()
             self._floor_puts += 1
             if self._floor_puts > len(self._by_node):
                 self._floor_puts = 0
                 self._hb_floor = min(
                     (m.heartbeat for m in self._by_node.values()),
                     default=None)
+                # in-place republishes mutate stored heartbeats without a
+                # fresh put observing the OLD value, so the ceiling can
+                # drift high or low — re-anchor it on the same cadence
+                self._recompute_ceil_locked()
             watchers = list(self._watchers)
             changed = list(self._change_watchers)
         for cb in watchers:
@@ -79,7 +108,9 @@ class TelemetryStore:
             old = self._by_node.pop(node, None)
             self._changes.record(node)
             # removal can only raise the true minimum; the floor stays a
-            # valid (conservative) lower bound
+            # valid (conservative) lower bound. The ceiling CAN drop
+            # (the newest node left), so recompute it exactly.
+            self._recompute_ceil_locked()
             watchers = list(self._watchers)
             changed = list(self._change_watchers)
         for cb in watchers:
@@ -91,6 +122,13 @@ class TelemetryStore:
         """Lower bound over every stored heartbeat (None when empty).
         GIL-atomic single read; see __init__ for the maintenance rule."""
         return self._hb_floor
+
+    def heartbeat_ceiling(self) -> float | None:
+        """Upper bound over every stored heartbeat (None when empty) —
+        the engine's telemetry-blackout detector: when even the NEWEST
+        heartbeat is past the staleness gate, the whole feed is dark and
+        degraded mode keeps scheduling off last-known capacity."""
+        return self._hb_ceil
 
     def changes_since(self, version: int) -> tuple[int, set[str] | None]:
         """(current version, nodes changed after `version`) — None for the
