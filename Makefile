@@ -10,8 +10,8 @@ local: native test
 
 native: native/libyodaplace.so
 
-native/libyodaplace.so: native/placement.cc
-	g++ -O2 -std=c++17 -shared -fPIC -o $@ $<
+native/libyodaplace.so: native/placement.cc native/fusedplane.cc
+	g++ -O2 -std=c++17 -shared -fPIC -o $@ $^
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -254,9 +254,29 @@ def batch_stats(sched) -> dict:
     }
 
 
+def native_stats(sched) -> dict:
+    """Native data-plane observability: fused scans served by the C++
+    kernel, pods that fell back to the numpy path (veto or load
+    failure), and the overlapped-prefetch hit/stale split — a consumed
+    prefetch is a scan the engine never had to wait for; a stale one
+    records a cluster change between dispatch and consume (counted,
+    discarded, re-scanned — placement never moves)."""
+    c = sched.metrics.counters
+    return {
+        "native_plane_active": sched.metrics.gauges.get(
+            "native_plane_active", 0.0) == 1.0,
+        "native_scans": c.get("native_scans_total", 0),
+        "native_fallbacks": c.get("native_fallbacks_total", 0),
+        "prefetch_dispatched": c.get("prefetch_dispatched_total", 0),
+        "prefetch_hits": c.get("prefetch_hits_total", 0),
+        "prefetch_stale": c.get("prefetch_stale_total", 0),
+    }
+
+
 def run_scale(units: int, pct: int = 0, pods_per_node: int = 5,
               diverse: bool = False, columnar: bool | None = None,
-              batch: bool | None = None, blackout: bool = False):
+              batch: bool | None = None, blackout: bool = False,
+              native: bool | None = None):
     """Scale stress (VERDICT r2 item 7): a large-cluster burst measuring
     whether cycle compute stays sub-linear in node count. pct=0 keeps
     kube-scheduler's adaptive percentageOfNodesToScore (scores ~42% of
@@ -273,14 +293,15 @@ def run_scale(units: int, pct: int = 0, pods_per_node: int = 5,
     gc.disable()
     try:
         return _run_scale_nogc(units, pct, pods_per_node, diverse, columnar,
-                               batch, blackout)
+                               batch, blackout, native)
     finally:
         gc.enable()
 
 
 def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
                     diverse: bool = False, columnar: bool | None = None,
-                    batch: bool | None = None, blackout: bool = False):
+                    batch: bool | None = None, blackout: bool = False,
+                    native: bool | None = None):
     store = build_scale_nodes(units)
     if blackout:
         # telemetry-blackout leg: the WHOLE feed died long before the
@@ -305,6 +326,8 @@ def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
                              pod_hinted_backoff_s=30.0)
     if columnar is not None:
         config = config.with_(columnar=columnar)
+    if native is not None:
+        config = config.with_(native_plane=native)
     if batch is False:
         config = config.with_(batch_max_pods=1)
     sched = Scheduler(cluster, config, clock=HybridClock())
@@ -380,6 +403,7 @@ def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
         **batch_stats(sched),
         **requeue_stats(sched),
         **resilience_stats(sched),
+        **native_stats(sched),
     }
 
 
@@ -528,12 +552,20 @@ def _run_serve_scale_nogc(n_nodes: int, n_pods: int):
         # deepened past one pod between intake passes
         batched = 0
         recovery: dict = {}
+        native: dict = {}
         sched = serve_box.get("sched")
         if sched is not None:
             for e in sched.engines.values():
                 batched += e.metrics.counters.get("batched_binds_total", 0)
                 for k, v in resilience_stats(e).items():
                     recovery[k] = recovery.get(k, 0) + (v or 0)
+                for k, v in native_stats(e).items():
+                    if k == "native_plane_active":
+                        native[k] = native.get(k, False) or v
+                    else:
+                        native[k] = native.get(k, 0) + (v or 0)
+        events = {"posted": getattr(cluster, "events_posted", 0),
+                  "dropped": getattr(cluster, "events_dropped", 0)}
         return {
             "nodes": n_nodes,
             "pods": n_pods,
@@ -554,6 +586,10 @@ def _run_serve_scale_nogc(n_nodes: int, n_pods: int):
             # self-healing counters (all-zero on a healthy serve run;
             # non-zero names the recovery path a survived outage took)
             "recovery": recovery,
+            # native data plane behind the wire + the Scheduled /
+            # FailedScheduling event trail (posted off-thread, deduped)
+            "native": native,
+            "events": events,
         }
 
 
@@ -650,15 +686,26 @@ def main():
         # shape. Measured twice (columnar on/off) so the speedup is a
         # recorded fact, not a claim.
         if time.monotonic() < deadline:
-            diverse = run_scale(125, pods_per_node=2, diverse=True)
+            # A/B/C on the identical workload: native fused kernel
+            # (default config when the .so is present), numpy columnar
+            # (native off), scalar (columnar off — which also disables
+            # native, it consumes the columnar arrays). Speedups are
+            # recorded facts, not claims.
+            diverse_native = run_scale(125, pods_per_node=2, diverse=True)
+            diverse = run_scale(125, pods_per_node=2, diverse=True,
+                                native=False)
             diverse_scalar = run_scale(125, pods_per_node=2, diverse=True,
                                        columnar=False)
             diverse["columnar_speedup_c50"] = round(
                 diverse_scalar["cycle_compute_p50_ms"]
                 / max(diverse["cycle_compute_p50_ms"], 1e-9), 2)
+            diverse_native["native_speedup_c50"] = round(
+                diverse["cycle_compute_p50_ms"]
+                / max(diverse_native["cycle_compute_p50_ms"], 1e-9), 2)
         else:
             diverse = {"skipped": "scale budget spent"}
             diverse_scalar = {"skipped": "scale budget spent"}
+            diverse_native = {"skipped": "scale budget spent"}
         node_ratio = big["nodes"] / small["nodes"]
         ratio_p50 = (big["cycle_compute_p50_ms"]
                      / max(small["cycle_compute_p50_ms"], 1e-9))
@@ -676,6 +723,7 @@ def main():
             "small": small, "large_adaptive": big, "large_pct10": big10,
             "large_adaptive_unbatched": big_nb,
             "large_diverse": diverse, "large_diverse_scalar": diverse_scalar,
+            "large_diverse_native": diverse_native,
             "node_ratio": round(node_ratio, 2),
             "cycle_compute_ratio_p50": round(ratio_p50, 2),
             "cycle_compute_ratio_p99": round(ratio_p99, 2),
@@ -726,6 +774,14 @@ def main():
         out["diverse_cycle_c50_ms"] = dv.get("cycle_compute_p50_ms",
                                              dv.get("skipped"))
         out["diverse_columnar_speedup"] = dv.get("columnar_speedup_c50")
+        nv = s.get("large_diverse_native") or {}
+        out["diverse_native_cycle_c50_ms"] = nv.get(
+            "cycle_compute_p50_ms", nv.get("skipped"))
+        out["diverse_native_speedup"] = nv.get("native_speedup_c50")
+        out["native_plane_active"] = nv.get("native_plane_active")
+        out["native_scans"] = nv.get("native_scans")
+        out["prefetch_hits"] = nv.get("prefetch_hits")
+        out["prefetch_stale"] = nv.get("prefetch_stale")
         big = s.get("large_adaptive") or {}
         for k in ("requeue_wakeups", "backoff_wait_p50_ms",
                   "backoff_wait_p99_ms"):

@@ -49,6 +49,10 @@ class FakeApiState:
         self.leases: dict[str, dict] = {}
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self.bindings: list[dict] = []
+        # core/v1 Events POSTed by the scheduler (FailedScheduling /
+        # Scheduled — the kubectl-describe trail); tests read them via
+        # GET /api/v1/events or the in-process list
+        self.pod_events: list[dict] = []
         # fault injection: list of [path_substring, status, remaining_count,
         # method]; remaining_count None = until clear_faults() (scripted
         # error STORMS rather than a fixed number of failures)
@@ -289,8 +293,20 @@ class _Handler(BaseHTTPRequestHandler):
         if "/tpunodemetrics" in base:
             return self._metrics_verb(method, base, kind)
 
+        if base == "/api/v1/events" and method == "GET":
+            with s.cond:
+                items = list(s.pod_events)
+                rv = s.rv
+            return self._json(200, {"items": items,
+                                    "metadata": {"resourceVersion": str(rv)}})
         if base.startswith("/api/v1/namespaces/"):
             parts = base.split("/")  # '', api, v1, namespaces, ns, pods, name[, sub]
+            if len(parts) >= 6 and parts[5] == "events" \
+                    and method == "POST":
+                body = self._body()
+                with s.cond:
+                    s.pod_events.append(body)
+                return self._json(201, body)
             if len(parts) >= 7 and parts[5] == "pods":
                 ns, name = parts[4], parts[6]
                 sub = parts[7] if len(parts) > 7 else None

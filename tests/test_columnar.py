@@ -96,7 +96,12 @@ def build_burst(rng: random.Random):
 def drive(cluster, pods, columnar: bool):
     sched = Scheduler(
         cluster,
+        # native_plane pinned OFF: this fuzz is the NUMPY plane's parity
+        # contract vs the scalar ground truth (the native kernel would
+        # otherwise serve the full scans and starve the vectorized-path
+        # counter; its own three-way fuzz lives in test_native_plane.py)
         SchedulerConfig(max_attempts=3, columnar=columnar,
+                        native_plane=False,
                         pod_hinted_backoff_s=0.0),
         clock=FakeClock(start=T0))
     for p in pods:

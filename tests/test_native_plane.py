@@ -1,0 +1,298 @@
+"""Native fused scheduling kernel: three-way parity fuzz vs the numpy
+columnar and scalar ground truths, direct kernel-vs-plugin agreement,
+the fallback chain (knob off / missing .so), and overlapped scan
+prefetch staleness.
+
+The contract under test (native/fusedplane.cc via
+scheduler/nativeplane.py): the fused filter+score+top-k call must
+produce EXACTLY the placements the numpy columnar path produces — which
+the columnar fuzz already pins to the scalar path — so all three engines
+agree on every pod's fate bit-for-bit. A consumed PREFETCH result must
+be indistinguishable from an inline scan: any cluster change between
+dispatch and consume discards it (counted), never changes a placement.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+np = pytest.importorskip("numpy")
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.scheduler.framework import CycleState
+from yoda_scheduler_tpu.scheduler.nativeplane import FusedPlane
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod
+
+from test_columnar import T0, build_burst, build_cluster, end_state
+
+NATIVE = FusedPlane.load() is not None
+
+require_native = pytest.mark.skipif(
+    not NATIVE, reason="libyodaplace.so not built (make native)")
+
+
+def drive(cluster, pods, *, native: bool, columnar: bool = True,
+          prefetch: bool = True):
+    sched = Scheduler(
+        cluster,
+        # explicit knobs: these tests must pin each plane regardless of
+        # the CI pass's YODA_NATIVE_PLANE / YODA_COLUMNAR environment
+        SchedulerConfig(max_attempts=3, columnar=columnar,
+                        native_plane=native, native_prefetch=prefetch,
+                        pod_hinted_backoff_s=0.0),
+        clock=FakeClock(start=T0))
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=10_000)
+    return sched
+
+
+# ------------------------------------------------------------------ the fuzz
+def test_parity_fuzz_three_way():
+    """>=200 randomized (cluster, burst) cases, each driven through all
+    three data planes — native, numpy columnar, scalar — with identical
+    seeds: every pod's fate (phase, chosen node) must be bit-identical.
+    When the library is present the native path must also actually
+    ENGAGE: a .so that builds but silently falls back (stale ABI, veto
+    bug) fails here, which is what CI's build-health fence runs."""
+    mismatches = []
+    native_used = 0
+    for case in range(200):
+        rngs = [random.Random(31000 + case) for _ in range(3)]
+        clusters = [build_cluster(r) for r in rngs]
+        bursts = [build_burst(r) for r in rngs]
+        nat = drive(clusters[0], bursts[0], native=True)
+        col = drive(clusters[1], bursts[1], native=False)
+        sca = drive(clusters[2], bursts[2], native=False, columnar=False)
+        native_used += nat.metrics.counters.get("native_scans_total", 0)
+        assert col.metrics.counters.get("native_scans_total", 0) == 0
+        assert sca.metrics.counters.get("native_scans_total", 0) == 0
+        a, b, c = (end_state(p) for p in bursts)
+        if not (a == b == c):
+            mismatches.append((case, a, b, c))
+    assert not mismatches, mismatches[:2]
+    if NATIVE:
+        # the fuzz must exercise the kernel, not agree by fallback
+        assert native_used > 200, native_used
+
+
+@require_native
+def test_native_scan_direct_parity():
+    """One fused call vs the plugin chain, node by node: the selected
+    candidate set must equal the scalar filter verdicts replayed in
+    rotation order, the MaxValue fold must equal MaxCollection's, and
+    the kernel's raw telemetry scores must be bit-identical to
+    TelemetryScore.score."""
+    from yoda_scheduler_tpu.scheduler.plugins.prescore import MAX_KEY
+    from yoda_scheduler_tpu.utils.labels import LabelError, spec_for
+
+    checked_pods = 0
+    for case in range(40):
+        rng = random.Random(41000 + case)
+        cluster = build_cluster(rng)
+        sched = Scheduler(cluster,
+                          SchedulerConfig(columnar=True, native_plane=True),
+                          clock=FakeClock(start=T0))
+        if sched._native is None:
+            pytest.skip("native plane failed to load")
+        snapshot = sched.snapshot()
+        vers = sched._cluster_versions()
+        nodes = snapshot.list()
+        if not nodes:
+            continue
+        for pod in build_burst(rng):
+            try:
+                spec = spec_for(pod)
+            except LabelError:
+                continue
+            if spec.is_gang or spec.topology is not None:
+                continue
+            state = CycleState()
+            state.write("now", T0)
+            state.write("workload_spec", spec)
+            state.write("snapshot", snapshot)
+            state.write("cycle_versions", vers)
+            filters = [p for p in sched.profile.filter
+                       if getattr(p, "relevant", None) is None
+                       or p.relevant(pod, snapshot)]
+            want = sched._num_feasible_to_find(len(nodes))
+            start = sched._filter_start % len(nodes)
+            out = sched._native_scan(state, pod, spec, filters, snapshot,
+                                     vers, nodes, want, False)
+            sched._filter_start = 0  # keep start deterministic per pod
+            if out is None or not hasattr(out, "feasible"):
+                continue
+            checked_pods += 1
+            # scalar replay of the same rotation
+            expect = []
+            for k in range(len(nodes)):
+                ni = nodes[(start + k) % len(nodes)]
+                ok = all(p.filter(state, pod, ni).ok for p in filters)
+                if ok:
+                    expect.append(ni.name)
+                    if len(expect) >= want:
+                        break
+            assert [n.name for n in out.feasible] == expect, (case,
+                                                              pod.labels)
+            # MaxValue parity: MaxCollection's fold over the same list
+            mc = sched.profile.pre_score[0]
+            st2 = CycleState()
+            st2.write("workload_spec", spec)
+            st2.write("snapshot", snapshot)
+            mc.pre_score(st2, pod, out.feasible)
+            mv = st2.read_or(MAX_KEY)
+            assert (mv.bandwidth, mv.clock, mv.core, mv.free_memory,
+                    mv.power, mv.total_memory) == out.mv6, (case,
+                                                            pod.labels)
+            # raw telemetry scores bit-identical to the scalar plugin
+            tel = sched.profile.score[0]
+            if tel.name in out.raws:
+                st2.write("now", T0)
+                for ni in out.feasible:
+                    s, _ = tel.score(st2, pod, ni)
+                    assert out.raws[tel.name][ni.name] == s, (case,
+                                                              ni.name)
+    assert checked_pods > 50, checked_pods
+
+
+# ------------------------------------------------------------ fallback chain
+def test_knob_off_restores_numpy_columnar():
+    """native_plane=False must restore the numpy columnar path exactly:
+    zero native scans, vectorized scans still live."""
+    rng = random.Random(7)
+    cluster = build_cluster(rng)
+    pods = build_burst(rng)
+    sched = drive(cluster, pods, native=False)
+    assert sched.metrics.counters.get("native_scans_total", 0) == 0
+    assert sched.metrics.gauges.get("native_plane_active") == 0.0
+
+
+@require_native
+def test_missing_library_degrades_silently(monkeypatch):
+    """A missing/stale .so must behave exactly like native_plane=False:
+    the engine schedules through numpy columnar, gauge reads 0."""
+    monkeypatch.setattr(FusedPlane, "load", classmethod(lambda cls: None))
+    rng_a, rng_b = random.Random(11), random.Random(11)
+    ca, cb = build_cluster(rng_a), build_cluster(rng_b)
+    pa, pb = build_burst(rng_a), build_burst(rng_b)
+    degraded = drive(ca, pa, native=True)   # load() -> None under patch
+    reference = drive(cb, pb, native=False)
+    assert degraded.metrics.gauges.get("native_plane_active") == 0.0
+    assert degraded.metrics.counters.get("native_scans_total", 0) == 0
+    assert end_state(pa) == end_state(pb)
+
+
+@require_native
+def test_loader_missing_symbol_is_per_kernel():
+    """The shared loader resolves symbols per KERNEL: asking for a
+    symbol the library doesn't export returns None for that kernel
+    only, while the fused kernel (and torus placement) keep loading
+    from the same .so."""
+    from yoda_scheduler_tpu.utils import nativeloader
+
+    assert nativeloader.bind_symbols(
+        {"yoda_symbol_from_the_future": (None, None)}) is None
+    assert FusedPlane.load() is not None
+    from yoda_scheduler_tpu.topology import native as topo_native
+
+    assert topo_native._lib() is not None
+
+
+def test_gauge_reports_active_plane():
+    rng = random.Random(13)
+    cluster = build_cluster(rng)
+    sched = Scheduler(cluster,
+                      SchedulerConfig(columnar=True, native_plane=True),
+                      clock=FakeClock(start=T0))
+    expected = 1.0 if NATIVE else 0.0
+    assert sched.metrics.gauges.get("native_plane_active") == expected
+
+
+# --------------------------------------------------------------- prefetch
+def _two_class_cluster():
+    store = TelemetryStore()
+    for i in range(6):
+        m = make_tpu_node(f"n{i}", chips=4)
+        m.heartbeat = T0
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return cluster
+
+
+def _two_class_pods(n=6):
+    # one label class per pod: every cycle is a memo-miss full scan, so
+    # the dispatcher arms a prefetch for each successor
+    return [Pod(f"p{i}", labels={"scv/number": "1",
+                                 "scv/memory": str(1000 + i)})
+            for i in range(n)]
+
+
+@require_native
+def test_prefetch_hit_on_quiet_cluster():
+    """No cluster change between dispatch and consume: the prefetched
+    scan is consumed (counted) and placements equal a no-prefetch
+    drive."""
+    ca, cb = _two_class_cluster(), _two_class_cluster()
+    pa, pb = _two_class_pods(), _two_class_pods()
+    with_pf = drive(ca, pa, native=True, prefetch=True)
+    without = drive(cb, pb, native=False)
+    assert end_state(pa) == end_state(pb)
+    assert with_pf.metrics.counters.get("prefetch_dispatched_total", 0) > 0
+    assert with_pf.metrics.counters.get("prefetch_hits_total", 0) > 0
+
+
+@require_native
+def test_prefetch_stale_after_mutation_discards_and_counts():
+    """Mutate the snapshot between prefetch and consume: the version
+    vector moved, so consume must DISCARD (prefetch_stale_total) and the
+    cycle re-scans — placement identical to a no-prefetch engine seeing
+    the same mutation at the same point."""
+
+    def run(native: bool, prefetch: bool):
+        cluster = _two_class_cluster()
+        pods = _two_class_pods(4)
+        sched = Scheduler(
+            cluster,
+            SchedulerConfig(max_attempts=3, columnar=True,
+                            native_plane=native,
+                            native_prefetch=prefetch,
+                            pod_hinted_backoff_s=0.0),
+            clock=FakeClock(start=T0))
+        for p in pods:
+            sched.submit(p)
+        outcomes = []
+        for step in range(100):
+            out = sched.run_one()
+            if out is None:
+                break
+            outcomes.append(out)
+            # after every cycle (prefetch now armed for the next head),
+            # mutate telemetry on a node the next scan will see: the
+            # version vector moves, so a prefetched mask is stale
+            m = make_tpu_node("n0", chips=4)
+            m.heartbeat = T0
+            m.generation = step + 2
+            cluster.telemetry.put(m)
+        return sched, pods, outcomes
+
+    nat, nat_pods, nat_out = run(native=True, prefetch=True)
+    ref, ref_pods, ref_out = run(native=False, prefetch=False)
+    assert end_state(nat_pods) == end_state(ref_pods)
+    assert nat_out == ref_out
+    if nat.metrics.counters.get("prefetch_dispatched_total", 0):
+        assert nat.metrics.counters.get("prefetch_stale_total", 0) > 0
+        assert nat.metrics.counters.get("prefetch_hits_total", 0) == 0
+
+
+@require_native
+def test_prefetch_off_knob():
+    cluster = _two_class_cluster()
+    pods = _two_class_pods()
+    sched = drive(cluster, pods, native=True, prefetch=False)
+    assert sched.metrics.counters.get("prefetch_dispatched_total", 0) == 0
+    assert sched.metrics.counters.get("native_scans_total", 0) > 0

@@ -82,6 +82,58 @@ class TestServeLoop:
             stop.set()
             t.join(timeout=5.0)
 
+    def test_scheduling_events_posted_over_real_http(self, server):
+        """Satellite (VERDICT r5 ask #2): the scheduler POSTs core/v1
+        Events over the live wire — Scheduled on bind, FailedScheduling
+        with the unschedulable reason the cycle trace carries — so
+        `kubectl describe pod` explains placement without scheduler
+        logs. Repeats of one verdict are deduplicated client-side."""
+        server.state.add_node("n1")
+        server.state.put_metrics(make_tpu_node("n1", chips=4).to_cr())
+        server.state.add_pod(pending_pod_manifest("ok", chips="2"))
+        # 99 chips can never fit the 4-chip node: permanently pending
+        server.state.add_pod(pending_pod_manifest("doomed", chips="99"))
+
+        client = KubeClient(server.url)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=run_scheduler_against_cluster,
+            args=(client, [(SchedulerConfig(), None)]),
+            kwargs={"metrics_port": None, "leader_elect": False,
+                    "poll_s": 0.05, "stop_event": stop},
+            daemon=True)
+        t.start()
+        try:
+            assert wait_for(lambda: (server.state.pod("ok") or {}).get(
+                "spec", {}).get("nodeName") == "n1"), "ok never bound"
+
+            def events_of(name, reason):
+                return [e for e in server.state.pod_events
+                        if e.get("involvedObject", {}).get("name") == name
+                        and e.get("reason") == reason]
+
+            # over REAL HTTP: the Scheduled event for the bound pod...
+            assert wait_for(lambda: events_of("ok", "Scheduled")), \
+                "no Scheduled event arrived"
+            ev = events_of("ok", "Scheduled")[0]
+            assert ev["type"] == "Normal"
+            assert "n1" in ev["message"]
+            assert ev["source"]["component"] == "yoda-tpu-scheduler"
+            # ...and the FailedScheduling event carrying the trace reason
+            assert wait_for(
+                lambda: events_of("doomed", "FailedScheduling")), \
+                "no FailedScheduling event arrived"
+            fev = events_of("doomed", "FailedScheduling")[0]
+            assert fev["type"] == "Warning"
+            assert "no feasible node" in fev["message"]
+            # the pod keeps retrying with the SAME verdict: dedup holds
+            # the event count at one per (pod, reason, message)
+            time.sleep(0.3)
+            assert len(events_of("doomed", "FailedScheduling")) == 1
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
     def test_multi_profile_serve_routes_both(self, server):
         server.state.add_node("n1")
         server.state.put_metrics(make_tpu_node("n1", chips=4).to_cr())

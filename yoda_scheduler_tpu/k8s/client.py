@@ -547,6 +547,13 @@ class KubeClient:
                 return None
             raise
 
+    def post_event(self, namespace: str, body: dict) -> None:
+        """POST a core/v1 Event (FailedScheduling / Scheduled — the
+        operator-facing trail kubectl describe pod shows). Best-effort
+        observability: callers run it off the hot path and swallow
+        failures; an event must never cost a bind."""
+        self.request("POST", f"/api/v1/namespaces/{namespace}/events", body)
+
     def bind(self, pod: Pod, node: str,
              assigned_chips: list | None = None) -> None:
         """POST the binding subresource. A 409 means the pod is already
@@ -924,6 +931,15 @@ class KubeCluster:
         self._bind_event = threading.Event()
         self._bind_threads: list[threading.Thread] | None = None
         self._bind_inflight = 0
+        # event-poster state (see post_event): one daemon thread drains
+        # the bounded queue; producers (engine + binder threads) append
+        # under self._lock
+        self._event_q: deque = deque()
+        self._event_event = threading.Event()
+        self._event_thread: threading.Thread | None = None
+        self._event_seen: dict = {}  # (pod key, reason) -> message
+        self.events_posted = 0
+        self.events_dropped = 0
         if self.watch_mode:
             self._reflectors = [
                 Reflector(client, "/api/v1/nodes",
@@ -944,6 +960,80 @@ class KubeCluster:
                           on_absent=self._namespace_absent,
                           metrics=self.metrics),
             ]
+
+    # ------------------------------------------------------------ pod events
+    def post_event(self, pod: Pod, reason: str, message: str,
+                   type_: str = "Normal") -> None:
+        """Queue a core/v1 Event for this pod (engine thread,
+        non-blocking): FailedScheduling with the unschedulable reason the
+        cycle trace carries, Scheduled on bind — what `kubectl describe
+        pod` surfaces to the operator. A dedicated daemon thread POSTs;
+        repeats of the same (pod, reason, message) are deduplicated
+        client-side (the apiserver would aggregate them anyway, and an
+        unschedulable pod retries for minutes), and a full queue drops
+        the event (counted) rather than stall scheduling."""
+        # uid in the key: a deleted-and-recreated pod (same name, new
+        # incarnation — the serve loop schedules it afresh) must get its
+        # own event trail even when the verdict text repeats
+        key = (pod.key, pod.k8s_uid, reason)
+        with self._lock:
+            # callers include binder threads (_async_bind_succeeded), not
+            # just the engine — the seen-map, queue cap, counters, and
+            # thread creation all need the cluster lock
+            if self._event_seen.get(key) == message:
+                return  # same verdict as last time: no new information
+            if len(self._event_q) >= 1024:
+                # dropped events are NOT recorded as seen: the pod's next
+                # identical verdict gets another chance once the queue
+                # drains
+                self.events_dropped += 1
+                return
+            self._event_seen[key] = message
+            while len(self._event_seen) > 4096:
+                self._event_seen.pop(next(iter(self._event_seen)))
+            self._event_q.append((key, pod.namespace, pod.name,
+                                  pod.k8s_uid, reason, message, type_))
+            if self._event_thread is None:
+                self._event_thread = threading.Thread(
+                    target=self._event_loop, daemon=True, name="eventer")
+                self._event_thread.start()
+        self._event_event.set()
+
+    def _event_loop(self) -> None:
+        seq = 0
+        while not self._stop.is_set():
+            self._event_event.wait(timeout=0.5)
+            self._event_event.clear()
+            while True:
+                try:
+                    key, ns, name, uid, reason, message, type_ = \
+                        self._event_q.popleft()
+                except IndexError:
+                    break
+                seq += 1
+                body = {
+                    "apiVersion": "v1", "kind": "Event",
+                    "metadata": {"name": f"{name}.{seq:x}.{id(self):x}",
+                                 "namespace": ns},
+                    "involvedObject": {"kind": "Pod", "name": name,
+                                       "namespace": ns, "uid": uid},
+                    "reason": reason, "message": message[:1024],
+                    "type": type_, "count": 1,
+                    "source": {"component": "yoda-tpu-scheduler"},
+                }
+                try:
+                    self.client.post_event(ns, body)
+                    with self._lock:
+                        self.events_posted += 1
+                except Exception:
+                    # best-effort: an apiserver brownout must not spin
+                    # this thread hot or back-pressure the engine — but
+                    # un-record the verdict so the pod's NEXT identical
+                    # retry re-posts instead of being deduplicated
+                    # against an event that never landed
+                    with self._lock:
+                        self.events_dropped += 1
+                        self._event_seen.pop(key, None)
 
     # --------------------------------------------------------- cluster events
     def subscribe(self, cb) -> None:
@@ -1334,6 +1424,7 @@ class KubeCluster:
         self.flush_binds(timeout=5.0)
         self._stop.set()
         self._bind_event.set()  # wake parked binder workers so they exit
+        self._event_event.set()  # and the (daemon) event poster
         # unblock reflectors parked in readline() so they observe the stop
         # event now rather than at their socket timeout
         close = getattr(self.client, "close_streams", None)

@@ -92,11 +92,11 @@ class ColumnarTable:
             self._label_classes.append(dict(labels))
         return hit
 
-    def selector_mask(self, selector: dict, rows=None):
-        """Rows whose node labels satisfy an exact-match nodeSelector.
-        Label classes are few, so the per-class check is done once and the
-        verdict broadcast through the class-id column (whole table, or
-        the given row subset)."""
+    def selector_classes(self, selector: dict):
+        """Per-label-CLASS verdict vector for an exact-match nodeSelector
+        (index = class id). The native fused kernel consumes this
+        directly (one byte per class, broadcast through the class-id
+        column inside the kernel); selector_mask broadcasts it here."""
         key = (tuple(sorted(selector.items())), len(self._label_classes))
         by_class = self._sel_cache.get(key)
         if by_class is None:
@@ -107,6 +107,14 @@ class ColumnarTable:
             if len(self._sel_cache) > 64:
                 self._sel_cache.clear()
             self._sel_cache[key] = by_class
+        return by_class
+
+    def selector_mask(self, selector: dict, rows=None):
+        """Rows whose node labels satisfy an exact-match nodeSelector.
+        Label classes are few, so the per-class check is done once and the
+        verdict broadcast through the class-id column (whole table, or
+        the given row subset)."""
+        by_class = self.selector_classes(selector)
         lc = self.label_class if rows is None else self.label_class[rows]
         return by_class[lc]
 

@@ -40,6 +40,19 @@ def _columnar_default() -> bool:
         "0", "false", "off")
 
 
+def _native_plane_default() -> bool:
+    """Opt-out knob for the native data plane (scheduler/nativeplane.py).
+    YODA_NATIVE_PLANE=0 restores the numpy columnar path end-to-end —
+    CI runs the tier-1 suite under both values."""
+    return os.environ.get("YODA_NATIVE_PLANE", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _native_prefetch_default() -> bool:
+    return os.environ.get("YODA_NATIVE_PREFETCH", "1").lower() not in (
+        "0", "false", "off")
+
+
 @dataclass(frozen=True)
 class ScoreWeights:
     """Per-attribute weights for the telemetry score.
@@ -112,6 +125,20 @@ class SchedulerConfig:
     # as the fallback (non-vectorizable plugins/pods) and ground truth;
     # False — or env YODA_COLUMNAR=0 — restores it end-to-end.
     columnar: bool = field(default_factory=_columnar_default)
+    # native data plane: run the memo-miss full filter+score scan as ONE
+    # GIL-releasing call into the fused C++ kernel (native/fusedplane.cc
+    # via scheduler/nativeplane.py), consuming the columnar table's
+    # arrays zero-copy. Requires the columnar plane; a missing or stale
+    # libyodaplace.so degrades silently (native_plane_active gauge 0).
+    # False — or env YODA_NATIVE_PLANE=0 — restores the numpy columnar
+    # path exactly (fallback chain: native -> numpy columnar -> scalar).
+    native_plane: bool = field(default_factory=_native_plane_default)
+    # overlapped scan prefetch: while a pod commits/binds, a worker
+    # thread runs the NEXT queue head's memo-miss fused scan against the
+    # current snapshot version, validated at consume time by the
+    # change-log version vector (stale -> discarded and counted). Only
+    # meaningful with the native plane active.
+    native_prefetch: bool = field(default_factory=_native_prefetch_default)
     # fragmentation-aware packing weight (plugins/score.py
     # FragmentationScore): steer 1-chip pods away from nodes whose free
     # set is down to its LAST pair, so 2-chip jobs keep finding pairs
@@ -184,6 +211,10 @@ class SchedulerConfig:
             pod_hinted_backoff_s=float(args.get(
                 "podHintedBackoffSeconds", defaults.pod_hinted_backoff_s)),
             columnar=bool(args.get("columnar", defaults.columnar)),
+            native_plane=bool(args.get("nativePlane",
+                                       defaults.native_plane)),
+            native_prefetch=bool(args.get("nativePrefetch",
+                                          defaults.native_prefetch)),
             fragmentation_weight=int(args.get(
                 "fragmentationWeight", defaults.fragmentation_weight)),
             batch_max_pods=max(int(args.get(

@@ -221,6 +221,23 @@ class _BatchCtx:
             setattr(self, k, v)
 
 
+class _NativeCycle:
+    """One fused native scan's outputs, reshaped for the engine: the
+    candidate NodeInfos in scan order, the per-plugin raw score dicts
+    (kernel-born for native scorers), the MaxValue fold + per-candidate
+    contributions for MaxCollection.native_install, and — when EVERY
+    active scorer was native — the fused normalize+weighted totals."""
+
+    __slots__ = ("feasible", "names_set", "checked", "mv6", "contribs",
+                 "raws", "totals", "scorers")
+
+
+# _native_scan verdict: the kernel ran and found ZERO feasible rows —
+# final (the numpy mask would agree), so the engine skips the numpy
+# attempt and hands the pod to the scalar scan for its diagnostics
+_NATIVE_EMPTY = object()
+
+
 class Scheduler:
     def __init__(
         self,
@@ -402,6 +419,24 @@ class Scheduler:
             ColumnarTable(self.allocator)
             if HAVE_NUMPY and self.config.columnar
             and self.allocator is not None else None)
+        # native data plane (scheduler/nativeplane.py): the fused C++
+        # kernel running the memo-miss full scan in one GIL-releasing
+        # call. Requires the columnar table (it consumes those arrays
+        # zero-copy); a missing/stale/unbuildable .so degrades silently
+        # to the numpy path — the gauge records which plane is live.
+        self._native = None
+        # (tag, FusedResult) from the overlapped scan prefetch, awaiting
+        # consume-time validation against the live version vector
+        self._prefetched: tuple | None = None
+        if self._columnar is not None and self.config.native_plane:
+            try:
+                from .nativeplane import FusedPlane
+
+                self._native = FusedPlane.load()
+            except Exception:  # pragma: no cover - defensive: a broken
+                self._native = None  # ctypes env must not kill the engine
+        self.metrics.set_gauge("native_plane_active",
+                               1.0 if self._native is not None else 0.0)
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
@@ -864,6 +899,213 @@ class Scheduler:
             trace.filter_verdicts[ni.name] = "ok"
         self.metrics.inc("columnar_filter_cycles_total")
         return feasible
+
+    # ----------------------------------------------------------- native plane
+    def _native_args(self, state, pod, spec, filters, snapshot, vers,
+                     nodes, want, degraded):
+        """Assemble one fused-kernel request from the plugins' native
+        capability hooks. Returns (req, sel_by_class, tel_plugin,
+        frag_plugin, scorers, all_native), or None when any active
+        filter vetoes — the pod then takes the numpy columnar path.
+        Shared by the in-cycle scan and the prefetch dispatcher, so a
+        consumed prefetch is built from EXACTLY the args the cycle
+        would have built (version-vector equality pins the rest)."""
+        table = self._columnar
+        if not table.sync(snapshot, vers, self._changes_since_vers):
+            return None
+        if len(table) != len(nodes):
+            return None
+        now = state.read_or("now")
+        req = {
+            "degraded": 1 if degraded else 0,
+            "now": float(now if now is not None else time.time()),
+            "chips": int(spec.chips),
+            "min_free_mb": int(spec.min_free_mb),
+            "min_clock_mhz": int(spec.min_clock_mhz),
+            "start": self._filter_start % len(nodes),
+            "want": int(want),
+        }
+        sel = None
+        for p in filters:
+            hook = getattr(p, "native_filter_args", None)
+            a = hook(state, pod, table) if hook is not None else None
+            if a is None:
+                return None
+            s = a.pop("sel_by_class", None)
+            if s is not None:
+                sel = s
+            req.update(a)
+        scorers = self._gated_scorers(pod, snapshot, degraded)
+        # kernel telemetry scoring divides by the kernel's own MaxValue
+        # fold, which stands in for MaxCollection's pre_score — a
+        # profile without a native_install-capable prescore plugin keeps
+        # telemetry scoring on the Python path (whatever writes MAX_KEY
+        # there owns the maxima)
+        has_installer = any(
+            getattr(p, "native_install", None) is not None
+            for p in self.profile.pre_score)
+        tel_p = frag_p = None
+        for p in scorers:
+            hook = getattr(p, "native_score_args", None)
+            a = hook(state, pod, table) if hook is not None else None
+            if a is None:
+                continue  # Python-fold scorer (topology, admission)
+            kind = a.pop("kind", None)
+            if kind == "telemetry" and tel_p is None and has_installer:
+                tel_p = p
+                req.update(a)
+                req["tel_score"] = 1
+            elif kind == "fragmentation" and frag_p is None:
+                frag_p = p
+                req.update(a)
+                req["frag_score"] = 1
+        # the kernel's fused normalize+weighted sum folds tel then frag;
+        # it may stand in for _fold_scores only when the gated scorer
+        # set is exactly those plugins IN THAT ORDER (float addition is
+        # order-sensitive; mixed cycles fold in Python instead)
+        native = [p for p in (tel_p, frag_p) if p is not None]
+        req["compute_totals"] = 1 if scorers == native else 0
+        return req, sel, tel_p, frag_p, scorers, scorers == native
+
+    def _native_scan(self, state, pod, spec, filters, snapshot, vers,
+                     nodes, want, degraded):
+        """One fused native cycle: consume a validated prefetch or run
+        the kernel inline. Returns a _NativeCycle, _NATIVE_EMPTY (zero
+        feasible — final), or None (veto/failure: numpy path next)."""
+        args = self._native_args(state, pod, spec, filters, snapshot,
+                                 vers, nodes, want, degraded)
+        if args is None:
+            self.metrics.inc("native_fallbacks_total")
+            return None
+        req, sel, tel_p, frag_p, scorers, all_native = args
+        res = None
+        pf = self._prefetched
+        if pf is not None:
+            tag, pres = pf
+            self._prefetched = None
+            # consume-time validation: same pod object, same version
+            # vector (⇒ same snapshot, table, spec-derived args), same
+            # scan window and regime, and no heartbeat aged past the
+            # staleness gate since dispatch. ANYTHING else → discard and
+            # count, exactly like the batch-conflict fallback.
+            if (pres is not None and tag[0] is pod and tag[1] == vers
+                    and tag[2] == req["start"] and tag[3] == want
+                    and tag[4] == bool(degraded) and tag[5] == len(nodes)
+                    and self._prefetch_fresh(req)):
+                res = pres
+                self.metrics.inc("prefetch_hits_total")
+            else:
+                self.metrics.inc("prefetch_stale_total")
+        if res is None:
+            res = self._native.run(self._columnar, req, sel_by_class=sel)
+        if res is None:
+            self.metrics.inc("native_fallbacks_total")
+            return None
+        self.metrics.inc("native_scans_total")
+        if res.found == 0:
+            return _NATIVE_EMPTY
+        feasible = [nodes[i] for i in res.rows]
+        names = [ni.name for ni in feasible]
+        nc = _NativeCycle()
+        nc.feasible = feasible
+        nc.names_set = frozenset(names)
+        nc.checked = res.checked
+        nc.mv6 = res.mv6
+        nc.contribs = {
+            name: (tuple(res.contrib[k]) if res.qcount[k] else None)
+            for k, name in enumerate(names)}
+        raws = {}
+        if tel_p is not None:
+            raws[tel_p.name] = dict(zip(names, res.tel))
+        if frag_p is not None:
+            raws[frag_p.name] = dict(zip(names, res.frag))
+        nc.raws = raws
+        nc.totals = dict(zip(names, res.totals)) if all_native else None
+        nc.scorers = scorers
+        return nc
+
+    def _prefetch_fresh(self, req: dict) -> bool:
+        """May a prefetched mask stand in for a fresh scan, staleness-
+        wise? Age only grows, so a node stale at DISPATCH is still stale
+        now — the one divergence is a node whose heartbeat aged past the
+        gate BETWEEN dispatch and consume. When even the oldest stored
+        heartbeat is fresh at consume time, no such node exists."""
+        if not req.get("tel_filter") or req.get("degraded"):
+            return True
+        floor_fn = getattr(self.cluster.telemetry, "heartbeat_floor", None)
+        if floor_fn is None:
+            return False
+        floor = floor_fn()
+        return floor is None or (req["now"] - floor) <= req["max_age"]
+
+    def _dispatch_prefetch(self) -> None:
+        """Overlapped scan prefetch (run_one, after each cycle): while
+        the finished cycle's bind is still on the wire — and reflector
+        threads ingest — the worker runs the NEXT queue head's memo-miss
+        fused scan against the snapshot version just produced. The scan
+        releases the GIL, so this costs the engine thread nothing but
+        the dispatch bookkeeping; _native_scan validates the result by
+        version vector at consume time. Only memo-MISS heads are worth
+        prefetching: a class with a live feasible/unschedulable memo
+        entry repairs in O(dirty) anyway."""
+        plane = self._native
+        if plane.inflight:
+            return
+        if self._prefetched is not None:
+            # the cycle that just finished never reached the consume
+            # point (memo hit, veto, gang/nominated pod, different head):
+            # the banked result's tag can no longer match a future cycle
+            # once this cycle moved the version vector — discard it now
+            # so its buffers unpin and prefetching resumes, and count it
+            # like any other stale result
+            self._prefetched = None
+            self.metrics.inc("prefetch_stale_total")
+        now = self.clock.time()
+        if now < self._breaker_until:
+            return
+        info = self.queue.peek(now)
+        if info is None:
+            return
+        pod = info.pod
+        try:
+            spec = spec_for(pod)
+        except LabelError:
+            return
+        if spec.is_gang:
+            return
+        if self.allocator is not None \
+                and self.allocator.nomination_of(pod.key) is not None:
+            return
+        memo_key = self._memo_key_of(pod, spec)
+        if memo_key in self._feas_memo or memo_key in self._unsched_memo:
+            return
+        vers = self._cluster_versions()
+        if vers is None:
+            return
+        snapshot = self.snapshot()
+        nodes = snapshot.list()
+        if not nodes:
+            return
+        degraded = self._detect_degraded(now)
+        state = CycleState()
+        state.write("now", now)
+        state.write("workload_spec", spec)
+        state.write("snapshot", snapshot)
+        state.write("cycle_versions", vers)
+        if degraded:
+            state.write("degraded", True)
+        filters = [p for p in self.profile.filter
+                   if getattr(p, "relevant", None) is None
+                   or p.relevant(pod, snapshot)]
+        want = self._num_feasible_to_find(len(nodes))
+        args = self._native_args(state, pod, spec, filters, snapshot,
+                                 vers, nodes, want, degraded)
+        if args is None:
+            return
+        req, sel = args[0], args[1]
+        tag = (pod, vers, req["start"], want, bool(degraded), len(nodes))
+        plane.prefetch_submit(tag, self._columnar, req, sel_by_class=sel)
+        self.metrics.inc("prefetch_dispatched_total")
 
     # --------------------------------------------------------------- snapshot
     def snapshot(self) -> Snapshot:
@@ -1385,8 +1627,49 @@ class Scheduler:
             self._score_memo.pop(ctx.memo_key, None)
         return handled
 
+    def _detect_degraded(self, now: float) -> bool:
+        """Telemetry-blackout verdict for one cycle (side-effect-free;
+        the regime-flip bookkeeping stays in _schedule_one_locked). Also
+        used by the prefetch dispatcher, whose scan must run under the
+        same regime the consuming cycle will detect."""
+        if not self.config.degraded_mode:
+            return False
+        ceil_fn = getattr(self.cluster.telemetry, "heartbeat_ceiling", None)
+        if ceil_fn is None:
+            return False
+        ceil = ceil_fn()
+        return (ceil is not None
+                and (now - ceil) > self.config.telemetry_max_age_s)
+
+    def _gated_scorers(self, pod, snapshot, degraded: bool) -> list:
+        """The cycle's effective scorer set: degraded mode drops
+        telemetry-dependent scorers, relevance gates drop plugins that
+        cannot move this pod's ranking. One definition — the scoring
+        section and the native scan must agree or their folds diverge."""
+        scorers = []
+        for p in self.profile.score:
+            if degraded and getattr(p, "telemetry_dependent", False):
+                # blackout degraded mode: stale quality numbers would
+                # rank nodes on noise — capacity/topology scorers carry
+                # the placement until the feed recovers
+                continue
+            gate = getattr(p, "score_relevant", None)
+            if gate is None:
+                gate = getattr(p, "relevant", None)
+            if gate is None or gate(pod, snapshot):
+                scorers.append(p)
+        return scorers
+
     def _schedule_one_locked(self, info: QueuedPodInfo,
                              batch_ctx: "_BatchCtx | None" = None) -> str:
+        if self._native is not None and self._native.inflight:
+            # thread-safety contract (nativeplane.py): the table must be
+            # quiescent before this cycle's first sync — wait for the
+            # in-flight prefetch scan (sub-ms) and bank its result for
+            # the consume-time validation in _native_scan
+            got = self._native.prefetch_wait()
+            if got is not None:
+                self._prefetched = got
         pod = info.pod
         now = self.clock.time()
         trace = CycleTrace(pod=pod.key, started=now)
@@ -1419,14 +1702,7 @@ class Scheduler:
         # of marking every node stale-infeasible. Detected per cycle; a
         # regime flip clears the class memos, because staleness verdicts
         # change with TIME and no version vector records the transition.
-        degraded = False
-        if self.config.degraded_mode:
-            ceil_fn = getattr(self.cluster.telemetry, "heartbeat_ceiling",
-                              None)
-            if ceil_fn is not None:
-                ceil = ceil_fn()
-                degraded = (ceil is not None and
-                            (now - ceil) > self.config.telemetry_max_age_s)
+        degraded = self._detect_degraded(now)
         if degraded != self._degraded:
             self._degraded = degraded
             self._unsched_memo.clear()
@@ -1583,6 +1859,40 @@ class Scheduler:
                                 info, trace, hit[1],
                                 rejected_by=tuple(combined))
 
+        # native fused scan: when every active filter AND prescore can be
+        # expressed in the fused kernel, the memo-miss full scan — filter
+        # mask, rotating early-stop top-k, MaxValue fold, native scorers'
+        # raw terms — collapses into ONE GIL-releasing C call over the
+        # columnar arrays (scheduler/nativeplane.py). Same gates as the
+        # numpy path below; any veto falls through to numpy columnar,
+        # then scalar — the fallback chain is scalar <- numpy <- native,
+        # each layer the ground truth of the one above.
+        nat: "_NativeCycle | None" = None
+        native_empty = False
+        if (feasible is None and self._native is not None
+                and vers is not None and nom is None and not spec.is_gang
+                and nodes and state.read_or(CANDIDATE_NODES_KEY) is None):
+            out = self._native_scan(state, pod, spec, filters, snapshot,
+                                    vers, nodes, want, degraded)
+            if out is _NATIVE_EMPTY:
+                # zero feasible rows, verdict-final (the numpy mask would
+                # agree bit-for-bit): skip the redundant numpy scan; the
+                # scalar loop below owns the per-node failure diagnostics
+                # and _filter_start deliberately stays unadvanced
+                native_empty = True
+            elif out is not None:
+                nat = out
+                feasible = nat.feasible
+                for ni in feasible:
+                    trace.filter_verdicts[ni.name] = "ok"
+                self._filter_start = (self._filter_start % len(nodes)
+                                      + nat.checked) % len(nodes)
+                if feas_ok:
+                    if len(self._feas_memo) > 256:
+                        self._feas_memo.clear()
+                    self._feas_memo[memo_key] = self._feas_entry(
+                        vers, feasible)
+
         # columnar full scan: when every active filter can express this
         # pod's predicates over the node table, the whole cluster is
         # evaluated in a handful of numpy calls instead of a per-(pod,
@@ -1590,7 +1900,8 @@ class Scheduler:
         # the columns can't see (nomination ordering, PreFilter candidate
         # narrowing, gang membership); zero-pass and every bail-out fall
         # through to the scalar scan below, which remains ground truth.
-        if (feasible is None and self._columnar is not None
+        if (feasible is None and not native_empty
+                and self._columnar is not None
                 and vers is not None and nom is None and not spec.is_gang
                 and nodes and state.read_or(CANDIDATE_NODES_KEY) is None):
             feasible = self._columnar_filter(state, pod, filters, snapshot,
@@ -1709,6 +2020,15 @@ class Scheduler:
                     and len(fent[1]) == len(feasible)):
                 state.write("feasible_names", fent[2])
         for p in self.profile.pre_score:
+            if nat is not None:
+                inst = getattr(p, "native_install", None)
+                if inst is not None:
+                    # the fused kernel already folded this plugin's
+                    # output (MaxValue + per-candidate contributions):
+                    # install it exactly where pre_score would leave it
+                    inst(state, spec, vers, nat.names_set, nat.contribs,
+                         nat.mv6)
+                    continue
             st = p.pre_score(state, pod, feasible)
             if st.code == Code.ERROR:
                 return self._cycle_error(info, trace, st.message)
@@ -1718,18 +2038,8 @@ class Scheduler:
         # score_relevant when its scoring inputs are narrower than its
         # filtering inputs)
         totals: dict[str, float] = {n.name: 0.0 for n in feasible}
-        scorers = []
-        for p in self.profile.score:
-            if degraded and getattr(p, "telemetry_dependent", False):
-                # blackout degraded mode: stale quality numbers would
-                # rank nodes on noise — capacity/topology scorers carry
-                # the placement until the feed recovers
-                continue
-            gate = getattr(p, "score_relevant", None)
-            if gate is None:
-                gate = getattr(p, "relevant", None)
-            if gate is None or gate(pod, snapshot):
-                scorers.append(p)
+        scorers = (nat.scorers if nat is not None
+                   else self._gated_scorers(pod, snapshot, degraded))
 
         # SCORE-class memo: a classmate's raw per-plugin scores are
         # verbatim repeats for every node the change logs call clean —
@@ -1767,12 +2077,24 @@ class Scheduler:
         # memo-miss cycle that can use batch scoring (sync is idempotent
         # per version vector — the repair path usually already paid it)
         col_rows = None
-        if (dirty_s is None and self._columnar is not None
+        if (nat is None and dirty_s is None and self._columnar is not None
                 and vers is not None and scorers):
             if self._columnar.sync(snapshot, vers, self._changes_since_vers):
                 col_rows = self._columnar.rows_for(feasible)
         raws: dict[str, dict[str, float]] = {}
         for p in scorers:
+            if nat is not None:
+                nraw = nat.raws.get(p.name)
+                if nraw is not None:
+                    # raw terms straight from the fused kernel; the fold
+                    # stays in profile order so mixed native/Python
+                    # cycles accumulate bit-identically. When EVERY
+                    # scorer was native the kernel also fused
+                    # normalize+sum (nat.totals, applied below).
+                    raws[p.name] = nraw
+                    if nat.totals is None:
+                        self._fold_scores(state, pod, p, nraw, totals)
+                    continue
             raw: dict[str, float] = {}
             if col_rows is not None:
                 sb = getattr(p, "score_batch", None)
@@ -1804,6 +2126,8 @@ class Scheduler:
                 raw[name] = s
             raws[p.name] = raw
             self._fold_scores(state, pod, p, raw, totals)
+        if nat is not None and nat.totals is not None:
+            totals = nat.totals
         if repairable and vers is not None:
             if len(self._score_memo) > 256:
                 self._score_memo.clear()
@@ -2133,8 +2457,24 @@ class Scheduler:
         self.metrics.observe(
             "schedule_latency_ms_class_" + workload_class(pod), e2e_ms)
         self.metrics.inc("pods_scheduled_total")
+        if not dispatched_async:
+            # Scheduled is posted on WIRE success only (upstream posts it
+            # after the binding subresource lands): sync binds and adopted
+            # ambiguous binds are proven here; async dispatches post from
+            # _async_bind_succeeded, so a terminal wire failure never
+            # leaves a false Scheduled trail behind a Pending pod
+            self._post_scheduled_event(pod, node)
         self._finish(trace, "bound", node=node)
         return True
+
+    def _post_scheduled_event(self, pod, node: str) -> None:
+        post = getattr(self.cluster, "post_event", None)
+        if post is not None:
+            try:
+                post(pod, "Scheduled",
+                     f"Successfully assigned {pod.key} to {node}")
+            except Exception:
+                pass  # observability must never fail a bind
 
     def _async_bind_succeeded(self, pod, node) -> None:
         """on_success callback for dispatched binds, run on a BINDER
@@ -2146,6 +2486,7 @@ class Scheduler:
         themselves stay engine-thread-only)."""
         if self.allocator is not None:
             self.allocator.unnominate(pod.key)
+        self._post_scheduled_event(pod, node)  # wire-proven, like the sync path
         self._bind_results.append(None)
 
     @staticmethod
@@ -2239,6 +2580,7 @@ class Scheduler:
                     if self.allocator is not None:
                         self.allocator.unnominate(pod.key)
                     self.metrics.inc("ambiguous_bind_recoveries_total")
+                    self._post_scheduled_event(pod, node)  # landed after all
                     self._breaker_success()
                     continue
             self._breaker_failure(err)
@@ -2262,6 +2604,16 @@ class Scheduler:
                        outcome: str = "unschedulable",
                        rejected_by: tuple = ()) -> str:
         info.last_failure = reason
+        # operator-facing trail (kubectl describe pod): backends with a
+        # wire (KubeCluster) POST a FailedScheduling Event carrying the
+        # same reason the cycle trace records — deduplicated and queued
+        # off-thread there, a no-op on in-memory fakes
+        post = getattr(self.cluster, "post_event", None)
+        if post is not None:
+            try:
+                post(info.pod, "FailedScheduling", reason, type_="Warning")
+            except Exception:
+                pass  # observability must never fail the cycle
         if self.allocator is not None:
             nom = self.allocator.nomination_of(info.pod.key)
             if (nom is not None and trace.filter_verdicts.get(nom[0]) != "ok"
@@ -2573,6 +2925,15 @@ class Scheduler:
             self._ok_since_crash = True
         self.metrics.observe("cycle_latency_ms",
                              (self.clock.time() - started) * 1e3)
+        if self._native is not None and self.config.native_prefetch:
+            # best-effort, and CONTAINED like the cycle itself: a raising
+            # capability hook here runs outside the per-pod crash
+            # containment, and the completed cycle's real outcome must
+            # not be replaced by an escaping dispatch error
+            try:
+                self._dispatch_prefetch()
+            except Exception:
+                self.metrics.inc("prefetch_dispatch_errors_total")
         return outcome
 
     def next_wake_at(self) -> float | None:

@@ -469,6 +469,17 @@ class FilterPlugin(Plugin):
         only dirty nodes."""
         return None
 
+    def native_filter_args(self, state: CycleState, pod: Pod, table):
+        """Native-data-plane capability hook (scheduler/nativeplane.py):
+        return the fused kernel's predicate parameters for this pod — a
+        dict of YodaPlaneReq fields (native/fusedplane.cc) — or None
+        when this plugin/pod combination cannot be expressed there. A
+        single None sends the WHOLE pod down the numpy-columnar (then
+        scalar) fallback chain; the kernel's verdicts must be
+        bit-identical to `filter`'s booleans for the pods it accepts
+        (parity pinned by tests/test_native_plane.py)."""
+        return None
+
 
 class PostFilterPlugin(Plugin):
     """Runs when no node passed Filter — the preemption hook (what PostFilter
@@ -501,6 +512,15 @@ class PreScorePlugin(Plugin):
     # implementations.
     pre_score_update = None
 
+    # Native-data-plane capability hook. None = the fused kernel cannot
+    # stand in for this plugin's pre_score, so the engine runs pre_score
+    # normally even on native cycles. The one implementation
+    # (MaxCollection.native_install) takes (state, spec, vers, names,
+    # contribs, mv6) — the kernel's per-candidate qualifying maxima and
+    # MaxValue fold — and must leave cycle state and its own memos
+    # exactly as a fresh pre_score call would.
+    native_install = None
+
 
 class ScorePlugin(Plugin):
     weight: int = 1
@@ -528,6 +548,16 @@ class ScorePlugin(Plugin):
         `table`, one per feasible candidate) — bit-identical to calling
         `score` per node — or None to keep the scalar loop. Normalize and
         the weighted sum still run on the full raw vector either way."""
+        return None
+
+    def native_score_args(self, state: CycleState, pod: Pod, table):
+        """Native-data-plane capability hook: return the fused kernel's
+        scoring parameters ({"kind": ..., weights...} — see
+        scheduler/nativeplane.py) or None to keep this plugin's scores
+        on the Python path (the engine folds kernel-born and
+        Python-born raw vectors in profile order, so a mixed cycle
+        stays bit-identical). Kernel raw terms must match `score`
+        bit-for-bit for the pods this hook accepts."""
         return None
 
     def normalize(self, state: CycleState, pod: Pod, scores: dict[str, float]) -> None:

@@ -631,6 +631,32 @@ class NodeAdmission(FilterPlugin, ScorePlugin, EnqueueExtensions):
                 or snapshot.any_preferred_pod_affinity()
                 or snapshot.any_taints())
 
+    def _fast_checks_only(self, pod: Pod, snapshot) -> bool:
+        """True when cordon + nodeSelector are the ONLY admission
+        predicates that can fire for this pod on this snapshot — the
+        eligibility gate shared by filter_batch and the native kernel."""
+        return not (pod.node_affinity or pod.pod_affinity
+                    or pod.pod_anti_affinity
+                    or pod.topology_spread or pod.host_ports
+                    or ((pod.cpu_millis or pod.memory_bytes)
+                        and snapshot.any_allocatable())
+                    or snapshot.any_taints()
+                    or snapshot.any_pod_anti_affinity())
+
+    def native_filter_args(self, state: CycleState, pod: Pod, table):
+        """Fused-kernel capability hook: cordon flag + the per-label-class
+        nodeSelector verdict vector, evaluated inside the kernel. Veto
+        set identical to filter_batch's."""
+        snapshot = state.read_or("snapshot")
+        if snapshot is None or not self._fast_checks_only(pod, snapshot):
+            return None
+        args = {}
+        if not _tolerates_cordon(pod):
+            args["check_cordon"] = 1
+        if pod.node_selector:
+            args["sel_by_class"] = table.selector_classes(pod.node_selector)
+        return args
+
     def filter_batch(self, state: CycleState, pod: Pod, table, rows=None):
         """Columnar verdicts for the admission FAST checks — cordon flag
         and exact-match nodeSelector, the two predicates expressible over
@@ -642,12 +668,7 @@ class NodeAdmission(FilterPlugin, ScorePlugin, EnqueueExtensions):
         snapshot = state.read_or("snapshot")
         if snapshot is None:
             return None
-        if (pod.node_affinity or pod.pod_affinity or pod.pod_anti_affinity
-                or pod.topology_spread or pod.host_ports
-                or ((pod.cpu_millis or pod.memory_bytes)
-                    and snapshot.any_allocatable())
-                or snapshot.any_taints()
-                or snapshot.any_pod_anti_affinity()):
+        if not self._fast_checks_only(pod, snapshot):
             return None
         ok = _np.ones(len(table) if rows is None else len(rows), dtype=bool)
         if not _tolerates_cordon(pod):

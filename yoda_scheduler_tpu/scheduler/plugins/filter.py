@@ -120,6 +120,28 @@ class TelemetryFilter(FilterPlugin, EnqueueExtensions):
             return QUEUE
         return SKIP
 
+    def native_filter_args(self, state: CycleState, pod, table):
+        """Fused-kernel capability hook (framework.FilterPlugin): the
+        same predicate PARAMETERS filter_batch evaluates, handed to the
+        native kernel instead of computed in numpy. The veto set is
+        filter_batch's exactly — anything the columns don't express
+        keeps the pod off the native path entirely."""
+        spec: WorkloadSpec = state.read("workload_spec")
+        if spec.is_gang or spec.topology is not None:
+            return None
+        if self.require_contiguous and spec.chips > 1:
+            return None
+        if self.allocator.has_holds():
+            return None
+        args = {"tel_filter": 1, "max_age": float(self.max_age)}
+        if spec.accelerator is not None:
+            args["use_accel"] = 1
+            args["accel_id"] = table.intern_of(spec.accelerator)
+        if spec.tpu_generation is not None:
+            args["use_gen"] = 1
+            args["gen_id"] = table.intern_of(spec.tpu_generation)
+        return args
+
     def filter_batch(self, state: CycleState, pod, table, rows=None):
         """Columnar verdicts for the capacity/staleness predicates —
         one boolean per node (whole table, or the `rows` subset the

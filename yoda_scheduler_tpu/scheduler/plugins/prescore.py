@@ -170,6 +170,22 @@ class MaxCollection(PreScorePlugin):
 
     _MISS = object()
 
+    def native_install(self, state: CycleState, spec, vers, names,
+                       contribs: dict, mv6: tuple) -> None:
+        """Fused-kernel PreScore twin (framework.PreScorePlugin): the
+        native cycle already folded the per-candidate qualifying maxima
+        and the cluster MaxValue inside the kernel — integer ops, exact
+        in both languages, so the result equals pre_score's full walk by
+        construction (pinned by tests/test_native_plane.py). Install it
+        exactly where pre_score would leave it: the cycle-state MAX_KEY
+        and this plugin's per-spec contributor memo, so the NEXT
+        classmate (native or not) repairs incrementally from here."""
+        if vers is not None:
+            if len(self._memo) > 256:
+                self._memo.clear()
+            self._memo[spec] = (vers, contribs, names, mv6)
+        state.write(MAX_KEY, MaxValue(*mv6))
+
     def pre_score_update(self, state: CycleState, pod, node_info,
                          names) -> bool:
         """Batch-commit hook (framework.PreScorePlugin): one classmate

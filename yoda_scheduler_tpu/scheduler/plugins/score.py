@@ -167,6 +167,22 @@ class TelemetryScore(ScorePlugin):
             self._basic_cache[node.name] = (bkey, basic)
         return basic + aa, Status.success()
 
+    def native_score_args(self, state: CycleState, pod, table):
+        """Fused-kernel capability hook (framework.ScorePlugin): the
+        ScoreWeights the kernel folds into basic + allocate + actual,
+        written there op-for-op like score_batch below. Veto (None) when
+        the duty-cycle penalty is enabled — same reason score_batch
+        bails: its fold order is the scalar path's, not the kernel's."""
+        if self.weights.duty_cycle:
+            return None
+        w = self.weights
+        return {"kind": "telemetry",
+                "w_bw": float(w.bandwidth), "w_clock": float(w.clock),
+                "w_core": float(w.core), "w_power": float(w.power),
+                "w_fm": float(w.free_memory), "w_tm": float(w.total_memory),
+                "w_alloc": float(w.allocate), "w_actual": float(w.actual),
+                "tel_weight": float(self.weight)}
+
     def score_batch(self, state: CycleState, pod, table, rows):
         """Columnar raw scores: basic + allocate + actual for every
         candidate row in one set of array ops. Arithmetic is written in
@@ -256,6 +272,14 @@ class FragmentationScore(ScorePlugin):
     def __init__(self, allocator: ChipAllocator, weight: int = 1) -> None:
         self.allocator = allocator
         self.weight = weight
+
+    def native_score_args(self, state: CycleState, pod, table):
+        """Fused-kernel capability hook: the last-pair penalty is one
+        comparison over the free-count column — always expressible."""
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        return {"kind": "fragmentation",
+                "frag_single": 1 if spec.chips == 1 else 0,
+                "frag_weight": float(self.weight)}
 
     def score_relevant(self, pod, snapshot) -> bool:
         """Hot-loop gate (core.py): the term only moves for SINGLE-chip

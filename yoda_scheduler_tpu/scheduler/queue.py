@@ -327,6 +327,28 @@ class SchedulingQueue:
             self._metrics.inc("requeue_wakeups_total", woken)
         return woken
 
+    def peek(self, now: float | None = None) -> QueuedPodInfo | None:
+        """Highest-priority READY pod without consuming it — the
+        overlapped-prefetch dispatcher asks what the next cycle will
+        schedule. Engine-thread-only, like pop. Drains the inbox and
+        backoff flush exactly as pop would (so the answer matches the
+        next pop), but burns no attempt and leaves the entry queued.
+        Comparator-scan mode (no heap key) returns None: peeking there
+        would cost a full scan per cycle for a hint."""
+        now = time.time() if now is None else now
+        if self._inbox:
+            self._drain_inbox(now)
+        self._flush_backoff(now)
+        if not self._n_active or self._key is None:
+            return None
+        while self._active:
+            _, stint, info = self._active[0]
+            if self._active_ids.get(id(info)) != stint:
+                heapq.heappop(self._active)  # stale entry: discard
+                continue
+            return info
+        return None
+
     def pop(self, now: float | None = None) -> QueuedPodInfo | None:
         """Pop the highest-priority ready pod (None if all are backing off).
 

@@ -1,10 +1,12 @@
 """ctypes bridge to the native placement engine (native/placement.cc).
 
-Loads ``libyodaplace.so`` if present (``make native`` builds it; no
-build-time dependency otherwise) and exposes drop-in twins of the torus
-placement functions. torus.py routes through here automatically when the
-library is available; the pure-Python implementation remains the reference
-and the fallback.
+Loads ``libyodaplace.so`` through the shared hardened loader
+(utils/nativeloader.py — one dlopen serves this kernel and the fused
+scheduling kernel, each resolving its OWN symbol set so a stale library
+degrades per kernel, never process-wide) and exposes drop-in twins of
+the torus placement functions. torus.py routes through here
+automatically when the library is available; the pure-Python
+implementation remains the reference and the fallback.
 """
 
 from __future__ import annotations
@@ -13,29 +15,17 @@ import ctypes
 import os
 from functools import lru_cache
 
-_LIB_NAME = "libyodaplace.so"
+from ..utils import nativeloader
 
 
 @lru_cache(maxsize=1)
 def _lib():
-    path = os.path.join(os.path.dirname(__file__), "..", "..", "native", _LIB_NAME)
-    candidates = [
-        os.environ.get("YODA_PLACEMENT_LIB", ""),
-        os.path.abspath(path),
-        os.path.join(os.path.dirname(__file__), _LIB_NAME),
-    ]
-    for c in candidates:
-        if c and os.path.exists(c):
-            try:
-                lib = ctypes.CDLL(c)
-            except OSError:
-                continue
-            lib.yoda_best_fit.restype = ctypes.c_int
-            lib.yoda_fits_shape.restype = ctypes.c_int
-            lib.yoda_largest_free_block.restype = ctypes.c_int
-            lib.yoda_contiguity.restype = ctypes.c_double
-            return lib
-    return None
+    return nativeloader.bind_symbols({
+        "yoda_best_fit": (ctypes.c_int, None),
+        "yoda_fits_shape": (ctypes.c_int, None),
+        "yoda_largest_free_block": (ctypes.c_int, None),
+        "yoda_contiguity": (ctypes.c_double, None),
+    })
 
 
 def available() -> bool:
