@@ -392,6 +392,62 @@ class _Handler(BaseHTTPRequestHandler):
                 last = batch[-1][0]
 
     # ------------------------------------------------------------ pod verbs
+    def _bind_conflict(self, body: dict, pod: dict) -> str | None:
+        """Server-side bind-time conflict semantics (caller holds
+        state.cond; the already-bound-pod 409 is checked by the caller).
+        Optimistic fleet commits are checked by the AUTHORITY, not just
+        engine bookkeeping: an overlapping chip claim on the target node,
+        a per-chip HBM claim past the chip's reported free HBM, or a
+        stale fencing token (lease reassigned since the replica last
+        renewed) all return a 409 message; None = the bind may proceed."""
+        s = self.state
+        node = body.get("target", {}).get("name", "")
+        ann = body.get("metadata", {}).get("annotations", {}) or {}
+        fence = ann.get("yoda.tpu/fence")
+        if fence:
+            try:
+                lease_name, holder, epoch = fence.rsplit("/", 2)
+            except ValueError:
+                return f"malformed fencing token {fence!r}"
+            lease = s.leases.get(lease_name)
+            spec = (lease or {}).get("spec", {})
+            if (lease is None or spec.get("holderIdentity") != holder
+                    or str(spec.get("leaseTransitions", 0)) != epoch):
+                return (f"stale fencing token {fence!r}: lease held by "
+                        f"{spec.get('holderIdentity')!r} at transition "
+                        f"{spec.get('leaseTransitions')}")
+        claim = ann.get("tpu/assigned-chips", "")
+        if not claim:
+            return None
+        claimed = {c for c in claim.split(";") if c}
+        for other in s.objects["pods"].values():
+            if other.get("spec", {}).get("nodeName") != node:
+                continue
+            theirs = other.get("metadata", {}).get(
+                "annotations", {}).get("tpu/assigned-chips", "")
+            overlap = claimed & {c for c in theirs.split(";") if c}
+            if overlap:
+                okey = _key(other)
+                return (f"chip claim conflict on {node}: {sorted(overlap)} "
+                        f"already owned by {okey}")
+        need_mb = int(pod.get("metadata", {}).get("labels", {}).get(
+            "scv/memory", "0") or 0)
+        if need_mb:
+            cr = s.objects["metrics"].get(node)
+            chips = (cr or {}).get("status", {}).get("chips", [])
+            by_coord = {}
+            for c in chips:
+                coords = c.get("coords")
+                if coords is not None:
+                    by_coord[",".join(str(x) for x in coords)] = c
+            for c in claimed:
+                chip = by_coord.get(c)
+                if chip is not None and need_mb > chip.get(
+                        "hbm_free_mb", 1 << 60):
+                    return (f"HBM oversubscription on {node}/{c}: need "
+                            f"{need_mb}MB")
+        return None
+
     def _pod_verb(self, method: str, ns: str, name: str, sub: str | None) -> None:
         s = self.state
         key = f"{ns}/{name}"
@@ -406,6 +462,10 @@ class _Handler(BaseHTTPRequestHandler):
                         "kind": "Status", "code": 409,
                         "message": f"pod {key} is already assigned to node "
                                    f"{pod['spec']['nodeName']}"})
+                conflict = self._bind_conflict(body, pod)
+                if conflict is not None:
+                    return self._json(409, {"kind": "Status", "code": 409,
+                                            "message": conflict})
                 s.bindings.append(body)
                 pod.setdefault("spec", {})["nodeName"] = body["target"]["name"]
                 # upstream parity (registry/core/pod assignPod): annotations

@@ -36,12 +36,17 @@ from yoda_scheduler_tpu.chaos import (
     CrashingReserve,
     CrashingScore,
     ENGINE_CRASH,
+    FLEET_KINDS,
     FaultPlan,
     FaultWindow,
+    LEASE_EXPIRY,
     PLUGIN_ERROR,
+    REPLICA_CRASH,
+    SPLIT_BRAIN,
     TELEMETRY_BLACKOUT,
 )
-from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler import (
+    FakeCluster, FleetCoordinator, Scheduler, SchedulerConfig)
 from yoda_scheduler_tpu.scheduler.core import FakeClock, default_profile
 from yoda_scheduler_tpu.scheduler.framework import ClusterEvent, POD_DELETED
 from yoda_scheduler_tpu.telemetry import (
@@ -258,6 +263,121 @@ def test_chaos_fuzz(seed):
     # actually intersected live cycles is seed-dependent — pods may all
     # bind before the window opens — so crash counters are asserted in
     # the targeted containment tests, not per fuzz seed.)
+
+
+# --------------------------------------------------------- fleet chaos fuzz
+_FLEET_SMOKE = 16
+_FLEET_FULL = 112  # >= 100 multi-replica scenarios in CI's chaos job
+
+
+def _fleet_seed_params():
+    return [s if s < _FLEET_SMOKE
+            else pytest.param(s, marks=pytest.mark.slow)
+            for s in range(_FLEET_FULL)]
+
+
+def _drive_fleet(fleet, plan, pods, rng):
+    """Run a replica fleet to convergence on its virtual clock, firing the
+    plan's fleet transitions the call sites can't inject: REPLICA_CRASH
+    (rebuild one replica + reconcile from cluster truth), LEASE_EXPIRY
+    (revoke one replica's shard leases mid-drain), and SPLIT_BRAIN
+    (duplicate-replica injection: every pod the chosen replica is
+    working on gets queued on a second replica too). Storms / lost binds
+    ride the ChaosCluster bind surface as in the single-engine fuzz."""
+    clock = fleet.clock
+    fired: set = set()
+    fault_end = plan.fault_end()
+    budget = 300.0 + fault_end
+    cycles = 0
+    while True:
+        now = clock.time()
+        assert now < budget, (
+            f"fleet drive did not converge by t={now:.1f}: pending "
+            f"{[p.name for p in pods if p.phase == PodPhase.PENDING]}")
+        cycles += 1
+        assert cycles < 300_000, "fleet drive cycle budget exhausted"
+        for w in plan.windows:
+            key = (w.kind, w.start)
+            if w.start > now or key in fired:
+                continue
+            if w.kind == REPLICA_CRASH:
+                fired.add(key)
+                fleet.crash_replica(rng.randrange(fleet.n), pods)
+            elif w.kind == LEASE_EXPIRY:
+                fired.add(key)
+                fleet.revoke_replica_leases(rng.randrange(fleet.n))
+            elif w.kind == SPLIT_BRAIN:
+                fired.add(key)
+                src = rng.randrange(fleet.n)
+                dst = (src + 1 + rng.randrange(fleet.n - 1)) % fleet.n
+                for p in pods:
+                    if (p.phase == PodPhase.PENDING
+                            and fleet.replicas[src].engine.tracks(p.key)):
+                        fleet.submit_to(dst, p)
+                # the duplicate replica also STEALS one of the original
+                # holder's shard leases: src's belief (and its fencing
+                # epoch) goes stale without it noticing — in trust-owned
+                # fleets the stale token travels all the way to the
+                # authority and must bounce there (stale_fence 409)
+                src_rep = fleet.replicas[src]
+                if src_rep.owned:
+                    s = sorted(src_rep.owned)[0]
+                    fleet.lease_store.steal(
+                        f"yoda-shard-{s}",
+                        fleet.replicas[dst].identity)
+        if fleet.step(rng) is not None:
+            clock.advance(TICK)
+            continue
+        wake = fleet.next_wake_at()
+        if wake is None:
+            if now >= fault_end and all(
+                    p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                    for p in pods):
+                return
+            clock.advance(0.5)
+        else:
+            clock.advance(max(wake - clock.time(), TICK))
+
+
+@pytest.mark.parametrize("seed", _fleet_seed_params())
+def test_fleet_chaos_fuzz(seed):
+    """One seeded multi-replica scenario end to end: 2-4 engine replicas
+    (sharded or free-for-all) race optimistic commits against the same
+    chaos cluster while the plan scripts storms, lost binds, replica
+    crashes, lease expiry mid-bind, and split-brain windows — and the
+    four invariants must hold FLEET-WIDE at convergence. The authority's
+    conflict rejections (not engine bookkeeping) are what carries the
+    no-double-bind / no-oversubscription half; post-fault convergence
+    carries the rest."""
+    rng = random.Random(10_000 + seed)
+    plan = FaultPlan(seed, horizon_s=20.0, kinds=FLEET_KINDS)
+    clock = FakeClock()
+    store = _fleet(rng)
+    cluster = ChaosCluster(store, plan=plan, clock=clock)
+    cluster.add_nodes_from_telemetry()
+    n_replicas = rng.choice((2, 3, 4))
+    mode = rng.choice(("sharded", "free-for-all"))
+    # both fencing postures: local re-validation (lease loss = clean
+    # FENCE_LOST abort) and trust-owned (stale tokens travel to the
+    # AUTHORITY and must bounce as stale_fence 409s — the wire posture)
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=MAX_AGE,
+                        breaker_cooldown_s=1.0),
+        replicas=n_replicas, clock=clock, mode=mode, seed=seed,
+        validate_fence_locally=bool(rng.getrandbits(1)))
+    pods = _workload(rng)
+    for p in pods:
+        fleet.submit(p)
+    _drive_fleet(fleet, plan, pods, rng)
+    _assert_invariants(pods, store, cluster, f"fleet-{seed}")
+    # the authority's conflict book is consistent with the outcome: any
+    # server-side rejection was resolved (the invariants above prove no
+    # rejected commit ever half-landed). pods_scheduled_total is NOT
+    # asserted against the workload — a crashed replica's counters die
+    # with it, and reconcile ADOPTS its binds without re-counting them.
+    stats = fleet.fleet_stats()
+    assert all(v >= 0 for v in stats["authority_rejections"].values())
 
 
 # ------------------------------------------------- targeted: crash containment

@@ -50,9 +50,25 @@ BIND_LOST = "BindLost"
 TELEMETRY_BLACKOUT = "TelemetryBlackout"
 PLUGIN_ERROR = "PluginError"
 ENGINE_CRASH = "EngineCrash"
+# fleet-only kinds (multi-replica shared-state scheduling): one REPLICA
+# dies mid-drain (the fleet rebuilds it and reconciles from cluster
+# truth), a replica's shard LEASES are revoked mid-bind-window (its
+# fenced commits must abort cleanly), and a SPLIT-BRAIN window injects a
+# duplicate replica — the same pods queued on two replicas at once, with
+# the original holder's lease epoch gone stale — so the authority's
+# conflict + fencing checks are the only thing standing between the
+# fleet and a double bind.
+REPLICA_CRASH = "ReplicaCrash"
+LEASE_EXPIRY = "LeaseExpiry"
+SPLIT_BRAIN = "SplitBrain"
 
 ALL_KINDS = (APISERVER_STORM, BIND_LOST, TELEMETRY_BLACKOUT, PLUGIN_ERROR,
              ENGINE_CRASH)
+# the fleet fuzz's kind mix: the single-engine kinds that stress the
+# commit path, plus the three fleet-only kinds above (blackout/plugin
+# crashes are engine-local and already covered by the single-engine fuzz)
+FLEET_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH, LEASE_EXPIRY,
+               SPLIT_BRAIN)
 
 
 class LostResponseError(ConnectionError):
@@ -84,9 +100,10 @@ class FaultPlan:
         for _ in range(rng.randint(1, max_windows)):
             kind = rng.choice(kinds)
             start = rng.uniform(0.5, horizon_s * 0.6)
-            if kind == ENGINE_CRASH:
-                # a crash is an instant, not an interval; the driver fires
-                # it once when the clock first passes `start`
+            if kind in (ENGINE_CRASH, REPLICA_CRASH, LEASE_EXPIRY):
+                # a crash / lease revocation is an instant, not an
+                # interval; the driver fires it once when the clock first
+                # passes `start`
                 self.windows.append(FaultWindow(kind, start, start))
                 continue
             dur = rng.uniform(1.0, horizon_s * 0.4)
@@ -147,18 +164,20 @@ class ChaosCluster(FakeCluster):
     def _count(self, kind: str) -> None:
         self.injected[kind] = self.injected.get(kind, 0) + 1
 
-    def bind(self, pod, node, assigned_chips=None) -> None:
+    def bind(self, pod, node, assigned_chips=None, fence=None) -> None:
         fault = self._bind_fault()
         if fault == APISERVER_STORM:
             self._count(fault)
             raise ConnectionError("chaos: apiserver unavailable (storm)")
         if fault == BIND_LOST:
             # the mutation lands, the response does not — the caller sees
-            # an error for a bind the cluster already holds
-            super().bind(pod, node, assigned_chips)
+            # an error for a bind the cluster already holds. (A conflict
+            # rejection raises INSTEAD of the lost response: the server
+            # never applied anything, so there is nothing to lose.)
+            super().bind(pod, node, assigned_chips, fence=fence)
             self._count(fault)
             raise LostResponseError("chaos: bind applied, response lost")
-        super().bind(pod, node, assigned_chips)
+        super().bind(pod, node, assigned_chips, fence=fence)
 
 
 class AsyncChaosCluster(ChaosCluster):
@@ -171,7 +190,7 @@ class AsyncChaosCluster(ChaosCluster):
     fault APPLIES the bind and then reports on_fail."""
 
     def bind_async(self, pod, node, assigned_chips=None,
-                   on_fail=None, on_success=None) -> None:
+                   on_fail=None, on_success=None, fence=None) -> None:
         fault = self._bind_fault()
         if fault == APISERVER_STORM:
             self._count(fault)
@@ -180,14 +199,26 @@ class AsyncChaosCluster(ChaosCluster):
                         ConnectionError("chaos: apiserver storm (async)"))
             return
         if fault == BIND_LOST:
-            super(ChaosCluster, self).bind(pod, node, assigned_chips)
+            try:
+                super(ChaosCluster, self).bind(pod, node, assigned_chips,
+                                               fence=fence)
+            except Exception as e:  # conflict rejection: report it, the
+                if on_fail is not None:  # response-loss never happened
+                    on_fail(pod, node, e)
+                return
             self._count(fault)
             if on_fail is not None:
                 on_fail(pod, node,
                         LostResponseError("chaos: async bind applied, "
                                           "response lost"))
             return
-        super(ChaosCluster, self).bind(pod, node, assigned_chips)
+        try:
+            super(ChaosCluster, self).bind(pod, node, assigned_chips,
+                                           fence=fence)
+        except Exception as e:
+            if on_fail is not None:
+                on_fail(pod, node, e)
+            return
         if on_success is not None:
             on_success(pod, node)
 

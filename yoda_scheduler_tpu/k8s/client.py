@@ -555,11 +555,14 @@ class KubeClient:
         self.request("POST", f"/api/v1/namespaces/{namespace}/events", body)
 
     def bind(self, pod: Pod, node: str,
-             assigned_chips: list | None = None) -> None:
+             assigned_chips: list | None = None, fence=None) -> None:
         """POST the binding subresource. A 409 means the pod is already
         assigned — possibly by OUR earlier attempt whose response was lost
         (the retry path re-POSTs). Recover by reading the pod back: bound to
         our target = success; bound elsewhere = genuine conflict, raised.
+        `fence` (a shard-lease fencing token, k8s/leaderelect.py) rides the
+        Binding's annotations so the apiserver can reject a commit from a
+        replica whose lease epoch went stale.
 
         An AMBIGUOUS wire failure (the connection died after the POST may
         have reached the server — surfaced by request() as ApiError(0)
@@ -584,6 +587,10 @@ class KubeClient:
             # RPC was ~40% of the binder's critical path
             body["metadata"]["annotations"] = {
                 ASSIGNED_CHIPS_LABEL: format_assigned_chips(assigned_chips)}
+        if fence is not None:
+            name, holder, epoch = fence
+            body["metadata"].setdefault("annotations", {})[
+                "yoda.tpu/fence"] = f"{name}/{holder}/{epoch}"
         for replay in (False, True):
             try:
                 self.request(
@@ -619,6 +626,27 @@ class KubeClient:
                         time.sleep(self.retry_backoff_s * (2 ** confirm_try))
                 bound_to = (live or {}).get("spec", {}).get("nodeName")
                 if bound_to == node:
+                    # same node is NOT proof it was OUR bind: a foreign
+                    # replica's same-key win on the same node (fleet
+                    # split-brain) also reads nodeName == node. The chip
+                    # annotation discriminates — our own replay carried
+                    # the identical assignment, a foreign win carries
+                    # theirs — and adopting a foreign assignment as ours
+                    # would overwrite the winner's chips in the cache and
+                    # double-book the physical chips they hold.
+                    want = body["metadata"].get("annotations", {}).get(
+                        ASSIGNED_CHIPS_LABEL)
+                    have = ((live or {}).get("metadata", {}).get(
+                        "annotations") or {}).get(ASSIGNED_CHIPS_LABEL)
+                    # absent `have` stays adoptable: every chip-claiming
+                    # bind attaches the annotation, so a foreign win
+                    # shows up present-and-different; absence just means
+                    # a server/test double that didn't echo annotations
+                    if want and have is not None and have != want:
+                        raise ApiError(
+                            "POST", "binding(conflict)", 409,
+                            f"pod bound to {bound_to!r} with a foreign "
+                            f"chip assignment".encode()) from e
                     log.info("bind %s -> %s: %s but already ours", pod.key,
                              node, "ambiguous" if ambiguous else "409")
                     break
@@ -1508,8 +1536,9 @@ class KubeCluster:
         with self._lock:
             return {k for k, p in self._pods.items() if p.terminating}
 
-    def bind(self, pod: Pod, node: str, assigned_chips=None) -> None:
-        self.client.bind(pod, node, assigned_chips)
+    def bind(self, pod: Pod, node: str, assigned_chips=None,
+             fence=None) -> None:
+        self.client.bind(pod, node, assigned_chips, fence=fence)
         pod.node = node
         pod.phase = PodPhase.BOUND
         if assigned_chips:
@@ -1534,7 +1563,7 @@ class KubeCluster:
     _BIND_WORKERS = 8
 
     def bind_async(self, pod: Pod, node: str, assigned_chips=None,
-                   on_fail=None, on_success=None) -> None:
+                   on_fail=None, on_success=None, fence=None) -> None:
         pod.node = node
         pod.phase = PodPhase.BOUND
         if assigned_chips:
@@ -1550,7 +1579,7 @@ class KubeCluster:
                     self._bind_threads.append(t)
                     t.start()
             self._bind_q.append((pod, node, assigned_chips, on_fail,
-                                 on_success))
+                                 on_success, fence))
             self._bind_inflight += 1
         self._bind_event.set()
 
@@ -1565,12 +1594,12 @@ class KubeCluster:
                             # parked worker wakes and exits
                             self._bind_event.clear()
                         break
-                    pod, node, chips, on_fail, on_success = \
+                    pod, node, chips, on_fail, on_success, fence = \
                         self._bind_q.popleft()
                 try:
                     try:
                         t0 = time.perf_counter_ns()
-                        self.client.bind(pod, node, chips)
+                        self.client.bind(pod, node, chips, fence=fence)
                         self.bind_wire_ns += time.perf_counter_ns() - t0
                         self.bind_wire_n += 1
                         if on_success is not None:
@@ -1696,7 +1725,20 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     from ..scheduler.multi import MultiProfileScheduler
 
     cluster.wait_synced()
-    sched = MultiProfileScheduler(cluster, profiles)
+    if len(profiles) == 1 and profiles[0][0].fleet_replicas > 1:
+        # scheduler fleet: N engine replicas over the ONE shared watch
+        # cache, each on its own thread, committing binds optimistically
+        # (scheduler/fleet.py). Multi-profile configs keep the classic
+        # co-hosted engines — a fleet is per-schedulerName.
+        from ..scheduler.fleet import FleetCoordinator
+
+        sched = FleetCoordinator(cluster, profiles[0][0],
+                                 enabled=profiles[0][1])
+        sched.start(stop)
+        log.info("scheduler fleet: %d replicas (%s mode)",
+                 sched.n, sched.mode)
+    else:
+        sched = MultiProfileScheduler(cluster, profiles)
     if out is not None:
         # harnesses (bench.run_serve_scale) read engine metrics —
         # batched_binds_total et al. — after the drain
@@ -1711,10 +1753,23 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     # (descheduleIntervalSeconds > 0)
     from ..scheduler.deschedule import Descheduler
 
-    deschedulers = [
-        (Descheduler(e), e.config.deschedule_interval_s, [0.0])
-        for e in sched.engines.values() if e.config.deschedule_interval_s > 0
-    ]
+    if getattr(sched, "threaded", False):
+        # fleet replicas run their cycles on their OWN threads: a
+        # serve-thread descheduler would read live allocator/filter state
+        # mid-mutation (and N per-replica copies would N-fold the
+        # eviction pressure). Defragmentation for fleets is future work;
+        # say so instead of racing.
+        deschedulers = []
+        if any(e.config.deschedule_interval_s > 0
+               for e in sched.engines.values()):
+            log.warning("descheduleIntervalSeconds is ignored with "
+                        "fleetReplicas > 1 (not yet fleet-safe)")
+    else:
+        deschedulers = [
+            (Descheduler(e), e.config.deschedule_interval_s, [0.0])
+            for e in sched.engines.values()
+            if e.config.deschedule_interval_s > 0
+        ]
 
     # pod.key -> k8s uid of the incarnation we handled. A deleted pod
     # recreated under the same name arrives with a new uid and must be
@@ -1725,7 +1780,7 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     while not stop.is_set():
         try:
             pending = [p for p in cluster.pending_pods()
-                       if p.scheduler_name in sched.engines]
+                       if sched.claims(p.scheduler_name)]
             pending_keys = {p.key for p in pending}
             for pod in pending:
                 if sched.tracks(pod.key):
@@ -1788,23 +1843,28 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
             # equivalence class coalesce into a shared pass whenever the
             # intake let the queue deepen, reported as batched_binds_total
             idle = False
-            for _ in range(64):
-                outcomes = []
-                for name, e in sched.engines.items():
-                    try:
-                        outcomes.append(e.run_one())
-                    except Exception as exc:
-                        log.error("profile %s cycle error: %s", name, exc)
-                        # None = "no progress": a persistently-throwing
-                        # profile must not defeat the all-idle poll_s wait
-                        # below, or the loop hot-spins re-listing the API
-                        # server
-                        outcomes.append(None)
-                if all(o is None for o in outcomes):
-                    idle = True
-                    break
-                if stop.is_set():
-                    break
+            if getattr(sched, "threaded", False):
+                # fleet replicas run their own cycle threads; this loop
+                # is intake-only and always sleeps on the wake event
+                idle = True
+            else:
+                for _ in range(64):
+                    outcomes = []
+                    for name, e in sched.engines.items():
+                        try:
+                            outcomes.append(e.run_one())
+                        except Exception as exc:
+                            log.error("profile %s cycle error: %s", name, exc)
+                            # None = "no progress": a persistently-throwing
+                            # profile must not defeat the all-idle poll_s
+                            # wait below, or the loop hot-spins re-listing
+                            # the API server
+                            outcomes.append(None)
+                    if all(o is None for o in outcomes):
+                        idle = True
+                        break
+                    if stop.is_set():
+                        break
             if idle:
                 # sleep until a cluster event / submission wakes an engine
                 # (event-driven requeue sets sched.wake) — poll_s is now

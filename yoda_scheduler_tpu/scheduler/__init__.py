@@ -18,8 +18,9 @@ from .framework import (
 from .config import SchedulerConfig, ScoreWeights
 from .core import Scheduler
 from .multi import MultiProfileScheduler
+from .fleet import FleetCoordinator, LocalLeaseStore
 from .deschedule import Descheduler, DeschedulePlan
-from .cluster import FakeCluster
+from .cluster import BindConflictError, FakeCluster
 
 __all__ = [
     "Status",
@@ -41,7 +42,10 @@ __all__ = [
     "ScoreWeights",
     "Scheduler",
     "MultiProfileScheduler",
+    "FleetCoordinator",
+    "LocalLeaseStore",
     "Descheduler",
     "DeschedulePlan",
+    "BindConflictError",
     "FakeCluster",
 ]

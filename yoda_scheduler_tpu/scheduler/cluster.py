@@ -31,8 +31,24 @@ from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chi
 class Cluster(Protocol):
     def node_names(self) -> list[str]: ...
     def pods_on(self, node: str) -> list[Pod]: ...
-    def bind(self, pod: Pod, node: str, assigned_chips: list[tuple[int, int, int]] | None) -> None: ...
+    # `fence` is only passed when the engine's fence_provider is set
+    # (sharded fleet replicas); fence-unaware backends are safe anywhere
+    # else, but a backend used under a sharded fleet must accept it
+    def bind(self, pod: Pod, node: str,
+             assigned_chips: list[tuple[int, int, int]] | None,
+             fence: tuple | None = None) -> None: ...
     def evict(self, pod: Pod) -> None: ...
+
+
+class BindConflictError(RuntimeError):
+    """The authority rejected a bind at commit time: the target pod is
+    already bound, the chip/HBM claim would oversubscribe the node, or the
+    caller's fencing token is stale. The HTTP analogue is a server-returned
+    409 — `status` carries that so the engine's breaker logic (which only
+    counts WIRE failures, status 0) never trips on a healthy-but-contended
+    cluster, and the conflict path can route on it."""
+
+    status = 409
 
 
 class FakeCluster:
@@ -49,6 +65,19 @@ class FakeCluster:
         self._lock = threading.RLock()
         self._nodes: set[str] = set()
         self._bound: dict[str, list[Pod]] = {}  # node -> pods
+        # pod.key -> node, maintained alongside _bound: O(1) already-bound
+        # conflict checks at bind time and O(1) bound_node_of (the fleet's
+        # foreign-bind guard reads it on the scheduling path)
+        self._bound_keys: dict[str, str] = {}
+        # optional shard-lease authority (scheduler/fleet.py
+        # LocalLeaseStore): when set, a bind carrying a fencing token is
+        # validated against it — a replica whose lease epoch went stale
+        # (split-brain, expiry mid-bind) gets a 409, never a silent write
+        self.lease_authority = None
+        # server-side rejections, by reason — the fleet bench and chaos
+        # fuzz read these to prove the authority (not engine bookkeeping)
+        # is what held the invariants
+        self.bind_conflicts: dict[str, int] = {}
         self._meta: dict[str, tuple[dict, tuple]] = {}  # node -> (labels, taints)
         self._pdbs: tuple = ()
         self._namespaces: dict[str, dict] = {}  # ns -> metadata.labels
@@ -134,6 +163,9 @@ class FakeCluster:
             self._nodes.discard(name)
             self._meta.pop(name, None)
             orphans = self._bound.pop(name, [])
+            for p in orphans:
+                if self._bound_keys.get(p.key) == name:
+                    del self._bound_keys[p.key]
             self._bump(name)
         for p in orphans:
             p.node = None
@@ -209,28 +241,73 @@ class FakeCluster:
 
     def bound_node_of(self, key: str) -> str | None:
         """Node holding pod `key`, or None — the cluster-truth read the
-        engine's ambiguous-bind adoption and restart reconciliation use
-        (annotation present in the cluster => adopt; absent => requeue).
-        O(bound pods); called only on bind failures and restarts, never
-        on the scheduling hot path."""
+        engine's ambiguous-bind adoption, restart reconciliation, and
+        foreign-bind conflict handling use (binding present in the
+        cluster => adopt/drop; absent => requeue). O(1) off the bound-key
+        index."""
         with self._lock:
-            for node, pods in self._bound.items():
-                for p in pods:
-                    if p.key == key:
-                        return node
-        return None
+            return self._bound_keys.get(key)
 
     # ---------------------------------------------------------------- binding
+    def _reject(self, reason: str, message: str) -> None:
+        # callers hold self._lock
+        self.bind_conflicts[reason] = self.bind_conflicts.get(reason, 0) + 1
+        raise BindConflictError(message)
+
+    def _check_bind(self, pod: Pod, node: str, assigned_chips,
+                    fence) -> None:
+        """Bind-time conflict enforcement, the authority's half of the
+        optimistic-concurrency contract (callers hold self._lock; raises
+        BEFORE any mutation). A fleet replica commits from its own
+        snapshot — the one place its stale view is actually checked is
+        here: already-bound pod, overlapping chip claim, per-chip HBM
+        oversubscription, or a stale fencing token all 409."""
+        cur = self._bound_keys.get(pod.key)
+        if cur is not None:
+            self._reject("pod_bound",
+                         f"pod {pod.key} is already bound to {cur}")
+        if fence is not None and self.lease_authority is not None \
+                and not self.lease_authority.validate_fence(fence):
+            self._reject("stale_fence",
+                         f"fencing token {fence} is stale (lease "
+                         "expired or reassigned)")
+        claimed = set(assigned_chips or ())
+        if not claimed:
+            return
+        taken: set = set()
+        for q in self._bound.get(node, ()):
+            taken |= q.assigned_chips()
+        overlap = claimed & taken
+        if overlap:
+            self._reject("chip_claim",
+                         f"chip claim conflict on {node}: "
+                         f"{sorted(overlap)} already owned")
+        need_mb = int(pod.labels.get("scv/memory", "0") or 0)
+        if need_mb:
+            m = self.telemetry.get(node)
+            if m is not None:
+                by_coord = {c.coords: c for c in m.chips}
+                for c in claimed:
+                    chip = by_coord.get(c)
+                    if chip is not None and need_mb > chip.hbm_free_mb:
+                        self._reject(
+                            "hbm",
+                            f"HBM oversubscription on {node}/{c}: "
+                            f"need {need_mb}MB > free {chip.hbm_free_mb}MB")
+
     def bind(self, pod: Pod, node: str,
-             assigned_chips: list[tuple[int, int, int]] | None = None) -> None:
+             assigned_chips: list[tuple[int, int, int]] | None = None,
+             fence=None) -> None:
         with self._lock:
             if node not in self._nodes:
                 raise KeyError(f"bind target {node!r} is not a node")
+            self._check_bind(pod, node, assigned_chips, fence)
             pod.node = node
             pod.phase = PodPhase.BOUND
             if assigned_chips is not None:
                 pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(assigned_chips)
             self._bound[node].append(pod)
+            self._bound_keys[pod.key] = node
             self._bump(node, grew=False)  # a bind only consumes capacity
         self._publish(ClusterEvent(POD_BOUND, node=node))
 
@@ -243,6 +320,8 @@ class FakeCluster:
                 after = [p for p in before if p.uid != pod.uid]
                 removed = len(after) != len(before)
                 self._bound[pod.node] = after
+                if removed and self._bound_keys.get(pod.key) == pod.node:
+                    del self._bound_keys[pod.key]
                 self._bump(pod.node)
         pod.node = None
         pod.phase = PodPhase.PENDING

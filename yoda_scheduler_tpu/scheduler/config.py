@@ -53,6 +53,31 @@ def _native_prefetch_default() -> bool:
         "0", "false", "off")
 
 
+def _fleet_default() -> int:
+    """Default replica count for the scheduler fleet (scheduler/fleet.py).
+    YODA_FLEET=<n> runs n engine replicas against the same apiserver,
+    each committing binds optimistically; unset/1/non-integer keeps the
+    classic single engine (whose placements stay bit-identical)."""
+    raw = os.environ.get("YODA_FLEET", "")
+    if not raw:
+        return 1
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        return 1
+
+
+def _valid_fleet_mode(mode: str) -> str:
+    """Reject unknown fleetMode values at config-load time: the sharded/
+    free-for-all A/B is the whole point of the knob, and a typo
+    ("free_for_all", "FreeForAll") silently falling back to sharded
+    would corrupt exactly the comparison the operator asked for."""
+    if mode not in ("sharded", "free-for-all"):
+        raise ValueError(
+            f"fleetMode must be 'sharded' or 'free-for-all', got {mode!r}")
+    return mode
+
+
 @dataclass(frozen=True)
 class ScoreWeights:
     """Per-attribute weights for the telemetry score.
@@ -179,6 +204,22 @@ class SchedulerConfig:
     # the breaker on success. 0 disables.
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 5.0
+    # scheduler fleet (scheduler/fleet.py): run this many engine replicas
+    # against the same apiserver, each scheduling from its own snapshot
+    # and committing binds OPTIMISTICALLY — the authority rejects
+    # conflicting commits with a 409 the engine resolves (foreign-bind
+    # drop / local retry). 1 (or env YODA_FLEET unset) keeps the classic
+    # single engine, bit-identical placements included.
+    fleet_replicas: int = field(default_factory=_fleet_default)
+    # shard leases: node pools hash into this many shards, each backed by
+    # a lease (yoda-shard-<i>); a replica schedules its owned shards
+    # preferentially and carries a fencing token on binds into them.
+    # 0 = one shard per replica.
+    shard_leases: int = 0
+    # "sharded" (leases + shard-affinity scoring + fencing) or
+    # "free-for-all" (every replica pulls from the shared intake with no
+    # node preference — the A/B baseline with the higher conflict rate)
+    fleet_mode: str = "sharded"
 
     def with_(self, **kw) -> "SchedulerConfig":
         return replace(self, **kw)
@@ -227,6 +268,12 @@ class SchedulerConfig:
                 "breakerThreshold", defaults.breaker_threshold)),
             breaker_cooldown_s=float(args.get(
                 "breakerCooldownSeconds", defaults.breaker_cooldown_s)),
+            fleet_replicas=max(int(args.get(
+                "fleetReplicas", defaults.fleet_replicas)), 1),
+            shard_leases=max(int(args.get(
+                "shardLeases", defaults.shard_leases)), 0),
+            fleet_mode=_valid_fleet_mode(str(args.get(
+                "fleetMode", defaults.fleet_mode))),
         )
 
 

@@ -237,6 +237,12 @@ class _NativeCycle:
 # attempt and hands the pod to the scalar scan for its diagnostics
 _NATIVE_EMPTY = object()
 
+# fence_provider verdict: the replica owned the target node's shard when
+# the cycle started, but the lease has since expired or been reassigned —
+# the commit is aborted cleanly (reservation unwound, attempt-free
+# retry) instead of burning a doomed RPC the authority would fence-reject
+FENCE_LOST = object()
+
 
 class Scheduler:
     def __init__(
@@ -446,6 +452,15 @@ class Scheduler:
         # engine lands back in B's queue, not A's; standalone engines
         # default to their own submit (which rejects foreign names).
         self.victim_router = None
+        # shard-lease fencing (scheduler/fleet.py): when set, called as
+        # fence_provider(pod, node) right before every bind dispatch.
+        # Returns a fencing token to carry on the bind (owned shard), None
+        # for an unfenced optimistic bind (node outside the replica's
+        # shards), or FENCE_LOST — the lease vanished mid-cycle — which
+        # aborts the commit cleanly through the unwind path. None (the
+        # default) skips fencing entirely: standalone engines are the
+        # fleet of one.
+        self.fence_provider = None
 
     # ----------------------------------------------------------------- intake
     def submit(self, pod: Pod) -> bool:
@@ -1673,6 +1688,18 @@ class Scheduler:
         pod = info.pod
         now = self.clock.time()
         trace = CycleTrace(pod=pod.key, started=now)
+        if pod.phase == PodPhase.BOUND and pod.node:
+            # a foreign fleet replica bound this pod after it entered our
+            # queue (shared-state optimistic scheduling — free-for-all
+            # poaching or a split-brain window queued it twice): drop the
+            # entry off cluster truth instead of burning a full cycle
+            # that would end in the authority's 409
+            if self.allocator is not None:
+                self.allocator.unnominate(pod.key)
+            self.metrics.inc("foreign_bind_skips_total")
+            self._finish(trace, "foreign-bound", node=pod.node,
+                         reason="already bound by a foreign replica")
+            return "foreign-bound"
         state = CycleState()
         state.write("now", now)
         self._csv_memo.clear()  # per-cycle dirty-set cache
@@ -2370,6 +2397,25 @@ class Scheduler:
         entry = self.allocator.assignment_of(pod) if self.allocator is not None else None
         coords = entry[1] if entry is not None else None
         dispatched_async = False
+        fence = None
+        if self.fence_provider is not None:
+            fence = self.fence_provider(pod, node)
+            if fence is FENCE_LOST:
+                # shard lease lost between cycle start and commit: abort
+                # cleanly through the unwind path — reservation released,
+                # capacity event for parked pods, attempt-free retry (the
+                # pod did nothing wrong; the next cycle re-places it,
+                # possibly unfenced on a shard we no longer prefer)
+                if self.allocator is not None:
+                    self.allocator.unreserve(CycleState(), pod, node)
+                    self.notify_event(ClusterEvent(POD_DELETED, node=node,
+                                                   origin=pod.key))
+                self.metrics.inc("lease_lost_aborts_total")
+                self.queue.requeue_immediate(info)
+                self._finish(trace, "lease-lost", node=node,
+                             reason="shard lease lost mid-cycle")
+                return False
+        fence_kw = {} if fence is None else {"fence": fence}
         try:
             if self.profile.bind is not None:
                 self.profile.bind.bind(CycleState(), pod, node)
@@ -2400,9 +2446,10 @@ class Scheduler:
                         pod, node, coords,
                         on_fail=lambda p, n, e, _info=info:
                             self._bind_results.append((_info, n, e)),
-                        on_success=self._async_bind_succeeded)
+                        on_success=self._async_bind_succeeded,
+                        **fence_kw)
                 else:
-                    self.cluster.bind(pod, node, coords)
+                    self.cluster.bind(pod, node, coords, **fence_kw)
         except Exception as e:
             # lost-response recovery (satellite of the chaos work): before
             # rolling back, ask the cluster whether the bind actually
@@ -2417,6 +2464,17 @@ class Scheduler:
                     bound_to = bn(pod.key)
                 except Exception:
                     bound_to = None
+            if getattr(e, "status", None) == 409:
+                # server-returned conflict: a FOREIGN replica's commit
+                # beat ours (optimistic shared-state scheduling) — never
+                # a wire failure, never the breaker. Checked BEFORE the
+                # adoption branch: a 409 means our POST was REJECTED, so
+                # even bound_to == node is someone else's same-key win on
+                # the same node (our own landed-but-409-on-replay case is
+                # resolved inside KubeClient.bind and never raises) —
+                # adopting it through the ambiguous tail would overwrite
+                # the winner's chip assignment with our coords.
+                return self._bind_conflict(info, node, trace, e, bound_to)
             if bound_to != node:
                 self._breaker_failure(e)
                 if self.allocator is not None:
@@ -2475,6 +2533,68 @@ class Scheduler:
                      f"Successfully assigned {pod.key} to {node}")
             except Exception:
                 pass  # observability must never fail a bind
+
+    def _bind_conflict(self, info: QueuedPodInfo, node: str,
+                       trace: CycleTrace, err: Exception,
+                       bound_to: str | None,
+                       release_reservation: bool = True) -> bool:
+        """A server-returned 409 rejected our optimistic commit — the
+        scheduler-fleet conflict path. Two shapes:
+
+        - FOREIGN-BIND conflict (`bound_to` names another node, or the
+          pod reads BOUND): another replica won the pod. Drop our queue
+          entry off cluster truth — requeueing would loop 409 forever.
+        - NODE-CLAIM conflict (pod still unbound): a foreign bind landed
+          on our chosen node between snapshot and commit, so our rows
+          were stale. The foreign bind already bumped the change log —
+          the next cycle's snapshot repair re-filters exactly the dirty
+          rows — so retry locally, attempt-free (the pod did nothing
+          wrong). A pathological conflict streak falls back to the
+          ordinary backoff path so a livelock can't hot-spin the engine.
+
+        Either way the server ANSWERED: a 409 is proof of a live
+        apiserver, so it feeds the breaker's success side, never its
+        failure count. Shared by the sync bind path and the async drain
+        (`release_reservation=False` there: the reservation was already
+        consumed at dispatch and the binder rolled its cache back)."""
+        pod = info.pod
+        self.metrics.inc("bind_conflicts_total")
+        self._breaker_success()
+        if release_reservation and self.allocator is not None:
+            self.allocator.unreserve(CycleState(), pod, node)
+            # the freed reservation is a capacity event for parked pods
+            self.notify_event(ClusterEvent(POD_DELETED, node=node,
+                                           origin=pod.key))
+        if bound_to is not None or pod.phase == PodPhase.BOUND:
+            if self.allocator is not None:
+                self.allocator.unnominate(pod.key)
+            if bound_to is not None and pod.node != bound_to:
+                # our copy disagrees with cluster truth (stale Pending on
+                # a wire backend, or a hypothetical optimistic write to
+                # the losing node): adopt the winner's node. The chip
+                # annotation is the winner's to publish — ours was never
+                # set on the sync path, and the async binder rolled its
+                # optimistic label back before reporting.
+                pod.node = bound_to
+                pod.phase = PodPhase.BOUND
+            self.metrics.inc("foreign_bind_conflicts_total")
+            self._finish(trace, "foreign-bound", node=pod.node,
+                         reason=str(err))
+            return False
+        info.conflicts += 1
+        if info.conflicts >= 8:
+            # losing 8 straight optimistic races means the cluster is
+            # pathologically contended (or our view persistently stale):
+            # back off like an ordinary unschedulable pod instead of
+            # spinning attempt-free retries
+            info.conflicts = 0
+            self._unschedulable(info, trace, f"bind conflict: {err}",
+                                outcome="bind-conflict")
+            return False
+        self.metrics.inc("bind_conflict_retries_total")
+        self.queue.requeue_immediate(info)
+        self._finish(trace, "bind-conflict", node=node, reason=str(err))
+        return False
 
     def _async_bind_succeeded(self, pod, node) -> None:
         """on_success callback for dispatched binds, run on a BINDER
@@ -2570,19 +2690,50 @@ class Scheduler:
             # dispatch-time optimistic accounting is correct as it
             # stands, so consume the nomination and move on instead of
             # requeueing a bound pod into a duplicate-bind loop
+            bound_to = None
             bn = getattr(self.cluster, "bound_node_of", None)
             if bn is not None:
                 try:
                     bound_to = bn(pod.key)
                 except Exception:
                     bound_to = None
-                if bound_to == node:
+                if bound_to == node and getattr(err, "status",
+                                                None) != 409:
+                    # ambiguous wire failure whose POST actually landed
+                    # (a 409 is NOT this: the server REJECTED our POST,
+                    # so a same-node bound_to is a foreign same-key win
+                    # — conflict-resolved below, winner's chips intact)
                     if self.allocator is not None:
                         self.allocator.unnominate(pod.key)
                     self.metrics.inc("ambiguous_bind_recoveries_total")
                     self._post_scheduled_event(pod, node)  # landed after all
                     self._breaker_success()
                     continue
+            if getattr(err, "status", None) == 409:
+                # conflict, the async flavour: the binder already rolled
+                # its cache (and our optimistic chip label) back before
+                # reporting, and the dispatch-time reservation was
+                # consumed — so only the pod's fields (shared-object
+                # backends that never applied) and the queue need
+                # attention before sharing the sync resolution logic.
+                # The label is deliberately NOT popped in the foreign
+                # case: on shared-object backends it is the WINNER's.
+                # The dispatch-time success tail already counted this pod
+                # in pods_scheduled_total/latency; record the correction
+                # so per-replica bind shares can be computed exactly
+                # (counters are monotonic — never decremented)
+                self.metrics.inc("async_bind_conflict_corrections_total")
+                trace = CycleTrace(pod=pod.key, started=self.clock.time())
+                if bound_to is None:
+                    if pod.node == node:
+                        pod.phase = PodPhase.PENDING
+                        pod.node = None
+                        pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+                    self.notify_event(ClusterEvent(POD_DELETED, node=node,
+                                                   origin=pod.key))
+                self._bind_conflict(info, node, trace, err, bound_to,
+                                    release_reservation=False)
+                continue
             self._breaker_failure(err)
             pod.phase = PodPhase.PENDING
             pod.node = None
@@ -2604,6 +2755,10 @@ class Scheduler:
                        outcome: str = "unschedulable",
                        rejected_by: tuple = ()) -> str:
         info.last_failure = reason
+        # any orderly non-conflict outcome breaks a 409 streak: the
+        # conflict counter means CONSECUTIVE optimistic-race losses, not
+        # lifetime losses (see _bind_conflict's fallback)
+        info.conflicts = 0
         # operator-facing trail (kubectl describe pod): backends with a
         # wire (KubeCluster) POST a FailedScheduling Event carrying the
         # same reason the cycle trace records — deduplicated and queued

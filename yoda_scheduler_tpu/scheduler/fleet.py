@@ -1,0 +1,624 @@
+"""Scheduler fleet: N engine replicas, one cluster, optimistic commits.
+
+The serve path tops out at what ONE engine thread can push through its
+cycle loop. This module runs ``fleet_replicas`` full engines (own queue,
+allocator, memos — everything engine-local) against the SAME cluster
+backend, in the Omega shared-state style: every replica schedules from
+its own snapshot and commits binds OPTIMISTICALLY. Nothing coordinates
+the hot path; correctness comes from the AUTHORITY:
+
+- the apiserver (tests/fake_apiserver.py) and FakeCluster both reject a
+  bind whose target pod is already bound, whose chip/HBM claim would
+  oversubscribe the node, or whose fencing token is stale — a 409 the
+  committer resolves as a *foreign-bind conflict* (drop the pod, another
+  replica won it) or a *node-claim conflict* (retry locally off the
+  freshly-invalidated rows; the foreign bind already bumped the change
+  log, so the ordinary snapshot repair re-filters exactly the dirty
+  nodes). Server-returned 409s never trip the PR 4 circuit breaker.
+  NOTE a vanilla kube apiserver natively enforces only the pod-level
+  half (binding 409s an already-assigned pod); the chip/fence checks
+  must be ported as an admission webhook for production fleets —
+  ARCHITECTURE.md "Authority scope, honestly".
+
+Two placement regimes, the A/B the bench measures:
+
+- **sharded** (default): node pools hash into ``shard_leases`` shards,
+  each backed by a lease. A replica acquires its preferred shards
+  (``shard % n == idx``), takes over expired ones (crash recovery),
+  scores its owned shards' nodes up (ShardScore), and carries each
+  shard's fencing epoch on binds into it — so replicas mostly place on
+  disjoint node pools and conflicts stay rare, while lease loss mid-bind
+  aborts the commit cleanly through the PR 4 unwind path.
+- **free-for-all**: round-robin intake, no node preference, no fencing —
+  every replica may take any pod, and only the optimistic 409s keep the
+  invariants. Higher conflict rate, zero coordination; the baseline.
+
+``fleet_replicas=1`` builds exactly one unmodified engine — placements
+stay bit-identical to the classic scheduler (pinned in tests/test_fleet.py).
+
+Driving: ``run_until_idle(rng)`` interleaves replica cycles
+deterministically (the chaos fuzz replays failures from a seed alone);
+``start(stop)`` runs one thread per replica for the serve/bench path.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+import zlib
+from collections import deque
+
+from .cluster import FakeCluster
+from .config import SchedulerConfig
+from .core import Clock, FENCE_LOST, Scheduler, default_profile
+from .framework import ScorePlugin, Status
+from .multi import _MergedMetricsView, _MergedTracesView
+from .registry import build_profile
+# the ONE lease-name prefix: fence tokens are matched by string between
+# the engine side (here) and the authority (fake_apiserver / the Lease
+# API via ShardLeaseManager) — a drifted copy would 409 every fenced bind
+from ..k8s.leaderelect import SHARD_LEASE_PREFIX
+from ..utils.labels import GANG_NAME_LABEL
+from ..utils.pod import Pod
+
+log = logging.getLogger("yoda-tpu.fleet")
+
+
+def shard_of(name: str, shard_count: int) -> int:
+    """Stable node/pod -> shard hash (crc32: identical across processes
+    and runs, unlike PYTHONHASHSEED-salted hash())."""
+    return zlib.crc32(name.encode()) % max(shard_count, 1)
+
+
+class LocalLeaseStore:
+    """In-memory shard-lease authority on an injectable clock — the same
+    semantics the wire path gets from the Lease API + fake apiserver
+    (k8s/leaderelect.py ShardLeaseManager): holder identity, float
+    durations, a monotonically-increasing transitions epoch bumped on
+    every change of holder, and fence validation at bind time
+    (FakeCluster.lease_authority). Chaos hooks: revoke() force-expires a
+    lease mid-bind-window, steal() reassigns it while the old holder's
+    belief — and epoch — go stale (split-brain)."""
+
+    def __init__(self, clock: Clock | None = None) -> None:
+        self.clock = clock or Clock()
+        self._lock = threading.Lock()
+        # name -> [holder | None, epoch, renew_t, duration_s]
+        self._leases: dict[str, list] = {}
+
+    def try_acquire(self, name: str, identity: str,
+                    duration_s: float) -> int | None:
+        """Acquire (absent/expired lease) or refresh (own lease). Returns
+        the fencing epoch, or None when another holder is live."""
+        with self._lock:
+            now = self.clock.time()
+            rec = self._leases.get(name)
+            if rec is None:
+                self._leases[name] = [identity, 1, now, duration_s]
+                return 1
+            holder, epoch, renew_t, dur = rec
+            if holder == identity:
+                rec[2], rec[3] = now, duration_s
+                return epoch
+            if now - renew_t <= dur:
+                return None  # live foreign holder
+            # takeover of an expired lease: the epoch bump is what makes
+            # the previous holder's in-flight fencing tokens rejectable
+            self._leases[name] = [identity, epoch + 1, now, duration_s]
+            return epoch + 1
+
+    def renew(self, name: str, identity: str, epoch: int) -> bool:
+        with self._lock:
+            rec = self._leases.get(name)
+            if rec is None or rec[0] != identity or rec[1] != epoch:
+                return False
+            if self.clock.time() - rec[2] > rec[3]:
+                return False  # expired: must re-acquire (epoch may move)
+            rec[2] = self.clock.time()
+            return True
+
+    def holder(self, name: str) -> tuple[str | None, int] | None:
+        with self._lock:
+            rec = self._leases.get(name)
+            return (rec[0], rec[1]) if rec is not None else None
+
+    def revoke(self, name: str) -> None:
+        """Chaos: force-expire the lease AND retire its epoch — the
+        holder cannot renew its way back; its outstanding fencing tokens
+        are stale from this instant."""
+        with self._lock:
+            rec = self._leases.get(name)
+            if rec is not None:
+                rec[0] = None
+                rec[1] += 1
+                rec[2] = float("-inf")
+
+    def steal(self, name: str, identity: str,
+              duration_s: float = 30.0) -> int:
+        """Chaos: reassign the lease to `identity` regardless of expiry —
+        the split-brain injection. The old holder still BELIEVES it owns
+        the previous epoch; the authority now disagrees."""
+        with self._lock:
+            rec = self._leases.get(name)
+            epoch = (rec[1] + 1) if rec is not None else 1
+            self._leases[name] = [identity, epoch, self.clock.time(),
+                                  duration_s]
+            return epoch
+
+    def validate_fence(self, fence: tuple) -> bool:
+        """Authority-side bind-time check: token (name, holder, epoch)
+        matches the live lease and the lease has not expired."""
+        name, identity, epoch = fence
+        with self._lock:
+            rec = self._leases.get(name)
+            return (rec is not None and rec[0] == identity
+                    and rec[1] == epoch
+                    and self.clock.time() - rec[2] <= rec[3])
+
+
+class ShardScore(ScorePlugin):
+    """Shard-affinity scoring for a fleet replica: nodes in the replica's
+    owned shards score a flat bonus, steering placement onto its node
+    pools so concurrent replicas rarely race for the same chips. Pure
+    preference — a pod whose only feasible nodes live in foreign shards
+    still places there (unfenced, resolved optimistically); the invariants
+    never depend on this plugin. The weight must dominate the other
+    scorers' normalized 0-100 bands (topology weight 6 is the largest
+    default) so the preference actually partitions."""
+
+    name = "shard-affinity"
+    normalize_kind = "identity"
+    score_inputs = "node"
+    telemetry_dependent = False
+
+    def __init__(self, shard_count: int, owned: dict, weight: int = 8) -> None:
+        self.shard_count = shard_count
+        self._owned = owned  # the replica's live shard->epoch map
+        self.weight = weight
+
+    def equivalence_key(self, pod):
+        return ()  # node-side only: every pod sees the same bonus map
+
+    def score(self, state, pod, node):
+        s = shard_of(node.name, self.shard_count)
+        return (100.0 if s in self._owned else 0.0), Status.success()
+
+
+class _Replica:
+    __slots__ = ("idx", "engine", "identity", "owned", "next_renew",
+                 "thread", "incarnation", "manager", "inbox")
+
+    def __init__(self, idx: int, engine: Scheduler, identity: str) -> None:
+        self.idx = idx
+        self.engine = engine
+        self.identity = identity
+        self.owned: dict[int, int] = {}  # shard -> fencing epoch
+        self.next_renew = 0.0
+        self.thread: threading.Thread | None = None
+        self.incarnation = 0
+        # wire backends only: the replica's ShardLeaseManager over the
+        # real Lease API (the apiserver is then the fence authority)
+        self.manager = None
+        # threaded mode: the SchedulingQueue is engine-thread-only (no
+        # internal lock), so cross-thread submit/forget ride this
+        # GIL-atomic deque and the replica's own loop applies them —
+        # the same marshalling pattern as the engine's _bind_results
+        self.inbox: deque = deque()
+
+
+class FleetCoordinator:
+    """N engine replicas over one cluster backend (module docstring).
+    API-compatible with MultiProfileScheduler where the serve loop needs
+    it (submit/tracks/forget/claims/engines/metrics/traces/wake)."""
+
+    def __init__(self, cluster, config: SchedulerConfig | None = None,
+                 replicas: int | None = None, clock: Clock | None = None,
+                 mode: str | None = None, shard_count: int | None = None,
+                 lease_store: LocalLeaseStore | None = None,
+                 enabled: dict | None = None,
+                 lease_duration_s: float = 30.0,
+                 renew_period_s: float = 0.5,
+                 shard_weight: int = 8,
+                 validate_fence_locally: bool = True,
+                 seed: int = 0) -> None:
+        self.cluster = cluster
+        self.config = config or SchedulerConfig()
+        self.clock = clock or Clock()
+        self.n = max(replicas if replicas is not None
+                     else self.config.fleet_replicas, 1)
+        self.mode = mode or self.config.fleet_mode
+        if self.mode not in ("sharded", "free-for-all"):
+            raise ValueError(f"unknown fleet mode {self.mode!r}")
+        self.sharded = self.mode != "free-for-all" and self.n > 1
+        self.shard_count = max(shard_count if shard_count is not None
+                               else (self.config.shard_leases or self.n), 1)
+        self.lease_duration_s = lease_duration_s
+        self.renew_period_s = renew_period_s
+        self.shard_weight = shard_weight
+        # True (default): fence_provider re-validates the token against
+        # the local store right before commit, catching lease loss as a
+        # clean FENCE_LOST abort. False: trust the owned map until the
+        # next renew (the wire posture — ShardLeaseManager replicas always
+        # do this), so a stale token actually travels to the AUTHORITY and
+        # comes back as a 409 — the chaos fuzz runs both regimes.
+        self.validate_fence_locally = validate_fence_locally
+        self.seed = seed
+        self._enabled = enabled
+        # lease plumbing depends on where the authority lives:
+        # - in-memory backends (FakeCluster family expose lease_authority)
+        #   share one LocalLeaseStore, wired in as the bind-time fence
+        #   validator;
+        # - wire backends (KubeCluster exposes .client) run each replica's
+        #   leases through the real Lease API (ShardLeaseManager) and the
+        #   APISERVER validates the fence annotation — an engine-side
+        #   store would fence against leases the server never saw.
+        self._wire_leases = (self.sharded
+                             and not hasattr(cluster, "lease_authority")
+                             and getattr(cluster, "client", None) is not None)
+        self.lease_store = lease_store or LocalLeaseStore(self.clock)
+        if self.sharded and getattr(cluster, "lease_authority", None) is None \
+                and hasattr(cluster, "lease_authority"):
+            cluster.lease_authority = self.lease_store
+        self.threaded = False
+        self.wake = threading.Event()
+        self._rr = 0
+        # pod keys submitted through a replica inbox but not yet drained
+        # onto its queue: tracks() consults this SET instead of copying
+        # every inbox per call (the serve intake calls tracks once per
+        # pending pod per pass — O(inboxes) copies there were quadratic
+        # during bursts). GIL-atomic add/discard; advisory like tracks()
+        # itself — the serve loop's seen-uid map is the duplicate guard.
+        self._inflight: set[str] = set()
+        self.replicas: list[_Replica] = [
+            self._build_replica(i) for i in range(self.n)]
+        sub = getattr(cluster, "subscribe", None)
+        if sub is not None:
+            sub(lambda ev: self.wake.set())
+
+    # -------------------------------------------------------------- building
+    def _build_replica(self, idx: int, incarnation: int = 0) -> _Replica:
+        # replica 0 runs the configured rng_seed so a fleet of ONE is the
+        # classic engine bit-for-bit; higher replicas deterministically
+        # diversify their tie-breaks, which spreads free-for-all replicas
+        # across equal-score nodes instead of racing for the same one
+        cfg = self.config if idx == 0 else self.config.with_(
+            rng_seed=self.config.rng_seed + 7919 * idx)
+        if self._enabled is None:
+            profile, _alloc, _gang = default_profile(cfg)
+        else:
+            profile = build_profile(cfg, self._enabled)
+        identity = f"{cfg.scheduler_name}-{idx}.{incarnation}"
+        rep = _Replica(idx, None, identity)
+        rep.incarnation = incarnation
+        if self.sharded:
+            profile.score.append(ShardScore(
+                self.shard_count, rep.owned, weight=self.shard_weight))
+        engine = Scheduler(self.cluster, cfg, profile=profile,
+                           clock=self.clock)
+        engine.victim_router = self.submit
+        if self.sharded:
+            if self._wire_leases:
+                from ..k8s.leaderelect import ShardLeaseManager
+
+                rep.manager = ShardLeaseManager(
+                    self.cluster.client, self.shard_count,
+                    identity=identity,
+                    preferred={s for s in range(self.shard_count)
+                               if s % self.n == idx},
+                    lease_duration_s=self.lease_duration_s,
+                    clock=self.clock)
+            engine.fence_provider = self._make_fence_provider(rep)
+        rep.engine = engine
+        return rep
+
+    def _make_fence_provider(self, rep: _Replica):
+        def provider(pod, node):
+            s = shard_of(node, self.shard_count)
+            epoch = rep.owned.get(s)
+            if epoch is None:
+                return None  # foreign shard: unfenced optimistic bind
+            token = (f"{SHARD_LEASE_PREFIX}{s}", rep.identity, epoch)
+            if rep.manager is not None or not self.validate_fence_locally:
+                # trust-owned posture: the AUTHORITY validates at commit —
+                # a token gone stale since the last renew comes back as
+                # an ordinary 409 conflict, same recovery path (wire
+                # replicas always run this way; local fleets opt in)
+                return token
+            if not self.lease_store.validate_fence(token):
+                # expired/stolen since the cycle started: ONE clean abort,
+                # then the shard leaves `owned` and retries go unfenced
+                rep.owned.pop(s, None)
+                rep.engine._score_memo.clear()
+                return FENCE_LOST
+            return token
+        return provider
+
+    # --------------------------------------------------------------- leases
+    def _lease_name(self, shard: int) -> str:
+        return f"{SHARD_LEASE_PREFIX}{shard}"
+
+    def _lease_step(self, rep: _Replica, now: float) -> None:
+        """One upkeep pass for one replica: renew owned shards (dropping
+        the lost), acquire preferred shards, take over expired ones."""
+        if rep.manager is not None:
+            # wire leases: the manager talks to the real Lease API; sync
+            # its owned map into the one ShardScore/fence_provider read
+            before = dict(rep.owned)
+            rep.manager.step()
+            rep.owned.clear()
+            rep.owned.update(rep.manager.owned)
+            if rep.owned != before:
+                rep.engine._score_memo.clear()
+            rep.next_renew = now + self.renew_period_s
+            return
+        changed = False
+        for s in list(rep.owned):
+            if not self.lease_store.renew(self._lease_name(s),
+                                          rep.identity, rep.owned[s]):
+                rep.owned.pop(s, None)
+                changed = True
+        for s in range(self.shard_count):
+            if s in rep.owned:
+                continue
+            preferred = (s % self.n == rep.idx)
+            if not preferred:
+                held = self.lease_store.holder(self._lease_name(s))
+                if held is None:
+                    continue  # absent: leave it to its preferrer
+            epoch = self.lease_store.try_acquire(
+                self._lease_name(s), rep.identity, self.lease_duration_s)
+            if epoch is not None:
+                rep.owned[s] = epoch
+                changed = True
+        if changed:
+            # shard ownership is a score input outside every version
+            # vector: the score-class memo must not replay stale
+            # shard-affinity raws
+            rep.engine._score_memo.clear()
+        rep.next_renew = now + self.renew_period_s
+
+    # --------------------------------------------------------------- intake
+    def claims(self, scheduler_name: str) -> bool:
+        return scheduler_name == self.config.scheduler_name
+
+    def _route(self, pod: Pod) -> _Replica:
+        # gangs ride their gang name in EVERY mode: gang state (permit
+        # parking, slice plans) is engine-local, so members split across
+        # replicas would each wait forever for peers the other engine
+        # holds — round-robin must never shred a gang
+        gang = pod.labels.get(GANG_NAME_LABEL)
+        if gang:
+            # STABLE index mapping, never live lease ownership: members
+            # of one gang arrive over time, and routing by ownership
+            # would split the gang permanently across replicas the first
+            # time a lease changed hands mid-assembly
+            return self.replicas[shard_of(gang, self.n)]
+        if not self.sharded:
+            self._rr = (self._rr + 1) % self.n
+            return self.replicas[self._rr]
+        s = shard_of(pod.key, self.shard_count)
+        for rep in self.replicas:
+            if s in rep.owned:
+                return rep
+        return self.replicas[s % self.n]
+
+    def submit(self, pod: Pod) -> bool:
+        if pod.scheduler_name != self.config.scheduler_name:
+            return False
+        rep = self._route(pod)
+        if self.threaded:
+            # the replica's queue is its own thread's property: marshal
+            # the submission through its inbox instead of racing pop()
+            self._inflight.add(pod.key)
+            rep.inbox.append(("submit", pod))
+            rep.engine.wake.set()
+            self.wake.set()
+            return True
+        ok = rep.engine.submit(pod)
+        if ok:
+            self.wake.set()
+        return ok
+
+    def submit_to(self, idx: int, pod: Pod) -> bool:
+        """Chaos hook: queue a pod on a SPECIFIC replica — the split-brain
+        injection queues the same pod on two replicas at once."""
+        return self.replicas[idx].engine.submit(pod)
+
+    def tracks(self, pod_key: str) -> bool:
+        # advisory in threaded mode (GIL-atomic dict/set reads; the
+        # serve loop's seen-uid map is the real duplicate guard)
+        return (pod_key in self._inflight
+                or any(r.engine.tracks(pod_key) for r in self.replicas))
+
+    def forget(self, pod_key: str) -> None:
+        for r in self.replicas:
+            if self.threaded:
+                r.inbox.append(("forget", pod_key))
+                r.engine.wake.set()
+            else:
+                r.engine.forget(pod_key)
+
+    # -------------------------------------------------------------- driving
+    def step(self, rng: random.Random | None = None) -> str | None:
+        """Deterministic single-step: lease upkeep for every due replica,
+        then one scheduling cycle on the first ready replica in seeded
+        rotation. Returns the cycle outcome or None when every replica is
+        idle. The chaos fuzz interleaves replicas through this, so a
+        seed fully determines the commit order."""
+        now = self.clock.time()
+        if self.sharded:
+            for rep in self.replicas:
+                if now >= rep.next_renew:
+                    self._lease_step(rep, now)
+        order = list(self.replicas)
+        if rng is not None:
+            rng.shuffle(order)
+        for rep in order:
+            outcome = rep.engine.run_one()
+            if outcome is not None:
+                return outcome
+        return None
+
+    def next_wake_at(self) -> float | None:
+        wakes = [w for w in (r.engine.next_wake_at()
+                             for r in self.replicas) if w is not None]
+        return min(wakes) if wakes else None
+
+    def run_until_idle(self, max_cycles: int = 100_000,
+                       rng: random.Random | None = None) -> int:
+        """Drain the whole fleet deterministically (tests/bench harness):
+        seeded replica interleave, shared virtual clock."""
+        rng = rng if rng is not None else random.Random(self.seed)
+        cycles = 0
+        while cycles < max_cycles:
+            if self.step(rng) is not None:
+                cycles += 1
+                continue
+            wake = self.next_wake_at()
+            if wake is None:
+                break
+            self.clock.sleep(max(wake - self.clock.time(), 0.01))
+            cycles += 1
+        return cycles
+
+    # ------------------------------------------------------------- threaded
+    def start(self, stop: threading.Event) -> None:
+        """Serve/bench mode: one thread per replica, each running its own
+        cycle loop (lease upkeep inline, cycles whenever ready, parked on
+        the engine's wake event otherwise)."""
+        self.threaded = True
+        for rep in self.replicas:
+            t = threading.Thread(target=self._loop, args=(rep, stop),
+                                 daemon=True, name=f"fleet-{rep.idx}")
+            rep.thread = t
+            t.start()
+
+    def _drain_inbox(self, rep: _Replica) -> None:
+        """Apply cross-thread submit/forget requests on the replica's own
+        thread (the queue has no internal lock)."""
+        while rep.inbox:
+            try:
+                op, arg = rep.inbox.popleft()
+            except IndexError:
+                return
+            if op == "submit":
+                rep.engine.submit(arg)
+                # after the queue actually holds it, engine.tracks covers
+                # it — drop the inflight marker (order matters: removing
+                # first would open a tracked-nowhere window)
+                self._inflight.discard(arg.key)
+            else:
+                rep.engine.forget(arg)
+
+    def _loop(self, rep: _Replica, stop: threading.Event) -> None:
+        engine = rep.engine
+        while not stop.is_set():
+            if rep.inbox:
+                self._drain_inbox(rep)
+            now = self.clock.time()
+            if self.sharded and now >= rep.next_renew:
+                self._lease_step(rep, now)
+            try:
+                outcome = engine.run_one()
+            except Exception:
+                # run_one contains cycle crashes; anything escaping is an
+                # engine bug — log and keep the replica alive (the fleet's
+                # whole point is surviving exactly this)
+                log.exception("replica %d cycle escaped containment",
+                              rep.idx)
+                outcome = None
+            if outcome is None:
+                wake = engine.next_wake_at()
+                timeout = 0.05
+                if wake is not None:
+                    timeout = min(max(wake - self.clock.time(), 0.001),
+                                  0.05)
+                if engine.wake.wait(timeout):
+                    engine.wake.clear()
+
+    def join(self, timeout: float = 5.0) -> None:
+        for rep in self.replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout=timeout)
+
+    # ----------------------------------------------------------- chaos hooks
+    def crash_replica(self, idx: int, pods=None) -> _Replica:
+        """A replica process dies: every engine-local thing (queue,
+        reservations, memos, lease beliefs) is gone. Build a fresh
+        incarnation and reconcile ITS share of the workload from cluster
+        truth — pods other replicas still track are left alone (fleet-
+        level tracks guard), bound pods are adopted, the rest requeue.
+        The dead incarnation's leases expire on their own; survivors take
+        them over through the ordinary expiry path."""
+        if self.threaded:
+            # the dead incarnation's thread would keep scheduling and the
+            # replacement would never get one — this hook simulates a
+            # process death for the DETERMINISTIC driver only
+            raise RuntimeError("crash_replica is not available in "
+                               "threaded mode")
+        old = self.replicas[idx]
+        rep = self._build_replica(idx, incarnation=old.incarnation + 1)
+        self.replicas[idx] = rep
+        if pods:
+            rep.engine.reconcile(
+                [p for p in pods if not self.tracks(p.key)])
+        return rep
+
+    def revoke_replica_leases(self, idx: int) -> int:
+        """Chaos: force-expire every lease the replica currently owns
+        (LEASE_EXPIRY window). Its next fenced commit aborts cleanly; the
+        shards are up for takeover immediately."""
+        rep = self.replicas[idx]
+        revoked = 0
+        for s in list(rep.owned):
+            self.lease_store.revoke(self._lease_name(s))
+            revoked += 1
+        return revoked
+
+    # ------------------------------------------------------------ reporting
+    @property
+    def engines(self) -> dict[str, Scheduler]:
+        return {f"replica-{r.idx}": r.engine for r in self.replicas}
+
+    @property
+    def metrics(self):
+        return _MergedMetricsView(self)
+
+    @property
+    def traces(self):
+        return _MergedTracesView(self)
+
+    def bin_pack_utilization(self) -> float:
+        return self.replicas[0].engine.bin_pack_utilization()
+
+    def fleet_stats(self) -> dict:
+        """Aggregate + per-replica shared-state counters: binds committed
+        per replica (the share), conflicts by resolution, lease aborts,
+        and the authority's own rejection book (the server-side proof)."""
+        keys = ("pods_scheduled_total", "bind_conflicts_total",
+                "bind_conflict_retries_total",
+                "foreign_bind_conflicts_total", "foreign_bind_skips_total",
+                "lease_lost_aborts_total", "bind_errors_total",
+                "async_bind_conflict_corrections_total")
+        agg = {k: 0 for k in keys}
+        per_replica = []
+        for r in self.replicas:
+            c = r.engine.metrics.counters
+            per_replica.append({k: c.get(k, 0) for k in keys})
+            for k in keys:
+                agg[k] += c.get(k, 0)
+        out = dict(agg)
+        # async dispatch counts optimistically; a later 409 records a
+        # correction — the share is committed binds, not dispatches
+        out["pods_scheduled_total"] -= out.pop(
+            "async_bind_conflict_corrections_total")
+        out["per_replica_binds"] = [
+            p["pods_scheduled_total"]
+            - p["async_bind_conflict_corrections_total"]
+            for p in per_replica]
+        out["shards_owned"] = [sorted(r.owned) for r in self.replicas]
+        out["authority_rejections"] = dict(
+            getattr(self.cluster, "bind_conflicts", {}) or {})
+        return out

@@ -337,6 +337,12 @@ class QueuedPodInfo:
     # which counts orderly unschedulable verdicts) — the engine
     # quarantines the pod past SchedulerConfig.quarantine_threshold
     crashes: int = 0
+    # consecutive server-rejected bind CONFLICTS (409 node-claim races in
+    # a scheduler fleet). Conflict retries are attempt-free and
+    # backoff-free — the loser of an optimistic race did nothing wrong —
+    # but a pathological streak falls back to the ordinary backoff path
+    # (core._bind_conflict)
+    conflicts: int = 0
 
 
 # --------------------------------------------------------------------------

@@ -89,6 +89,12 @@ class MultiProfileScheduler:
             return False
         return engine.submit(pod)
 
+    def claims(self, scheduler_name: str) -> bool:
+        """Does some profile serve this spec.schedulerName? (The serve
+        loop's intake filter — FleetCoordinator answers the same question
+        for its single shared name.)"""
+        return scheduler_name in self.engines
+
     def tracks(self, pod_key: str) -> bool:
         return any(e.tracks(pod_key) for e in self.engines.values())
 
