@@ -20,7 +20,10 @@ control loop keeps CONVERGING through any single-component outage:
   leader failover over the real localhost fake apiserver.
 """
 
+import json
+import os
 import random
+import tempfile
 import threading
 import time
 
@@ -167,7 +170,46 @@ def _drive(sched, store, plan, pods, rebuild=None):
             clock.advance(max(wake - clock.time(), TICK))
 
 
-def _assert_invariants(pods, store, cluster, seed):
+def _dump_flight(sched, cluster, tag: str) -> str | None:
+    """Black-box failure reporting: write the engine's (or fleet's)
+    flight-recorder ring to disk so a failed chaos seed ships its
+    interleaved fault/recovery timeline with the assertion. Directory:
+    $YODA_FLIGHT_DIR (CI uploads it as an artifact on chaos-job failure)
+    or a tempdir fallback."""
+    if sched is None:
+        return None
+    d = os.environ.get("YODA_FLIGHT_DIR") or os.path.join(
+        tempfile.gettempdir(), "yoda-flight")
+    try:
+        os.makedirs(d, exist_ok=True)
+        events = sched.flight.snapshot()
+        injected = dict(getattr(cluster, "injected", {}) or {})
+        path = os.path.join(d, f"flight-{tag}.json")
+        with open(path, "w") as f:
+            json.dump({"reason": f"invariant violation ({tag})",
+                       "injected_faults": injected,
+                       "events": events}, f, indent=1)
+        return path
+    except OSError:
+        return None
+
+
+def _assert_invariants(pods, store, cluster, seed, sched=None):
+    try:
+        _assert_invariants_inner(pods, store, cluster, seed)
+    except AssertionError as e:
+        # stamp the violation into the ring first (single engines only —
+        # the fleet's merged view is read-only), then dump the black box
+        flight = getattr(sched, "flight", None)
+        if hasattr(flight, "record"):
+            flight.record("invariant_violation", seed=str(seed))
+        path = _dump_flight(sched, cluster, str(seed))
+        if path is not None and hasattr(e, "add_note"):
+            e.add_note(f"flight recorder dumped to {path}")
+        raise
+
+
+def _assert_invariants_inner(pods, store, cluster, seed):
     by_metrics = {m.node: m for m in store.list()}
 
     # 1 + 4. no pod lost / convergence: the workload is satisfiable, so
@@ -242,8 +284,12 @@ def test_chaos_fuzz(seed):
     pods = _workload(rng)
 
     def build():
-        return _build_engine(cluster, clock, plan=plan,
-                             crash_hook=crash_hook)
+        s = _build_engine(cluster, clock, plan=plan,
+                          crash_hook=crash_hook)
+        # injected faults land in the engine's black box, so a failing
+        # seed's dump reads as one interleaved fault/recovery timeline
+        cluster.flight = s.flight
+        return s
 
     def rebuild(_old):
         # ENGINE_CRASH: the process died; all engine-local state
@@ -257,7 +303,7 @@ def test_chaos_fuzz(seed):
     for p in pods:
         sched.submit(p)
     sched = _drive(sched, store, plan, pods, rebuild=rebuild)
-    _assert_invariants(pods, store, cluster, seed)
+    _assert_invariants(pods, store, cluster, seed, sched=sched)
     # engine thread survived by construction — a raise anywhere in the
     # drive would have failed the test. (Whether a PLUGIN_ERROR window
     # actually intersected live cycles is seed-dependent — pods may all
@@ -370,7 +416,7 @@ def test_fleet_chaos_fuzz(seed):
     for p in pods:
         fleet.submit(p)
     _drive_fleet(fleet, plan, pods, rng)
-    _assert_invariants(pods, store, cluster, f"fleet-{seed}")
+    _assert_invariants(pods, store, cluster, f"fleet-{seed}", sched=fleet)
     # the authority's conflict book is consistent with the outcome: any
     # server-side rejection was resolved (the invariants above prove no
     # rejected commit ever half-landed). pods_scheduled_total is NOT
@@ -493,6 +539,63 @@ def test_breaker_opens_parks_and_recovers():
     # attempts (threshold + one probe per reopen), not one per pod per
     # backoff tick
     assert cluster.injected[APISERVER_STORM] <= 8, cluster.injected
+
+
+# ------------------------------------------- targeted: flight recorder
+def test_flight_recorder_auto_dumps_on_breaker_open(tmp_path):
+    """The black box: a storm that opens the breaker must leave a dump on
+    disk (the trip kind auto-dump), and the ring must read as an
+    interleaved timeline — injected faults (ChaosCluster.flight) next to
+    the engine's breaker transitions."""
+    clock = FakeClock()
+    plan = FaultPlan(0, horizon_s=10.0)
+    plan.windows = [FaultWindow(APISERVER_STORM, 0.0, 4.0)]
+    store, cluster = _simple_rig(clock=clock, cluster_cls=ChaosCluster,
+                                 plan=plan)
+    cluster.clock = clock
+    sched = _build_engine(cluster, clock, breaker_threshold=3,
+                          telemetry_max_age_s=1e9,
+                          flight_dump_dir=str(tmp_path))
+    cluster.flight = sched.flight
+    pods = [Pod(f"p{i}", labels={"tpu/accelerator": "tpu",
+                                 "scv/number": "1"}) for i in range(4)]
+    for p in pods:
+        sched.submit(p)
+    _drain(sched, pods)
+    assert all(p.phase == PodPhase.BOUND for p in pods)
+    kinds = [e["kind"] for e in sched.flight.snapshot()]
+    assert "fault_injected" in kinds
+    assert "breaker_open" in kinds
+    assert "breaker_close" in kinds
+    # chronology: the first injected fault precedes the breaker opening
+    assert kinds.index("fault_injected") < kinds.index("breaker_open")
+    # the trip kind auto-dumped to the configured directory
+    assert sched.flight.dumps, "breaker_open did not dump the black box"
+    with open(sched.flight.dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "breaker_open"
+    assert any(e["kind"] == "breaker_open" for e in doc["events"])
+
+
+def test_invariant_violation_dump_path(tmp_path, monkeypatch):
+    """_assert_invariants ships the black box with a failed seed: force a
+    bogus invariant check and assert the dump lands in $YODA_FLIGHT_DIR."""
+    monkeypatch.setenv("YODA_FLIGHT_DIR", str(tmp_path))
+    clock = FakeClock()
+    store, cluster = _simple_rig(clock=clock)
+    sched = _build_engine(cluster, clock, telemetry_max_age_s=1e9)
+    pod = Pod("p0", labels={"tpu/accelerator": "tpu", "scv/number": "1"})
+    sched.submit(pod)
+    _drain(sched, [pod])
+    # lie about the pod's phase so invariant 1 trips
+    pod.phase = PodPhase.PENDING
+    with pytest.raises(AssertionError):
+        _assert_invariants([pod], store, cluster, "forced", sched=sched)
+    dumps = list(tmp_path.iterdir())
+    assert dumps, "invariant violation did not dump the flight recorder"
+    doc = json.loads(dumps[0].read_text())
+    assert any(e["kind"] == "invariant_violation" for e in doc["events"])
+    pod.phase = PodPhase.BOUND  # restore for any shared state
 
 
 # ------------------------------------------------------ targeted: degraded mode

@@ -1,0 +1,390 @@
+"""utils/obs.py: lifecycle spans, labeled metrics, exposition correctness,
+quantile caching, the injectable-clock trace contract, the flight
+recorder, and the e2e latency decomposition stamps.
+
+The Prometheus tests are PARSER-based: the rendered text must round-trip
+through prometheus_client's exposition parser (the spec's reference
+implementation), not just match substrings — # HELP lines, label-value
+escaping, and +Inf buckets are exactly the things substring tests miss.
+"""
+
+import json
+
+import pytest
+
+pytest.importorskip("prometheus_client",
+                    reason="exposition golden tests need the reference "
+                           "parser (pip install prometheus-client)")
+from prometheus_client.parser import text_string_to_metric_families  # noqa: E402
+
+from yoda_scheduler_tpu.scheduler import (
+    FakeCluster, FleetCoordinator, Scheduler, SchedulerConfig)
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.obs import (
+    CycleTrace,
+    FlightRecorder,
+    Histogram,
+    Metrics,
+    SpanRing,
+    export_chrome_trace,
+    span_sampled,
+)
+
+
+def mk_sched(n_nodes=2, chips=4, config=None, clock=None):
+    store = TelemetryStore()
+    clock = clock or FakeClock(start=1000.0)
+    for i in range(n_nodes):
+        m = make_tpu_node(f"n{i}", chips=chips)
+        m.heartbeat = clock.time()
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg = config or SchedulerConfig(telemetry_max_age_s=1e9,
+                                    trace_sampling=1)
+    return Scheduler(cluster, cfg, clock=clock), clock
+
+
+def parse(text):
+    """prometheus text -> {family name: {frozenset(labels): value}}."""
+    out = {}
+    for fam in text_string_to_metric_families(text):
+        for s in fam.samples:
+            out.setdefault(s.name, {})[
+                frozenset(s.labels.items())] = s.value
+    return out
+
+
+# ------------------------------------------------------- clock threading
+class TestCycleTraceClock:
+    def test_finish_requires_explicit_now(self):
+        t = CycleTrace(pod="default/p", started=5.0)
+        with pytest.raises(TypeError):
+            t.finish("bound")  # wall-clock default was the bug
+
+    def test_trace_latency_uses_engine_clock_not_wall(self):
+        """A chaos-style virtual-clock run: trace latencies must be pure
+        simulated time — a pod that waits out a 1s backoff on the fake
+        clock reports ~1000ms, never wall microseconds (or wall epochs
+        mixed with the virtual epoch)."""
+        sched, clock = mk_sched(n_nodes=1, chips=1)
+        blocker = Pod("blocker", labels={"scv/number": "1",
+                                         "tpu/accelerator": "tpu"})
+        waiter = Pod("waiter", labels={"scv/number": "1",
+                                       "tpu/accelerator": "tpu"})
+        sched.submit(blocker)
+        sched.run_until_idle(max_cycles=3)
+        sched.submit(waiter)
+        sched.run_until_idle(max_cycles=10)  # waiter: unschedulable, parks
+        assert waiter.phase == PodPhase.PENDING
+        for t in sched.traces.recent(10):
+            # every latency is in the virtual timebase: non-negative and
+            # far below the 1000.0 epoch (wall time.time() leaking into
+            # either end would produce ~1.7e12 ms values)
+            assert 0.0 <= t.latency_ms < 60_000.0, t
+            assert t.started >= 1000.0, t
+
+    def test_started_has_no_wall_default(self):
+        assert CycleTrace(pod="x").started == 0.0
+
+
+# ------------------------------------------------------- histogram cache
+class TestHistogramQuantile:
+    def test_quantiles_correct_and_cached(self):
+        h = Histogram()
+        for v in [5, 1, 9, 3, 7]:
+            h.observe(v)
+        assert h.quantile(0.0) == 1
+        # cache is keyed by observation count: same n -> same sorted list
+        first = h._sorted
+        assert first is not None and first[0] == 5
+        h.quantile(0.5)
+        assert h._sorted is first  # no re-sort between observations
+        h.observe(0)
+        assert h.quantile(0.0) == 0  # invalidated by the new observation
+        assert h._sorted[0] == 6
+
+    def test_merge_invalidates_via_count(self):
+        a, b = Histogram(), Histogram()
+        a.observe(10)
+        assert a.quantile(0.5) == 10
+        b.observe(1)
+        a.merge_from(b)
+        assert a.quantile(0.0) == 1
+
+
+# ----------------------------------------------------- labeled exposition
+class TestLabeledMetrics:
+    def test_plain_counters_keep_flat_rendering(self):
+        m = Metrics()
+        m.inc("pods_scheduled_total")
+        text = m.render_prometheus()
+        assert "yoda_tpu_pods_scheduled_total 1" in text
+        assert "# HELP yoda_tpu_pods_scheduled_total" in text
+        assert "# TYPE yoda_tpu_pods_scheduled_total counter" in text
+
+    def test_labeled_series_round_trip_through_parser(self):
+        m = Metrics()
+        m.inc("scheduling_outcomes_total", labels={"outcome": "bound"})
+        m.inc("scheduling_outcomes_total", 2,
+              labels={"outcome": "unschedulable"})
+        m.set_gauge("shard_owned", 1.0,
+                    labels={"shard": "3", "replica": "replica-1"})
+        m.observe("schedule_latency_ms", 12.5)
+        fams = parse(m.render_prometheus())
+        oc = fams["yoda_tpu_scheduling_outcomes_total"]
+        assert oc[frozenset({("outcome", "bound")}.__iter__())] == 1
+        assert oc[frozenset([("outcome", "unschedulable")])] == 2
+        sh = fams["yoda_tpu_shard_owned"]
+        assert sh[frozenset([("shard", "3"),
+                             ("replica", "replica-1")])] == 1.0
+        # histogram: +Inf bucket == count, sum present
+        buckets = fams["yoda_tpu_schedule_latency_ms_bucket"]
+        inf = next(v for k, v in buckets.items()
+                   if ("le", "+Inf") in k)
+        count = fams["yoda_tpu_schedule_latency_ms_count"]
+        assert inf == list(count.values())[0] == 1
+        assert list(
+            fams["yoda_tpu_schedule_latency_ms_sum"].values())[0] == 12.5
+
+    def test_label_value_escaping(self):
+        m = Metrics()
+        evil = 'quo"te\\slash\nnewline'
+        m.inc("filter_rejections_total", labels={"plugin": evil})
+        text = m.render_prometheus()
+        fams = parse(text)  # the parser itself chokes on bad escaping
+        labels = list(fams["yoda_tpu_filter_rejections_total"].keys())[0]
+        assert ("plugin", evil) in labels  # value survives round-trip
+
+    def test_labeled_counter_reader(self):
+        m = Metrics()
+        m.inc("cycle_plane_total", labels={"plane": "native"})
+        assert m.labeled_counter("cycle_plane_total",
+                                 {"plane": "native"}) == 1
+        assert m.labeled_counter("cycle_plane_total",
+                                 {"plane": "scalar"}) == 0
+
+    def test_every_family_carries_help(self):
+        m = Metrics()
+        m.inc("some_novel_counter_total")
+        m.set_gauge("some_novel_gauge", 2.0)
+        m.observe("some_novel_hist_ms", 1.0)
+        text = m.render_prometheus()
+        for fam in ("some_novel_counter_total", "some_novel_gauge",
+                    "some_novel_hist_ms"):
+            assert f"# HELP yoda_tpu_{fam}" in text, fam
+
+
+# --------------------------------------------------------------- spans
+class TestSpanRing:
+    def test_sampling_is_deterministic_and_rate_shaped(self):
+        keys = [f"default/pod-{i}" for i in range(4000)]
+        assert all(span_sampled(k, 1) for k in keys)
+        assert not any(span_sampled(k, 0) for k in keys)
+        picked = [k for k in keys if span_sampled(k, 8)]
+        assert picked == [k for k in keys if span_sampled(k, 8)]  # stable
+        assert 4000 / 16 < len(picked) < 4000 / 4  # ~1 in 8
+
+    def test_chrome_export_shape(self, tmp_path):
+        ring = SpanRing(pid=2)
+        ring.record("queued", "default/p", 1.0, 1.5, {"attempts": 0})
+        ring.record("cycle", "default/p", 1.5, 1.6)
+        doc = export_chrome_trace([ring], str(tmp_path / "t.json"))
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        meta = [e for e in evs if e["ph"] == "M"]
+        spans = [e for e in evs if e["ph"] == "X"]
+        assert meta and meta[0]["args"]["name"] == "default/p"
+        assert len(spans) == 2
+        q = spans[0]
+        assert q["ts"] == 1.0e6 and q["dur"] == 0.5e6 and q["pid"] == 2
+        assert q["args"] == {"attempts": 0}
+        # same subject -> same tid (one Perfetto lane per pod)
+        assert spans[0]["tid"] == spans[1]["tid"]
+        on_disk = json.loads((tmp_path / "t.json").read_text())
+        assert on_disk["traceEvents"] == evs
+
+    def test_ring_is_bounded(self):
+        ring = SpanRing(capacity=4)
+        for i in range(10):
+            ring.record("cycle", f"p{i}", i, i + 1)
+        assert len(ring) == 4
+
+    def test_engine_records_full_tree_at_sampling_1(self):
+        sched, _ = mk_sched()
+        pods = [Pod(f"p{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(4)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        names = {s[0] for s in sched.spans.snapshot()}
+        for expected in ("queued", "cycle", "cycle.filter", "cycle.score",
+                         "cycle.reserve", "bind_wire"):
+            assert expected in names, (expected, names)
+        # cycle spans carry outcome + plane attribution
+        cycles = [s for s in sched.spans.snapshot() if s[0] == "cycle"]
+        assert any(s[4].get("outcome") == "bound" for s in cycles)
+        assert all(s[3] >= s[2] for s in sched.spans.snapshot())
+
+    def test_sampling_zero_records_nothing(self):
+        sched, _ = mk_sched(config=SchedulerConfig(
+            telemetry_max_age_s=1e9, trace_sampling=0))
+        pod = Pod("p", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(pod)
+        sched.run_until_idle()
+        assert pod.phase == PodPhase.BOUND
+        assert len(sched.spans) == 0
+
+    def test_backoff_stint_becomes_queued_backoff_span(self):
+        sched, clock = mk_sched(n_nodes=1, chips=1)
+        blocker = Pod("blocker", labels={"scv/number": "1",
+                                         "tpu/accelerator": "tpu"})
+        waiter = Pod("waiter", labels={"scv/number": "1",
+                                       "tpu/accelerator": "tpu"})
+        sched.submit(blocker)
+        sched.run_until_idle(max_cycles=3)
+        sched.submit(waiter)
+        sched.run_until_idle(max_cycles=12)
+        segs = [s[4]["segment"] for s in sched.spans.snapshot()
+                if s[0] == "queued" and s[1] == "default/waiter"]
+        assert "intake" in segs and "backoff" in segs
+
+
+# ------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_ring_bounded_and_kind_collision_safe(self):
+        f = FlightRecorder(capacity=3)
+        f.record("a", kind="payload-kind", x=1)  # detail key named kind
+        for i in range(5):
+            f.record("b", i=i)
+        snap = f.snapshot()
+        assert len(snap) == 3
+        assert all(e["kind"] == "b" for e in snap)
+
+    def test_trip_kind_auto_dumps_and_rate_limits(self, tmp_path):
+        f = FlightRecorder(dump_dir=str(tmp_path), min_dump_interval_s=60)
+        f.record("breaker_open", failures=3)
+        f.record("breaker_open", failures=4)  # rate-limited: no 2nd file
+        assert len(f.dumps) == 1
+        doc = json.loads(open(f.dumps[0]).read())
+        assert doc["reason"] == "breaker_open"
+        assert doc["events"][0]["failures"] == 3
+
+    def test_non_trip_kinds_stay_in_memory(self, tmp_path):
+        f = FlightRecorder(dump_dir=str(tmp_path))
+        f.record("degraded_mode", active=True)
+        assert not f.dumps and not list(tmp_path.iterdir())
+
+    def test_uses_injected_clock_for_timestamps(self):
+        clock = FakeClock(start=42.0)
+        f = FlightRecorder(clock=clock)
+        f.record("x")
+        assert f.snapshot()[0]["ts"] == 42.0
+
+
+# --------------------------------------------------- e2e decomposition
+class TestE2EDecomposition:
+    def test_phases_partition_e2e_within_5pct(self):
+        import bench
+        from yoda_scheduler_tpu.scheduler.core import HybridClock
+
+        # HybridClock: real compute time + virtual sleeps — phases need
+        # elapsed time to partition (a pure FakeClock drain is 0ms e2e)
+        sched, clock = mk_sched(n_nodes=4, chips=4, clock=HybridClock())
+        pods = [Pod(f"p{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(12)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        bd = bench.e2e_breakdown(sched)
+        assert bd["n"] == 12
+        # per-pod the stamps partition enqueue->bind exactly, so the
+        # mean-based coverage is the arithmetic check on the stamps
+        assert bd["coverage_mean_pct"] >= 95.0, bd
+        assert bd["coverage_pct"] >= 95.0, bd
+
+    def test_backoff_time_lands_in_queue_wait(self):
+        sched, clock = mk_sched(n_nodes=1, chips=1)
+        blocker = Pod("blocker", labels={"scv/number": "1",
+                                         "tpu/accelerator": "tpu"})
+        waiter = Pod("waiter", labels={"scv/number": "1",
+                                       "tpu/accelerator": "tpu"})
+        sched.submit(blocker)
+        sched.run_until_idle(max_cycles=3)
+        sched.submit(waiter)
+        sched.run_until_idle(max_cycles=10)
+        # free the node: waiter binds on a retry after real backoff
+        sched.cluster.evict(blocker)
+        sched.submit(blocker := blocker)  # noqa: F841 (readability)
+        sched.run_until_idle(max_cycles=50)
+        assert waiter.phase == PodPhase.BOUND
+        h = sched.metrics.histograms.get("e2e_queue_wait_ms")
+        assert h is not None and h.n >= 1
+        # the waiter sat out at least one ~1s backoff on the fake clock
+        assert max(h.samples()) >= 900.0
+
+
+# ------------------------------------------ fleet merged labeled scrape
+class TestFleetMergedMetrics:
+    def test_single_scrape_exposes_per_replica_series(self):
+        store = TelemetryStore()
+        clock = FakeClock(start=100.0)
+        for i in range(8):
+            m = make_tpu_node(f"n{i}", chips=4)
+            m.heartbeat = clock.time()
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        fleet = FleetCoordinator(
+            cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+            replicas=2, clock=clock, mode="sharded")
+        pods = [Pod(f"p{i}", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(16)]
+        for p in pods:
+            fleet.submit(p)
+        fleet.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in pods)
+        fams = parse(fleet.metrics.render_prometheus())
+        sched_fam = fams["yoda_tpu_pods_scheduled_total"]
+        replicas = {dict(k).get("replica") for k in sched_fam.keys()}
+        assert {"replica-0", "replica-1"} <= replicas
+        # every replica's share is labeled; the sum is the fleet total
+        assert sum(sched_fam.values()) == 16
+        # labeled engine series keep their own labels + the replica one
+        oc = fams["yoda_tpu_scheduling_outcomes_total"]
+        assert any(("outcome", "bound") in k and
+                   ("replica", "replica-0") in k for k in oc)
+        # shard-lease ownership surfaces as a labeled info gauge
+        sh = fams.get("yoda_tpu_shard_owned", {})
+        assert any(("replica", "replica-0") in k for k in sh)
+        assert all(dict(k).get("shard") is not None for k in sh)
+
+    def test_wire_registry_merges_into_scrape(self):
+        """KubeCluster's own registry (binder RTTs, watch_confirm,
+        reflector counters) must ride the same merged scrape, labeled as
+        the shared wire — otherwise the README-advertised bind_wire_ms /
+        watch_confirm_ms families never reach /metrics."""
+        from types import SimpleNamespace
+
+        from yoda_scheduler_tpu.scheduler.multi import _MergedMetricsView
+
+        eng = SimpleNamespace(metrics=Metrics())
+        eng.metrics.inc("pods_scheduled_total")
+        wire = Metrics()
+        wire.observe("bind_wire_ms", 2.0)
+        wire.observe("watch_confirm_ms", 3.0)
+        wire.inc("bind_wire_total", labels={"outcome": "ok"})
+        ms = SimpleNamespace(engines={"e0": eng},
+                             cluster=SimpleNamespace(metrics=wire))
+        fams = parse(_MergedMetricsView(ms).render_prometheus())
+        assert any(("replica", "wire") in k and ("outcome", "ok") in k
+                   for k in fams["yoda_tpu_bind_wire_total"])
+        assert "yoda_tpu_bind_wire_ms_bucket" in fams
+        assert "yoda_tpu_watch_confirm_ms_count" in fams

@@ -135,14 +135,19 @@ class ChaosCluster(FakeCluster):
     need "exactly the Nth bind fails" rather than a time window."""
 
     def __init__(self, telemetry=None, plan: FaultPlan | None = None,
-                 clock=None, bind_script: dict[int, str] | None = None
-                 ) -> None:
+                 clock=None, bind_script: dict[int, str] | None = None,
+                 flight=None) -> None:
         super().__init__(telemetry)
         self.plan = plan
         self.clock = clock
         self.bind_script = dict(bind_script or {})
         self.bind_calls = 0
         self.injected: dict[str, int] = {}
+        # optional utils.obs.FlightRecorder: injected faults land in the
+        # same black-box ring as the engine's reactions, so a dump reads
+        # as one interleaved timeline (fault fired -> breaker opened ->
+        # recovery path taken)
+        self.flight = flight
 
     def _now(self) -> float:
         return self.clock.time() if self.clock is not None else 0.0
@@ -163,6 +168,9 @@ class ChaosCluster(FakeCluster):
 
     def _count(self, kind: str) -> None:
         self.injected[kind] = self.injected.get(kind, 0) + 1
+        if self.flight is not None:
+            self.flight.record("fault_injected", fault=kind,
+                               bind_call=self.bind_calls - 1)
 
     def bind(self, pod, node, assigned_chips=None, fence=None) -> None:
         fault = self._bind_fault()

@@ -99,9 +99,10 @@ def cmd_simulate(args) -> int:
     if args.metrics_port is not None:
         from .utils.httpserv import serve
 
-        # merged view: every profile's counters/latencies/traces
+        # merged view: every profile's counters/latencies/traces/spans
         server, _ = serve(sched.metrics, sched.traces,
-                          port=args.metrics_port)
+                          port=args.metrics_port,
+                          spans=sched.spans, flight=sched.flight)
         log.info("metrics on http://%s:%d/metrics", *server.server_address)
 
     pods: list[Pod] = []

@@ -48,7 +48,7 @@ from ..scheduler.framework import (
 )
 from ..telemetry.schema import CRD_GROUP, CRD_PLURAL, CRD_VERSION, TpuNodeMetrics
 from ..telemetry.store import TelemetryStore
-from ..utils.obs import Metrics
+from ..utils.obs import Metrics, SpanRing, span_sampled
 from ..utils.changelog import ChangeLog
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
@@ -954,6 +954,17 @@ class KubeCluster:
         self._gc_cb_installed = False
         self.bind_wire_ns = 0
         self.bind_wire_n = 0
+        # wire-side lifecycle spans (bind_wire RTT on the binder threads,
+        # watch_confirm = bind dispatch -> watch-cache confirmation),
+        # merged into /traces/export next to the engines' rings. The wire
+        # path runs on real time, so spans here stamp time.time() — the
+        # same timebase a real-clock engine's spans use. trace_sampling
+        # mirrors the engine knob; _serve syncs it from the profile.
+        self.spans = SpanRing(pid=1000)
+        self.trace_sampling = 8
+        # pod key -> wall time the bind was dispatched, consumed by the
+        # confirming watch event (bounded; stale keys evict oldest)
+        self._confirm_t0: dict[str, float] = {}
         # async binder state (see bind_async)
         self._bind_q: deque = deque()
         self._bind_event = threading.Event()
@@ -1223,6 +1234,21 @@ class KubeCluster:
             # the pre-bind pod may still be in flight); keep the newer.
             elif old is None or not _stale_event(old, p):
                 self._set_pod(key, p)
+                if p.node:
+                    # watch_confirm: the apiserver's own event now shows
+                    # the bind we dispatched — close the span opened at
+                    # dispatch (write-through set node immediately, so the
+                    # POD_BOUND condition below never fires for our own
+                    # binds; the confirm stamp is how dispatch->confirmed
+                    # latency stays measurable)
+                    t0 = self._confirm_t0.pop(key, None)
+                    if t0 is not None:
+                        nowt = time.time()
+                        self.metrics.observe("watch_confirm_ms",
+                                             (nowt - t0) * 1e3)
+                        if span_sampled(key, self.trace_sampling):
+                            self.spans.record("watch_confirm", key, t0,
+                                              nowt, {"node": p.node})
                 if p.node and (old is None or old.node != p.node):
                     events.append(ClusterEvent(POD_BOUND, node=p.node))
                 elif old is None and not p.node:
@@ -1380,6 +1406,14 @@ class KubeCluster:
             }
         out["bind_wire_ms"] = round(self.bind_wire_ns / 1e6, 2)
         out["bind_wire_n"] = self.bind_wire_n
+        bw = self.metrics.histograms.get("bind_wire_ms")
+        if bw is not None and bw.n:
+            out["bind_wire_p50_ms"] = round(bw.quantile(0.5), 2)
+            out["bind_wire_p99_ms"] = round(bw.quantile(0.99), 2)
+        wc = self.metrics.histograms.get("watch_confirm_ms")
+        if wc is not None and wc.n:
+            out["watch_confirm_p50_ms"] = round(wc.quantile(0.5), 2)
+            out["watch_confirm_p99_ms"] = round(wc.quantile(0.99), 2)
         # reflector storm counters (relists / 410 expiries / watch
         # errors): a brownout that only logged before now reads as a
         # slope an operator (and the serve bench) can see
@@ -1536,6 +1570,14 @@ class KubeCluster:
         with self._lock:
             return {k for k, p in self._pods.items() if p.terminating}
 
+    def _stamp_confirm(self, key: str) -> None:
+        """Open the watch_confirm window for a dispatched bind (caller
+        holds the lock). Bounded: keys whose confirming event never lands
+        (rolled-back binds) evict oldest-first."""
+        self._confirm_t0[key] = time.time()
+        while len(self._confirm_t0) > 4096:
+            self._confirm_t0.pop(next(iter(self._confirm_t0)))
+
     def bind(self, pod: Pod, node: str, assigned_chips=None,
              fence=None) -> None:
         self.client.bind(pod, node, assigned_chips, fence=fence)
@@ -1547,6 +1589,7 @@ class KubeCluster:
             # write-through so the next cycle sees the bind without waiting
             # for the watch event (which will confirm it)
             self._set_pod(pod.key, pod)
+            self._stamp_confirm(pod.key)
 
     # --------------------------------------------------------- async binding
     # Upstream kube-scheduler's model: the scheduling cycle is serial, the
@@ -1571,6 +1614,7 @@ class KubeCluster:
                 assigned_chips)
         with self._lock:
             self._set_pod(pod.key, pod)
+            self._stamp_confirm(pod.key)
             if self._bind_threads is None:
                 self._bind_threads = []
                 for i in range(self._BIND_WORKERS):
@@ -1599,9 +1643,22 @@ class KubeCluster:
                 try:
                     try:
                         t0 = time.perf_counter_ns()
+                        w0 = time.time()
                         self.client.bind(pod, node, chips, fence=fence)
-                        self.bind_wire_ns += time.perf_counter_ns() - t0
+                        dt_ns = time.perf_counter_ns() - t0
+                        self.bind_wire_ns += dt_ns
                         self.bind_wire_n += 1
+                        # per-bind wire attribution: RTT histogram +
+                        # labeled outcome counter + a bind_wire span for
+                        # sampled pods (the async twin of the engine's
+                        # sync-path wire span)
+                        self.metrics.observe("bind_wire_ms", dt_ns / 1e6)
+                        self.metrics.inc("bind_wire_total",
+                                         labels={"outcome": "ok"})
+                        if span_sampled(pod.key, self.trace_sampling):
+                            self.spans.record(
+                                "bind_wire", pod.key, w0,
+                                w0 + dt_ns / 1e9, {"node": node})
                         if on_success is not None:
                             try:
                                 on_success(pod, node)
@@ -1609,6 +1666,11 @@ class KubeCluster:
                                 log.exception(
                                     "bind on_success handler failed")
                     except Exception as e:
+                        self.metrics.inc(
+                            "bind_wire_total",
+                            labels={"outcome": "conflict"
+                                    if getattr(e, "status", None) == 409
+                                    else "error"})
                         # roll the optimistic entry back IN PLACE to
                         # Pending (the cache object is the same one the
                         # serve loop's intake reads — dropping it would
@@ -1632,6 +1694,10 @@ class KubeCluster:
                                 cur.phase = PodPhase.PENDING
                                 cur.labels.pop(ASSIGNED_CHIPS_LABEL, None)
                                 self._bump(node)
+                                # the bind never landed: a later rebind's
+                                # watch_confirm must not measure from
+                                # THIS dispatch
+                                self._confirm_t0.pop(pod.key, None)
                                 rolled_back = True
                         log.warning("async bind %s -> %s failed: %s%s",
                                     pod.key, node, e,
@@ -1744,10 +1810,16 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
         # batched_binds_total et al. — after the drain
         out["sched"] = sched
 
+    # the wire ring samples at the same rate the engines do, so a sampled
+    # pod's tree is complete: queued/cycle (engine) + bind_wire/
+    # watch_confirm (binder + reflector threads)
+    cluster.trace_sampling = profiles[0][0].trace_sampling
+
     if metrics_port is not None:
         from ..utils.httpserv import serve
 
-        serve(sched.metrics, sched.traces, host="0.0.0.0", port=metrics_port)
+        serve(sched.metrics, sched.traces, host="0.0.0.0", port=metrics_port,
+              spans=sched.spans, flight=sched.flight)
 
     # periodic defragmentation per profile that opts in
     # (descheduleIntervalSeconds > 0)

@@ -53,6 +53,22 @@ def _native_prefetch_default() -> bool:
         "0", "false", "off")
 
 
+def _trace_sampling_default() -> int:
+    """Default pod sampling rate for lifecycle span tracing (utils/obs.py
+    SpanRing): spans are recorded for 1-in-N pods (deterministic by pod
+    key, so a sampled pod's tree is complete across fleet replicas).
+    YODA_TRACE_SAMPLING=0 disables tracing, =1 traces every pod; the CI
+    instrumentation-overhead fence pins <3% p50 regression at this
+    default."""
+    raw = os.environ.get("YODA_TRACE_SAMPLING", "")
+    if not raw:
+        return 8
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 8
+
+
 def _fleet_default() -> int:
     """Default replica count for the scheduler fleet (scheduler/fleet.py).
     YODA_FLEET=<n> runs n engine replicas against the same apiserver,
@@ -220,6 +236,17 @@ class SchedulerConfig:
     # "free-for-all" (every replica pulls from the shared intake with no
     # node preference — the A/B baseline with the higher conflict rate)
     fleet_mode: str = "sharded"
+    # lifecycle span tracing (utils/obs.py SpanRing): record the full
+    # queued/cycle/bind_wire/watch_confirm span tree for 1-in-N pods
+    # (deterministic by pod key). 0 disables, 1 traces every pod; env
+    # YODA_TRACE_SAMPLING overrides. Per-pod e2e phase accounting (the
+    # e2e_breakdown histograms) is always on — it is a handful of float
+    # adds per bind, not a span.
+    trace_sampling: int = field(default_factory=_trace_sampling_default)
+    # black-box flight recorder: directory auto-dumps land in when the
+    # breaker opens or a chaos invariant trips ("" = in-memory ring only;
+    # env YODA_FLIGHT_DIR overrides an empty value)
+    flight_dump_dir: str = ""
 
     def with_(self, **kw) -> "SchedulerConfig":
         return replace(self, **kw)
@@ -274,6 +301,10 @@ class SchedulerConfig:
                 "shardLeases", defaults.shard_leases)), 0),
             fleet_mode=_valid_fleet_mode(str(args.get(
                 "fleetMode", defaults.fleet_mode))),
+            trace_sampling=max(int(args.get(
+                "traceSampling", defaults.trace_sampling)), 0),
+            flight_dump_dir=str(args.get(
+                "flightDumpDir", defaults.flight_dump_dir)),
         )
 
 

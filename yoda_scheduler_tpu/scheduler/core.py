@@ -77,7 +77,8 @@ from .plugins.prescore import MAX_KEY
 from .plugins.topology import SLICE_USE_KEY
 from ..utils.labels import (
     GANG_NAME_LABEL, LabelError, spec_for, workload_class)
-from ..utils.obs import CycleTrace, Metrics, TraceLog
+from ..utils.obs import (
+    CycleTrace, FlightRecorder, Metrics, SpanRing, TraceLog, span_sampled)
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
 
 # distinguishes "caller supplied no metrics" from "telemetry is None"
@@ -360,6 +361,19 @@ class Scheduler:
         self._ok_since_crash = True
         self._last_crash_key: str | None = None
         self.traces = TraceLog()
+        # lifecycle span tracing (utils/obs.py): every 1-in-trace_sampling
+        # pod gets the full queued/cycle/bind_wire span tree, recorded on
+        # THIS engine's clock into a bounded ring and exported as
+        # Chrome/Perfetto trace-event JSON (/traces/export, bench.py
+        # --trace-out). Appends are GIL-atomic tuple pushes; unsampled
+        # pods pay one memoized dict lookup per cycle.
+        self.spans = SpanRing()
+        # black-box flight recorder: structured engine events (breaker
+        # transitions, degraded flips, quarantines, fence aborts, conflict
+        # fallbacks) in a bounded ring, auto-dumped to disk when a trip
+        # kind fires and a dump dir is configured
+        self.flight = FlightRecorder(
+            clock=self.clock, dump_dir=self.config.flight_dump_dir or None)
         self.rng = random.Random(self.config.rng_seed)
         self._filter_start = 0  # rotating offset for percentageOfNodesToScore
         # node -> ((telemetry generation, pods version), NodeInfo) — see
@@ -527,6 +541,16 @@ class Scheduler:
         if pct >= 100:
             return num_nodes
         return max(num_nodes * pct // 100, 100)
+
+    def _sampled(self, pod: Pod) -> bool:
+        """Is this pod in the 1-in-trace_sampling span-traced set?
+        Memoized on the pod (the decision is a pure function of the key,
+        so retries and fleet replicas agree)."""
+        s = pod.__dict__.get("_span_sampled")
+        if s is None:
+            s = span_sampled(pod.key, self.config.trace_sampling)
+            pod.__dict__["_span_sampled"] = s
+        return s
 
     @staticmethod
     def _memo_key_of(pod: Pod, spec) -> tuple:
@@ -1359,8 +1383,12 @@ class Scheduler:
                     # failing every bind): park the remaining members
                     # back on the active queue with no attempt burned —
                     # run_one's gate holds them until the cooldown
+                    now_park = self.clock.time()
                     for parked in leftover[i:]:
-                        self.queue.requeue_immediate(parked)
+                        # now= closes the batch-cycle stint and opens a
+                        # fresh queue-wait one, so the breaker-cooldown
+                        # wait lands in e2e_queue_wait_ms like any park
+                        self.queue.requeue_immediate(parked, now=now_park)
                     break
                 try:
                     self._schedule_one_locked(info)
@@ -1423,6 +1451,8 @@ class Scheduler:
             if (vers is None or dirty is None
                     or not dirty <= {prev_node}):
                 self.metrics.inc("batch_conflict_fallbacks_total")
+                self.flight.record("batch_conflict_fallback",
+                                   pod=pod.key, prev_node=prev_node)
                 break
             self._csv_memo.clear()
             state.write("now", now)
@@ -1568,6 +1598,10 @@ class Scheduler:
             prev_cycle_vers = vers
             # ---- Reserve -> (Permit) -> Bind, the ordinary sub-steps
             trace = CycleTrace(pod=pod.key, started=now)
+            # batch commit IS the class-memo repair path; plane-attribute
+            # member cycles the same way (cycle_plane_total{plane="memo"})
+            trace.plane = "memo"
+            info.commit_started = self.clock.time()  # e2e: commit opens
             reserved: list[ReservePlugin] = []
             st = Status.success()
             for p in self.profile.reserve:
@@ -1687,7 +1721,18 @@ class Scheduler:
                 self._prefetched = got
         pod = info.pod
         now = self.clock.time()
+        # e2e decomposition: open the compute phase. A batch member
+        # falling back to this per-pod cycle arrives with the stint
+        # run_one opened at the shared pop still live — fold that
+        # pop-to-fallback wait into t_cycle first (it IS batch cycle
+        # time), or the interval would vanish from the breakdown
+        if info.cycle_started >= 0.0:
+            info.t_cycle += max(now - info.cycle_started, 0.0)
+        info.cycle_started = now
         trace = CycleTrace(pod=pod.key, started=now)
+        # lifecycle spans: sampled pods stamp each extension point (one
+        # clock read per phase); everyone else pays one memoized lookup
+        rec = self.spans if self._sampled(pod) else None
         if pod.phase == PodPhase.BOUND and pod.node:
             # a foreign fleet replica bound this pod after it entered our
             # queue (shared-state optimistic scheduling — free-for-all
@@ -1737,6 +1782,7 @@ class Scheduler:
             self._score_memo.clear()
             self.metrics.set_gauge("degraded", 1.0 if degraded else 0.0)
             self.metrics.inc("degraded_transitions_total")
+            self.flight.record("degraded_mode", active=degraded)
         if degraded:
             state.write("degraded", True)
             self.metrics.inc("degraded_cycles_total")
@@ -1764,6 +1810,7 @@ class Scheduler:
             hit = self._unsched_memo.get(memo_key)
             if hit is not None and hit[0] == vers:
                 self.metrics.inc("unsched_memo_hits_total")
+                trace.plane = "memo"
                 return self._unschedulable(info, trace, hit[1],
                                            rejected_by=hit[2])
 
@@ -1819,12 +1866,14 @@ class Scheduler:
                    and not snapshot.any_pod_anti_affinity())
         feasible: list[NodeInfo] | None = None
         rejectors: set[str] = set()
+        t_filter0 = now  # span stamp: filter phase effectively starts here
         if feas_ok:
             hit = self._feas_memo.get(memo_key)
             if hit is not None:
                 feasible = self._repair_feasible(
                     hit, vers, now, state, pod, snapshot, filters, want)
                 if feasible is not None:
+                    trace.plane = "memo"
                     self.metrics.inc("feas_memo_hits_total")
                     # refresh versions + infos so the next classmate's
                     # dirty set stays small
@@ -1846,6 +1895,7 @@ class Scheduler:
                 rep = self._repair_unsched(hit, state, pod, snapshot,
                                            filters, trace)
                 if rep is not None:
+                    trace.plane = "memo"
                     passing, extra_rej, dirty = rep
                     if passing:
                         self.metrics.inc("unsched_memo_repairs_total")
@@ -1907,8 +1957,10 @@ class Scheduler:
                 # scalar loop below owns the per-node failure diagnostics
                 # and _filter_start deliberately stays unadvanced
                 native_empty = True
+                trace.plane = "native"
             elif out is not None:
                 nat = out
+                trace.plane = "native"
                 feasible = nat.feasible
                 for ni in feasible:
                     trace.filter_verdicts[ni.name] = "ok"
@@ -1933,12 +1985,15 @@ class Scheduler:
                 and nodes and state.read_or(CANDIDATE_NODES_KEY) is None):
             feasible = self._columnar_filter(state, pod, filters, snapshot,
                                             vers, nodes, want, trace)
+            if feasible is not None:
+                trace.plane = "numpy"
             if feas_ok and feasible:
                 if len(self._feas_memo) > 256:
                     self._feas_memo.clear()
                 self._feas_memo[memo_key] = self._feas_entry(vers, feasible)
 
         if feasible is None:
+            trace.plane = "native" if native_empty else "scalar"
             order = [(self._filter_start + i) % len(nodes)
                      for i in range(len(nodes))]
             if nom is not None:
@@ -1982,6 +2037,11 @@ class Scheduler:
                     self._feas_memo.clear()
                 self._feas_memo[memo_key] = self._feas_entry(vers, feasible)
 
+        if rec is not None:
+            rec.record("cycle.filter", pod.key, t_filter0, self.clock.time(),
+                       {"plane": trace.plane or "scalar",
+                        "feasible": len(feasible) if feasible else 0,
+                        "want": want})
         if not feasible:
             # a nominated preemptor whose victims are still in graceful
             # termination is just waiting for capacity it is already
@@ -2037,6 +2097,7 @@ class Scheduler:
             return self._unschedulable(info, trace, reason,
                                        rejected_by=tuple(rejectors))
 
+        t_score0 = self.clock.time() if rec is not None else 0.0
         # PreScore. When the candidate set came off the feasible-class
         # memo, hand prescore plugins its name frozenset so they can key
         # their own incremental folds on set identity (MaxCollection
@@ -2165,6 +2226,10 @@ class Scheduler:
         best_score = max(totals.values())
         best_nodes = [n for n, s in totals.items() if s == best_score]
         chosen = self.rng.choice(best_nodes)
+        if rec is not None:
+            rec.record("cycle.score", pod.key, t_score0, self.clock.time(),
+                       {"scorers": [p.name for p in scorers],
+                        "chosen": chosen})
 
         # arm the batch commit loop (schedule_batch): classmates popped
         # with this pod may commit against this cycle's candidate ranking
@@ -2188,6 +2253,7 @@ class Scheduler:
                 chosen=chosen)
 
         # Reserve
+        info.commit_started = self.clock.time()  # e2e: commit phase opens
         reserved: list[ReservePlugin] = []
         for p in self.profile.reserve:
             try:
@@ -2205,8 +2271,12 @@ class Scheduler:
                                            f"reserve: {st.message}",
                                            rejected_by=(p.name,))
             reserved.append(p)
+        if rec is not None:
+            rec.record("cycle.reserve", pod.key, info.commit_started,
+                       self.clock.time(), {"node": chosen})
 
         # Permit
+        t_permit0 = self.clock.time() if rec is not None else 0.0
         for p in self.profile.permit:
             try:
                 st, timeout = p.permit(state, pod, chosen)
@@ -2224,6 +2294,9 @@ class Scheduler:
                 return self._unschedulable(info, trace,
                                            f"permit: {st.message}",
                                            rejected_by=(p.name,))
+        if rec is not None and self.profile.permit:
+            rec.record("cycle.permit", pod.key, t_permit0,
+                       self.clock.time(), {"node": chosen})
 
         # Bind this pod, then any gang peers its admission released
         if not self._bind(info, chosen, trace):
@@ -2376,7 +2449,7 @@ class Scheduler:
                 if viol:
                     self.metrics.inc("preempt_pdb_violations_total", viol)
                 info.last_failure = f"preempting on {nominated}"
-                self.queue.requeue_immediate(info)
+                self.queue.requeue_immediate(info, now=self.clock.time())
                 self._finish(trace, "preempting", reason=info.last_failure)
                 return "preempting"
         return None
@@ -2394,6 +2467,7 @@ class Scheduler:
         the cache back (freeing the chips — allocation accounting follows
         the cache) and re-enters the pod through _async_bind_failed."""
         pod = info.pod
+        rec = self.spans if self._sampled(pod) else None
         entry = self.allocator.assignment_of(pod) if self.allocator is not None else None
         coords = entry[1] if entry is not None else None
         dispatched_async = False
@@ -2411,11 +2485,13 @@ class Scheduler:
                     self.notify_event(ClusterEvent(POD_DELETED, node=node,
                                                    origin=pod.key))
                 self.metrics.inc("lease_lost_aborts_total")
-                self.queue.requeue_immediate(info)
+                self.flight.record("fence_abort", pod=pod.key, node=node)
+                self.queue.requeue_immediate(info, now=self.clock.time())
                 self._finish(trace, "lease-lost", node=node,
                              reason="shard lease lost mid-cycle")
                 return False
         fence_kw = {} if fence is None else {"fence": fence}
+        t_wire0 = self.clock.time()
         try:
             if self.profile.bind is not None:
                 self.profile.bind.bind(CycleState(), pod, node)
@@ -2497,6 +2573,15 @@ class Scheduler:
                 # a synchronous wire success is the breaker's probe signal
                 # (async successes report in order via _bind_results)
                 self._breaker_success()
+        # wire phase closes here: for sync backends this is the real bind
+        # RTT (retries and confirm GETs included); for async dispatch it
+        # is the dispatch cost — the binder-measured RTT lands in the
+        # cluster's bind_wire_ms histogram instead
+        wire_end = self.clock.time()
+        wire_s = max(wire_end - t_wire0, 0.0)
+        if rec is not None:
+            rec.record("bind_wire", pod.key, t_wire0, wire_end,
+                       {"node": node, "dispatched_async": dispatched_async})
         if self.allocator is not None:
             self.allocator.complete(pod)  # reservation consumed
             if not dispatched_async:
@@ -2508,12 +2593,27 @@ class Scheduler:
             # it itself at dispatch — re-setting here would race the
             # binder rollback's label pop on a fast failure)
             pod.labels[ASSIGNED_CHIPS_LABEL] = format_assigned_chips(coords)
-        e2e_ms = (self.clock.time() - info.enqueued) * 1e3
+        now_b = self.clock.time()
+        e2e_ms = (now_b - info.enqueued) * 1e3
         self.metrics.observe("schedule_latency_ms", e2e_ms)
         # per-class decomposition (gang / multi-chip / gpu / unlabeled ...):
         # aggregate p50 hides class-level regressions behind class mix
         self.metrics.observe(
             "schedule_latency_ms_class_" + workload_class(pod), e2e_ms)
+        # e2e latency decomposition: the queue/engine stamps partition this
+        # pod's enqueue->bind interval into queue-wait (active + backoff),
+        # cycle compute (every attempt's pre-commit work), commit
+        # (reserve/permit/bookkeeping) and wire — bench.e2e_breakdown
+        # reads these histograms, and their p50s must cover >=95% of the
+        # measured e2e p50 (the CI fence)
+        if info.commit_started >= 0.0 and info.cycle_started >= 0.0:
+            compute_s = info.t_cycle + max(
+                info.commit_started - info.cycle_started, 0.0)
+            commit_s = max(now_b - info.commit_started - wire_s, 0.0)
+            self.metrics.observe("e2e_queue_wait_ms", info.t_queue * 1e3)
+            self.metrics.observe("e2e_cycle_compute_ms", compute_s * 1e3)
+            self.metrics.observe("e2e_commit_ms", commit_s * 1e3)
+            self.metrics.observe("e2e_wire_ms", wire_s * 1e3)
         self.metrics.inc("pods_scheduled_total")
         if not dispatched_async:
             # Scheduled is posted on WIRE success only (upstream posts it
@@ -2559,6 +2659,11 @@ class Scheduler:
         consumed at dispatch and the binder rolled its cache back)."""
         pod = info.pod
         self.metrics.inc("bind_conflicts_total")
+        self.flight.record(
+            "bind_conflict", pod=pod.key, node=node,
+            resolution=("foreign-bind"
+                        if bound_to is not None
+                        or pod.phase == PodPhase.BOUND else "node-claim"))
         self._breaker_success()
         if release_reservation and self.allocator is not None:
             self.allocator.unreserve(CycleState(), pod, node)
@@ -2592,7 +2697,7 @@ class Scheduler:
                                 outcome="bind-conflict")
             return False
         self.metrics.inc("bind_conflict_retries_total")
-        self.queue.requeue_immediate(info)
+        self.queue.requeue_immediate(info, now=self.clock.time())
         self._finish(trace, "bind-conflict", node=node, reason=str(err))
         return False
 
@@ -2645,6 +2750,13 @@ class Scheduler:
                 8 * self.config.breaker_cooldown_s)
             self.metrics.inc("breaker_opens_total")
             self.metrics.set_gauge("breaker_open", 1.0)
+            # trip kind: auto-dumps the flight ring when a dump dir is
+            # configured — the black box lands on disk WHILE the storm is
+            # live, not after someone asks
+            self.flight.record("breaker_open",
+                               failures=self._breaker_failures,
+                               cooldown_s=self._breaker_until - now,
+                               error=f"{type(e).__name__}: {e}")
 
     def _breaker_success(self) -> None:
         """A bind reached the server: reset the failure streak and close
@@ -2658,6 +2770,7 @@ class Scheduler:
         self.metrics.set_gauge("breaker_open", 0.0)
         if was_open:
             self.metrics.inc("breaker_closes_total")
+            self.flight.record("breaker_close")
 
     def _drain_bind_failures(self) -> None:
         """Fold async wire outcomes and recover pods whose dispatched
@@ -2786,6 +2899,11 @@ class Scheduler:
             self._doom_gang_of(info, reason)
             self._fail_permanently(info, reason, trace=trace)
             return "failed"
+        for pname in rejected_by:
+            # per-plugin rejection attribution (labeled metric): which
+            # plugin is gating the pending backlog, by name
+            self.metrics.inc("filter_rejections_total",
+                             labels={"plugin": pname})
         self.queue.requeue_backoff(info, now=self.clock.time(),
                                    rejected_by=tuple(rejected_by))
         self.metrics.inc("pods_unschedulable_total")
@@ -2853,6 +2971,9 @@ class Scheduler:
                                            origin=pod.key))
         info.crashes += 1
         self.metrics.inc("cycle_crashes_total")
+        self.flight.record("cycle_crash", pod=pod.key,
+                           error=f"{type(e).__name__}: {e}",
+                           crashes=info.crashes)
         trace = CycleTrace(pod=pod.key, started=self.clock.time())
         reason = f"cycle crash: {type(e).__name__}: {e}"
         thresh = self.config.quarantine_threshold
@@ -2871,6 +2992,7 @@ class Scheduler:
             while len(self.quarantined) > 1024:
                 self.quarantined.pop(next(iter(self.quarantined)))
             self.metrics.inc("pods_quarantined_total")
+            self.flight.record("quarantine", pod=pod.key, reason=reason)
             self._doom_gang_of(info, reason)
             self._fail_permanently(info, reason, trace=trace)
             return "quarantined"
@@ -2886,8 +3008,28 @@ class Scheduler:
 
     def _finish(self, trace: CycleTrace, outcome: str, node: str | None = None,
                 reason: str = "") -> None:
-        trace.finish(outcome, node=node, reason=reason, now=self.clock.time())
+        now = self.clock.time()
+        trace.finish(outcome, node=node, reason=reason, now=now)
         self.traces.add(trace)
+        self.metrics.inc("scheduling_outcomes_total",
+                         labels={"outcome": outcome})
+        if trace.plane:
+            self.metrics.inc("cycle_plane_total",
+                             labels={"plane": trace.plane})
+        if self._sampled_key(trace.pod):
+            attrs = {"outcome": outcome}
+            if trace.plane:
+                attrs["plane"] = trace.plane
+            if node:
+                attrs["node"] = node
+            if reason:
+                attrs["reason"] = reason[:200]
+            self.spans.record("cycle", trace.pod, trace.started, now, attrs)
+
+    def _sampled_key(self, key: str) -> bool:
+        """Span-sampling verdict from a bare pod key (call sites without
+        the pod object — crash/failure traces)."""
+        return span_sampled(key, self.config.trace_sampling)
 
     # -------------------------------------------------------- waiting / gangs
     def check_waiting(self) -> None:
@@ -3029,6 +3171,7 @@ class Scheduler:
             if self.submit(pod):
                 requeued += 1
                 self.metrics.inc("reconcile_requeued_total")
+        self.flight.record("reconcile", adopted=adopted, requeued=requeued)
         return adopted, requeued
 
     # -------------------------------------------------------------- main loop
@@ -3067,12 +3210,19 @@ class Scheduler:
                 return None
             self.metrics.observe("batch_size", len(infos))
             started = self.clock.time()
+            for i in infos:
+                self._record_queued_span(i, started)
+                # batch members' compute phase opens at the shared pop:
+                # time spent waiting for earlier members IS batch cycle
+                # time (the head's _schedule_one_locked restamps itself)
+                i.cycle_started = started
             outcome = self.schedule_batch(infos)
         else:
             info = self.queue.pop(now=self.clock.time())
             if info is None:
                 return None
             started = self.clock.time()
+            self._record_queued_span(info, started)
             outcome = self.schedule_one(info)
         if outcome not in ("crash", "quarantined"):
             # a cycle completed without crashing: the next crash is a
@@ -3090,6 +3240,19 @@ class Scheduler:
             except Exception:
                 self.metrics.inc("prefetch_dispatch_errors_total")
         return outcome
+
+    def _record_queued_span(self, info: QueuedPodInfo, now: float) -> None:
+        """One `queued` lifecycle span per queue stint (sampled pods):
+        intake wait for the first pop, a backoff segment (with the parking
+        plugins) for every retry stint."""
+        if info.stint_started < 0.0 or not self._sampled(info.pod):
+            return
+        attrs: dict = {"segment": "backoff" if info.attempts else "intake",
+                       "attempts": info.attempts}
+        if info.rejected_by:
+            attrs["parked_by"] = list(info.rejected_by)
+        self.spans.record("queued", info.pod.key, info.stint_started, now,
+                          attrs)
 
     def next_wake_at(self) -> float | None:
         """Earliest future instant at which run_one could make progress:

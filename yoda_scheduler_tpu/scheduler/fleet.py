@@ -54,7 +54,8 @@ from .cluster import FakeCluster
 from .config import SchedulerConfig
 from .core import Clock, FENCE_LOST, Scheduler, default_profile
 from .framework import ScorePlugin, Status
-from .multi import _MergedMetricsView, _MergedTracesView
+from .multi import (_MergedFlightView, _MergedMetricsView, _MergedSpansView,
+                    _MergedTracesView)
 from .registry import build_profile
 # the ONE lease-name prefix: fence tokens are matched by string between
 # the engine side (here) and the authority (fake_apiserver / the Lease
@@ -297,6 +298,9 @@ class FleetCoordinator:
                 self.shard_count, rep.owned, weight=self.shard_weight))
         engine = Scheduler(self.cluster, cfg, profile=profile,
                            clock=self.clock)
+        # replica-distinct pid: a merged /traces/export shows each
+        # replica as its own process row in the Perfetto UI
+        engine.spans.pid = idx
         engine.victim_router = self.submit
         if self.sharded:
             if self._wire_leases:
@@ -589,6 +593,14 @@ class FleetCoordinator:
     @property
     def traces(self):
         return _MergedTracesView(self)
+
+    @property
+    def spans(self):
+        return _MergedSpansView(self)
+
+    @property
+    def flight(self):
+        return _MergedFlightView(self)
 
     def bin_pack_utilization(self) -> float:
         return self.replicas[0].engine.bin_pack_utilization()

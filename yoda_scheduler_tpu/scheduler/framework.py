@@ -343,6 +343,24 @@ class QueuedPodInfo:
     # but a pathological streak falls back to the ordinary backoff path
     # (core._bind_conflict)
     conflicts: int = 0
+    # ---- e2e latency decomposition (observability). The queue and the
+    # engine partition each pod's enqueue->bind interval on the injectable
+    # clock: time sitting in the active queue or backoff (t_queue,
+    # accumulated at pop), completed non-binding cycle time (t_cycle,
+    # accumulated at requeue), and the final cycle's compute/commit split
+    # (cycle_started/commit_started stamps) — observed into the e2e_*
+    # histograms when the pod binds (core._bind). Plain float adds per
+    # transition; never a span allocation. Sentinel is -1.0, NOT 0.0:
+    # chaos/fuzz rigs run FakeClock from t=0, where 0.0 is a legitimate
+    # stamp.
+    last_queued_at: float = -1.0
+    t_queue: float = 0.0
+    t_cycle: float = 0.0
+    cycle_started: float = -1.0
+    commit_started: float = -1.0
+    # start of the queue stint the last pop consumed (last_queued_at is
+    # reset at pop; span recording needs the start after the fact)
+    stint_started: float = -1.0
 
 
 # --------------------------------------------------------------------------

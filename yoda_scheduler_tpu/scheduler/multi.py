@@ -67,9 +67,13 @@ class MultiProfileScheduler:
             else:
                 profile = build_profile(cfg, enabled, self.allocator,
                                         self.gangs)
-            self.engines[cfg.scheduler_name] = Scheduler(
-                cluster, cfg, profile=profile, clock=self.clock,
-                cycle_lock=self._cycle_lock)
+            engine = Scheduler(cluster, cfg, profile=profile,
+                               clock=self.clock,
+                               cycle_lock=self._cycle_lock)
+            # profile-distinct pid: the merged /traces/export must not
+            # collide two profiles' pods onto the same Perfetto lanes
+            engine.spans.pid = len(self.engines)
+            self.engines[cfg.scheduler_name] = engine
         # one shared wake event across engines: the serve loop sleeps on it
         # between passes instead of blind-polling — any submission or
         # cluster event (on any engine) sets it
@@ -139,32 +143,69 @@ class MultiProfileScheduler:
 
     @property
     def metrics(self):
-        """Live merged view over every engine's metrics, rendering the same
-        Prometheus text a single engine would — so /metrics shows ALL
-        profiles' activity, not just the first's. Counters sum; histograms
-        merge their retained samples (bounded per engine)."""
+        """Live merged view over every engine's metrics: ONE /metrics
+        scrape exposes every profile's (or fleet replica's) activity as
+        per-replica LABELED series — counters and gauges carry
+        `replica="<engine name>"` (the fleet's replica-0/-1/... or the
+        profile's schedulerName), labeled series keep their own labels
+        plus the replica dimension, and histograms merge fleet-wide
+        (bounded retained samples per engine)."""
         return _MergedMetricsView(self)
 
     @property
     def traces(self):
         return _MergedTracesView(self)
 
+    @property
+    def spans(self):
+        return _MergedSpansView(self)
+
+    @property
+    def flight(self):
+        return _MergedFlightView(self)
+
 
 class _MergedMetricsView:
-    def __init__(self, ms: MultiProfileScheduler) -> None:
+    def __init__(self, ms) -> None:
         self._ms = ms
 
     def _merged(self):
         from ..utils.obs import Metrics
 
         out = Metrics()
-        for e in self._ms.engines.values():
-            for k, v in e.metrics.counters.items():
-                out.inc(k, v)
-            for k, v in e.metrics.gauges.items():
-                out.set_gauge(k, v)
-            for k, h in e.metrics.histograms.items():
+        sources = [(name, e.metrics)
+                   for name, e in self._ms.engines.items()]
+        # the cluster backend's own registry (KubeCluster: binder wire
+        # RTTs, watch_confirm, reflector storm counters) rides the same
+        # scrape, labeled as the shared wire
+        cluster_metrics = getattr(getattr(self._ms, "cluster", None),
+                                  "metrics", None)
+        if isinstance(cluster_metrics, Metrics):
+            sources.append(("wire", cluster_metrics))
+        for name, m in sources:
+            # consistent copies under the writer lock: engines insert
+            # new names/label keys concurrently with a scrape
+            counters, lab_c, gauges, lab_g, hists = m.snapshot_families()
+            for k, v in counters.items():
+                out.inc(k, v, labels={"replica": name})
+            for k, fam in lab_c.items():
+                for lk, v in fam.items():
+                    out.inc(k, v, labels={**dict(lk), "replica": name})
+            for k, v in gauges.items():
+                out.set_gauge(k, v, labels={"replica": name})
+            for k, fam in lab_g.items():
+                for lk, v in fam.items():
+                    out.set_gauge(k, v,
+                                  labels={**dict(lk), "replica": name})
+            for k, h in hists.items():
                 out.histogram(k).merge_from(h)
+        # fleet shard ownership (FleetCoordinator only): which replica
+        # holds which shard lease, as a labeled info gauge
+        for rep in getattr(self._ms, "replicas", ()):
+            for shard in list(rep.owned):
+                out.set_gauge("shard_owned", 1.0,
+                              labels={"shard": str(shard),
+                                      "replica": f"replica-{rep.idx}"})
         return out
 
     def render_prometheus(self, prefix: str = "yoda_tpu") -> str:
@@ -175,7 +216,7 @@ class _MergedMetricsView:
 
 
 class _MergedTracesView:
-    def __init__(self, ms: MultiProfileScheduler) -> None:
+    def __init__(self, ms) -> None:
         self._ms = ms
 
     def recent(self, n: int = 50):
@@ -183,3 +224,35 @@ class _MergedTracesView:
                       for t in e.traces.recent(n)]
         all_traces.sort(key=lambda t: t.started)
         return all_traces[-n:]
+
+
+class _MergedSpansView:
+    """Every engine's lifecycle SpanRing (plus the cluster backend's wire
+    ring, when it keeps one) behind the rings() contract /traces/export
+    consumes."""
+
+    def __init__(self, ms) -> None:
+        self._ms = ms
+
+    def rings(self):
+        out = list(self._ms.engines.values())
+        rings = [e.spans for e in out]
+        cluster_ring = getattr(getattr(self._ms, "cluster", None),
+                               "spans", None)
+        if cluster_ring is not None:
+            rings.append(cluster_ring)
+        return rings
+
+
+class _MergedFlightView:
+    def __init__(self, ms) -> None:
+        self._ms = ms
+
+    def snapshot(self) -> list[dict]:
+        events = []
+        for name, e in self._ms.engines.items():
+            for ev in e.flight.snapshot():
+                ev["replica"] = name
+                events.append(ev)
+        events.sort(key=lambda ev: ev["ts"])
+        return events

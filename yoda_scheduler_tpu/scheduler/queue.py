@@ -206,6 +206,7 @@ class SchedulingQueue:
         info = QueuedPodInfo(pod=pod)
         if now is not None:
             info.enqueued = now
+        info.last_queued_at = info.enqueued  # queue-wait phase starts
         self._push_active(info)
         self._inc(pod.key)
 
@@ -369,7 +370,7 @@ class SchedulingQueue:
                 if self._active_ids.get(id(info)) != stint:
                     continue  # gathered/removed, or a PREVIOUS stint's
                     # entry for a since-requeued pod: stale either way
-                self._consume_active(info)
+                self._consume_active(info, now)
                 return info
             return None
         best_i = 0
@@ -377,10 +378,19 @@ class SchedulingQueue:
             if self._less(self._active[i], self._active[best_i]):
                 best_i = i
         info = self._active.pop(best_i)
-        self._consume_active(info)
+        self._consume_active(info, now)
         return info
 
-    def _consume_active(self, info: QueuedPodInfo) -> None:
+    def _consume_active(self, info: QueuedPodInfo,
+                        now: float | None = None) -> None:
+        if now is not None and info.last_queued_at >= 0.0:
+            # e2e decomposition: close the pod's queue-wait stint (covers
+            # both active-queue wait and backoff — last_queued_at is
+            # stamped at add/requeue time, not at activation). 0.0 is a
+            # legitimate FakeClock instant; -1.0 is the unset sentinel.
+            info.t_queue += max(now - info.last_queued_at, 0.0)
+            info.stint_started = info.last_queued_at
+            info.last_queued_at = -1.0
         self._active_ids.pop(id(info), None)
         self._n_active -= 1
         self._dec(info.pod.key)
@@ -420,7 +430,7 @@ class SchedulingQueue:
                 heapq.heappop(heap)  # stale: popped/removed/requeued
                 continue
             heapq.heappop(heap)
-            self._consume_active(info)
+            self._consume_active(info, now)
             batch.append(info)
         if not heap:
             self._by_bkey.pop(k, None)
@@ -451,16 +461,34 @@ class SchedulingQueue:
         info.not_before = now + delay
         info.backoff_started = now
         info.rejected_by = tuple(rejected_by)
+        self._close_cycle_stint(info, now)
         self._park(info)
         self._inc(info.pod.key)
 
-    def requeue_immediate(self, info: QueuedPodInfo) -> None:
+    def requeue_immediate(self, info: QueuedPodInfo,
+                          now: float | None = None) -> None:
         """Return a pod to the active queue with no backoff — used for a
         preemptor after its victims were evicted, so its priority wins the
         next pop (the nominated-node fast-retry analogue)."""
         info.not_before = 0.0
+        if now is not None:
+            self._close_cycle_stint(info, now)
         self._push_active(info)
         self._inc(info.pod.key)
+
+    @staticmethod
+    def _close_cycle_stint(info: QueuedPodInfo, now: float) -> None:
+        """e2e decomposition: the pod is re-entering the queue after a
+        non-binding cycle — fold that cycle's elapsed time into t_cycle
+        and open a new queue-wait stint. Batch members carry the stint
+        run_one opened at the shared pop, so a breaker-parked leftover
+        folds its pop-to-park wait here (it IS batch cycle time); only a
+        pod with no open stint (cycle_started sentinel) folds nothing."""
+        if info.cycle_started >= 0.0:
+            info.t_cycle += max(now - info.cycle_started, 0.0)
+            info.cycle_started = -1.0
+        info.commit_started = -1.0
+        info.last_queued_at = now
 
     def remove(self, pod_key: str) -> list[QueuedPodInfo]:
         """Drop a pod from the active queue and backoff lot (external

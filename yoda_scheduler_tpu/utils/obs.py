@@ -1,17 +1,39 @@
-"""Observability: structured per-cycle traces + a metrics registry.
+"""Observability: lifecycle spans, labeled metrics, traces, flight recorder.
 
-The reference has neither (metrics explicitly disabled at reference
+The reference has none of it (metrics explicitly disabled at reference
 pkg/yoda/scheduler.go:55, tracing = leveled klog strings only; SURVEY §5).
-Here every scheduling cycle emits one structured trace record (pod, filter
-verdicts per node, scores, outcome, latency) and the registry exposes the
-BASELINE metrics: schedule-latency histogram and bin-pack utilisation gauge,
-renderable in Prometheus text exposition format.
+Four layers live here:
+
+- ``CycleTrace`` / ``TraceLog``: one structured record per scheduling cycle
+  (pod, filter verdicts, scores, outcome, latency) in a bounded ring.
+- ``SpanRing``: span-structured lifecycle tracing — every sampled pod gets
+  a span tree from intake to confirmed bind (``queued`` with backoff
+  segments, ``cycle`` with per-extension-point children and plane
+  attribution, ``bind_wire``, ``watch_confirm``), recorded as flat tuples
+  on the engine's injectable clock and exportable as Chrome/Perfetto
+  trace-event JSON (``/traces/export``, ``bench.py --trace-out``).
+- ``Metrics``: counters/gauges/histograms, now with a label dimension
+  (``plugin``, ``outcome``, ``plane``, ``replica``, ``shard``), # HELP
+  lines, label-value escaping, and +Inf buckets per the Prometheus text
+  exposition spec (round-tripped through prometheus_client's parser in
+  tests/test_obs.py).
+- ``FlightRecorder``: a black-box bounded ring of structured engine events
+  (breaker transitions, degraded-mode flips, quarantines, fence aborts,
+  conflict fallbacks) that dumps to disk when a chaos invariant trips or
+  the circuit breaker opens.
+
+Everything here must be cheap enough to leave on: span/flight appends are
+one tuple into a GIL-atomic bounded deque, and the hot-path metric calls
+allocate nothing beyond the record itself.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -24,17 +46,25 @@ class CycleTrace:
     reason: str = ""
     filter_verdicts: dict[str, str] = field(default_factory=dict)
     scores: dict[str, float] = field(default_factory=dict)
-    started: float = field(default_factory=time.time)
+    # which data plane served the cycle's scan: scalar | numpy | native |
+    # memo (class-memo hit/repair, no full scan) | "" (cycle never reached
+    # the filter step)
+    plane: str = ""
+    # stamped by the OWNING engine from ITS clock — no wall-clock default:
+    # chaos runs drive the engine on a virtual clock, and a time.time()
+    # fallback here silently mixed wall and simulated time in latencies
+    started: float = 0.0
     latency_ms: float = 0.0
 
     def finish(self, outcome: str, node: str | None = None, reason: str = "",
-               now: float | None = None) -> "CycleTrace":
-        """`now` must come from the same clock that stamped `started` (the
-        scheduler's injectable clock); defaults to wall time."""
+               *, now: float) -> "CycleTrace":
+        """`now` is REQUIRED and must come from the same clock that stamped
+        `started` (the scheduler's injectable clock) — a wall-time default
+        here used to mix real and simulated time in chaos-run latencies."""
         self.outcome = outcome
         self.node = node
         self.reason = reason
-        self.latency_ms = ((time.time() if now is None else now) - self.started) * 1e3
+        self.latency_ms = (now - self.started) * 1e3
         return self
 
 
@@ -50,6 +80,11 @@ class Histogram:
         # bounded sample for exact quantiles in benches; a long-running
         # scheduler keeps at most the most recent `keep_values` observations
         self._values: deque[float] = deque(maxlen=keep_values)
+        # quantile memo: (observation count at sort time, sorted snapshot).
+        # Bench summary blocks ask for several percentiles back to back; a
+        # fresh O(n log n) sort of up to 100k retained samples per call was
+        # pure waste — the sorted view is valid until the next observe.
+        self._sorted: tuple[int, list[float]] | None = None
 
     def observe(self, v: float) -> None:
         self.n += 1
@@ -64,7 +99,12 @@ class Histogram:
     def quantile(self, q: float) -> float:
         if not self._values:
             return 0.0
-        xs = sorted(self._values)
+        memo = self._sorted
+        if memo is not None and memo[0] == self.n:
+            xs = memo[1]
+        else:
+            xs = sorted(self._values)
+            self._sorted = (self.n, xs)
         idx = min(int(q * len(xs)), len(xs) - 1)
         return xs[idx]
 
@@ -86,20 +126,112 @@ class Histogram:
                 self.observe(v)
 
 
+_NAME_BAD = None  # compiled lazily (module import stays cheap)
+
+
+def _metric_name(name: str) -> str:
+    """Sanitize a metric family name per the exposition spec
+    ([a-zA-Z_:][a-zA-Z0-9_:]*): internal series names may carry workload
+    classes with dashes (schedule_latency_ms_class_tpu-single), which a
+    real Prometheus parser rejects outright."""
+    global _NAME_BAD
+    if _NAME_BAD is None:
+        import re
+
+        _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+    return _NAME_BAD.sub("_", name)
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text exposition escaping for label VALUES: backslash,
+    double quote, and newline (in that order — escaping the escapes)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """# HELP text escaping: backslash and newline only (quotes are legal)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def fmt_labels(labels: dict | tuple) -> str:
+    """Render a label set as `{k="v",...}` with spec-compliant value
+    escaping; empty input renders as the empty string (no braces)."""
+    items = labels.items() if isinstance(labels, dict) else labels
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in items]
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
 class Metrics:
+    # HELP text for the well-known series; anything unregistered gets a
+    # generated one-liner so every family still carries a # HELP line
+    HELP: dict[str, str] = {
+        "schedule_latency_ms": "End-to-end pod scheduling latency "
+                               "(enqueue to bind), milliseconds.",
+        "cycle_latency_ms": "One scheduling cycle's compute latency, "
+                            "milliseconds.",
+        "e2e_queue_wait_ms": "Per-bound-pod time spent queued or in "
+                             "backoff, milliseconds.",
+        "e2e_cycle_compute_ms": "Per-bound-pod scheduling-cycle compute "
+                                "time (all attempts), milliseconds.",
+        "e2e_commit_ms": "Per-bound-pod reserve/permit/bind bookkeeping "
+                         "time, excluding the wire, milliseconds.",
+        "e2e_wire_ms": "Per-bound-pod bind wire time (apiserver RTT), "
+                       "milliseconds.",
+        "bind_wire_ms": "Binding subresource POST round-trip time, "
+                        "milliseconds.",
+        "watch_confirm_ms": "Bind dispatch to watch-cache confirmation, "
+                            "milliseconds.",
+        "scheduling_outcomes_total": "Scheduling cycle outcomes, labeled "
+                                     "by outcome.",
+        "cycle_plane_total": "Scheduling cycles by serving data plane "
+                             "(scalar|numpy|native|memo).",
+        "filter_rejections_total": "Pods rejected per filter plugin "
+                                   "(labeled by plugin).",
+        "pods_scheduled_total": "Pods successfully bound.",
+        "pods_unschedulable_total": "Cycles that ended unschedulable.",
+        "breaker_open": "Apiserver circuit breaker state (1 = open).",
+        "degraded": "Telemetry-blackout degraded mode (1 = active).",
+    }
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: dict[str, int] = {}
         self.gauges: dict[str, float] = {}
         self.histograms: dict[str, Histogram] = {}
+        # labeled series: name -> {sorted (k, v) tuple -> value}. Plain
+        # (unlabeled) series keep the flat dicts above — every existing
+        # counters.get("...") consumer stays valid.
+        self.labeled_counters: dict[str, dict[tuple, int]] = {}
+        self.labeled_gauges: dict[str, dict[tuple, float]] = {}
 
-    def inc(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + by
+    @staticmethod
+    def _lkey(labels: dict) -> tuple:
+        return tuple(sorted(labels.items()))
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def inc(self, name: str, by: int = 1, labels: dict | None = None) -> None:
         with self._lock:
-            self.gauges[name] = value
+            if labels:
+                fam = self.labeled_counters.setdefault(name, {})
+                k = self._lkey(labels)
+                fam[k] = fam.get(k, 0) + by
+            else:
+                self.counters[name] = self.counters.get(name, 0) + by
+
+    def set_gauge(self, name: str, value: float,
+                  labels: dict | None = None) -> None:
+        with self._lock:
+            if labels:
+                self.labeled_gauges.setdefault(
+                    name, {})[self._lkey(labels)] = value
+            else:
+                self.gauges[name] = value
+
+    def labeled_counter(self, name: str, labels: dict) -> int:
+        """Read one labeled counter value (0 when absent) — test/bench
+        convenience, not a hot-path call."""
+        return self.labeled_counters.get(name, {}).get(
+            self._lkey(labels), 0)
 
     def observe(self, name: str, value: float) -> None:
         # plain get first: setdefault(name, Histogram()) would construct
@@ -118,25 +250,59 @@ class Metrics:
         with self._lock:
             return self.histograms.setdefault(name, Histogram())
 
+    def snapshot_families(self):
+        """Consistent shallow copies of every registry dict, taken under
+        the writer lock: (counters, labeled_counters, gauges,
+        labeled_gauges, histograms). Merged/multi-engine readers iterate
+        these instead of the live dicts — an engine inserting its first
+        'native' plane key mid-scrape must not blow up the reader with
+        'dictionary changed size during iteration'."""
+        with self._lock:
+            return (dict(self.counters),
+                    {k: dict(v) for k, v in self.labeled_counters.items()},
+                    dict(self.gauges),
+                    {k: dict(v) for k, v in self.labeled_gauges.items()},
+                    dict(self.histograms))
+
     # --------------------------------------------------- prometheus exposition
+    def _help_line(self, lines: list[str], prefix: str, k: str,
+                   typ: str) -> None:
+        text = self.HELP.get(k)
+        if text is None:
+            text = f"yoda-tpu scheduler {typ} {k.replace('_', ' ')}."
+        name = _metric_name(k)
+        lines.append(f"# HELP {prefix}_{name} {_escape_help(text)}")
+        lines.append(f"# TYPE {prefix}_{name} {typ}")
+
     def render_prometheus(self, prefix: str = "yoda_tpu") -> str:
         lines: list[str] = []
         with self._lock:
-            for k, v in sorted(self.counters.items()):
-                lines.append(f"# TYPE {prefix}_{k} counter")
-                lines.append(f"{prefix}_{k} {v}")
-            for k, v in sorted(self.gauges.items()):
-                lines.append(f"# TYPE {prefix}_{k} gauge")
-                lines.append(f"{prefix}_{k} {v}")
+            names = sorted(set(self.counters) | set(self.labeled_counters))
+            for k in names:
+                self._help_line(lines, prefix, k, "counter")
+                n = _metric_name(k)
+                if k in self.counters:
+                    lines.append(f"{prefix}_{n} {self.counters[k]}")
+                for lk, v in sorted(self.labeled_counters.get(k, {}).items()):
+                    lines.append(f"{prefix}_{n}{fmt_labels(lk)} {v}")
+            names = sorted(set(self.gauges) | set(self.labeled_gauges))
+            for k in names:
+                self._help_line(lines, prefix, k, "gauge")
+                n = _metric_name(k)
+                if k in self.gauges:
+                    lines.append(f"{prefix}_{n} {self.gauges[k]}")
+                for lk, v in sorted(self.labeled_gauges.get(k, {}).items()):
+                    lines.append(f"{prefix}_{n}{fmt_labels(lk)} {v}")
             for k, h in sorted(self.histograms.items()):
-                lines.append(f"# TYPE {prefix}_{k} histogram")
+                self._help_line(lines, prefix, k, "histogram")
+                n = _metric_name(k)
                 cum = 0
                 for b, c in zip(h.bounds, h.counts):
                     cum += c
-                    lines.append(f'{prefix}_{k}_bucket{{le="{b}"}} {cum}')
-                lines.append(f'{prefix}_{k}_bucket{{le="+Inf"}} {h.n}')
-                lines.append(f"{prefix}_{k}_sum {h.total}")
-                lines.append(f"{prefix}_{k}_count {h.n}")
+                    lines.append(f'{prefix}_{n}_bucket{{le="{b}"}} {cum}')
+                lines.append(f'{prefix}_{n}_bucket{{le="+Inf"}} {h.n}')
+                lines.append(f"{prefix}_{n}_sum {h.total}")
+                lines.append(f"{prefix}_{n}_count {h.n}")
         return "\n".join(lines) + "\n"
 
 
@@ -157,3 +323,169 @@ class TraceLog:
     def recent(self, n: int = 50) -> list[CycleTrace]:
         with self._lock:
             return list(self._buf)[-n:]
+
+
+# ------------------------------------------------------------------ spans
+def span_sampled(key: str, sampling: int) -> bool:
+    """Deterministic 1-in-`sampling` pod sampling decision (crc32, stable
+    across runs and replicas — the same pod samples identically on every
+    fleet member, so a sampled pod's spans are complete). sampling<=0
+    disables tracing; 1 traces every pod."""
+    if sampling <= 0:
+        return False
+    if sampling == 1:
+        return True
+    return zlib.crc32(key.encode()) % sampling == 0
+
+
+class SpanRing:
+    """Low-overhead lifecycle span recorder: a bounded ring of finished
+    spans, each a flat tuple (name, subject, t0, t1, attrs|None) stamped
+    on the owning engine's injectable clock. record() is one tuple build
+    plus a GIL-atomic deque append — no locks, no allocation beyond the
+    record — so it can sit on the scheduling hot path at the default
+    sampling rate. Export is Chrome/Perfetto trace-event JSON ("X"
+    complete events, microsecond timestamps): one track (tid) per pod, so
+    a pod's queued -> cycle -> bind_wire -> watch_confirm tree reads as a
+    lane in the Perfetto UI."""
+
+    def __init__(self, capacity: int = 16384, pid: int = 0) -> None:
+        self._buf: deque[tuple] = deque(maxlen=capacity)
+        self.pid = pid  # replica index in a fleet; 0 standalone
+
+    def record(self, name: str, subject: str, t0: float, t1: float,
+               attrs: dict | None = None) -> None:
+        self._buf.append((name, subject, t0, t1, attrs))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def snapshot(self) -> list[tuple]:
+        return list(self._buf)
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+    def chrome_events(self) -> list[dict]:
+        """Chrome trace-event dicts for every retained span. Timestamps
+        are the recording clock's seconds scaled to microseconds; on a
+        virtual clock the trace is in virtual time, which is exactly what
+        a chaos replay should show."""
+        events: list[dict] = []
+        tids: dict[str, int] = {}
+        # snapshot before iterating: the engine appends concurrently, and
+        # iterating a live deque raises "mutated during iteration"
+        # (list(deque) is GIL-atomic)
+        for name, subject, t0, t1, attrs in list(self._buf):
+            tid = tids.get(subject)
+            if tid is None:
+                tid = len(tids) + 1
+                tids[subject] = tid
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": self.pid,
+                    "tid": tid, "args": {"name": subject}})
+            ev = {
+                "name": name, "cat": "scheduling", "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round(max(t1 - t0, 0.0) * 1e6, 3),
+                "pid": self.pid, "tid": tid,
+            }
+            if attrs:
+                ev["args"] = attrs
+            events.append(ev)
+        return events
+
+
+def export_chrome_trace(rings, path: str | None = None) -> dict:
+    """Merge one or more SpanRings into a Chrome/Perfetto trace document
+    ({"traceEvents": [...], "displayTimeUnit": "ms"}); optionally write it
+    to `path`. Accepts any iterable of objects exposing chrome_events()."""
+    events: list[dict] = []
+    for ring in rings:
+        events.extend(ring.chrome_events())
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+# --------------------------------------------------------- flight recorder
+# event kinds that auto-trigger a disk dump when a dump dir is configured
+TRIP_KINDS = frozenset({"breaker_open", "invariant_violation",
+                        "quarantine"})
+
+
+class FlightRecorder:
+    """Black-box recorder: a bounded ring of structured engine events —
+    breaker transitions, degraded-mode flips, quarantines, fence aborts,
+    conflict fallbacks, crash containment — cheap enough to run always.
+    record() is one tuple append (GIL-atomic deque); when the event kind
+    is in TRIP_KINDS and a dump directory is configured (constructor arg
+    or $YODA_FLIGHT_DIR), the ring auto-dumps to a JSON file, rate-limited
+    to one dump per `min_dump_interval_s` of wall time so a flapping
+    breaker cannot fill a disk. test_chaos.py dumps explicitly on
+    invariant violations and CI uploads the directory on failure."""
+
+    def __init__(self, capacity: int = 2048, clock=None,
+                 dump_dir: str | None = None,
+                 min_dump_interval_s: float = 5.0) -> None:
+        self._buf: deque[tuple] = deque(maxlen=capacity)
+        self._clock = clock  # engine clock; ts in its timebase
+        self.dump_dir = (dump_dir if dump_dir is not None
+                         else os.environ.get("YODA_FLIGHT_DIR", ""))
+        self.min_dump_interval_s = min_dump_interval_s
+        self._last_dump_wall = 0.0
+        self.dumps: list[str] = []  # paths written (tests/CI read these)
+
+    def _now(self) -> float:
+        return self._clock.time() if self._clock is not None else time.time()
+
+    def record(self, kind: str, /, **detail) -> None:
+        # positional-only `kind`: detail keys are free-form event payload
+        # and must never collide with the event-kind parameter
+        self._buf.append((self._now(), kind, detail or None))
+        if kind in TRIP_KINDS and self.dump_dir:
+            self.auto_dump(reason=kind)
+
+    def snapshot(self) -> list[dict]:
+        # event kind merged LAST: a detail payload key named "kind" must
+        # never masquerade as the event kind
+        return [{"ts": ts, **(detail or {}), "kind": kind}
+                for ts, kind, detail in list(self._buf)]
+
+    def auto_dump(self, reason: str) -> str | None:
+        """Rate-limited trigger dump (wall-clock limited: the recorder's
+        own clock may be virtual and frozen mid-storm)."""
+        wall = time.time()
+        if wall - self._last_dump_wall < self.min_dump_interval_s:
+            return None
+        self._last_dump_wall = wall
+        return self.dump(reason=reason)
+
+    def dump(self, path: str | None = None, reason: str = "") -> str | None:
+        """Write the ring to `path` (or an auto-named file under dump_dir).
+        Best-effort: a full disk must never take the engine down."""
+        if path is None:
+            if not self.dump_dir:
+                return None
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+            except OSError:
+                return None
+            # id(self) uniquifies across recorders sharing one dump dir
+            # in one process (fleet replicas tripping within the same
+            # wall millisecond must not overwrite each other's dump)
+            path = os.path.join(
+                self.dump_dir,
+                f"flight-{os.getpid()}-{id(self):x}-"
+                f"{int(time.time() * 1e3):x}-{reason or 'manual'}.json")
+        doc = {"reason": reason, "wall_time": time.time(),
+               "events": self.snapshot()}
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1)
+        except OSError:
+            return None
+        self.dumps.append(path)
+        return path
