@@ -68,6 +68,29 @@ class FakeApiState:
         # kubelet does for terminationGracePeriodSeconds); the test then
         # calls finish_termination() to emit the final DELETED
         self.graceful_deletion = False
+        # ValidatingAdmissionWebhook on pods/binding: when registered
+        # (set_webhook), the binding handler POSTs an AdmissionReview to
+        # the URL before applying; a denial is surfaced to the client with
+        # the webhook's status code and the real apiserver's message shape
+        # ('admission webhook "<name>" denied the request: ...'). An
+        # unreachable webhook follows failure_policy: "Fail" -> 500 (the
+        # recommended safety posture), "Ignore" -> the bind proceeds with
+        # only the pod-level check.
+        self.webhook: dict | None = None
+        # vanilla-apiserver posture: skip the built-in chip/HBM/fence
+        # battery on bindings (a conformant apiserver enforces only the
+        # pod-level 409) — implied by registering a webhook; settable on
+        # its own to demonstrate the unprotected hole
+        self.vanilla_authority = False
+        self.webhook_calls = 0
+        self.webhook_denials = 0
+        self.webhook_errors = 0
+        # watch bookmarks (allowWatchBookmarks): opt-in server capability,
+        # like the real feature gate — a parked watch emits a BOOKMARK at
+        # the current resourceVersion so quiet clients resume past
+        # compactions without the 410 -> full-relist path. Off by default
+        # so the 410-path tests keep exercising exactly that path.
+        self.bookmarks_enabled = False
 
     # ------------------------------------------------------------- mutation
     def _stamp(self, kind: str, obj: dict, typ: str) -> dict:
@@ -131,6 +154,21 @@ class FakeApiState:
             else:
                 self.faults[:] = [f for f in self.faults
                                   if f[0] != path_substring]
+
+    def set_webhook(self, url: str, failure_policy: str = "Fail",
+                    timeout_s: float = 2.0,
+                    ca_file: str | None = None) -> None:
+        """Register a pods/binding validating webhook (the fake's
+        ValidatingWebhookConfiguration). `ca_file` verifies an https
+        callee (the caBundle analogue); an https URL without one is
+        accepted unverified — test convenience only."""
+        with self.cond:
+            self.webhook = {"url": url, "failure_policy": failure_policy,
+                            "timeout_s": timeout_s, "ca_file": ca_file}
+
+    def clear_webhook(self) -> None:
+        with self.cond:
+            self.webhook = None
 
     def cut_watches(self, kind: str | None = None) -> None:
         """Force every in-flight watch stream of `kind` (default: all) to
@@ -340,6 +378,13 @@ class _Handler(BaseHTTPRequestHandler):
         from_rv = int(q.get("resourceVersion", ["0"])[0] or 0)
         timeout_s = float(q.get("timeoutSeconds", ["30"])[0])
         deadline = time.monotonic() + min(timeout_s, 30.0)
+        # watch bookmarks: requested by the client AND enabled on the
+        # server (the real feature-gate shape). A parked stream advances
+        # the client's resourceVersion past writes of OTHER kinds, so a
+        # quiet reflector survives compaction without the 410 re-list.
+        bookmarks = (s.bookmarks_enabled
+                     and q.get("allowWatchBookmarks",
+                               ["false"])[0] == "true")
 
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -365,6 +410,7 @@ class _Handler(BaseHTTPRequestHandler):
         # polluted the watch-lag measurement)
         rv_of = lambda e: e[0]  # noqa: E731
         while time.monotonic() < deadline:
+            bm_rv = None
             with s.cond:
                 if s.watch_epochs[kind] != epoch0:
                     return  # scripted stream cut: end mid-watch
@@ -382,6 +428,8 @@ class _Handler(BaseHTTPRequestHandler):
                     evs = s.events[kind]
                     i = bisect.bisect_right(evs, last, key=rv_of)
                     batch = evs[i:]
+                if not batch and bookmarks and s.rv > last:
+                    bm_rv = s.rv  # quiet stream, global rv moved on
             if batch:
                 try:
                     # one write+flush per batch, pre-serialized lines
@@ -390,6 +438,68 @@ class _Handler(BaseHTTPRequestHandler):
                 except (BrokenPipeError, ConnectionResetError):
                     return
                 last = batch[-1][0]
+            elif bm_rv is not None:
+                line = json.dumps({"type": "BOOKMARK", "object": {
+                    "kind": "Bookmark",
+                    "metadata": {"resourceVersion": str(bm_rv)}}}) + "\n"
+                try:
+                    self.wfile.write(line.encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    return
+                last = bm_rv
+
+    # -------------------------------------------------------- webhook call
+    def _call_webhook(self, cfg: dict, ns: str, name: str,
+                      body: dict):
+        """POST an AdmissionReview v1 to the registered pods/binding
+        webhook. Returns (allowed, code, message), or None when the
+        webhook is unreachable/misbehaving (failurePolicy decides what
+        that means). Never called with the state lock held."""
+        import ssl
+        import urllib.request
+
+        s = self.state
+        with s.cond:
+            s.uid_seq += 1
+            uid = f"review-{s.uid_seq}"
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": uid,
+                "kind": {"group": "", "version": "v1", "kind": "Binding"},
+                "resource": {"group": "", "version": "v1",
+                             "resource": "pods"},
+                "subResource": "binding",
+                "namespace": ns, "name": name,
+                "operation": "CREATE",
+                "object": body,
+            },
+        }
+        req = urllib.request.Request(
+            cfg["url"], data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        ctx = None
+        if cfg["url"].startswith("https"):
+            # caBundle analogue; absent = unverified (test convenience —
+            # a real apiserver always verifies against the caBundle)
+            ctx = (ssl.create_default_context(cafile=cfg["ca_file"])
+                   if cfg.get("ca_file")
+                   else ssl._create_unverified_context())
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=cfg.get("timeout_s", 2.0),
+                    context=ctx) as resp:
+                doc = json.loads(resp.read())
+        except Exception:
+            return None
+        r = doc.get("response") or {}
+        if r.get("uid") != uid:
+            return None  # a response for some other review is no verdict
+        status = r.get("status") or {}
+        return (bool(r.get("allowed")), int(status.get("code") or 400),
+                status.get("message", ""))
 
     # ------------------------------------------------------------ pod verbs
     def _bind_conflict(self, body: dict, pod: dict) -> str | None:
@@ -462,10 +572,58 @@ class _Handler(BaseHTTPRequestHandler):
                         "kind": "Status", "code": 409,
                         "message": f"pod {key} is already assigned to node "
                                    f"{pod['spec']['nodeName']}"})
-                conflict = self._bind_conflict(body, pod)
-                if conflict is not None:
-                    return self._json(409, {"kind": "Status", "code": 409,
-                                            "message": conflict})
+                wh = dict(s.webhook) if s.webhook is not None else None
+            if wh is not None:
+                # call-out OUTSIDE the state lock: the webhook's claim
+                # index is fed by watches of THIS server, and its fence
+                # checks GET leases from it — holding s.cond here would
+                # deadlock the very reads the verdict depends on
+                verdict = self._call_webhook(wh, ns, name, body)
+                with s.cond:
+                    s.webhook_calls += 1
+                if verdict is None:
+                    with s.cond:
+                        s.webhook_errors += 1
+                    if wh["failure_policy"] != "Ignore":
+                        return self._json(500, {
+                            "kind": "Status", "code": 500,
+                            "message": 'failed calling webhook '
+                                       '"yoda-bind-authority.yoda.tpu": '
+                                       'connection error (failurePolicy='
+                                       'Fail)'})
+                elif not verdict[0]:
+                    with s.cond:
+                        s.webhook_denials += 1
+                    code = verdict[1] if 400 <= verdict[1] < 600 else 400
+                    return self._json(code, {
+                        "kind": "Status", "code": code,
+                        "message": 'admission webhook "yoda-bind-'
+                                   'authority.yoda.tpu" denied the '
+                                   f'request: {verdict[2]}'})
+            with s.cond:
+                # re-validate under the lock: the call-out window is the
+                # TOCTOU a real apiserver closes with storage-level
+                # optimistic concurrency — a racing bind that landed
+                # meanwhile must still 409
+                pod = s.objects["pods"].get(key)
+                if pod is None:
+                    return self._json(404, {"kind": "Status", "code": 404})
+                if pod.get("spec", {}).get("nodeName"):
+                    return self._json(409, {
+                        "kind": "Status", "code": 409,
+                        "message": f"pod {key} is already assigned to node "
+                                   f"{pod['spec']['nodeName']}"})
+                if wh is None and not s.vanilla_authority:
+                    # built-in authority battery (PR 6), checked ATOMICALLY
+                    # with the apply. With a webhook registered (or
+                    # vanilla_authority set) the server behaves like a
+                    # CONFORMANT apiserver instead: only the pod-level 409
+                    # above — chip/fence checks belong to the webhook.
+                    conflict = self._bind_conflict(body, pod)
+                    if conflict is not None:
+                        return self._json(409, {"kind": "Status",
+                                                "code": 409,
+                                                "message": conflict})
                 s.bindings.append(body)
                 pod.setdefault("spec", {})["nodeName"] = body["target"]["name"]
                 # upstream parity (registry/core/pod assignPod): annotations

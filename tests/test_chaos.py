@@ -34,6 +34,7 @@ from yoda_scheduler_tpu.chaos import (
     APISERVER_STORM,
     AsyncChaosCluster,
     BIND_LOST,
+    CLOCK_SKEW,
     ChaosCluster,
     CrashingFilter,
     CrashingReserve,
@@ -43,10 +44,16 @@ from yoda_scheduler_tpu.chaos import (
     FaultPlan,
     FaultWindow,
     LEASE_EXPIRY,
+    NETWORK_PARTITION,
+    PartitionableView,
     PLUGIN_ERROR,
     REPLICA_CRASH,
+    SLOW_APISERVER,
     SPLIT_BRAIN,
     TELEMETRY_BLACKOUT,
+    VanillaAuthorityCluster,
+    WEBHOOK_DOWN,
+    WEBHOOK_KINDS,
 )
 from yoda_scheduler_tpu.scheduler import (
     FakeCluster, FleetCoordinator, Scheduler, SchedulerConfig)
@@ -425,6 +432,340 @@ def test_fleet_chaos_fuzz(seed):
     stats = fleet.fleet_stats()
     assert all(v >= 0 for v in stats["authority_rejections"].values())
 
+
+# -------------------------------------- webhook-era chaos fuzz (vanilla
+# authority + webhook gate + partition/skew/slow-apiserver windows)
+_WH_SMOKE = 16
+_WH_FULL = 96
+
+
+def _wh_seed_params():
+    return [s if s < _WH_SMOKE
+            else pytest.param(s, marks=pytest.mark.slow)
+            for s in range(_WH_FULL)]
+
+
+def _ownership(fleet):
+    """shard -> owning replica idx, or None while ownership is split,
+    duplicated, or incomplete."""
+    owned = {}
+    for rep in fleet.replicas:
+        for s in rep.owned:
+            if s in owned:
+                return None
+            owned[s] = rep.idx
+    if set(owned) != set(range(fleet.shard_count)):
+        return None
+    return owned
+
+
+def _drive_webhook_fleet(fleet, plan, pods, rng, views):
+    """Like _drive_fleet, plus the windowed faults the call sites can't
+    inject: NETWORK_PARTITION freezes a seeded replica's cluster view for
+    the window (binds still flow), CLOCK_SKEW drifts a replica's lease
+    clock slow past the lease duration (renewals silently missed).
+    SLOW_APISERVER / WEBHOOK_DOWN live inside the chaos cluster."""
+    clock = fleet.clock
+    fired: set = set()
+    active: dict = {}  # (kind, start) -> (end, undo)
+    fault_end = plan.fault_end()
+    budget = 300.0 + fault_end
+    cycles = 0
+    while True:
+        now = clock.time()
+        assert now < budget, (
+            f"webhook-fleet drive did not converge by t={now:.1f}: pending "
+            f"{[p.name for p in pods if p.phase == PodPhase.PENDING]}")
+        cycles += 1
+        assert cycles < 300_000, "webhook-fleet drive budget exhausted"
+        for w in plan.windows:
+            key = (w.kind, w.start)
+            if w.start > now or key in fired:
+                continue
+            if w.kind == REPLICA_CRASH:
+                fired.add(key)
+                # a crash during a partition implicitly heals it: the
+                # replacement replica starts with a fresh (live) view
+                fleet.crash_replica(rng.randrange(fleet.n), pods)
+            elif w.kind == NETWORK_PARTITION:
+                fired.add(key)
+                idx = rng.randrange(fleet.n)
+                views[idx].freeze()
+                active[key] = (w.end, views[idx].thaw)
+            elif w.kind == CLOCK_SKEW:
+                fired.add(key)
+                idx = rng.randrange(fleet.n)
+                skew = -(fleet.lease_duration_s * 2
+                         + rng.uniform(0.0, 3.0))
+                fleet.skew_replica_clock(idx, skew)
+                active[key] = (
+                    w.end,
+                    lambda i=idx: fleet.skew_replica_clock(i, 0.0))
+        for key in list(active):
+            end, undo = active[key]
+            if now >= end:
+                undo()
+                del active[key]
+        if fleet.step(rng) is not None:
+            clock.advance(TICK)
+            continue
+        wake = fleet.next_wake_at()
+        if wake is None:
+            if now >= fault_end and not active and all(
+                    p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                    for p in pods):
+                return
+            clock.advance(0.5)
+        else:
+            clock.advance(max(wake - clock.time(), TICK))
+
+
+@pytest.mark.parametrize("seed", _wh_seed_params())
+def test_webhook_chaos_fuzz(seed):
+    """One seeded scenario against the VANILLA-authority posture: the
+    server itself enforces only the pod-level 409; the chip/HBM/fence
+    battery lives in the webhook gate, which the plan can take DOWN while
+    replicas are partitioned (watch frozen, binds flowing), clock-skewed
+    (renewals silently missed), or behind a slow apiserver. The four
+    invariants must hold fleet-wide, and shard ownership must re-converge
+    to the preferred mapping afterwards (no permanently orphaned or
+    sticky shard).
+
+    Fail mode alternates by seed. failOpen's one documented blind spot —
+    a partition CONCURRENT with webhook downtime — is excluded for
+    fail-open seeds (the deployment guidance; the hazard itself is
+    pinned by test_failopen_partition_hazard_is_real below)."""
+    rng = random.Random(30_000 + seed)
+    fail_open = bool(seed % 2)
+    plan = FaultPlan(seed, horizon_s=20.0, kinds=WEBHOOK_KINDS)
+    if fail_open:
+        down = plan.windows_of(WEBHOOK_DOWN)
+        plan.windows = [
+            w for w in plan.windows
+            if w.kind != NETWORK_PARTITION
+            or not any(w.start < d.end and d.start < w.end for d in down)]
+    clock = FakeClock()
+    store = _fleet(rng)
+    cluster = VanillaAuthorityCluster(store, plan=plan, clock=clock,
+                                      fail_open=fail_open)
+    cluster.add_nodes_from_telemetry()
+    n_replicas = rng.choice((2, 3))
+    views: dict = {}
+
+    def wrap(c, idx):
+        v = PartitionableView(c)
+        views[idx] = v
+        return v
+
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=MAX_AGE,
+                        breaker_cooldown_s=1.0),
+        replicas=n_replicas, clock=clock, mode="sharded", seed=seed,
+        lease_duration_s=2.0, renew_period_s=0.25, rebalance_s=1.0,
+        validate_fence_locally=bool(rng.getrandbits(1)),
+        cluster_wrapper=wrap)
+    pods = _workload(rng)
+    for p in pods:
+        fleet.submit(p)
+    _drive_webhook_fleet(fleet, plan, pods, rng, views)
+    _assert_invariants(pods, store, cluster, f"webhook-{seed}",
+                       sched=fleet)
+    # shard ownership re-convergence: every shard ends owned by exactly
+    # its preferred replica (all replicas alive at the end), through the
+    # heartbeat-keyed rebalance handoffs — no orphan, no sticky takeover
+    deadline = clock.time() + 120.0
+    while clock.time() < deadline:
+        owned = _ownership(fleet)
+        if owned is not None and all(i == s % fleet.n
+                                     for s, i in owned.items()):
+            break
+        fleet.step(rng)
+        clock.advance(0.25)
+    owned = _ownership(fleet)
+    assert owned is not None, (
+        f"seed {seed}: shard ownership never re-converged: "
+        f"{[sorted(r.owned) for r in fleet.replicas]}")
+    assert all(i == s % fleet.n for s, i in owned.items()), (
+        f"seed {seed}: takeover stayed sticky: {owned}")
+
+
+# ----------------------- targeted: partition / skew / slow / webhook-down
+def _two_chip_rig(plan=None, fail_open=False):
+    """One node, two chips: pod A (1 chip) + pod B (2 chips) can never
+    both fit — the staging for every partition-conflict test."""
+    clock = FakeClock()
+    store = TelemetryStore()
+    m = make_tpu_node("n0", chips=2)
+    m.heartbeat = 0.0
+    store.put(m)
+    cluster = VanillaAuthorityCluster(store, plan=plan, clock=clock,
+                                      fail_open=fail_open)
+    cluster.add_nodes_from_telemetry()
+    views: dict = {}
+
+    def wrap(c, idx):
+        v = PartitionableView(c)
+        views[idx] = v
+        return v
+
+    fleet = FleetCoordinator(
+        cluster, SchedulerConfig(telemetry_max_age_s=MAX_AGE),
+        replicas=2, clock=clock, mode="free-for-all", seed=1,
+        cluster_wrapper=wrap)
+    return clock, store, cluster, fleet, views
+
+
+def test_partitioned_replica_stale_bind_caught_by_webhook():
+    """A replica that can bind but not watch places off its frozen view;
+    with the webhook UP, its chip-overlapping commit bounces at the API
+    boundary (chip_claim 409) and nothing double-books — the exact
+    safety claim the webhook port exists for."""
+    clock, store, cluster, fleet, views = _two_chip_rig()
+    a = Pod("a", labels={"tpu/accelerator": "tpu", "scv/number": "1"})
+    b = Pod("b", labels={"tpu/accelerator": "tpu", "scv/number": "2"})
+    views[1].freeze()  # replica 1 loses its watch BEFORE a binds
+    fleet.submit_to(0, a)
+    assert fleet.replicas[0].engine.run_one() == "bound"
+    assert a.phase == PodPhase.BOUND
+    # replica 1 schedules b off the frozen (both-chips-free) view
+    fleet.submit_to(1, b)
+    outcomes = []
+    for _ in range(12):
+        out = fleet.replicas[1].engine.run_one()
+        if out is None:
+            break
+        outcomes.append(out)
+        clock.advance(0.05)
+    assert cluster.bind_conflicts.get("chip_claim", 0) >= 1, outcomes
+    assert b.phase != PodPhase.BOUND
+    # no double-booking: chip owners are disjoint
+    owners = {}
+    for p in cluster.all_pods():
+        for c in p.assigned_chips():
+            assert (p.node, c) not in owners
+            owners[(p.node, c)] = p.name
+    views[1].thaw()
+
+
+def test_failopen_partition_hazard_is_real():
+    """The contrast case, and the reason failOpen is NOT the default:
+    with the webhook DOWN in fail-open AND the replica partitioned, the
+    stale commit sails through the pod-level-only check and the chips
+    double-book. This is the documented trade — the fuzz keeps these two
+    windows disjoint for fail-open seeds, and deployments that cannot
+    rule the overlap out must run failurePolicy=Fail."""
+    plan = FaultPlan(0, horizon_s=100.0)
+    plan.windows = [FaultWindow(WEBHOOK_DOWN, 0.0, 1e9)]
+    clock, store, cluster, fleet, views = _two_chip_rig(
+        plan=plan, fail_open=True)
+    a = Pod("a", labels={"tpu/accelerator": "tpu", "scv/number": "1"})
+    b = Pod("b", labels={"tpu/accelerator": "tpu", "scv/number": "2"})
+    views[1].freeze()
+    fleet.submit_to(0, a)
+    assert fleet.replicas[0].engine.run_one() == "bound"
+    fleet.submit_to(1, b)
+    assert fleet.replicas[1].engine.run_one() == "bound"  # unchecked!
+    assert b.phase == PodPhase.BOUND
+    claimed = a.assigned_chips() & b.assigned_chips()
+    assert claimed, "expected the fail-open double-booking to demonstrate"
+    assert cluster.webhook_skipped >= 1
+
+
+def test_slow_apiserver_is_latency_not_failure():
+    """SLOW_APISERVER: binds complete after injected delay. The breaker
+    must never count it, nothing backs off, every pod binds."""
+    clock = FakeClock()
+    plan = FaultPlan(0, horizon_s=10.0)
+    plan.windows = [FaultWindow(SLOW_APISERVER, 0.0, 5.0)]
+    store, cluster = _simple_rig(clock=clock, cluster_cls=ChaosCluster,
+                                 plan=plan)
+    cluster.clock = clock
+    sched = _build_engine(cluster, clock, breaker_threshold=3,
+                          telemetry_max_age_s=1e9)
+    pods = [Pod(f"p{i}", labels={"tpu/accelerator": "tpu",
+                                 "scv/number": "1"}) for i in range(5)]
+    for p in pods:
+        sched.submit(p)
+    _drain(sched, pods)
+    assert all(p.phase == PodPhase.BOUND for p in pods)
+    c = sched.metrics.counters
+    assert cluster.injected[SLOW_APISERVER] >= 1
+    assert c.get("breaker_opens_total", 0) == 0
+    assert c.get("bind_errors_total", 0) == 0
+
+
+def test_clock_skew_stale_fence_bounces_at_authority():
+    """A replica whose lease clock drifts slow silently misses renewals;
+    its shards expire and change hands while it keeps committing on the
+    old epochs (trust-owned posture) — the stale fences must bounce at
+    the AUTHORITY, and the replica must recover once the drift heals."""
+    clock = FakeClock()
+    store = _fleet(random.Random(7))
+    cluster = ChaosCluster(store, clock=clock)
+    cluster.add_nodes_from_telemetry()
+    fleet = FleetCoordinator(
+        cluster, SchedulerConfig(telemetry_max_age_s=MAX_AGE),
+        replicas=2, clock=clock, mode="sharded", seed=7,
+        lease_duration_s=2.0, renew_period_s=0.25, rebalance_s=0.0,
+        validate_fence_locally=False)
+    rng = random.Random(7)
+    # let both replicas acquire their preferred shards
+    for _ in range(4):
+        fleet.step(rng)
+        clock.advance(0.3)
+    assert all(rep.owned for rep in fleet.replicas)
+    # the drifting replica must be the one whose shard holds the TPU
+    # nodes, or its stale fence never rides a TPU bind (the tpu-shard is
+    # deterministic for this seed's node names: crc32 puts them in 1)
+    from yoda_scheduler_tpu.scheduler.fleet import shard_of
+    tpu_shard = shard_of("t0", fleet.shard_count)
+    victim = tpu_shard % fleet.n
+    other = 1 - victim
+    # the victim drifts 100s slow: its renewals stop dead
+    fleet.skew_replica_clock(victim, -100.0)
+    clock.advance(3.0)  # past the lease duration: its shards expire
+    fleet.step(rng)     # the peer's upkeep takes the expired shards over
+    stale = dict(fleet.replicas[victim].owned)
+    assert tpu_shard in stale, \
+        "victim should still BELIEVE it owns the tpu shard"
+    assert tpu_shard in fleet.replicas[other].owned, \
+        "peer never took over the expired shard"
+    # the victim commits into its believed-owned shard with dead epochs
+    pods = [Pod(f"p{i}", labels={"tpu/accelerator": "tpu",
+                                 "scv/number": "1"}) for i in range(4)]
+    for p in pods:
+        fleet.submit_to(victim, p)
+    for _ in range(30):
+        if cluster.bind_conflicts.get("stale_fence", 0) >= 1:
+            break
+        if fleet.replicas[victim].engine.run_one() is None:
+            clock.advance(0.1)
+    assert cluster.bind_conflicts.get("stale_fence", 0) >= 1
+    # heal the drift: the victim's next upkeep drops the lost leases and
+    # everything converges unfenced/re-fenced
+    fleet.skew_replica_clock(victim, 0.0)
+    _drive_fleet(fleet, FaultPlan(0, horizon_s=0.1), pods, rng)
+    assert all(p.phase == PodPhase.BOUND for p in pods)
+    _assert_invariants(pods, store, cluster, "clock-skew", sched=fleet)
+
+
+def test_partition_heals_and_view_rebuilds():
+    """After thaw, the replica's memos must NOT serve frozen-era state:
+    foreign binds that landed during the partition are visible and the
+    replica places around them."""
+    clock, store, cluster, fleet, views = _two_chip_rig()
+    a = Pod("a", labels={"tpu/accelerator": "tpu", "scv/number": "1"})
+    b = Pod("b", labels={"tpu/accelerator": "tpu", "scv/number": "1"})
+    views[1].freeze()
+    fleet.submit_to(0, a)
+    assert fleet.replicas[0].engine.run_one() == "bound"
+    views[1].thaw()
+    fleet.submit_to(1, b)
+    assert fleet.replicas[1].engine.run_one() == "bound"
+    assert b.phase == PodPhase.BOUND
+    assert not (a.assigned_chips() & b.assigned_chips())
+    assert cluster.bind_conflicts.get("chip_claim", 0) == 0
 
 # ------------------------------------------------- targeted: crash containment
 def _simple_rig(n_nodes=4, clock=None, cluster_cls=FakeCluster, **ck):
@@ -926,6 +1267,59 @@ def test_watch_cut_and_410_storm_recovery_counted():
                 "reflector_watch_expired_total", 0) >= 1
             assert cluster.metrics.counters.get(
                 "reflector_relists_total", 0) > relists0
+        finally:
+            cluster.stop()
+
+
+def test_watch_bookmarks_avoid_410_relist():
+    """The bookmark slice of the wire overhaul: with the server emitting
+    BOOKMARKs (allowWatchBookmarks), a QUIET reflector's resourceVersion
+    advances past other kinds' writes — so compaction + a stream cut
+    resumes from the bookmark instead of taking the 410 full-relist path
+    (contrast: test_watch_cut_and_410_storm_recovery_counted, which runs
+    bookmarks-off and MUST keep seeing the 410)."""
+    from fake_apiserver import FakeApiServer
+    from yoda_scheduler_tpu.k8s.client import KubeCluster
+
+    with FakeApiServer() as api:
+        api.state.bookmarks_enabled = True
+        api.state.add_node("n0")
+        client = _mk_client(api.url)
+        cluster = KubeCluster(client, TelemetryStore())
+        cluster.start()
+        try:
+            assert cluster.wait_synced(10.0)
+            relists0 = cluster.metrics.counters.get(
+                "reflector_relists_total", 0)
+            # rv churn on a DIFFERENT kind: the nodes stream stays quiet
+            for i in range(3):
+                api.state.add_pod({"metadata": {"name": f"rv{i}"},
+                                   "spec": {}})
+            # wait until the quiet nodes watcher has bookmarked past it
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if cluster.metrics.counters.get(
+                        "reflector_bookmarks_total", 0) >= 1:
+                    break
+                time.sleep(0.05)
+            assert cluster.metrics.counters.get(
+                "reflector_bookmarks_total", 0) >= 1
+            # compact nodes history, cut the stream: the re-watch comes
+            # from the BOOKMARKED rv and must NOT 410
+            api.state.compact("nodes")
+            api.state.cut_watches("nodes")
+            time.sleep(0.3)
+            api.state.add_node("n1")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if "n1" in cluster.node_names():
+                    break
+                time.sleep(0.05)
+            assert "n1" in cluster.node_names()
+            assert cluster.metrics.counters.get(
+                "reflector_watch_expired_total", 0) == 0
+            assert cluster.metrics.counters.get(
+                "reflector_relists_total", 0) == relists0
         finally:
             cluster.stop()
 

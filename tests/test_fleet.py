@@ -569,3 +569,155 @@ def test_split_brain_duplicate_submission_single_bind():
             assert p.key not in seen
             seen.add(p.key)
     assert len(seen) == len(pods)
+
+
+# ------------------------------------------------- dynamic shard rebalancing
+def test_local_lease_store_release_and_live():
+    clock = FakeClock()
+    store = LocalLeaseStore(clock)
+    e1 = store.try_acquire("L", "a", 10.0)
+    assert e1 == 1 and store.live("L")
+    # wrong identity/epoch cannot release
+    assert not store.release("L", "b", e1)
+    assert not store.release("L", "a", e1 + 1)
+    assert store.live("L")
+    # a real release clears the holder, retires the epoch, and the lease
+    # is immediately acquirable by anyone
+    assert store.release("L", "a", e1)
+    assert not store.live("L")
+    assert not store.validate_fence(("L", "a", e1))
+    e2 = store.try_acquire("L", "b", 10.0)
+    assert e2 == e1 + 2  # release bumped once, takeover bumped again
+    # expiry also reads not-live
+    clock.advance(11.0)
+    assert not store.live("L")
+
+
+def test_shard_rebalance_releases_takeover_back_to_preferred():
+    """PR 6's takeover was sticky: a dead replica's shards stayed with
+    whoever took them over. With rebalancing, the survivor hands them
+    back the moment the replacement's heartbeat is live again."""
+    _store, cluster = _rig()
+    clock = FakeClock()
+    fleet = FleetCoordinator(
+        cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+        replicas=2, clock=clock, mode="sharded", seed=3,
+        lease_duration_s=2.0, renew_period_s=0.25, rebalance_s=1.0)
+    rng = random.Random(3)
+    for _ in range(4):
+        fleet.step(rng)
+        clock.advance(0.3)
+    assert sorted(fleet.replicas[0].owned) == [0]
+    assert sorted(fleet.replicas[1].owned) == [1]
+    # replica 1 dies; its replacement exists but must wait out the old
+    # heartbeat+leases, during which replica 0 takes the orphan over
+    fleet.crash_replica(1)
+    clock.advance(2.5)  # past the lease duration
+    fleet.step(rng)
+    assert 1 in fleet.replicas[0].owned, "survivor never took over"
+    c0 = fleet.replicas[0].engine.metrics.counters
+    assert c0.get("shard_takeovers_total", 0) >= 1
+    # ... and hands it back once the replacement heartbeats
+    deadline = clock.time() + 30.0
+    while clock.time() < deadline:
+        if sorted(fleet.replicas[1].owned) == [1] \
+                and sorted(fleet.replicas[0].owned) == [0]:
+            break
+        fleet.step(rng)
+        clock.advance(0.25)
+    assert sorted(fleet.replicas[0].owned) == [0]
+    assert sorted(fleet.replicas[1].owned) == [1]
+    assert c0.get("shard_rebalance_releases_total", 0) >= 1
+    flight_kinds = [e["kind"] for e in
+                    fleet.replicas[0].engine.flight.snapshot()]
+    assert "shard_takeover" in flight_kinds
+    assert "shard_rebalance" in flight_kinds
+
+
+def test_orphaned_absent_shard_claimed_after_grace():
+    """A preferrer that dies before EVER creating its shard lease must
+    not leave the shard permanently unowned: after one lease duration of
+    observed absence, a survivor claims it."""
+    _store, cluster = _rig()
+    clock = FakeClock()
+    fleet = FleetCoordinator(
+        cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+        replicas=2, clock=clock, mode="sharded", seed=3,
+        lease_duration_s=2.0, renew_period_s=0.25, rebalance_s=1.0)
+    rep0 = fleet.replicas[0]
+    # replica 1 NEVER steps (died pre-acquisition): drive only replica 0
+    for _ in range(30):
+        fleet._lease_step(rep0, clock.time())
+        clock.advance(0.3)
+        if sorted(rep0.owned) == [0, 1]:
+            break
+    assert sorted(rep0.owned) == [0, 1], (
+        "orphaned absent shard was never claimed", sorted(rep0.owned))
+
+
+def test_sticky_takeover_without_rebalance_knob():
+    """rebalance_s=0 restores the PR 6 posture exactly: takeover
+    ownership stays where it landed."""
+    _store, cluster = _rig()
+    clock = FakeClock()
+    fleet = FleetCoordinator(
+        cluster, SchedulerConfig(telemetry_max_age_s=1e9),
+        replicas=2, clock=clock, mode="sharded", seed=3,
+        lease_duration_s=2.0, renew_period_s=0.25, rebalance_s=0.0)
+    rng = random.Random(3)
+    for _ in range(4):
+        fleet.step(rng)
+        clock.advance(0.3)
+    fleet.crash_replica(1)
+    clock.advance(2.5)
+    fleet.step(rng)
+    assert 1 in fleet.replicas[0].owned
+    for _ in range(40):
+        fleet.step(rng)
+        clock.advance(0.25)
+    assert 1 in fleet.replicas[0].owned  # sticky, by explicit choice
+
+
+def test_wire_shard_lease_manager_rebalances_over_real_http():
+    """The wire twin: ShardLeaseManager heartbeats + releases through
+    the real Lease API — a returning replica gets its shards back."""
+    from fake_apiserver import FakeApiServer
+    from yoda_scheduler_tpu.k8s.client import KubeClient
+    from yoda_scheduler_tpu.k8s.leaderelect import ShardLeaseManager
+
+    with FakeApiServer() as api:
+        client = KubeClient(api.url, max_retries=1, retry_backoff_s=0.05)
+        # preferred sets follow the s %% replica_count convention the
+        # rebalancer keys handoffs on (same mapping FleetCoordinator uses)
+        a = ShardLeaseManager(client, 4, identity="a",
+                              preferred={0, 2}, lease_duration_s=1.0,
+                              replica_count=2, replica_idx=0,
+                              rebalance=True)
+        b = ShardLeaseManager(client, 4, identity="b",
+                              preferred={1, 3}, lease_duration_s=1.0,
+                              replica_count=2, replica_idx=1,
+                              rebalance=True)
+        a.step()
+        b.step()
+        assert sorted(a.owned) == [0, 2]
+        assert sorted(b.owned) == [1, 3]
+        # a dies: b takes its expired shards over (a's heartbeat expires
+        # on the same horizon, so the handoff gate opens)
+        time.sleep(1.2)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and sorted(b.owned) != [0, 1, 2, 3]:
+            b.step()
+            time.sleep(0.1)
+        assert sorted(b.owned) == [0, 1, 2, 3]
+        assert b.takeovers >= 2
+        # a returns: its heartbeat revives, b releases, a re-acquires
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not (
+                sorted(a.owned) == [0, 2]
+                and sorted(b.owned) == [1, 3]):
+            a.step()
+            b.step()
+            time.sleep(0.1)
+        assert sorted(a.owned) == [0, 2]
+        assert sorted(b.owned) == [1, 3]
+        assert b.rebalance_releases >= 2

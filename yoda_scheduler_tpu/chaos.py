@@ -61,6 +61,20 @@ ENGINE_CRASH = "EngineCrash"
 REPLICA_CRASH = "ReplicaCrash"
 LEASE_EXPIRY = "LeaseExpiry"
 SPLIT_BRAIN = "SplitBrain"
+# the webhook-era kinds, attacking exactly the vanilla-apiserver path:
+# a replica that can BIND but not WATCH (its view freezes while commits
+# still flow — the webhook is then the only thing standing between its
+# stale placements and a double-booking), lease clocks drifting between
+# replicas (renewals silently missed; stale fencing epochs travel to the
+# authority), a SLOW apiserver (latency is not failure: the breaker must
+# not trip and no invariant may bend), and the webhook itself going DOWN
+# under both failure policies (Fail = binds 500 until it returns;
+# Ignore = pod-level checks only, the documented unsafe-under-partition
+# trade).
+NETWORK_PARTITION = "NetworkPartition"
+CLOCK_SKEW = "ClockSkew"
+SLOW_APISERVER = "SlowApiServer"
+WEBHOOK_DOWN = "WebhookDown"
 
 ALL_KINDS = (APISERVER_STORM, BIND_LOST, TELEMETRY_BLACKOUT, PLUGIN_ERROR,
              ENGINE_CRASH)
@@ -69,11 +83,28 @@ ALL_KINDS = (APISERVER_STORM, BIND_LOST, TELEMETRY_BLACKOUT, PLUGIN_ERROR,
 # crashes are engine-local and already covered by the single-engine fuzz)
 FLEET_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH, LEASE_EXPIRY,
                SPLIT_BRAIN)
+# the webhook/partition fuzz's mix (tests/test_chaos.py, run against a
+# VANILLA-authority cluster + webhook gate): storms and lost binds keep
+# the wire honest while the four new kinds attack the watch/lease/
+# webhook legs, and replica crashes exercise shard rebalancing under it
+WEBHOOK_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
+                 NETWORK_PARTITION, CLOCK_SKEW, SLOW_APISERVER,
+                 WEBHOOK_DOWN)
 
 
 class LostResponseError(ConnectionError):
     """The mutation was applied; the response never arrived (the
     fake-apiserver ``-1`` fault / k8s AmbiguousRequestError analogue)."""
+
+
+class WebhookUnavailableError(RuntimeError):
+    """failurePolicy=Fail with the webhook unreachable: the apiserver
+    refuses the bind with a server-returned 500 ('failed calling
+    webhook'). status=500 so the engine treats it as an ORDERLY refusal
+    — backoff retry, never the breaker (the apiserver itself answered)
+    and never the conflict path (nothing was judged)."""
+
+    status = 500
 
 
 @dataclass(frozen=True)
@@ -172,7 +203,23 @@ class ChaosCluster(FakeCluster):
             self.flight.record("fault_injected", fault=kind,
                                bind_call=self.bind_calls - 1)
 
+    # one bind's injected latency during a SLOW_APISERVER window: long
+    # enough to push lease renew deadlines and queue deadlines around
+    # (the virtual clock advances), short enough that a window's worth of
+    # binds stays inside the convergence budget
+    slow_bind_latency_s = 0.25
+
+    def _maybe_slow(self) -> None:
+        """SLOW_APISERVER: latency, not failure — the bind completes
+        after a delay (virtual clock advances). The breaker must never
+        count it and no invariant may bend under it."""
+        if (self.plan is not None and self.clock is not None
+                and self.plan.active(SLOW_APISERVER, self._now())):
+            self._count(SLOW_APISERVER)
+            self.clock.sleep(self.slow_bind_latency_s)
+
     def bind(self, pod, node, assigned_chips=None, fence=None) -> None:
+        self._maybe_slow()
         fault = self._bind_fault()
         if fault == APISERVER_STORM:
             self._count(fault)
@@ -199,6 +246,7 @@ class AsyncChaosCluster(ChaosCluster):
 
     def bind_async(self, pod, node, assigned_chips=None,
                    on_fail=None, on_success=None, fence=None) -> None:
+        self._maybe_slow()
         fault = self._bind_fault()
         if fault == APISERVER_STORM:
             self._count(fault)
@@ -229,6 +277,191 @@ class AsyncChaosCluster(ChaosCluster):
             return
         if on_success is not None:
             on_success(pod, node)
+
+
+class VanillaAuthorityCluster(ChaosCluster):
+    """ChaosCluster in the VANILLA-apiserver posture: the server itself
+    enforces only the pod-level 409 (a conformant kube-apiserver's whole
+    battery); the chip/HBM/fence half runs in an attached WEBHOOK GATE
+    that a WEBHOOK_DOWN window takes away — under both failure policies:
+
+    - ``fail_open=False`` (failurePolicy=Fail): a bind during the window
+      is refused with a server-returned 500 (WebhookUnavailableError) —
+      safety over availability; the engine backs the pod off and it
+      binds when the webhook returns.
+    - ``fail_open=True`` (failurePolicy=Ignore): binds flow with only
+      the pod-level check. Availability over safety — combined with a
+      concurrently PARTITIONED replica this is exactly the double-
+      booking window (demonstrated by a targeted test; the fuzz keeps
+      the two windows disjoint for fail-open seeds, which is the
+      deployment guidance in ARCHITECTURE.md)."""
+
+    def __init__(self, telemetry=None, plan: FaultPlan | None = None,
+                 clock=None, bind_script: dict[int, str] | None = None,
+                 flight=None, fail_open: bool = False) -> None:
+        super().__init__(telemetry, plan=plan, clock=clock,
+                         bind_script=bind_script, flight=flight)
+        self.fail_open = fail_open
+        self.webhook_checked = 0   # full-battery verdicts served
+        self.webhook_skipped = 0   # fail-open binds admitted unchecked
+
+    def _webhook_down(self) -> bool:
+        return (self.plan is not None
+                and self.plan.active(WEBHOOK_DOWN, self._now()))
+
+    def _check_bind(self, pod, node, assigned_chips, fence) -> None:
+        # the vanilla half: the binding subresource 409s an already-
+        # assigned pod no matter what
+        cur = self._bound_keys.get(pod.key)
+        if cur is not None:
+            self._reject("pod_bound",
+                         f"pod {pod.key} is already bound to {cur}")
+        if self._webhook_down():
+            self._count(WEBHOOK_DOWN)
+            if self.fail_open:
+                self.webhook_skipped += 1
+                if self.flight is not None:
+                    self.flight.record("webhook_fail_open", pod=pod.key,
+                                       node=node, state="down")
+                return  # failurePolicy=Ignore: pod-level check only
+            raise WebhookUnavailableError(
+                'failed calling webhook "yoda-bind-authority.yoda.tpu": '
+                "connection refused (failurePolicy=Fail)")
+        self.webhook_checked += 1
+        # webhook up: the full battery — the pod-level check re-runs
+        # inside, which is harmless (it just passed)
+        super()._check_bind(pod, node, assigned_chips, fence)
+
+
+class PartitionableView:
+    """Per-replica cluster facade for NETWORK_PARTITION: while frozen,
+    the replica's WATCH-side reads (membership, per-node pod lists, the
+    change-log versions) serve a snapshot taken at partition start — the
+    replica schedules off an ever-staler view — while its BINDS (and the
+    bind path's recovery reads: ``bound_node_of`` models the confirm
+    GET) still reach the live cluster. The replica's own binds are
+    write-through into the frozen view, as a real client's optimistic
+    cache update would be; everything it cannot see is what the
+    authority's conflict battery exists for.
+
+    Everything not explicitly frozen delegates to the inner cluster."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        self._frozen: dict | None = None
+        # post-thaw rebuild floor: change-log versions handed out while
+        # frozen count only OUR writes, so a memo holding one cannot be
+        # diffed against the real log (foreign changes interleaved with
+        # ours would be skipped). Any version below the floor rebuilds.
+        self._rebuild_below: int | None = None
+
+    # ------------------------------------------------------------- chaos
+    def freeze(self) -> None:
+        inner = self._inner
+        nodes = inner.node_names()
+        self._frozen = {
+            "nodes": nodes,
+            "pods_on": {n: inner.pods_on(n) for n in nodes},
+            "pods_ver": {n: inner.pods_version(n) for n in nodes},
+            "nodes_ver": inner.nodes_version,
+            "gver": inner.pods_global_version,
+        }
+
+    def thaw(self) -> None:
+        self._frozen = None
+        self._rebuild_below = self._inner.pods_global_version
+
+    @property
+    def partitioned(self) -> bool:
+        return self._frozen is not None
+
+    # ----------------------------------------------------- frozen reads
+    def node_names(self):
+        f = self._frozen
+        return list(f["nodes"]) if f is not None else \
+            self._inner.node_names()
+
+    def pods_on(self, node):
+        f = self._frozen
+        return list(f["pods_on"].get(node, ())) if f is not None else \
+            self._inner.pods_on(node)
+
+    def all_pods(self):
+        f = self._frozen
+        if f is None:
+            return self._inner.all_pods()
+        return [p for pods in f["pods_on"].values() for p in pods]
+
+    def pods_version(self, node):
+        f = self._frozen
+        return f["pods_ver"].get(node, 0) if f is not None else \
+            self._inner.pods_version(node)
+
+    @property
+    def nodes_version(self):
+        f = self._frozen
+        return f["nodes_ver"] if f is not None else \
+            self._inner.nodes_version
+
+    @property
+    def pods_global_version(self):
+        f = self._frozen
+        return f["gver"] if f is not None else \
+            self._inner.pods_global_version
+
+    def changes_since(self, version):
+        f = self._frozen
+        if f is None:
+            if self._rebuild_below is not None \
+                    and version < self._rebuild_below:
+                # a frozen-era version: not diffable — full rebuild
+                return self._inner.pods_global_version, None
+            return self._inner.changes_since(version)
+        # no watch = no change information: anything not already applied
+        # reads as "rebuild from (frozen) state" — deliberately the
+        # conservative full-rebuild signal, never a bogus empty diff for
+        # a version we cannot actually diff against
+        if version == f["gver"]:
+            return f["gver"], set()
+        return f["gver"], None
+
+    def changes_since_directed(self, version):
+        if self._frozen is None:
+            if self._rebuild_below is not None \
+                    and version < self._rebuild_below:
+                return self._inner.pods_global_version, None, None
+            return self._inner.changes_since_directed(version)
+        ver, dirty = self.changes_since(version)
+        # dirty is () or None (rebuild); grew mirrors it per the
+        # changelog contract (both None on rebuild, grew ⊆ dirty)
+        return ver, dirty, (set() if dirty is not None else None)
+
+    # -------------------------------------------------- live bind path
+    def bind(self, pod, node, assigned_chips=None, fence=None) -> None:
+        self._inner.bind(pod, node, assigned_chips, fence=fence)
+        f = self._frozen
+        if f is not None:
+            # the client SAW its 2xx: write through into the frozen view
+            # (a real scheduler's optimistic cache update), bumping the
+            # frozen versions so the replica's memos notice its own write
+            f["pods_on"].setdefault(node, []).append(pod)
+            f["pods_ver"][node] = f["pods_ver"].get(node, 0) + 1
+            f["gver"] += 1
+
+    def evict(self, pod) -> None:
+        node = pod.node
+        self._inner.evict(pod)
+        f = self._frozen
+        if f is not None and node in f["pods_on"]:
+            f["pods_on"][node] = [p for p in f["pods_on"][node]
+                                  if p.uid != pod.uid]
+            f["pods_ver"][node] = f["pods_ver"].get(node, 0) + 1
+            f["gver"] += 1
+
+    def __getattr__(self, name):
+        # telemetry, node_meta, bound_node_of, lease_authority, subscribe,
+        # bind_conflicts, ... — everything else is live
+        return getattr(self._inner, name)
 
 
 def blackout(store, now: float, max_age_s: float) -> None:

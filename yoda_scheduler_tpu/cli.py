@@ -637,6 +637,44 @@ def cmd_serve(args) -> int:
         leader_elect=args.leader_elect)
 
 
+def cmd_webhook(args) -> int:
+    """Run the bind-authority admission webhook (k8s/webhook.py): the
+    chip/fence half of the conflict battery as a pods/binding
+    ValidatingAdmissionWebhook, deployed NEXT TO a vanilla apiserver
+    (deploy/bind-authority-webhook.yaml). Its own process, not the
+    scheduler's — the authority must survive scheduler restarts."""
+    from .k8s.client import KubeClient
+    from .k8s.webhook import serve_webhook
+    from .scheduler.config import SchedulerConfig
+
+    client = KubeClient.from_env(
+        args.kubeconfig, args.apiserver,
+        insecure_skip_tls_verify=args.insecure_skip_tls_verify)
+    if client is None:
+        log.error("no reachable Kubernetes API server to feed the claim "
+                  "index from")
+        return 2
+    cfg = SchedulerConfig()
+    if args.config:
+        profiles = load_profiles(args.config)
+        cfg = profiles[0][0]
+    port = args.port if args.port is not None else (cfg.webhook_port or 8443)
+    fail_open = cfg.webhook_fail_open or args.fail_open
+    server = serve_webhook(
+        client, port=port, certfile=args.tls_cert, keyfile=args.tls_key,
+        fail_open=fail_open, stale_after_s=cfg.webhook_stale_after_s,
+        host=args.host)
+    try:
+        import threading
+
+        threading.Event().wait()  # serve until killed
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="yoda-tpu-scheduler")
     ap.add_argument("--v", type=int, default=1, help="log verbosity (klog-style)")
@@ -688,6 +726,30 @@ def main(argv=None) -> int:
     srv.add_argument("--metrics-port", type=int, default=10251)
     srv.add_argument("--leader-elect", action="store_true")
     srv.set_defaults(fn=cmd_serve)
+
+    wh = sub.add_parser(
+        "webhook", help="run the pods/binding bind-authority admission "
+                        "webhook (chip/fence conflict checks for vanilla "
+                        "apiservers)")
+    wh.add_argument("--config", default=None,
+                    help="scheduler profile YAML (webhookPort/failOpen/"
+                         "webhookStaleAfterSeconds knobs)")
+    wh.add_argument("--port", type=int, default=None,
+                    help="listen port (default: webhookPort knob, else "
+                         "8443)")
+    wh.add_argument("--host", default="0.0.0.0")
+    wh.add_argument("--tls-cert", default=None,
+                    help="PEM certificate (a ValidatingWebhookConfiguration "
+                         "requires an https callee; omit only for local "
+                         "testing)")
+    wh.add_argument("--tls-key", default=None)
+    wh.add_argument("--fail-open", action="store_true",
+                    help="allow binds while the claim index is stale "
+                         "(availability over safety; default fail-closed)")
+    wh.add_argument("--kubeconfig", default=None)
+    wh.add_argument("--apiserver", default=None)
+    wh.add_argument("--insecure-skip-tls-verify", action="store_true")
+    wh.set_defaults(fn=cmd_webhook)
 
     args = ap.parse_args(argv)
     logging.basicConfig(

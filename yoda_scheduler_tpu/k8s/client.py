@@ -67,7 +67,24 @@ class ApiError(RuntimeError):
 
     def __init__(self, method: str, path: str, status: int, body: bytes = b""):
         self.status = status
+        self.body = body
         super().__init__(f"{method} {path} -> {status}: {body[:200]!r}")
+
+
+def is_webhook_denial(e: Exception) -> bool:
+    """A validating-admission-webhook DENIAL: the apiserver surfaces it
+    with the webhook's status code (ours sets 409; third-party webhooks
+    commonly 400/403) and the canonical 'admission webhook "..." denied
+    the request' message. For the bind path a denial is an authority
+    conflict verdict — it must take the 409 recovery protocol, never the
+    wire-failure path (core._is_authority_conflict is the engine twin)."""
+    status = getattr(e, "status", None)
+    if status not in (400, 403, 409):
+        return False
+    text = getattr(e, "body", b"") or str(e).encode()
+    if isinstance(text, str):
+        text = text.encode()
+    return b"denied the request" in text
 
 
 class AmbiguousRequestError(ConnectionError):
@@ -602,7 +619,11 @@ class KubeClient:
                 ambiguous = (e.status == 0
                              and isinstance(e.__cause__,
                                             AmbiguousRequestError))
-                if e.status != 409 and not ambiguous:
+                # a webhook denial (400/403-coded) is a conflict verdict
+                # too: resolve it through the same read-back protocol so
+                # the engine sees the uniform 409 shape
+                if e.status != 409 and not ambiguous \
+                        and not is_webhook_denial(e):
                     raise
                 # the confirm GET is the ONE read standing between an
                 # ambiguous bind and a duplicate-bind window, so it gets
@@ -651,9 +672,14 @@ class KubeClient:
                              node, "ambiguous" if ambiguous else "409")
                     break
                 if bound_to or not ambiguous:
+                    # keep the authority's own reason (webhook denials
+                    # carry the conflicting chip/fence in the message) —
+                    # the raw body, not str(e), which truncates at 200
+                    reason = getattr(e, "body", b"") or str(e).encode()
+                    detail = (f"pod bound to {bound_to!r}".encode()
+                              if bound_to else b"rejected: " + reason)
                     raise ApiError("POST", "binding(conflict)", 409,
-                                   f"pod bound to {bound_to!r}".encode()) \
-                        from e
+                                   detail) from e
                 if replay:
                     raise  # unbound after a replayed POST: genuine failure
                 log.info("bind %s -> %s: ambiguous failure, pod unbound; "
@@ -667,6 +693,27 @@ class KubeClient:
         except ApiError as e:
             if e.status != 404:  # already gone = evicted
                 raise
+
+    def iter_pods(self, limit: int = 500, timeout: float = 30.0):
+        """Yield every non-terminal Pod, PAGE BY PAGE (limit + continue
+        tokens) — the restart-reconciliation read. A generator, not a
+        merged list: Scheduler.reconcile consumes it incrementally, so a
+        50k-pod restart holds one page in memory, and a single-page read
+        (the old shape) can never silently reconcile only the first 500
+        pods of a large cluster."""
+        cont = None
+        while True:
+            q = f"/api/v1/pods?limit={limit}"
+            if cont:
+                q += "&continue=" + urllib.parse.quote(cont)
+            doc = self.request("GET", q, timeout=timeout)
+            for item in doc.get("items", []):
+                p = _pod_from_api(item)
+                if p is not None:
+                    yield p
+            cont = doc.get("metadata", {}).get("continue")
+            if not cont:
+                return
 
     def list_bound_pods(self) -> dict[str, list[Pod]]:
         """Every pod holding a node — any phase except terminal. Filtering on
@@ -851,6 +898,11 @@ class Reflector:
                         if new_rv is not None:
                             rv = new_rv
                         if ev.get("type") == "BOOKMARK":
+                            # rv already advanced above: the re-watch
+                            # after rotation resumes from the bookmark
+                            # instead of an event rv that compaction may
+                            # have outrun (410 -> full re-list)
+                            self._inc("reflector_bookmarks_total")
                             t_mark = time.perf_counter_ns()
                             continue
                         self.on_event(ev.get("type", ""), obj)
@@ -1814,6 +1866,23 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     # pod's tree is complete: queued/cycle (engine) + bind_wire/
     # watch_confirm (binder + reflector threads)
     cluster.trace_sampling = profiles[0][0].trace_sampling
+
+    # restart reconciliation against CLUSTER truth, over the PAGINATED
+    # pod read (iter_pods follows continue tokens): bound pods are
+    # adopted as-is, pods stranded mid-bind by the previous incarnation
+    # (stale chip annotation, no binding) are scrubbed and requeued now
+    # instead of waiting out the intake's pending-only view
+    recon = getattr(sched, "reconcile", None)
+    if recon is not None:
+        try:
+            adopted, requeued = recon(client.iter_pods())
+            if adopted or requeued:
+                log.info("startup reconcile: adopted %d bound pods, "
+                         "requeued %d stranded ones", adopted, requeued)
+        except Exception as e:
+            # best-effort: the watch intake still schedules everything
+            # pending; reconcile only accelerates crash recovery
+            log.warning("startup reconcile failed: %s", e)
 
     if metrics_port is not None:
         from ..utils.httpserv import serve

@@ -41,6 +41,14 @@ log = logging.getLogger("yoda-tpu.le")
 
 LEASE_PATH = ("/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}")
 SHARD_LEASE_PREFIX = "yoda-shard-"
+# replica liveness heartbeats (dynamic shard rebalancing): each fleet
+# replica renews `yoda-replica-<idx>` alongside its shard leases. A
+# replica holding a foreign shard (crash takeover) watches the PREFERRED
+# owner's heartbeat and hands the shard back the moment that replica is
+# provably alive again — without this, takeover ownership was sticky
+# forever. A distinct prefix so a heartbeat can never be mistaken for a
+# fencing lease.
+REPLICA_HB_PREFIX = "yoda-replica-"
 
 
 def _duration_fields(duration_s: float) -> dict:
@@ -220,7 +228,9 @@ class ShardLeaseManager:
                  prefix: str = SHARD_LEASE_PREFIX,
                  lease_duration_s: float = 1.0,
                  preferred: set[int] | None = None,
-                 clock=time) -> None:
+                 clock=time, replica_count: int | None = None,
+                 replica_idx: int | None = None,
+                 rebalance: bool = False) -> None:
         self.client = client
         self.shard_count = shard_count
         self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
@@ -230,6 +240,22 @@ class ShardLeaseManager:
         self.preferred = preferred
         self.clock = clock
         self.owned: dict[int, int] = {}  # shard -> transitions epoch
+        # dynamic rebalancing (needs the fleet geometry): shard s's
+        # preferred owner is replica s % replica_count; this replica
+        # heartbeats `yoda-replica-<replica_idx>` and releases foreign
+        # shards whose preferred owner's heartbeat is live again
+        self.replica_count = replica_count
+        self.replica_idx = replica_idx
+        self.rebalance = rebalance and replica_count is not None \
+            and replica_idx is not None
+        # shard -> first instant its lease was observed ABSENT: the
+        # orphan guard — a preferrer that died before ever creating its
+        # lease must not leave the shard unowned forever
+        self._absent_since: dict[int, float] = {}
+        # per-step heartbeat-liveness memo (see _hb_live)
+        self._hb_memo: dict[int, bool] = {}
+        self.rebalance_releases = 0
+        self.takeovers = 0
 
     def _name(self, shard: int) -> str:
         return f"{self.prefix}{shard}"
@@ -268,21 +294,66 @@ class ShardLeaseManager:
                 and int(spec.get("leaseTransitions", 0) or 0) == int(epoch))
 
     def step(self) -> None:
-        """One upkeep pass: renew every owned shard (dropping the lost),
-        then try to acquire free/expired shards this replica prefers."""
+        """One upkeep pass: heartbeat (when rebalancing), renew every
+        owned shard (dropping the lost), release foreign shards whose
+        preferred owner is alive again, then try to acquire free/expired
+        shards this replica prefers — plus provably-orphaned ones."""
+        now = self.clock.time()
+        self._hb_memo.clear()  # liveness re-read once per pass
+        if self.rebalance:
+            self._heartbeat()
         for shard in list(self.owned):
             if not self._renew(shard):
                 self.owned.pop(shard, None)
                 log.warning("%s lost shard lease %d", self.identity, shard)
+        if self.rebalance:
+            for shard in list(self.owned):
+                pref = shard % self.replica_count
+                if pref == self.replica_idx:
+                    continue
+                if self._hb_live(pref):
+                    # the preferred owner is provably back: hand the
+                    # shard over (epoch retired so our in-flight fences
+                    # die with it) instead of staying sticky forever
+                    if self._release(shard):
+                        self.owned.pop(shard, None)
+                        self.rebalance_releases += 1
+                        log.info("%s released shard %d to replica %d",
+                                 self.identity, shard, pref)
         for shard in range(self.shard_count):
             if shard in self.owned:
                 continue
             if self.preferred is not None and shard not in self.preferred:
-                # non-preferred shards are only taken over once their
-                # holder has provably expired (crash takeover)
-                if not self._expired(shard):
+                if self.rebalance and self._hb_live(
+                        shard % self.replica_count):
+                    # alive preferrer: the shard is theirs to (re)take —
+                    # acquiring it would instantly undo a rebalance
+                    # release (see ShardLeaseManager step docstring)
+                    self._absent_since.pop(shard, None)
                     continue
-            self._acquire(shard)
+                lease = self._get(shard)
+                if lease is None:
+                    # absent: leave it to its preferrer — unless
+                    # rebalancing is on AND it has stayed absent past a
+                    # full lease duration (the preferrer died before
+                    # ever creating it): the orphan guard claims it.
+                    # Without rebalancing there is no handback either,
+                    # so claiming here would permanently rob a peer
+                    # that merely started late.
+                    first = self._absent_since.setdefault(shard, now)
+                    if not self.rebalance \
+                            or now - first <= self.lease_duration_s:
+                        continue
+                else:
+                    self._absent_since.pop(shard, None)
+                    # non-preferred shards are only taken over once
+                    # their holder has provably expired (crash takeover)
+                    if not self._lease_expired(lease):
+                        continue
+            if self._acquire(shard):
+                self._absent_since.pop(shard, None)
+                if self.owned.get(shard, 1) > 1:
+                    self.takeovers += 1
 
     # ------------------------------------------------------------- internals
     def _get(self, shard: int) -> dict | None:
@@ -291,14 +362,109 @@ class ShardLeaseManager:
         except Exception:
             return None
 
-    def _expired(self, shard: int) -> bool:
-        lease = self._get(shard)
-        if lease is None:
-            return False  # absent = never owned; leave it to its preferrer
+    def _lease_expired(self, lease: dict) -> bool:
         spec = lease.get("spec", {})
         renew = _parse_micro_time(spec.get("renewTime"))
         return (renew is None or self.clock.time() - renew >
                 _duration_of(spec, self.lease_duration_s))
+
+    def _expired(self, shard: int) -> bool:
+        lease = self._get(shard)
+        if lease is None:
+            return False  # absent = never owned; leave it to its preferrer
+        return self._lease_expired(lease)
+
+    # ------------------------------------------- heartbeats + rebalancing
+    def _hb_path(self, idx: int) -> str:
+        return LEASE_PATH.format(ns=self.namespace,
+                                 name=f"{REPLICA_HB_PREFIX}{idx}")
+
+    def _hb_live(self, idx: int) -> bool:
+        """Is replica `idx` provably alive (its heartbeat lease held and
+        unexpired)? Identity is NOT checked: any incarnation serving the
+        index counts — the handoff goes to the slot, not the process.
+        Memoized per step() pass: `shard % replica_count` takes at most
+        replica_count distinct values, so without the memo a 32-shard
+        fleet would re-GET the same one or two heartbeat leases once per
+        shard per upkeep tick."""
+        memo = self._hb_memo
+        if idx in memo:
+            return memo[idx]
+        try:
+            lease = self.client.request("GET", self._hb_path(idx),
+                                        timeout=3.0, retries=0)
+        except Exception:
+            memo[idx] = False
+            return False
+        spec = (lease or {}).get("spec", {}) or {}
+        memo[idx] = bool(spec.get("holderIdentity")) \
+            and not self._lease_expired(lease)
+        return memo[idx]
+
+    def _heartbeat(self) -> None:
+        """Acquire-or-renew this replica's own liveness lease. A fresh
+        incarnation waits out the dead one's remaining duration (the
+        conservative read: liveness must never be claimable early)."""
+        try:
+            lease = self.client.request(
+                "GET", self._hb_path(self.replica_idx),
+                timeout=3.0, retries=0)
+        except Exception:
+            lease = None
+        if lease is None:
+            body = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                    "metadata": {
+                        "name": f"{REPLICA_HB_PREFIX}{self.replica_idx}",
+                        "namespace": self.namespace},
+                    "spec": self._spec(1)}
+            try:
+                self.client.request(
+                    "POST",
+                    f"/apis/coordination.k8s.io/v1/namespaces/"
+                    f"{self.namespace}/leases", body)
+            except Exception:
+                pass
+            return
+        spec = lease.get("spec", {})
+        if spec.get("holderIdentity") != self.identity \
+                and not self._lease_expired(lease):
+            return  # a live foreign incarnation still owns the slot
+        transitions = int(spec.get("leaseTransitions", 0) or 0)
+        if spec.get("holderIdentity") != self.identity:
+            transitions += 1
+        lease = dict(lease)
+        lease["spec"] = self._spec(max(transitions, 1))
+        try:
+            self.client.request("PUT", self._hb_path(self.replica_idx),
+                                lease)
+        except Exception:
+            pass
+
+    def _release(self, shard: int) -> bool:
+        """Voluntarily give a shard up: holder cleared, epoch bumped (our
+        in-flight fencing tokens are stale from this instant), renewTime
+        cleared so the next claimant sees it immediately acquirable."""
+        lease = self._get(shard)
+        if lease is None:
+            return False
+        spec = lease.get("spec", {})
+        if spec.get("holderIdentity") != self.identity or int(
+                spec.get("leaseTransitions", 0) or 0) != self.owned.get(
+                    shard):
+            return False  # already taken over: nothing of ours to release
+        lease = dict(lease)
+        lease["spec"] = {
+            "holderIdentity": None,
+            **_duration_fields(self.lease_duration_s),
+            "renewTime": None, "acquireTime": None,
+            "leaseTransitions": int(spec.get("leaseTransitions", 0) or 0)
+            + 1,
+        }
+        try:
+            self.client.request("PUT", self._path(shard), lease)
+            return True
+        except Exception:
+            return False
 
     def _renew(self, shard: int) -> bool:
         lease = self._get(shard)

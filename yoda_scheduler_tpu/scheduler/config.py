@@ -236,6 +236,29 @@ class SchedulerConfig:
     # "free-for-all" (every replica pulls from the shared intake with no
     # node preference — the A/B baseline with the higher conflict rate)
     fleet_mode: str = "sharded"
+    # dynamic shard rebalancing (scheduler/fleet.py): replicas heartbeat
+    # `yoda-replica-<idx>` leases, and a replica holding a foreign shard
+    # (crash takeover) hands it back — at this cadence — once the
+    # preferred owner's heartbeat is live again, so a recovered replica
+    # gets its shards re-leased instead of ownership staying sticky with
+    # whoever survived the crash. Also arms the orphan guard (a shard
+    # whose preferrer died before ever leasing it is claimed after one
+    # lease duration). 0 disables: sticky takeover, the PR 6 behaviour.
+    shard_rebalance_s: float = 5.0
+    # bind-authority admission webhook (k8s/webhook.py): the port the
+    # `yoda-tpu webhook` server listens on (deploy/bind-authority-
+    # webhook.yaml wires the Service + ValidatingWebhookConfiguration to
+    # it). 0 = not serving a webhook from this process.
+    webhook_port: int = 0
+    # webhook self-degradation posture when its claim index goes stale
+    # (watch feed dead past webhook_stale_after_s): False (default)
+    # fail-CLOSED — deny binds with a retryable 503 until the feed
+    # recovers (safety over availability, the recommended setting);
+    # True fail-OPEN — allow everything, counted and flight-recorded
+    # (availability over safety: under a concurrent scheduler partition
+    # this is exactly the double-booking window, see ARCHITECTURE.md).
+    webhook_fail_open: bool = False
+    webhook_stale_after_s: float = 30.0
     # lifecycle span tracing (utils/obs.py SpanRing): record the full
     # queued/cycle/bind_wire/watch_confirm span tree for 1-in-N pods
     # (deterministic by pod key). 0 disables, 1 traces every pod; env
@@ -301,6 +324,15 @@ class SchedulerConfig:
                 "shardLeases", defaults.shard_leases)), 0),
             fleet_mode=_valid_fleet_mode(str(args.get(
                 "fleetMode", defaults.fleet_mode))),
+            shard_rebalance_s=float(args.get(
+                "shardRebalanceSeconds", defaults.shard_rebalance_s)),
+            webhook_port=int(args.get(
+                "webhookPort", defaults.webhook_port)),
+            webhook_fail_open=bool(args.get(
+                "failOpen", defaults.webhook_fail_open)),
+            webhook_stale_after_s=float(args.get(
+                "webhookStaleAfterSeconds",
+                defaults.webhook_stale_after_s)),
             trace_sampling=max(int(args.get(
                 "traceSampling", defaults.trace_sampling)), 0),
             flight_dump_dir=str(args.get(

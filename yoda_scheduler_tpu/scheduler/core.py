@@ -2540,10 +2540,11 @@ class Scheduler:
                     bound_to = bn(pod.key)
                 except Exception:
                     bound_to = None
-            if getattr(e, "status", None) == 409:
-                # server-returned conflict: a FOREIGN replica's commit
-                # beat ours (optimistic shared-state scheduling) — never
-                # a wire failure, never the breaker. Checked BEFORE the
+            if self._is_authority_conflict(e):
+                # server-returned conflict — the apiserver's 409 or a
+                # bind-authority webhook denial: a FOREIGN replica's
+                # commit beat ours (optimistic shared-state scheduling),
+                # never a wire failure, never the breaker. Checked BEFORE the
                 # adoption branch: a 409 means our POST was REJECTED, so
                 # even bound_to == node is someone else's same-key win on
                 # the same node (our own landed-but-409-on-replay case is
@@ -2715,6 +2716,24 @@ class Scheduler:
         self._bind_results.append(None)
 
     @staticmethod
+    def _is_authority_conflict(e: Exception) -> bool:
+        """A server-side REJECTION of our commit: the apiserver's own
+        409, or a pods/binding admission-webhook denial — which a real
+        apiserver surfaces with the WEBHOOK's status code (ours sets
+        409; third-party authorities commonly 400/403). Either way the
+        authority ANSWERED and refused, so the verdict takes the
+        conflict path (foreign-bind adopt / attempt-free local retry),
+        never the breaker and never the bind-error backoff. The denial
+        shape itself has ONE definition (k8s.client.is_webhook_denial —
+        imported lazily: this is the error path, and core must not
+        import the k8s package at module load)."""
+        if getattr(e, "status", None) == 409:
+            return True
+        from ..k8s.client import is_webhook_denial
+
+        return is_webhook_denial(e)
+
+    @staticmethod
     def _is_wire_failure(e: Exception) -> bool:
         """Only WIRE-class bind failures feed the breaker: connection
         drops, timeouts, and transport errors surfaced with status 0
@@ -2810,8 +2829,8 @@ class Scheduler:
                     bound_to = bn(pod.key)
                 except Exception:
                     bound_to = None
-                if bound_to == node and getattr(err, "status",
-                                                None) != 409:
+                if bound_to == node \
+                        and not self._is_authority_conflict(err):
                     # ambiguous wire failure whose POST actually landed
                     # (a 409 is NOT this: the server REJECTED our POST,
                     # so a same-node bound_to is a foreign same-key win
@@ -2822,7 +2841,7 @@ class Scheduler:
                     self._post_scheduled_event(pod, node)  # landed after all
                     self._breaker_success()
                     continue
-            if getattr(err, "status", None) == 409:
+            if self._is_authority_conflict(err):
                 # conflict, the async flavour: the binder already rolled
                 # its cache (and our optimistic chip label) back before
                 # reporting, and the dispatch-time reservation was
@@ -3171,7 +3190,11 @@ class Scheduler:
             if self.submit(pod):
                 requeued += 1
                 self.metrics.inc("reconcile_requeued_total")
-        self.flight.record("reconcile", adopted=adopted, requeued=requeued)
+        if adopted or requeued:
+            # no-op passes stay out of the black box: multi-profile and
+            # paginated reconciles route per-pod through here
+            self.flight.record("reconcile", adopted=adopted,
+                               requeued=requeued)
         return adopted, requeued
 
     # -------------------------------------------------------------- main loop

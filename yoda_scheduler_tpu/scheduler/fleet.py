@@ -60,7 +60,7 @@ from .registry import build_profile
 # the ONE lease-name prefix: fence tokens are matched by string between
 # the engine side (here) and the authority (fake_apiserver / the Lease
 # API via ShardLeaseManager) — a drifted copy would 409 every fenced bind
-from ..k8s.leaderelect import SHARD_LEASE_PREFIX
+from ..k8s.leaderelect import REPLICA_HB_PREFIX, SHARD_LEASE_PREFIX
 from ..utils.labels import GANG_NAME_LABEL
 from ..utils.pod import Pod
 
@@ -136,6 +136,29 @@ class LocalLeaseStore:
                 rec[1] += 1
                 rec[2] = float("-inf")
 
+    def release(self, name: str, identity: str, epoch: int) -> bool:
+        """Voluntary handoff (dynamic shard rebalancing): the holder
+        gives the lease up — holder cleared, epoch bumped (the releaser's
+        in-flight fencing tokens die with it), immediately acquirable by
+        the next claimant. False when the lease was already someone
+        else's (a takeover raced the release; nothing of ours remains)."""
+        with self._lock:
+            rec = self._leases.get(name)
+            if rec is None or rec[0] != identity or rec[1] != epoch:
+                return False
+            rec[0] = None
+            rec[1] += 1
+            rec[2] = float("-inf")
+            return True
+
+    def live(self, name: str) -> bool:
+        """Held by SOMEONE and unexpired — the replica-heartbeat liveness
+        read the rebalancer keys handoffs on."""
+        with self._lock:
+            rec = self._leases.get(name)
+            return (rec is not None and rec[0] is not None
+                    and self.clock.time() - rec[2] <= rec[3])
+
     def steal(self, name: str, identity: str,
               duration_s: float = 30.0) -> int:
         """Chaos: reassign the lease to `identity` regardless of expiry —
@@ -189,7 +212,8 @@ class ShardScore(ScorePlugin):
 
 class _Replica:
     __slots__ = ("idx", "engine", "identity", "owned", "next_renew",
-                 "thread", "incarnation", "manager", "inbox")
+                 "thread", "incarnation", "manager", "inbox",
+                 "clock_skew", "next_rebalance", "absent_since")
 
     def __init__(self, idx: int, engine: Scheduler, identity: str) -> None:
         self.idx = idx
@@ -207,6 +231,14 @@ class _Replica:
         # GIL-atomic deque and the replica's own loop applies them —
         # the same marshalling pattern as the engine's _bind_results
         self.inbox: deque = deque()
+        # chaos hook (CLOCK_SKEW): offset added to THIS replica's view of
+        # the clock for lease upkeep — a slow clock silently misses
+        # renewals while the replica keeps binding on stale epochs, the
+        # split-brain-by-drift scenario the fencing checks exist for
+        self.clock_skew = 0.0
+        self.next_rebalance = 0.0
+        # shard -> first instant its lease read ABSENT (orphan guard)
+        self.absent_since: dict[int, float] = {}
 
 
 class FleetCoordinator:
@@ -223,7 +255,9 @@ class FleetCoordinator:
                  renew_period_s: float = 0.5,
                  shard_weight: int = 8,
                  validate_fence_locally: bool = True,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 rebalance_s: float | None = None,
+                 cluster_wrapper=None) -> None:
         self.cluster = cluster
         self.config = config or SchedulerConfig()
         self.clock = clock or Clock()
@@ -247,6 +281,17 @@ class FleetCoordinator:
         self.validate_fence_locally = validate_fence_locally
         self.seed = seed
         self._enabled = enabled
+        # dynamic shard rebalancing cadence (config shardRebalanceSeconds,
+        # 0 disables): replicas heartbeat `yoda-replica-<idx>` and a
+        # takeover holder hands a foreign shard back once its preferred
+        # owner's heartbeat is live again — dead-replica shards are
+        # RE-LEASED instead of staying sticky with whoever took them over
+        self.rebalance_s = (self.config.shard_rebalance_s
+                            if rebalance_s is None else rebalance_s)
+        # chaos/test hook: per-replica cluster facade factory
+        # (wrapper(cluster, idx) -> backend) — NETWORK_PARTITION freezes
+        # one replica's watch view while its binds still flow
+        self._wrapper = cluster_wrapper
         # lease plumbing depends on where the authority lives:
         # - in-memory backends (FakeCluster family expose lease_authority)
         #   share one LocalLeaseStore, wired in as the bind-time fence
@@ -296,7 +341,9 @@ class FleetCoordinator:
         if self.sharded:
             profile.score.append(ShardScore(
                 self.shard_count, rep.owned, weight=self.shard_weight))
-        engine = Scheduler(self.cluster, cfg, profile=profile,
+        backend = (self.cluster if self._wrapper is None
+                   else self._wrapper(self.cluster, idx))
+        engine = Scheduler(backend, cfg, profile=profile,
                            clock=self.clock)
         # replica-distinct pid: a merged /traces/export shows each
         # replica as its own process row in the Perfetto UI
@@ -312,7 +359,9 @@ class FleetCoordinator:
                     preferred={s for s in range(self.shard_count)
                                if s % self.n == idx},
                     lease_duration_s=self.lease_duration_s,
-                    clock=self.clock)
+                    clock=self.clock,
+                    replica_count=self.n, replica_idx=idx,
+                    rebalance=self.rebalance_s > 0)
             engine.fence_provider = self._make_fence_provider(rep)
         rep.engine = engine
         return rep
@@ -343,6 +392,9 @@ class FleetCoordinator:
     def _lease_name(self, shard: int) -> str:
         return f"{SHARD_LEASE_PREFIX}{shard}"
 
+    def _hb_name(self, idx: int) -> str:
+        return f"{REPLICA_HB_PREFIX}{idx}"
+
     def _lease_step(self, rep: _Replica, now: float) -> None:
         """One upkeep pass for one replica: renew owned shards (dropping
         the lost), acquire preferred shards, take over expired ones."""
@@ -358,24 +410,81 @@ class FleetCoordinator:
             rep.next_renew = now + self.renew_period_s
             return
         changed = False
+        if self.rebalance_s > 0:
+            # liveness heartbeat: `yoda-replica-<idx>` says "someone is
+            # serving this slot" — the read every OTHER replica's
+            # rebalance handoff keys on. Same duration as shard leases,
+            # so liveness and ownership expire on the same horizon.
+            self.lease_store.try_acquire(self._hb_name(rep.idx),
+                                         rep.identity,
+                                         self.lease_duration_s)
         for s in list(rep.owned):
             if not self.lease_store.renew(self._lease_name(s),
                                           rep.identity, rep.owned[s]):
                 rep.owned.pop(s, None)
                 changed = True
+        if self.rebalance_s > 0 and now >= rep.next_rebalance:
+            rep.next_rebalance = now + self.rebalance_s
+            for s in list(rep.owned):
+                pref = s % self.n
+                if pref == rep.idx:
+                    continue
+                if self.lease_store.live(self._hb_name(pref)):
+                    # the preferred owner is provably alive again: hand
+                    # its shard back (release retires our epoch, so any
+                    # in-flight fenced commit of ours dies cleanly at
+                    # the authority) instead of staying sticky forever
+                    if self.lease_store.release(self._lease_name(s),
+                                                rep.identity,
+                                                rep.owned[s]):
+                        rep.owned.pop(s, None)
+                        changed = True
+                        rep.engine.metrics.inc(
+                            "shard_rebalance_releases_total")
+                        rep.engine.flight.record(
+                            "shard_rebalance", shard=s,
+                            released_to=pref, by=rep.identity)
         for s in range(self.shard_count):
             if s in rep.owned:
                 continue
             preferred = (s % self.n == rep.idx)
             if not preferred:
+                if self.rebalance_s > 0 \
+                        and self.lease_store.live(
+                            self._hb_name(s % self.n)):
+                    # the preferrer is alive: the shard is THEIRS to
+                    # (re)take — grabbing it here would instantly undo a
+                    # rebalance release (ours or anyone's)
+                    rep.absent_since.pop(s, None)
+                    continue
                 held = self.lease_store.holder(self._lease_name(s))
                 if held is None:
-                    continue  # absent: leave it to its preferrer
+                    # absent: leave it to its preferrer — unless the
+                    # preferrer provably died before ever creating it
+                    # (orphan guard: nobody may own a shard forever-
+                    # nobody, or its pods route to a replica that never
+                    # fences them)
+                    first = rep.absent_since.setdefault(s, now)
+                    if self.rebalance_s <= 0 \
+                            or now - first <= self.lease_duration_s:
+                        continue
+                else:
+                    rep.absent_since.pop(s, None)
             epoch = self.lease_store.try_acquire(
                 self._lease_name(s), rep.identity, self.lease_duration_s)
             if epoch is not None:
+                rep.absent_since.pop(s, None)
+                was_foreign = epoch > 1
                 rep.owned[s] = epoch
                 changed = True
+                if was_foreign:
+                    # epoch 1 = first-ever creation; anything later means
+                    # a previous holder's epoch was retired — a takeover
+                    # (crash recovery) or a rebalance handoff landing
+                    rep.engine.metrics.inc("shard_takeovers_total")
+                    rep.engine.flight.record(
+                        "shard_takeover", shard=s, epoch=epoch,
+                        by=rep.identity, preferred=preferred)
         if changed:
             # shard ownership is a score input outside every version
             # vector: the score-class memo must not replay stale
@@ -444,6 +553,39 @@ class FleetCoordinator:
             else:
                 r.engine.forget(pod_key)
 
+    def reconcile(self, pods) -> tuple[int, int]:
+        """Fleet-wide restart reconciliation (the serve loop's startup
+        pass, fed by the paginated iter_pods read): bound pods are
+        adopted from cluster truth, stranded pods are scrubbed and routed
+        through the ordinary shard-aware submit. Works on a one-shot
+        generator — one pass, per-pod routing."""
+        from ..utils.pod import ASSIGNED_CHIPS_LABEL, PodPhase
+
+        adopted = requeued = 0
+        bn = getattr(self.cluster, "bound_node_of", None)
+        m = self.replicas[0].engine.metrics
+        for pod in pods:
+            if pod.scheduler_name != self.config.scheduler_name \
+                    or self.tracks(pod.key):
+                continue
+            node = bn(pod.key) if bn is not None else None
+            if node is not None:
+                pod.node = node
+                pod.phase = PodPhase.BOUND
+                adopted += 1
+                m.inc("reconcile_adopted_total")
+                continue
+            pod.node = None
+            pod.phase = PodPhase.PENDING
+            pod.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+            if self.submit(pod):
+                requeued += 1
+                m.inc("reconcile_requeued_total")
+        if adopted or requeued:
+            self.replicas[0].engine.flight.record(
+                "reconcile", adopted=adopted, requeued=requeued)
+        return adopted, requeued
+
     # -------------------------------------------------------------- driving
     def step(self, rng: random.Random | None = None) -> str | None:
         """Deterministic single-step: lease upkeep for every due replica,
@@ -454,8 +596,15 @@ class FleetCoordinator:
         now = self.clock.time()
         if self.sharded:
             for rep in self.replicas:
-                if now >= rep.next_renew:
-                    self._lease_step(rep, now)
+                # lease upkeep runs on the REPLICA's view of the clock
+                # (chaos CLOCK_SKEW): a drifted-slow replica silently
+                # skips renewals — its leases expire under it while it
+                # keeps committing on stale epochs, and only the
+                # authority's fence check stands between that and a
+                # silent write
+                rep_now = now + rep.clock_skew
+                if rep_now >= rep.next_renew:
+                    self._lease_step(rep, rep_now)
         order = list(self.replicas)
         if rng is not None:
             rng.shuffle(order)
@@ -521,7 +670,7 @@ class FleetCoordinator:
         while not stop.is_set():
             if rep.inbox:
                 self._drain_inbox(rep)
-            now = self.clock.time()
+            now = self.clock.time() + rep.clock_skew
             if self.sharded and now >= rep.next_renew:
                 self._lease_step(rep, now)
             try:
@@ -569,6 +718,13 @@ class FleetCoordinator:
             rep.engine.reconcile(
                 [p for p in pods if not self.tracks(p.key)])
         return rep
+
+    def skew_replica_clock(self, idx: int, skew_s: float) -> None:
+        """Chaos (CLOCK_SKEW): drift one replica's lease clock by
+        `skew_s` (negative = running slow). A drift past the lease
+        duration makes the replica miss its renewals without noticing —
+        the split-brain-by-drift scenario. 0 heals the drift."""
+        self.replicas[idx].clock_skew = skew_s
 
     def revoke_replica_leases(self, idx: int) -> int:
         """Chaos: force-expire every lease the replica currently owns

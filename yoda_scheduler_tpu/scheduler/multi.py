@@ -107,6 +107,25 @@ class MultiProfileScheduler:
         for e in self.engines.values():
             e.forget(pod_key)
 
+    def reconcile(self, pods) -> tuple[int, int]:
+        """Restart reconciliation across profiles: each pod is judged by
+        the ONE engine whose schedulerName claims it (Scheduler.reconcile
+        semantics — adopt bound, scrub+requeue stranded). `pods` may be a
+        one-shot generator (the paginated iter_pods read): it is bucketed
+        per engine in one pass, then each engine reconciles ONCE — a
+        per-pod engine.reconcile call would emit one flight-recorder
+        event per pod and churn the bounded ring at restart scale."""
+        buckets: dict[str, list] = {}
+        for pod in pods:
+            if pod.scheduler_name in self.engines:
+                buckets.setdefault(pod.scheduler_name, []).append(pod)
+        adopted = requeued = 0
+        for name, batch in buckets.items():
+            a, r = self.engines[name].reconcile(batch)
+            adopted += a
+            requeued += r
+        return adopted, requeued
+
     # ------------------------------------------------------------------- drive
     def run_until_idle(self, max_cycles: int = 10_000) -> int:
         """Drain all engines round-robin, one scheduling cycle per turn

@@ -411,9 +411,16 @@ def export_chrome_trace(rings, path: str | None = None) -> dict:
 
 
 # --------------------------------------------------------- flight recorder
-# event kinds that auto-trigger a disk dump when a dump dir is configured
+# event kinds that auto-trigger a disk dump when a dump dir is configured.
+# webhook_deny / webhook_fail_open (the bind-authority webhook catching a
+# would-be double-booking / flipping its degradation posture) and
+# shard_takeover (a replica claiming a dead peer's shard) are trip kinds
+# too: each marks the system actively absorbing a fault, exactly the
+# moment the black box should land on disk. Dumps stay rate-limited
+# (min_dump_interval_s), so a deny storm costs one file per window.
 TRIP_KINDS = frozenset({"breaker_open", "invariant_violation",
-                        "quarantine"})
+                        "quarantine", "webhook_deny", "webhook_fail_open",
+                        "shard_takeover"})
 
 
 class FlightRecorder:
