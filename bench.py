@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import time
 
 from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
@@ -477,6 +478,184 @@ def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
         export_chrome_trace([sched.spans], trace_out)
         out["trace_out"] = trace_out
     return out
+
+
+# ---------------------------------------------------------------- fairness
+def build_fairness_nodes(units: int) -> TelemetryStore:
+    """Mixed-generation fleet for the policy-engine tier: per unit,
+    8 v4 hosts (4 x 32GB chips — the only homes for the mem-heavy
+    class) and 4 v5e hosts (8 x 16GB chips)."""
+    store = TelemetryStore()
+    now = time.time()
+    for i in range(8 * units):
+        m = make_tpu_node(f"v4-{i}", chips=4, generation="v4")
+        m.heartbeat = now
+        store.put(m)
+    for i in range(4 * units):
+        m = make_tpu_node(f"v5e-{i}", chips=8, generation="v5e")
+        m.heartbeat = now
+        store.put(m)
+    return store
+
+
+class _JctCluster(FakeCluster):
+    """FakeCluster recording each pod's bind instant on the ENGINE's
+    clock (virtual backoff included), so the fairness tier can report
+    per-tenant time-to-bind — the placement-time JCT proxy."""
+
+    def __init__(self, telemetry) -> None:
+        super().__init__(telemetry)
+        self.clock = None  # set after the scheduler exists
+        self.bound_at: dict[str, float] = {}
+
+    def bind(self, pod, node, assigned_chips=None, fence=None):
+        super().bind(pod, node, assigned_chips, fence=fence)
+        if self.clock is not None:
+            self.bound_at[pod.key] = self.clock.time()
+
+
+_FAIRNESS_CLASSES = {
+    # light jobs run ~2x faster on v5e; mem-heavy only FITS v4 (its
+    # 20000MB floor exceeds a v5e chip's 16GB HBM), so every light pod
+    # a chip-agnostic ranking parks on v4 strands a mem-heavy pod
+    "light": {"v5e": 2.0, "v4": 0.9},
+    "mem-heavy": {"v4": 1.0},
+}
+
+
+def _fairness_pods(units: int, tenants: dict[str, float] | None,
+                   seed: int = 0, oversub: float = 1.0
+                   ) -> list[tuple[Pod, str, str]]:
+    """(pod, tenant, class) triples: per unit, 32 mem-heavy singles
+    (exactly the v4 capacity) + 32 light singles (exactly the v5e
+    capacity) — at oversub=1 total demand == total capacity, so every
+    light pod misplaced onto v4 is a mem-heavy failure the bound count
+    records; the DRF leg oversubmits (>1) so every tenant presses past
+    its quota and shares must CONVERGE there. `tenants` assigns pods
+    randomly weighted by quota; None = one anonymous tenant (the
+    heterogeneity A/B legs)."""
+    names = (sorted(tenants) if tenants
+             else ["default"])
+    weights = ([tenants[t] for t in names] if tenants else [1.0])
+    out: list[tuple[Pod, str, str]] = []
+    rng = random.Random(seed)
+    i = 0
+    for kind, count, labels in (
+            ("mem-heavy", int(32 * units * oversub),
+             {"scv/number": "1", "tpu/accelerator": "tpu",
+              "scv/memory": "20000", "scv/class": "mem-heavy"}),
+            ("light", int(32 * units * oversub),
+             {"scv/number": "1", "tpu/accelerator": "tpu",
+              "scv/memory": "1000", "scv/class": "light"})):
+        for _ in range(count):
+            t = rng.choices(names, weights=weights)[0]
+            lab = dict(labels)
+            if tenants:
+                lab["scv/tenant"] = t
+            out.append((Pod(f"f{i}", labels=lab), t, kind))
+            i += 1
+    rng.shuffle(out)
+    return out
+
+
+def run_fairness(units: int = 2, hetero: bool = True, drf: bool = False,
+                 quotas: tuple = (), seed: int = 0) -> dict:
+    """One fairness-tier leg: mixed-generation fleet + mixed-tenant
+    trace, reporting per-tenant bound counts, time-to-bind JCT p50, and
+    end-state dominant shares. `hetero` toggles the policy objective
+    (the chip-agnostic A/B); `drf` adds the fairness sort + quota gate
+    over `quotas` ((tenant, share-cap, preemption-budget), ...)."""
+    store = build_fairness_nodes(units)
+    cluster = _JctCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg = SchedulerConfig(
+        max_attempts=8, telemetry_max_age_s=1e9,
+        pod_hinted_backoff_s=30.0,
+        policy_objective="makespan" if hetero else "",
+        workload_classes=tuple(
+            (c, tuple(sorted(g.items())))
+            for c, g in sorted(_FAIRNESS_CLASSES.items())),
+        drf_fairness=drf,
+        tenant_quotas=quotas,
+        starvation_after_s=3600.0,
+        rng_seed=seed)
+    sched = Scheduler(cluster, cfg, clock=HybridClock())
+    cluster.clock = sched.clock
+    tenants = {t: q for t, q, _ in quotas} if drf and quotas else None
+    triples = _fairness_pods(
+        units, {t: max(q, 0.01) for t, q in tenants.items()} if tenants
+        else None, seed=seed, oversub=1.6 if drf else 1.0)
+    t_submit: dict[str, float] = {}
+    t0 = time.perf_counter()
+    for pod, _, _ in triples:
+        t_submit[pod.key] = sched.clock.time()
+        sched.submit(pod)
+    sched.run_until_idle(max_cycles=40 * len(triples))
+    wall = time.perf_counter() - t0
+    per_tenant: dict[str, dict] = {}
+    per_class: dict[str, dict] = {}
+    for pod, tenant, kind in triples:
+        for key, book in ((tenant, per_tenant), (kind, per_class)):
+            blk = book.setdefault(key, {"submitted": 0, "bound": 0,
+                                        "failed": 0, "jcts": []})
+            blk["submitted"] += 1
+            if pod.phase == PodPhase.BOUND:
+                blk["bound"] += 1
+                done = cluster.bound_at.get(pod.key)
+                if done is not None:
+                    blk["jcts"].append((done - t_submit[pod.key]) * 1e3)
+            elif pod.phase == PodPhase.FAILED:
+                blk["failed"] += 1
+    for book in (per_tenant, per_class):
+        for blk in book.values():
+            js = sorted(blk.pop("jcts"))
+            blk["jct_p50_ms"] = (round(js[len(js) // 2], 2) if js else None)
+    shares = {}
+    if sched.policy is not None and sched.policy.book is not None:
+        sched.policy.book.refresh()
+        shares = {t: round(sched.policy.book.dominant_share(t), 4)
+                  for t in sorted(sched.policy.book.tenants())}
+    m = sched.metrics
+    out = {
+        "nodes": len(cluster.node_names()),
+        "pods": len(triples),
+        "bound": m.counters.get("pods_scheduled_total", 0),
+        "wall_s": round(wall, 2),
+        "hetero": hetero,
+        "drf": drf,
+        "per_class": per_class,
+        "per_tenant": per_tenant,
+        "dominant_shares_end": shares,
+        "quotas": {t: q for t, q, _ in quotas},
+        "quota_rejections": {
+            dict(k).get("tenant"): v
+            for k, v in m.labeled_counters.get(
+                "tenant_quota_rejections_total", {}).items()},
+        "starvation_trips": sum(m.labeled_counters.get(
+            "tenant_starvation_trips_total", {}).values()),
+        "preemptions_budget_denied": sum(m.labeled_counters.get(
+            "preemptions_budget_denied_total", {}).values()),
+    }
+    return out
+
+
+def run_fairness_tier(units: int = 2) -> dict:
+    """The committed fairness artifact: the heterogeneity A/B (identical
+    trace, objective on vs chip-agnostic) and the multi-tenant DRF leg
+    (quota'd tenants over-submitting; shares must converge to quota,
+    nobody starves). CI fences read exactly these numbers."""
+    hetero_on = run_fairness(units, hetero=True, drf=False)
+    hetero_off = run_fairness(units, hetero=False, drf=False)
+    drf = run_fairness(
+        units, hetero=True, drf=True,
+        quotas=(("acme", 0.40, 2), ("beta", 0.30, 2),
+                ("gamma", 0.20, 1), ("delta", 0.10, 1)))
+    return {
+        "hetero_on": hetero_on,
+        "hetero_off": hetero_off,
+        "hetero_bound_gain": hetero_on["bound"] - hetero_off["bound"],
+        "drf": drf,
+    }
 
 
 def per_pod_ratio(small: dict, big: dict) -> float:
@@ -952,6 +1131,14 @@ def main():
             "compute_per_pod_ratio": round(per_pod, 2),
             "sublinear": per_pod < node_ratio,
         }
+    # policy-engine fairness tier (mixed-generation heterogeneity A/B +
+    # multi-tenant DRF drain); opt out with YODA_BENCH_NO_FAIRNESS=1
+    fairness = {}
+    if not os.environ.get("YODA_BENCH_NO_FAIRNESS"):
+        try:
+            fairness = run_fairness_tier()
+        except Exception as e:  # the fairness bench must never sink the run
+            fairness = {"error": repr(e)}
     if args.trace_out:
         # dedicated fully-sampled leg: every pod span-traced, exported as
         # one Chrome/Perfetto document — the visual answer to "where does
@@ -970,6 +1157,7 @@ def main():
         "scale": scale,
         "serve_scale": serve_scale,
         "serve_fleet": serve_fleet,
+        "fairness": fairness,
     }
     # only a FULL, error-free run may overwrite the committed artifact: a
     # smoke run (YODA_BENCH_NO_SCALE/NO_SERVE, e.g. ci.yaml's
@@ -977,7 +1165,8 @@ def main():
     # otherwise silently replace it with a partial record (the error
     # still surfaces in the stdout headline's serve summary)
     if (scale and serve_scale and "error" not in serve_scale
-            and serve_fleet and "error" not in serve_fleet):
+            and serve_fleet and "error" not in serve_fleet
+            and fairness and "error" not in fairness):
         full_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
         try:
@@ -1029,6 +1218,22 @@ def main():
                 "batched_binds_total", "error")
         return {k: s[k] for k in keys if k in s}
 
+    def fairness_summary(s):
+        if not s or "drf" not in s:
+            return s or {}
+        drf = s["drf"]
+        return {
+            "hetero_bound_gain": s.get("hetero_bound_gain"),
+            "hetero_on_bound": s["hetero_on"]["bound"],
+            "hetero_off_bound": s["hetero_off"]["bound"],
+            "drf_shares_end": drf.get("dominant_shares_end"),
+            "drf_quotas": drf.get("quotas"),
+            "drf_starvation_trips": drf.get("starvation_trips"),
+            "drf_jct_p50_by_tenant": {
+                t: b.get("jct_p50_ms")
+                for t, b in drf.get("per_tenant", {}).items()},
+        }
+
     def fleet_summary(s):
         if not s or "legs" not in s:
             return s or {}
@@ -1062,6 +1267,7 @@ def main():
         "scale": scale_summary(scale),
         "serve": serve_summary(serve_scale),
         "serve_fleet": fleet_summary(serve_fleet),
+        "fairness": fairness_summary(fairness),
         "full_detail": "BENCH_FULL.json",
     }))
 

@@ -482,3 +482,175 @@ def test_random_burst_invariants_concurrent_preemption(seed):
 
     _run_concurrent(rng, store, sched, pods, publish)
     _check_invariants(pods, store, seed)
+
+
+# ---------------------------------------------------------------- fairness
+# Policy-engine fairness invariants (ISSUE 9), fuzzed chaos-style: each
+# seed builds a random mixed-generation fleet, random tenant quotas +
+# preemption budgets, and a random mixed-tenant burst, then asserts the
+# three fairness invariants on the drained state:
+#
+#   F1 bounded wait / no starvation: every pod RESOLVES (bound or
+#      failed at max_attempts — nothing pending forever), and a tenant
+#      whose demand fits inside its quota binds ALL of it;
+#   F2 DRF convergence: no tenant's dominant share exceeds its quota
+#      (+ the one-pod granularity the gate admits at the boundary);
+#   F3 preemption budgets never exceeded: evictions charged per tenant
+#      stay within the configured lifetime budget.
+#
+# The first 8 seeds ride tier-1; the rest of the 64-seed matrix runs in
+# the CI fairness job (-m slow exclusion keeps tier-1's budget).
+
+def _fairness_fleet(rng: random.Random) -> TelemetryStore:
+    store = TelemetryStore()
+    now = time.time()
+    for i in range(rng.randint(4, 8)):
+        m = make_tpu_node(f"v4-{i}", chips=4, generation="v4")
+        m.heartbeat = now
+        store.put(m)
+    for i in range(rng.randint(2, 5)):
+        m = make_tpu_node(f"v5e-{i}", chips=8,
+                          generation=rng.choice(("v5e", "v5p")))
+        m.heartbeat = now
+        store.put(m)
+    return store
+
+
+@pytest.mark.parametrize(
+    "seed",
+    [pytest.param(s, marks=() if s < 8 else (pytest.mark.slow,))
+     for s in range(64)])
+def test_fairness_drain_invariants(seed):
+    from yoda_scheduler_tpu.utils.labels import tenant_of
+
+    rng = random.Random(200_000 + seed)
+    store = _fairness_fleet(rng)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cap_chips = sum(len(m.chips) for m in store.list())
+    # every 4th seed runs the PREEMPTION regime: the uncapped scavenger
+    # tenant pre-fills the cluster at low priority, so the tenants' wave
+    # can only get in by evicting — exercising the budget gate for real
+    # (a 64-seed instrumented sweep of this matrix evicted 21 victims
+    # and quota-rejected 1526 cycles; the planner's budget route-around
+    # leaves the whole-plan denial gate as a rare multi-victim backstop
+    # — 1 denial across the sweep)
+    preempt_regime = seed % 4 == 3
+    # random quota split: 3 capped tenants + one uncapped scavenger
+    # (whose preemption budget is what the preempt regime fuzzes)
+    q = sorted(rng.uniform(0.08, 0.35) for _ in range(3))
+    quotas = (("acme", q[2], rng.choice((0, 1, 2, -1))),
+              ("beta", q[1], rng.choice((0, 1, -1))),
+              ("gamma/ml", q[0], rng.choice((0, 2))),
+              ("scav", 0.0, rng.choice((0, 1, 2, 3))))
+    sched = Scheduler(cluster, SchedulerConfig(
+        max_attempts=4, telemetry_max_age_s=3600.0, degraded_mode=False,
+        policy_objective=rng.choice(("makespan", "avg-jct",
+                                     "finish-time-fairness")),
+        drf_fairness=True, tenant_quotas=quotas,
+        preemption_budget_window_s=0.0,  # lifetime budgets: F3 is exact
+        starvation_after_s=3600.0,
+        workload_classes=(("light", (("v4", 0.9), ("v5e", 2.0))),),
+        rng_seed=seed), clock=HybridClock())
+    # per-tenant demand: "fits" tenants stay under quota capacity
+    # (F1 asserts they bind everything), others oversubscribe ~1.5x
+    pods = []
+    fits: dict[str, bool] = {}
+    demand: dict[str, int] = {}
+    for tenant, quota, _ in quotas:
+        fit = rng.random() < 0.5
+        fits[tenant] = fit
+        chips_budget = int(quota * cap_chips)
+        target = (max(chips_budget - 2, 1) if fit
+                  else int(chips_budget * 1.5) + 2)
+        got = 0
+        while got < target:
+            # fit tenants submit singles only: the bind-all assertion
+            # is about FAIRNESS, and a 2-chip pod stranded by free-chip
+            # fragmentation would fail it for a non-fairness reason
+            # (that gap is ROADMAP item 4's defragmenter)
+            chips = 1 if fit else rng.choice((1, 1, 2))
+            if fit and got + chips > chips_budget - 1:
+                break
+            labels = {"scv/number": str(chips), "tpu/accelerator": "tpu",
+                      "scv/tenant": tenant}
+            if rng.random() < 0.4:
+                labels["scv/class"] = "light"
+            if rng.random() < 0.3:
+                labels["scv/memory"] = str(rng.choice((1000, 4000)))
+            if preempt_regime:
+                # the tenants' wave arrives at HIGH priority against a
+                # full cluster: only preemption (budget willing) fits it
+                labels["scv/priority"] = str(rng.randint(5, 9))
+            elif rng.random() < 0.3:
+                labels["scv/priority"] = str(rng.randint(1, 9))
+            pods.append(Pod(f"{tenant.replace('/', '-')}-{len(pods)}",
+                            labels=labels))
+            got += chips
+        demand[tenant] = got
+    # the uncapped scavenger: a light leftover-soak in the quota
+    # regimes, a cluster-filling low-priority flood in the preempt one
+    n_scav = cap_chips if preempt_regime else rng.randint(4, 12)
+    scavs = [Pod(f"scav-{i}", labels={
+        "scv/number": "1", "tpu/accelerator": "tpu",
+        "scv/tenant": "scav",
+        "scv/priority": str(rng.randint(0, 3))})
+        for i in range(n_scav)]
+    if preempt_regime:
+        for p in scavs:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=30_000)
+    else:
+        pods.extend(scavs)
+    rng.shuffle(pods)
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=30_000)
+    if preempt_regime:
+        pods.extend(scavs)  # invariants cover the flood too
+
+    # F1: everything resolves; in-quota tenants bind ALL their demand
+    unresolved = [p.name for p in pods
+                  if p.phase not in (PodPhase.BOUND, PodPhase.FAILED)]
+    assert not unresolved, f"seed {seed}: unresolved {unresolved}"
+    book = sched.policy.book
+    book.refresh()
+    # bind-all is a FAIRNESS guarantee, so it only binds when capacity
+    # could have served everyone: when total demand exceeds the
+    # cluster, somebody legitimately loses on capacity, quota headroom
+    # or not (and the preempt regime pre-fills the cluster by design)
+    total_demand = sum(demand.values()) + n_scav
+    capacity_open = (not preempt_regime
+                     and total_demand <= cap_chips - 2)
+    for tenant, quota, _ in quotas:
+        if quota <= 0.0 or tenant not in fits:
+            continue
+        mine = [p for p in pods if tenant_of(p) == tenant]
+        if fits[tenant] and capacity_open:
+            unbound = [p.name for p in mine if p.phase != PodPhase.BOUND]
+            assert not unbound, (
+                f"seed {seed}: tenant {tenant} starved inside its quota "
+                f"(demand {demand[tenant]} of {int(quota * cap_chips)} "
+                f"chips): {unbound}")
+    # F2: shares never exceed quota (+ the gate's one-pod granularity is
+    # ON the admit side, so the bound share itself must sit at/below cap)
+    for tenant, quota, _ in quotas:
+        if quota <= 0.0:
+            continue  # uncapped tenant: no share ceiling to assert
+        share = book.dominant_share(tenant)
+        assert share <= quota + 1e-9, (
+            f"seed {seed}: tenant {tenant} share {share:.4f} exceeds "
+            f"quota {quota:.4f}")
+    # F3: preemption budgets never exceeded (lifetime window)
+    for tenant, _, budget in quotas:
+        if budget < 0:
+            continue
+        evicted = sched.metrics.labeled_counter(
+            "preemption_victims_total", {"tenant": tenant})
+        assert evicted <= budget, (
+            f"seed {seed}: tenant {tenant} lost {evicted} pods to "
+            f"preemption, budget {budget}")
+        assert sched.policy.budgets.spent(
+            tenant, sched.clock.time()) <= max(budget, 0)
+    # the chip-level invariants hold under the policy plugins too
+    _check_invariants([p for p in pods], store, seed)

@@ -83,6 +83,13 @@ class ColumnarTable:
         """Id of an already-seen string; -1 (matches no row) otherwise."""
         return self._intern.get(s, -1)
 
+    def intern_table(self) -> dict[str, int]:
+        """The live string -> id intern map (READ-ONLY; accel and
+        generation strings share one id space). Batch scorers that map
+        interned ids to per-value weights (HeterogeneityScore) build
+        their lookup vectors from it — ids are dense [0, len)."""
+        return self._intern
+
     def _label_id(self, labels: dict) -> int:
         key = tuple(sorted(labels.items()))
         hit = self._label_key.get(key)

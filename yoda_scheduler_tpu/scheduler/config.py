@@ -83,6 +83,63 @@ def _fleet_default() -> int:
         return 1
 
 
+def _policy_objective_default() -> str:
+    """Default objective for the policy engine's heterogeneity scorer
+    (scheduler/policy/). Unset = the policy engine stays OUT of the
+    profile and placements are bit-identical to the pre-policy default
+    (the CI parity leg pins this). YODA_POLICY_OBJECTIVE overrides."""
+    return _valid_policy_objective(
+        os.environ.get("YODA_POLICY_OBJECTIVE", ""))
+
+
+def _valid_policy_objective(objective: str) -> str:
+    """Reject unknown policyObjective values at config-load time — a
+    typo silently disabling the whole policy engine would corrupt
+    exactly the placement comparison the operator asked for (same
+    posture as _valid_fleet_mode)."""
+    if objective not in ("", "makespan", "avg-jct", "finish-time-fairness"):
+        raise ValueError(
+            "policyObjective must be '', 'makespan', 'avg-jct' or "
+            f"'finish-time-fairness', got {objective!r}")
+    return objective
+
+
+def _drf_default() -> bool:
+    """DRF fairness layer (tenant-fairness queue ordering + quota gate
+    + preemption budgets): default OFF; YODA_DRF=1 enables."""
+    return os.environ.get("YODA_DRF", "0").lower() in ("1", "true", "on")
+
+
+def _freeze_tenants(tenants) -> tuple:
+    """Normalise a config `tenants:` mapping ({name: {quota: 0.5,
+    preemptionBudget: 3}}) into the frozen ((name, quota, budget), ...)
+    tuple the dataclass carries. Accepts the frozen form unchanged."""
+    if not tenants:
+        return ()
+    if isinstance(tenants, dict):
+        out = []
+        for name, body in sorted(tenants.items()):
+            body = body or {}
+            out.append((str(name), float(body.get("quota", 0.0)),
+                        int(body.get("preemptionBudget", -1))))
+        return tuple(out)
+    return tuple((str(n), float(q), int(b)) for n, q, b in tenants)
+
+
+def _freeze_classes(classes) -> tuple:
+    """Normalise a `workloadClasses:` mapping ({class: {v4: 1.0,
+    v5e: 1.9}}) into ((class, ((gen, ratio), ...)), ...)."""
+    if not classes:
+        return ()
+    if isinstance(classes, dict):
+        return tuple(
+            (str(c), tuple(sorted((str(g), float(r))
+                                  for g, r in (gens or {}).items())))
+            for c, gens in sorted(classes.items()))
+    return tuple((str(c), tuple((str(g), float(r)) for g, r in gens))
+                 for c, gens in classes)
+
+
 def _valid_fleet_mode(mode: str) -> str:
     """Reject unknown fleetMode values at config-load time: the sharded/
     free-for-all A/B is the whole point of the knob, and a typo
@@ -259,6 +316,35 @@ class SchedulerConfig:
     # this is exactly the double-booking window, see ARCHITECTURE.md).
     webhook_fail_open: bool = False
     webhook_stale_after_s: float = 30.0
+    # ---- policy engine (scheduler/policy/) ----
+    # heterogeneity-aware placement objective: "" (off, the default —
+    # profile and placements bit-identical to pre-policy), "makespan",
+    # "avg-jct", or "finish-time-fairness". Selecting one adds the
+    # HeterogeneityScore plugin: per-workload-class throughput ratios
+    # across accelerator generations (Gavel) weight the ranking.
+    policy_objective: str = field(default_factory=_policy_objective_default)
+    # HeterogeneityScore weight (absolute 0..100*k term, like topology)
+    heterogeneity_weight: int = 4
+    # per-class throughput overrides: ((class, ((gen, ratio), ...)), ...)
+    # — config `workloadClasses: {train: {v4: 1.0, v5e: 1.9}}`. Classes
+    # come from the scv/class pod label (spec-derived fallback); absent
+    # entries use the generation catalog's compute proxy.
+    workload_classes: tuple = ()
+    # multi-tenant DRF fairness layer: tenant-fairness queue ordering +
+    # quota admission gate + per-tenant preemption budgets. Tenancy =
+    # scv/tenant label, falling back to the pod namespace.
+    drf_fairness: bool = field(default_factory=_drf_default)
+    # hierarchical tenant quotas: ((tenant, dominant-share cap,
+    # preemption budget), ...) — config `tenants: {acme: {quota: 0.5,
+    # preemptionBudget: 3}, "acme/ml": {quota: 0.25}}`. quota 0 = no
+    # cap; budget -1 = unlimited, else max victims the tenant may LOSE
+    # to preemption per rolling window.
+    tenant_quotas: tuple = ()
+    preemption_budget_window_s: float = 60.0
+    # starvation watch: a pod still unbound after this many seconds
+    # trips the flight recorder (tenant_starvation) and the per-tenant
+    # counter. 0 disables.
+    starvation_after_s: float = 300.0
     # lifecycle span tracing (utils/obs.py SpanRing): record the full
     # queued/cycle/bind_wire/watch_confirm span tree for 1-in-N pods
     # (deterministic by pod key). 0 disables, 1 traces every pod; env
@@ -333,6 +419,21 @@ class SchedulerConfig:
             webhook_stale_after_s=float(args.get(
                 "webhookStaleAfterSeconds",
                 defaults.webhook_stale_after_s)),
+            policy_objective=_valid_policy_objective(str(args.get(
+                "policyObjective", defaults.policy_objective))),
+            heterogeneity_weight=int(args.get(
+                "heterogeneityWeight", defaults.heterogeneity_weight)),
+            workload_classes=_freeze_classes(args.get(
+                "workloadClasses", defaults.workload_classes)),
+            drf_fairness=bool(args.get(
+                "drfFairness", defaults.drf_fairness)),
+            tenant_quotas=_freeze_tenants(args.get(
+                "tenants", defaults.tenant_quotas)),
+            preemption_budget_window_s=float(args.get(
+                "preemptionBudgetWindowSeconds",
+                defaults.preemption_budget_window_s)),
+            starvation_after_s=float(args.get(
+                "starvationAfterSeconds", defaults.starvation_after_s)),
             trace_sampling=max(int(args.get(
                 "traceSampling", defaults.trace_sampling)), 0),
             flight_dump_dir=str(args.get(

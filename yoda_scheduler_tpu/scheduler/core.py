@@ -76,7 +76,7 @@ from .plugins import (
 from .plugins.prescore import MAX_KEY
 from .plugins.topology import SLICE_USE_KEY
 from ..utils.labels import (
-    GANG_NAME_LABEL, LabelError, spec_for, workload_class)
+    GANG_NAME_LABEL, LabelError, spec_for, tenant_of, workload_class)
 from ..utils.obs import (
     CycleTrace, FlightRecorder, Metrics, SpanRing, TraceLog, span_sampled)
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
@@ -151,6 +151,11 @@ class Profile:
         self.reserve = reserve or []
         self.permit = permit or []
         self.bind = bind
+        # policy engine (scheduler/policy/): set by default_profile /
+        # registry.build_profile when policyObjective / DRF knobs enable
+        # it; None = the pre-policy engine, placements bit-identical.
+        # The Scheduler attaches its cluster/metrics/flight at init.
+        self.policy = None
 
 
 def default_profile(config: SchedulerConfig,
@@ -169,11 +174,32 @@ def default_profile(config: SchedulerConfig,
                              allocator=allocator)
     topo = TopologyScore(allocator, weight=config.topology_weight)
     admission = NodeAdmission(allocator)
+    # policy engine (scheduler/policy/): built only when a knob asks for
+    # it — the unset default constructs the EXACT pre-policy plugin set,
+    # so placements stay bit-identical (pinned by tests/test_policy.py)
+    policy = None
+    policy_enabled = (config.policy_objective or config.drf_fairness
+                      or config.tenant_quotas)
+    if policy_enabled:
+        from .policy import (HeterogeneityScore, PolicyEngine,
+                             TenantFairnessSort, TenantQuotaGate)
+
+        policy = PolicyEngine(config)
+    hetero = ([HeterogeneityScore(policy.model, config.policy_objective,
+                                  weight=config.heterogeneity_weight,
+                                  policy=policy)]
+              if policy is not None and config.policy_objective
+              and config.heterogeneity_weight > 0 else [])
+    drf_on = policy is not None and (config.drf_fairness
+                                     or config.tenant_quotas)
     profile = Profile(
-        queue_sort=PrioritySort(),
-        # GangPermit.pre_filter computes multi-slice plans for gangs no
-        # single slice can host
-        pre_filter=[gang_permit],
+        queue_sort=(TenantFairnessSort(policy) if drf_on
+                    else PrioritySort()),
+        # quota gate first (one node-independent check per cycle, before
+        # gang planning pays anything); GangPermit.pre_filter computes
+        # multi-slice plans for gangs no single slice can host
+        pre_filter=([TenantQuotaGate(policy)] if drf_on else [])
+        + [gang_permit],
         # admission first: nodeSelector/taint rejections are cheap and spare
         # the telemetry filter's capacity math on excluded nodes
         filter=[admission,
@@ -187,11 +213,13 @@ def default_profile(config: SchedulerConfig,
             *([FragmentationScore(allocator,
                                   weight=config.fragmentation_weight)]
               if config.fragmentation_weight > 0 else []),
+            *hetero,
             admission,
         ],
         reserve=[allocator, gang_permit],
         permit=[gang_permit],
     )
+    profile.policy = policy
     return profile, allocator, gang_permit
 
 
@@ -374,6 +402,14 @@ class Scheduler:
         # kind fires and a dump dir is configured
         self.flight = FlightRecorder(
             clock=self.clock, dump_dir=self.config.flight_dump_dir or None)
+        # policy engine (scheduler/policy/): built plugin-side by
+        # default_profile / registry.build_profile; the engine hands it
+        # the live surfaces its DRF book and starvation watch read.
+        # None (the default) keeps every policy hook out of the cycle.
+        self.policy = getattr(profile, "policy", None)
+        if self.policy is not None:
+            self.policy.attach(self.cluster, self.metrics, self.flight,
+                               self.clock)
         self.rng = random.Random(self.config.rng_seed)
         self._filter_start = 0  # rotating offset for percentageOfNodesToScore
         # node -> ((telemetry generation, pods version), NodeInfo) — see
@@ -2397,6 +2433,14 @@ class Scheduler:
         (the unschedulable-class repair path: only dirty nodes can have
         become curable) — callers pass it only when every post-filter
         plugin advertises `supports_restricted`."""
+        if self.policy is not None and self.policy.quotas:
+            # per-tenant preemption budgets: hand the planner a victim
+            # predicate so budget-exhausted tenants' pods drop out of
+            # the candidate pools and plans route AROUND them (the
+            # whole-plan admits() below stays the exact backstop)
+            budgets = self.policy.budgets
+            state.write("victim_budget_ok",
+                        lambda v: budgets.has_budget(tenant_of(v), now))
         for p in self.profile.post_filter:
             if only_nodes is not None:
                 nominated, victims, st = p.post_filter(
@@ -2406,14 +2450,39 @@ class Scheduler:
                 nominated, victims, st = p.post_filter(
                     state, pod, snapshot, trace.filter_verdicts)
             if st.ok and nominated is not None:
+                # per-tenant preemption budgets (scheduler/policy/): a
+                # plan that would overdraw ANY victim tenant's rolling
+                # budget is refused whole — the preemptor stays
+                # unschedulable until budgets refill or capacity frees.
+                # Gated BEFORE any eviction, so a budget can never be
+                # half charged; the PDB ledger already ranked plans
+                # below the budget layer, so both protections hold.
+                if (self.policy is not None
+                        and not self.policy.budgets.admits(victims, now)):
+                    # admits() counted the denial per budget level
+                    # (preemptions_budget_denied_total{tenant})
+                    self.flight.record(
+                        "preemption_budget_denied", pod=pod.key,
+                        victims=len(victims))
+                    continue
                 # on a real API server evict() is a DELETE: the victim's
                 # controller recreates it as a new incarnation which the
                 # serve loop submits — requeueing the dead object locally
                 # would race it (same contract as Descheduler.run_once)
                 local = getattr(self.cluster, "supports_local_requeue", False)
+                if self.policy is not None:
+                    self.policy.budgets.charge(victims, now)
                 for victim in victims:
                     self.cluster.evict(victim)
                     self.metrics.inc("pods_evicted_total")
+                    if self.policy is not None:
+                        # per-tenant disruption attribution: who LOST a
+                        # pod to preemption. A DISTINCT family from the
+                        # flat plan counter below — mixing victim-count
+                        # labels into preemptions_total would make
+                        # sum() over that family read plans + victims
+                        self.metrics.inc("preemption_victims_total",
+                                         labels={"tenant": tenant_of(victim)})
                     if local:
                         router = self.victim_router or self.submit
                         if not router(victim):
@@ -2616,6 +2685,12 @@ class Scheduler:
             self.metrics.observe("e2e_commit_ms", commit_s * 1e3)
             self.metrics.observe("e2e_wire_ms", wire_s * 1e3)
         self.metrics.inc("pods_scheduled_total")
+        if self.policy is not None:
+            # fold the bind into the DRF book (one dirty node off the
+            # change log), retire any gang in-flight claim, and
+            # republish per-tenant shares/breaches
+            self.policy.on_bind(pod)
+            self.policy.resolved(pod.key)
         if not dispatched_async:
             # Scheduled is posted on WIRE success only (upstream posts it
             # after the binding subresource lands): sync binds and adopted
@@ -2923,7 +2998,12 @@ class Scheduler:
             # plugin is gating the pending backlog, by name
             self.metrics.inc("filter_rejections_total",
                              labels={"plugin": pname})
-        self.queue.requeue_backoff(info, now=self.clock.time(),
+        now = self.clock.time()
+        if self.policy is not None:
+            # starvation watch: a pod still unbound past the configured
+            # threshold trips the flight recorder + per-tenant counter
+            self.policy.note_wait(info.pod, now - info.enqueued)
+        self.queue.requeue_backoff(info, now=now,
                                    rejected_by=tuple(rejected_by))
         self.metrics.inc("pods_unschedulable_total")
         self._finish(trace, outcome, reason=reason)
@@ -3097,6 +3177,12 @@ class Scheduler:
         self.failed[info.pod.key] = reason
         if self.allocator is not None:
             self.allocator.unnominate(info.pod.key)
+        if self.policy is not None:
+            # drop the pod from the starvation-watch dedup set: a pod
+            # that tripped and then failed terminally must not pin the
+            # set toward its clear-all backstop (which would re-trip
+            # still-starving pods)
+            self.policy.resolved(info.pod.key)
         self.metrics.inc("pods_failed_total")
         if trace is None:
             trace = CycleTrace(pod=info.pod.key, started=self.clock.time())
@@ -3158,6 +3244,8 @@ class Scheduler:
                 self.doomed_gangs.pop(gang, None)
         if self.allocator is not None:
             self.allocator.unnominate(pod_key)
+        if self.policy is not None:
+            self.policy.resolved(pod_key)  # starvation-watch dedup set
         self.failed.pop(pod_key, None)
         self.quarantined.pop(pod_key, None)
 

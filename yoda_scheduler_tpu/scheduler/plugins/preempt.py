@@ -85,11 +85,18 @@ class PriorityPreemption(PostFilterPlugin):
         if spec.is_gang:
             return self._gang_post_filter(state, spec, my_prio, pod,
                                           snapshot, now, ledger)
+        # per-tenant preemption budgets (scheduler/policy/): a tenant
+        # with NO remaining budget contributes no victims, so the
+        # planner routes around it toward admissible plans instead of
+        # proposing one the engine's whole-plan budget gate must refuse
+        # (that gate stays the backstop for multi-victim overdraws)
+        victim_ok = state.read_or("victim_budget_ok")
         # minimal disruption: no-PDB-violation plans always win, then
         # fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
         def evictable_victim(p: Pod) -> bool:
-            return _priority(p) < my_prio and _evictable(p)
+            return (_priority(p) < my_prio and _evictable(p)
+                    and (victim_ok is None or victim_ok(p)))
 
         for node in snapshot.list():
             if only_nodes is not None and node.name not in only_nodes:
@@ -114,7 +121,8 @@ class PriorityPreemption(PostFilterPlugin):
             if obstacles is None:
                 continue
             victims = self._plan_node(spec, my_prio, node, pod_key=pod.key,
-                                      ledger=ledger, pod=pod, now=now)
+                                      ledger=ledger, pod=pod, now=now,
+                                      victim_ok=victim_ok)
             if victims is None:
                 continue  # capacity unreachable even with evictions
             seen_keys = {v.key for v in victims}
@@ -210,7 +218,9 @@ class PriorityPreemption(PostFilterPlugin):
                 if host.name in covered:
                     continue
                 victims = self._plan_node(spec, my_prio, host, pod_key=pod.key,
-                                          ledger=ledger, pod=pod, now=now)
+                                          ledger=ledger, pod=pod, now=now,
+                                          victim_ok=state.read_or(
+                                              "victim_budget_ok"))
                 if victims is None:
                     continue  # this host can't reach spec.chips at all
                 # per-host cost leads with this host's own PDB violations
@@ -268,7 +278,8 @@ class PriorityPreemption(PostFilterPlugin):
                    pod_key: str | None = None,
                    ledger: DisruptionLedger | None = None,
                    pod: Pod | None = None,
-                   now: float | None = None) -> list[Pod] | None:
+                   now: float | None = None,
+                   victim_ok=None) -> list[Pod] | None:
         """Victims on this node that free `spec.chips` qualifying chips AND
         (when `pod` carries container requests and the node reports
         allocatable) enough cpu/memory: [] when the node already fits
@@ -327,7 +338,8 @@ class PriorityPreemption(PostFilterPlugin):
         # the target is unreachable. This is the common case for every node
         # during an unschedulable burst.
         pool = [p for p in node.pods
-                if _priority(p) < my_prio and _evictable(p)]
+                if _priority(p) < my_prio and _evictable(p)
+                and (victim_ok is None or victim_ok(p))]
         if not pool:
             return None
         if len(ok_coords) - hold < spec.chips:

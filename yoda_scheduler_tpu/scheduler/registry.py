@@ -27,9 +27,12 @@ from .plugins import (
     TopologyScore,
 )
 
-# shared-state objects (allocator, gang coordinator) are built once per
-# profile and injected into every plugin factory that wants them
-Factory = Callable[[SchedulerConfig, ChipAllocator, GangCoordinator], object]
+# shared-state objects (allocator, gang coordinator, policy engine) are
+# built once per profile and injected into every plugin factory that
+# wants them; `policy` is None unless the config's policy knobs (or an
+# explicitly-enabled policy plugin) ask for one
+Factory = Callable[
+    [SchedulerConfig, ChipAllocator, GangCoordinator, object], object]
 
 _REGISTRY: dict[str, Factory] = {}
 
@@ -44,19 +47,54 @@ def registered() -> list[str]:
     return sorted(_REGISTRY)
 
 
-register("priority-sort", lambda cfg, alloc, gangs: PrioritySort())
-register("node-admission", lambda cfg, alloc, gangs: NodeAdmission(alloc))
+register("priority-sort", lambda cfg, alloc, gangs, pol: PrioritySort())
+register("node-admission", lambda cfg, alloc, gangs, pol: NodeAdmission(alloc))
 register("telemetry-filter",
-         lambda cfg, alloc, gangs: TelemetryFilter(alloc, gangs, cfg.telemetry_max_age_s))
-register("max-collection", lambda cfg, alloc, gangs: MaxCollection(alloc))
+         lambda cfg, alloc, gangs, pol: TelemetryFilter(
+             alloc, gangs, cfg.telemetry_max_age_s))
+register("max-collection", lambda cfg, alloc, gangs, pol: MaxCollection(alloc))
 register("telemetry-score",
-         lambda cfg, alloc, gangs: TelemetryScore(alloc, cfg.weights, weight=1))
+         lambda cfg, alloc, gangs, pol: TelemetryScore(
+             alloc, cfg.weights, weight=1))
 register("topology-score",
-         lambda cfg, alloc, gangs: TopologyScore(alloc, weight=cfg.topology_weight))
+         lambda cfg, alloc, gangs, pol: TopologyScore(
+             alloc, weight=cfg.topology_weight))
 register("gang-permit",
-         lambda cfg, alloc, gangs: GangPermit(gangs, timeout_s=cfg.gang_timeout_s,
-                                              allocator=alloc))
-register("priority-preemption", lambda cfg, alloc, gangs: PriorityPreemption(alloc, gangs))
+         lambda cfg, alloc, gangs, pol: GangPermit(
+             gangs, timeout_s=cfg.gang_timeout_s, allocator=alloc))
+register("priority-preemption",
+         lambda cfg, alloc, gangs, pol: PriorityPreemption(alloc, gangs))
+
+
+def _hetero(cfg, pol):
+    from .policy import HeterogeneityScore
+
+    return HeterogeneityScore(
+        pol.model, cfg.policy_objective or "makespan",
+        weight=cfg.heterogeneity_weight, policy=pol)
+
+
+def _fair_sort(pol):
+    from .policy import TenantFairnessSort
+
+    return TenantFairnessSort(pol)
+
+
+def _quota_gate(pol):
+    from .policy import TenantQuotaGate
+
+    return TenantQuotaGate(pol)
+
+
+# policy-engine plugins (scheduler/policy/): not in DEFAULT_ENABLED —
+# the knobs (policyObjective / drfFairness / tenants) or an explicit
+# `plugins:` enablement opt a deployment in
+register("heterogeneity-score", lambda cfg, alloc, gangs, pol: _hetero(cfg, pol))
+register("tenant-fairness-sort", lambda cfg, alloc, gangs, pol: _fair_sort(pol))
+register("tenant-quota-gate", lambda cfg, alloc, gangs, pol: _quota_gate(pol))
+
+_POLICY_PLUGINS = frozenset({
+    "heterogeneity-score", "tenant-fairness-sort", "tenant-quota-gate"})
 
 
 # the default enablement per extension point (mirrors default_profile);
@@ -108,13 +146,24 @@ def build_profile(config: SchedulerConfig,
         return profile
     alloc = allocator or ChipAllocator()
     gangs = gangs or GangCoordinator()
+    # one shared PolicyEngine when the config's policy knobs OR an
+    # explicitly-enabled policy plugin need it (the sort, gate, and
+    # scorer must read the same DRF book)
+    policy = None
+    if (config.policy_objective or config.drf_fairness
+            or config.tenant_quotas
+            or any(n in _POLICY_PLUGINS
+                   for names in (enabled or {}).values() for n in names)):
+        from .policy import PolicyEngine
+
+        policy = PolicyEngine(config)
     built: dict[str, object] = {}
 
     def get(name: str):
         if name not in built:
             if name not in _REGISTRY:
                 raise KeyError(f"unknown plugin {name!r}; known: {registered()}")
-            built[name] = _REGISTRY[name](config, alloc, gangs)
+            built[name] = _REGISTRY[name](config, alloc, gangs, policy)
         return built[name]
 
     from .framework import PreFilterPlugin, PreScorePlugin, ReservePlugin
@@ -144,7 +193,41 @@ def build_profile(config: SchedulerConfig,
     for p in built.values():
         if isinstance(p, PreFilterPlugin) and p not in pre_filters:
             pre_filters.append(p)
-    return Profile(
+    # the policy KNOBS enforce regardless of how the profile was
+    # assembled: a deployment with a `plugins:` block (the shipped
+    # ConfigMap has one) must behave exactly like default_profile when
+    # the operator flips drfFairness/tenants/policyObjective — without
+    # this, the knobs would silently build a PolicyEngine that nothing
+    # consults. Explicit enablement still wins: an already-enabled
+    # policy plugin (or a custom queue sort) is never stomped.
+    if policy is not None:
+        from .policy import (HeterogeneityScore, TenantFairnessSort,
+                             TenantQuotaGate)
+
+        drf_on = config.drf_fairness or config.tenant_quotas
+        if drf_on and not any(isinstance(p, TenantQuotaGate)
+                              for p in pre_filters):
+            pre_filters.insert(0, get("tenant-quota-gate"))
+        if drf_on and type(queue_sort) is PrioritySort:
+            # only the DEFAULT sort is upgraded; a custom comparator the
+            # operator explicitly enabled keeps its ordering
+            queue_sort = get("tenant-fairness-sort")
+        if (config.policy_objective and config.heterogeneity_weight > 0
+                and not any(isinstance(p, HeterogeneityScore)
+                            for p in scores)):
+            # same fold position as default_profile — BEFORE a trailing
+            # admission scorer. Float addition is order-sensitive, and
+            # the two construction paths must sum raws identically or
+            # near-tie rankings could differ between them.
+            at = next((i for i in range(len(scores) - 1, -1, -1)
+                       if isinstance(scores[i], NodeAdmission)),
+                      None)
+            het = get("heterogeneity-score")
+            if at is not None:
+                scores.insert(at, het)
+            else:
+                scores.append(het)
+    profile = Profile(
         queue_sort=queue_sort,
         pre_filter=pre_filters,
         filter=filters,
@@ -154,3 +237,5 @@ def build_profile(config: SchedulerConfig,
         reserve=reserves,
         permit=permits,
     )
+    profile.policy = policy
+    return profile

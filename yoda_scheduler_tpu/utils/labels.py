@@ -36,6 +36,16 @@ TOPOLOGY_LABEL = "tpu/topology"
 GANG_NAME_LABEL = "tpu/gang-name"
 GANG_SIZE_LABEL = "tpu/gang-size"
 
+# Policy-engine labels (scheduler/policy/). The workload CLASS names the
+# job's throughput profile across accelerator generations (Gavel's
+# job-type axis, arXiv:2008.09213) — it rides the WorkloadSpec so every
+# spec-keyed surface (class memos, batch keys, per-spec maxima memo)
+# distinguishes classes automatically. The TENANT names the quota/DRF
+# accounting unit; absent, the pod's namespace is the tenant (it is part
+# of the memo/batch keys already).
+WORKLOAD_CLASS_LABEL = "scv/class"
+TENANT_LABEL = "scv/tenant"
+
 
 class LabelError(ValueError):
     """A workload label is present but malformed."""
@@ -90,6 +100,12 @@ class WorkloadSpec:
     topology: str | None = None      # e.g. "2x2"
     gang_name: str | None = None
     gang_size: int = 0
+    # declared throughput-profile class (scv/class); None = classless —
+    # the heterogeneity model then falls back to a coarse spec-derived
+    # class. A scheduling input ONLY when the policy engine is enabled;
+    # carrying it on the spec keeps the class memos and batch keys sound
+    # (two pods differing only in class never share a spec).
+    workload_class: str | None = None
 
     # Whether the pod opted into accelerator scheduling at all: a pod with no
     # scv/* labels still defaults to 1 chip (reference behaviour — any pod
@@ -119,6 +135,10 @@ class WorkloadSpec:
                 parse_topology(topo)
             except ValueError:
                 raise LabelError(TOPOLOGY_LABEL, topo, "must look like '2x2x1'") from None
+        wclass = labels.get(WORKLOAD_CLASS_LABEL)
+        if wclass is not None and not wclass:
+            raise LabelError(WORKLOAD_CLASS_LABEL, wclass,
+                             "must be a non-empty class name")
         return cls(
             chips=_parse_uint(labels, NUMBER_LABEL, 1),
             min_free_mb=_parse_uint(labels, MEMORY_LABEL, 0),
@@ -129,6 +149,7 @@ class WorkloadSpec:
             topology=topo,
             gang_name=gang_name,
             gang_size=gang_size,
+            workload_class=wclass,
         )
 
     @property
@@ -143,7 +164,8 @@ class WorkloadSpec:
         if h is None:
             h = hash((self.chips, self.min_free_mb, self.min_clock_mhz,
                       self.priority, self.accelerator, self.tpu_generation,
-                      self.topology, self.gang_name, self.gang_size))
+                      self.topology, self.gang_name, self.gang_size,
+                      self.workload_class))
             object.__setattr__(self, "_hash_memo", h)
         return h
 
@@ -153,7 +175,7 @@ class WorkloadSpec:
 _SPEC_LABELS = (
     NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
     ACCELERATOR_LABEL, GENERATION_LABEL, TOPOLOGY_LABEL,
-    GANG_NAME_LABEL, GANG_SIZE_LABEL,
+    GANG_NAME_LABEL, GANG_SIZE_LABEL, WORKLOAD_CLASS_LABEL,
 )
 
 # the complete public label surface (spec inputs + the bind-time chip
@@ -161,7 +183,7 @@ _SPEC_LABELS = (
 # other scv/* or tpu/* label as a probable typo
 from .pod import ASSIGNED_CHIPS_LABEL as _ASSIGNED  # no cycle: pod imports only .memo
 
-KNOWN_LABELS = frozenset(_SPEC_LABELS) | {_ASSIGNED}
+KNOWN_LABELS = frozenset(_SPEC_LABELS) | {_ASSIGNED, TENANT_LABEL}
 
 
 def workload_class(pod) -> str:
@@ -182,6 +204,15 @@ def workload_class(pod) -> str:
     if ACCELERATOR_LABEL in pod.labels or NUMBER_LABEL in pod.labels:
         return "tpu-single"
     return "unlabeled"
+
+
+def tenant_of(pod) -> str:
+    """The pod's quota/DRF accounting unit (scheduler/policy/): the
+    scv/tenant label when present, else the namespace. Both inputs are
+    already inside the engine's memo/batch keys (namespace directly,
+    the label via plugin equivalence contributions), so tenancy can
+    never alias across a memo class."""
+    return pod.labels.get(TENANT_LABEL) or pod.namespace
 
 
 _SPEC_INTERN: dict[WorkloadSpec, WorkloadSpec] = {}
@@ -215,6 +246,7 @@ def spec_for(pod) -> WorkloadSpec:
     # pod every cycle, and the genexpr frame was measurable there
     key = (g(NUMBER_LABEL), g(MEMORY_LABEL), g(CLOCK_LABEL),
            g(PRIORITY_LABEL), g(ACCELERATOR_LABEL), g(GENERATION_LABEL),
-           g(TOPOLOGY_LABEL), g(GANG_NAME_LABEL), g(GANG_SIZE_LABEL))
+           g(TOPOLOGY_LABEL), g(GANG_NAME_LABEL), g(GANG_SIZE_LABEL),
+           g(WORKLOAD_CLASS_LABEL))
     return memo(pod, "_spec_cache", key,
                 lambda: _intern_spec(WorkloadSpec.from_labels(labels)))

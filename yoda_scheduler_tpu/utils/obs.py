@@ -192,6 +192,20 @@ class Metrics:
         "pods_unschedulable_total": "Cycles that ended unschedulable.",
         "breaker_open": "Apiserver circuit breaker state (1 = open).",
         "degraded": "Telemetry-blackout degraded mode (1 = active).",
+        "tenant_dominant_share": "DRF dominant share (max over chips/"
+                                 "HBM of used/capacity) per tenant.",
+        "preemption_victims_total": "Pods evicted by preemption, per "
+                                    "victim tenant.",
+        "tenant_quota_rejections_total": "Pods refused by the tenant "
+                                         "quota gate, per tenant.",
+        "tenant_quota_breaches_total": "Episodes of a tenant's dominant "
+                                       "share exceeding its quota cap.",
+        "tenant_starvation_trips_total": "Pods unbound past the "
+                                         "starvation threshold, per "
+                                         "tenant.",
+        "preemptions_budget_denied_total": "Preemption plans refused by "
+                                           "per-tenant budgets, labeled "
+                                           "by the denying budget level.",
     }
 
     def __init__(self) -> None:
@@ -418,9 +432,15 @@ def export_chrome_trace(rings, path: str | None = None) -> dict:
 # too: each marks the system actively absorbing a fault, exactly the
 # moment the black box should land on disk. Dumps stay rate-limited
 # (min_dump_interval_s), so a deny storm costs one file per window.
+# tenant_quota_breach (a tenant's dominant share EXCEEDS its configured
+# cap in cluster truth — the quota gate can only stop further binds) and
+# tenant_starvation (a pod unbound past starvationAfterSeconds) are the
+# policy engine's trip kinds: both mark fairness actively failing, the
+# moment the black box should land on disk.
 TRIP_KINDS = frozenset({"breaker_open", "invariant_violation",
                         "quarantine", "webhook_deny", "webhook_fail_open",
-                        "shard_takeover"})
+                        "shard_takeover", "tenant_quota_breach",
+                        "tenant_starvation"})
 
 
 class FlightRecorder:
