@@ -338,7 +338,7 @@ def run_scale(units: int, pct: int = 0, pods_per_node: int = 5,
               diverse: bool = False, columnar: bool | None = None,
               batch: bool | None = None, blackout: bool = False,
               native: bool | None = None, sampling: int | None = None,
-              trace_out: str | None = None):
+              trace_out: str | None = None, defrag: bool = False):
     """Scale stress (VERDICT r2 item 7): a large-cluster burst measuring
     whether cycle compute stays sub-linear in node count. pct=0 keeps
     kube-scheduler's adaptive percentageOfNodesToScore (scores ~42% of
@@ -355,7 +355,8 @@ def run_scale(units: int, pct: int = 0, pods_per_node: int = 5,
     gc.disable()
     try:
         return _run_scale_nogc(units, pct, pods_per_node, diverse, columnar,
-                               batch, blackout, native, sampling, trace_out)
+                               batch, blackout, native, sampling, trace_out,
+                               defrag)
     finally:
         gc.enable()
 
@@ -364,7 +365,7 @@ def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
                     diverse: bool = False, columnar: bool | None = None,
                     batch: bool | None = None, blackout: bool = False,
                     native: bool | None = None, sampling: int | None = None,
-                    trace_out: str | None = None):
+                    trace_out: str | None = None, defrag: bool = False):
     store = build_scale_nodes(units)
     if blackout:
         # telemetry-blackout leg: the WHOLE feed died long before the
@@ -395,6 +396,26 @@ def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
         config = config.with_(batch_max_pods=1)
     if sampling is not None:
         config = config.with_(trace_sampling=sampling)
+    if defrag:
+        # active defragmentation leg (the ROADMAP-item-4 recovered-
+        # capacity measurement): consolidate stray singles mid-drain so
+        # tpu-2c pods stop failing on per-node fragmentation. The tight
+        # interval matters — the burst saturates the cluster within the
+        # first virtual seconds, so passes must interleave the drain to
+        # catch the window where strays and holes coexist; once the
+        # cluster is full the destination pre-scan makes every further
+        # pass a cheap no-op.
+        # 0.25s virtual interval ~ the bench compresses a production day
+        # into seconds; production deployments run 30-60s intervals
+        # (deploy ConfigMap examples) — the RATIO of passes to bind
+        # traffic is what this leg reproduces. The effectively-infinite
+        # cooldown migrates each stray AT MOST ONCE for the whole drain:
+        # measured at the 1000-node tier, re-migration adds churn (and
+        # its event fan-out across the parked backlog) without recovering
+        # any additional tpu-2c capacity.
+        config = config.with_(defrag_interval_s=0.25,
+                              defrag_cooldown_s=1e9,
+                              max_migrations_per_pass=16)
     sched = Scheduler(cluster, config, clock=HybridClock())
     n_pods = n_nodes * pods_per_node
     kinds = ("tpu-1c", "tpu-2c", "gpu", "plain")
@@ -472,6 +493,8 @@ def _run_scale_nogc(units: int, pct: int, pods_per_node: int,
         **resilience_stats(sched),
         **native_stats(sched),
     }
+    if defrag:
+        out.update(defrag_stats(sched))
     if trace_out:
         from yoda_scheduler_tpu.utils.obs import export_chrome_trace
 
@@ -655,6 +678,172 @@ def run_fairness_tier(units: int = 2) -> dict:
         "hetero_off": hetero_off,
         "hetero_bound_gain": hetero_on["bound"] - hetero_off["bound"],
         "drf": drf,
+    }
+
+
+# ------------------------------------------------------- elastic / defrag
+def _bind_seed_pod(cluster, name, node, chips, labels=None):
+    """Pre-bind a fragmentation-seed pod onto `node` claiming its first
+    `chips` chips (the coords come from the node's own telemetry, so the
+    seed is valid under the allocator's accounting)."""
+    m = cluster.telemetry.get(node)
+    taken = set()
+    for q in cluster.pods_on(node):
+        taken |= q.assigned_chips()
+    coords = [c.coords for c in m.chips if c.coords not in taken][:chips]
+    p = Pod(name, labels=dict(labels or {"scv/number": str(chips),
+                                         "tpu/accelerator": "tpu"}))
+    cluster.bind(p, node, coords)
+    return p
+
+
+def defrag_stats(sched) -> dict:
+    """Active-defragmentation observability: passes run, migrations per
+    strategy, skips per interlock reason, and per-pod churn (unique
+    migrated pods vs total migrations — the cooldown makes these equal
+    unless a pod legitimately re-migrated a full window later)."""
+    c = sched.metrics.counters
+    lc = sched.metrics.labeled_counters
+    migrated: set = set()
+    for ev in sched.flight.snapshot():
+        if ev.get("kind") == "defrag_pass":
+            migrated.update(ev.get("pods", ()))
+    return {
+        "defrag_passes": c.get("defrag_passes_total", 0),
+        "defrag_migrations": c.get("pods_descheduled_total", 0),
+        "defrag_by_strategy": {
+            dict(k)["strategy"]: v
+            for k, v in lc.get("defrag_evictions_total", {}).items()},
+        "defrag_skips": {
+            dict(k)["reason"]: v
+            for k, v in lc.get("defrag_skips_total", {}).items()},
+        "unique_migrated_pods": len(migrated),
+    }
+
+
+def run_elastic_gang_leg() -> dict:
+    """The acceptance demo: a 4-member elastic gang (tpu/gang-min 2)
+    cannot fit whole — two slice hosts are occupied by movable residents
+    — so it ADMITS at min, then the defrag loop migrates the residents
+    to standalone nodes and the gang GROWS to full size as the chips
+    free. Reports the grow/shrink lifecycle counters CI fences."""
+    store = TelemetryStore()
+    now = time.time()
+    for m in make_v4_slice("es", "2x2x4"):
+        m.heartbeat = now + 1e8
+        store.put(m)
+    for j in range(2):
+        m = make_tpu_node(f"et{j}", chips=4)
+        m.heartbeat = now + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg = SchedulerConfig(
+        telemetry_max_age_s=1e9, elastic_gangs=True,
+        defrag_interval_s=5.0, defrag_cooldown_s=60.0,
+        pod_hinted_backoff_s=30.0, max_attempts=12)
+    sched = Scheduler(cluster, cfg, clock=HybridClock())
+    residents = [
+        _bind_seed_pod(cluster, f"resident-{h}", f"es-host-{h}", 4)
+        for h in (2, 3)]
+    workers = [Pod(f"eg-w{i}", labels={
+        "tpu/gang-name": "eg", "tpu/gang-size": "4", "tpu/gang-min": "2",
+        "scv/number": "4"}) for i in range(4)]
+    for w in workers:
+        sched.submit(w)
+    sched.run_until_idle(max_cycles=20_000)
+    c = sched.metrics.counters
+    return {
+        "gang_size": 4,
+        "gang_min": 2,
+        "bound_members_end": sum(
+            w.phase == PodPhase.BOUND for w in workers),
+        "admissions_at_min": sched.metrics.labeled_counter(
+            "gang_elastic_admissions_total", {"reason": "no-fit"}),
+        "grow_binds": c.get("gang_grow_total", 0),
+        "completions": c.get("gang_elastic_completions_total", 0),
+        "residents_migrated_off_slice": sum(
+            1 for r in residents if r.node and not
+            r.node.startswith("es-host-")),
+        **defrag_stats(sched),
+    }
+
+
+def run_defrag_leg(units: int = 4, defrag: bool = True) -> dict:
+    """The defrag A/B: every slice host carries a 3-single dent (one
+    free chip), every standalone node a 3-single dent (one free hole) —
+    zero 2-chip pairs anywhere — then a tpu-2c burst arrives. Without
+    the controller every 2c pod fails on fragmentation; with it, slice
+    singles migrate into the standalone holes, pairs reassemble on the
+    slice hosts, and the burst binds up to the consolidation limit."""
+    store = TelemetryStore()
+    now = time.time()
+    for i in range(units):
+        for m in make_v4_slice(f"es{i}", "2x2x4"):
+            m.heartbeat = now + 1e8
+            store.put(m)
+        for j in range(2):
+            m = make_tpu_node(f"et{i}-{j}", chips=4)
+            m.heartbeat = now + 1e8
+            store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg = SchedulerConfig(
+        telemetry_max_age_s=1e9, elastic_gangs=True,
+        defrag_interval_s=5.0 if defrag else 0.0,
+        defrag_cooldown_s=60.0, max_migrations_per_pass=8,
+        pod_hinted_backoff_s=30.0, max_attempts=8)
+    sched = Scheduler(cluster, cfg, clock=HybridClock())
+    # fragmentation seed: 1 free chip per slice host, 1 free hole per
+    # standalone — pair capacity is zero until singles consolidate
+    seeds = 0
+    for i in range(units):
+        for h in range(4):
+            for k in range(3):
+                _bind_seed_pod(cluster, f"sfill{i}-{h}-{k}",
+                               f"es{i}-host-{h}", 1,
+                               labels={"scv/number": "1",
+                                       "tpu/accelerator": "tpu"})
+                seeds += 1
+        for j in range(2):
+            for k in range(3):
+                _bind_seed_pod(cluster, f"tfill{i}-{j}-{k}",
+                               f"et{i}-{j}", 1,
+                               labels={"scv/number": "1",
+                                       "tpu/accelerator": "tpu"})
+                seeds += 1
+    n2c = 3 * units
+    burst = [Pod(f"want2c-{i}", labels={
+        "scv/number": "2", "tpu/accelerator": "tpu"})
+        for i in range(n2c)]
+    t0 = time.perf_counter()
+    for p in burst:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=50_000)
+    wall = time.perf_counter() - t0
+    bound = sum(p.phase == PodPhase.BOUND for p in burst)
+    return {
+        "nodes": len(cluster.node_names()),
+        "seed_singles": seeds,
+        "tpu2c_submitted": n2c,
+        "tpu2c_bound": bound,
+        "tpu2c_failed": n2c - bound,
+        "wall_s": round(wall, 2),
+        **defrag_stats(sched),
+    }
+
+
+def run_elastic_tier(units: int = 4) -> dict:
+    """The committed elastic/defrag artifact: the gang grow demo plus
+    the fragmented-cluster tpu-2c A/B. CI fences read these numbers."""
+    gang = run_elastic_gang_leg()
+    off = run_defrag_leg(units, defrag=False)
+    on = run_defrag_leg(units, defrag=True)
+    return {
+        "elastic_gang": gang,
+        "defrag_off": off,
+        "defrag_on": on,
+        "tpu2c_recovered": off["tpu2c_failed"] - on["tpu2c_failed"],
     }
 
 
@@ -1139,6 +1328,14 @@ def main():
             fairness = run_fairness_tier()
         except Exception as e:  # the fairness bench must never sink the run
             fairness = {"error": repr(e)}
+    # elastic gangs + active defragmentation tier (grow demo + the
+    # fragmented-cluster tpu-2c A/B); opt out with YODA_BENCH_NO_ELASTIC=1
+    elastic = {}
+    if not os.environ.get("YODA_BENCH_NO_ELASTIC"):
+        try:
+            elastic = run_elastic_tier()
+        except Exception as e:  # must never sink the run
+            elastic = {"error": repr(e)}
     if args.trace_out:
         # dedicated fully-sampled leg: every pod span-traced, exported as
         # one Chrome/Perfetto document — the visual answer to "where does
@@ -1158,6 +1355,7 @@ def main():
         "serve_scale": serve_scale,
         "serve_fleet": serve_fleet,
         "fairness": fairness,
+        "elastic": elastic,
     }
     # only a FULL, error-free run may overwrite the committed artifact: a
     # smoke run (YODA_BENCH_NO_SCALE/NO_SERVE, e.g. ci.yaml's
@@ -1166,7 +1364,8 @@ def main():
     # still surfaces in the stdout headline's serve summary)
     if (scale and serve_scale and "error" not in serve_scale
             and serve_fleet and "error" not in serve_fleet
-            and fairness and "error" not in fairness):
+            and fairness and "error" not in fairness
+            and elastic and "error" not in elastic):
         full_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
         try:
@@ -1234,6 +1433,20 @@ def main():
                 for t, b in drf.get("per_tenant", {}).items()},
         }
 
+    def elastic_summary(s):
+        if not s or "elastic_gang" not in s:
+            return s or {}
+        g = s["elastic_gang"]
+        return {
+            "gang_bound_at_min_then_grown_to":
+                f'{g["gang_min"]}->{g["bound_members_end"]}',
+            "gang_grow_binds": g["grow_binds"],
+            "tpu2c_failed_off": s["defrag_off"]["tpu2c_failed"],
+            "tpu2c_failed_on": s["defrag_on"]["tpu2c_failed"],
+            "tpu2c_recovered": s["tpu2c_recovered"],
+            "migrations": s["defrag_on"]["defrag_migrations"],
+        }
+
     def fleet_summary(s):
         if not s or "legs" not in s:
             return s or {}
@@ -1268,6 +1481,7 @@ def main():
         "serve": serve_summary(serve_scale),
         "serve_fleet": fleet_summary(serve_fleet),
         "fairness": fairness_summary(fairness),
+        "elastic": elastic_summary(elastic),
         "full_detail": "BENCH_FULL.json",
     }))
 

@@ -39,6 +39,8 @@ from yoda_scheduler_tpu.chaos import (
     CrashingFilter,
     CrashingReserve,
     CrashingScore,
+    DEFRAG_RACE,
+    ELASTIC_KINDS,
     ENGINE_CRASH,
     FLEET_KINDS,
     FaultPlan,
@@ -431,6 +433,183 @@ def test_fleet_chaos_fuzz(seed):
     # with it, and reconcile ADOPTS its binds without re-counting them.
     stats = fleet.fleet_stats()
     assert all(v >= 0 for v in stats["authority_rejections"].values())
+
+
+# ----------------------- elastic/defrag chaos fuzz (ISSUE 10 satellite)
+_EL_SMOKE = 8
+_EL_FULL = 48
+
+
+def _elastic_seed_params():
+    return [s if s < _EL_SMOKE
+            else pytest.param(s, marks=pytest.mark.slow)
+            for s in range(_EL_FULL)]
+
+
+def _elastic_workload(rng: random.Random) -> list[Pod]:
+    """Satisfiable ONLY through defragmentation: one elastic gang wants
+    the whole 4-host slice (4 x 4 chips, min 2) while singles — bounded
+    by standalone capacity (12 chips) — may initially land ON the slice.
+    Convergence therefore requires the defrag loop to migrate them off,
+    and the gang to ride admission-at-min + growth through the faults."""
+    pods = [Pod(f"eg-w{i}", labels={
+        "tpu/gang-name": "eg", "tpu/gang-size": "4", "tpu/gang-min": "2",
+        "scv/number": "4"}) for i in range(4)]
+    for i in range(rng.randint(6, 10)):
+        pods.append(Pod(f"s{i}", labels={
+            "tpu/accelerator": "tpu", "scv/number": "1"}))
+    for i in range(rng.randint(0, 4)):
+        pods.append(Pod(f"gp{i}", labels={
+            "tpu/accelerator": "gpu", "scv/number": "1"}))
+    rng.shuffle(pods)
+    return pods
+
+
+def _gang_bound_now(cluster, gang: str) -> int:
+    return sum(1 for n in cluster.node_names()
+               for p in cluster.pods_on(n)
+               if p.labels.get("tpu/gang-name") == gang
+               and not p.terminating)
+
+
+def _drive_elastic_fleet(fleet, plan, pods, rng, views, store):
+    """_drive_fleet plus the elastic-era transitions: DEFRAG_RACE forces
+    the owning replica's migration pass at the seeded instant (evictions
+    interleaved with other replicas' binds on the same nodes) and
+    NETWORK_PARTITION freezes one replica's view mid-growth. Checks the
+    FIFTH invariant continuously: once the gang reached its min, our own
+    migrations/evictions never take cluster truth below it."""
+    clock = fleet.clock
+    fired: set = set()
+    active: dict = {}
+    fault_end = plan.fault_end()
+    budget = 300.0 + fault_end
+    cycles = 0
+    reached_min = False
+    while True:
+        now = clock.time()
+        assert now < budget, (
+            f"elastic drive did not converge by t={now:.1f}: pending "
+            f"{[p.name for p in pods if p.phase == PodPhase.PENDING]}")
+        cycles += 1
+        assert cycles < 300_000, "elastic drive cycle budget exhausted"
+        bound = _gang_bound_now(fleet.cluster, "eg")
+        if bound >= 2:
+            reached_min = True
+        elif reached_min:
+            raise AssertionError(
+                f"gang dropped below min: {bound}/2 bound at t={now:.1f}")
+        for w in plan.windows:
+            key = (w.kind, w.start)
+            if w.start > now or key in fired:
+                continue
+            if w.kind == REPLICA_CRASH:
+                fired.add(key)
+                fleet.crash_replica(rng.randrange(fleet.n), pods)
+            elif w.kind == NETWORK_PARTITION:
+                fired.add(key)
+                idx = rng.randrange(fleet.n)
+                views[idx].freeze()
+                active[key] = (w.end, views[idx].thaw)
+            elif w.kind == DEFRAG_RACE:
+                fired.add(key)
+                # force the migration pass NOW, on whichever replica
+                # currently owns it — its evictions land between the
+                # other replicas' optimistic binds on the same nodes
+                for rep in fleet.replicas:
+                    d = rep.engine.defrag
+                    if d is not None and (d.owner_check is None
+                                          or d.owner_check()):
+                        d.run_pass(now)
+                        break
+        for key in list(active):
+            end, undo = active[key]
+            if now >= end:
+                undo()
+                del active[key]
+        if fleet.step(rng) is not None:
+            clock.advance(TICK)
+            continue
+        wake = fleet.next_wake_at()
+        if wake is None:
+            if now >= fault_end and not active and all(
+                    p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                    for p in pods):
+                return
+            clock.advance(0.5)
+        else:
+            clock.advance(max(wake - clock.time(), TICK))
+
+
+@pytest.mark.parametrize("seed", _elastic_seed_params())
+def test_elastic_defrag_chaos_fuzz(seed):
+    """One seeded elastic/defrag scenario end to end: a 2-3 replica
+    sharded fleet with the defrag loop live (shard-0 owner only) and an
+    elastic gang growing from min toward full, while the plan scripts
+    storms, lost binds, replica crashes, partitions, and DEFRAG_RACE
+    windows (the descheduler evicting while another replica binds the
+    same node). The four global invariants must hold fleet-wide at
+    convergence, plus the fifth: no gang ever drops below its
+    tpu/gang-min from our own migrations, and no pod migrates more than
+    once per cooldown window."""
+    rng = random.Random(50_000 + seed)
+    plan = FaultPlan(seed, horizon_s=20.0, kinds=ELASTIC_KINDS)
+    clock = FakeClock()
+    store = _fleet(rng)
+    # the feed stays LIVE through the whole run (no TELEMETRY_BLACKOUT
+    # in ELASTIC_KINDS): this fuzz's convergence depends on the defrag
+    # loop, and its degraded-mode interlock — correctly — refuses to
+    # migrate off a dead feed (the interlock itself is pinned by
+    # tests/test_elastic.py::TestDefragController). Re-put so the
+    # store's heartbeat floor/ceiling follow.
+    for m in store.list():
+        m.heartbeat = 1e8
+        store.put(m)
+    cluster = ChaosCluster(store, plan=plan, clock=clock)
+    cluster.add_nodes_from_telemetry()
+    n_replicas = rng.choice((2, 3))
+    views: dict = {}
+
+    def wrap(c, idx):
+        v = PartitionableView(c)
+        views[idx] = v
+        return v
+
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=MAX_AGE,
+                        breaker_cooldown_s=1.0,
+                        elastic_gangs=True,
+                        gang_timeout_s=2.0,
+                        defrag_interval_s=2.0,
+                        defrag_cooldown_s=5.0),
+        replicas=n_replicas, clock=clock, mode="sharded", seed=seed,
+        validate_fence_locally=bool(rng.getrandbits(1)),
+        cluster_wrapper=wrap)
+    pods = _elastic_workload(rng)
+    for p in pods:
+        fleet.submit(p)
+    _drive_elastic_fleet(fleet, plan, pods, rng, views, store)
+    _assert_invariants(pods, store, cluster, f"elastic-{seed}",
+                       sched=fleet)
+    # the gang converged to FULL size (the workload is satisfiable once
+    # defrag moves the singles off the slice)
+    assert _gang_bound_now(cluster, "eg") == 4
+    # migration churn bounded: no pod migrates more than once per
+    # cooldown window. Checked per engine ring (the cooldown book is
+    # engine-local; a crashed replica's replacement starts a fresh one)
+    # from the defrag_pass flight events' pod lists + timestamps.
+    for rep in fleet.replicas:
+        per_pod: dict[str, float] = {}
+        for ev in rep.engine.flight.snapshot():
+            if ev["kind"] != "defrag_pass":
+                continue
+            for key in ev.get("pods", ()):
+                last = per_pod.get(key)
+                assert last is None or ev["ts"] - last >= 5.0 - 1e-6, (
+                    f"seed {seed}: {key} migrated twice inside the "
+                    f"cooldown window ({last} -> {ev['ts']})")
+                per_pod[key] = ev["ts"]
 
 
 # -------------------------------------- webhook-era chaos fuzz (vanilla

@@ -1,0 +1,764 @@
+"""Elastic gangs + active defragmentation (scheduler/elastic/, ISSUE 10).
+
+Covers the tentpole surfaces — tpu/gang-min admission-at-min, event-woken
+growth, scv/deadline-seconds SLO pressure, shrink-to-min preemption, the
+defrag controller's closed loop with its safety rails and fleet
+ownership — plus the satellites: off-by-default parity, the gang-fail
+quota-claim retirement regression, and the new metrics' exposition
+round-trip through prometheus_client's reference parser.
+"""
+
+import random
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import (
+    FakeCluster, FleetCoordinator, Scheduler, SchedulerConfig)
+from yoda_scheduler_tpu.scheduler.core import FakeClock, HybridClock, default_profile
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore, make_tpu_node, make_v4_slice)
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.labels import LabelError, WorkloadSpec, spec_for
+
+
+def mk_sched(nodes, config=None, start=1000.0):
+    store = TelemetryStore()
+    clock = FakeClock(start=start)
+    for n in nodes:
+        n.heartbeat = clock.time()
+        store.put(n)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return Scheduler(cluster, config or SchedulerConfig(), clock=clock), clock
+
+
+def refresh(sched):
+    for m in sched.cluster.telemetry.list():
+        m.heartbeat = sched.clock.time()
+
+
+def elastic_gang(name, size, gmin, chips=4, prio=0, deadline=None,
+                 extra=None):
+    pods = []
+    for i in range(size):
+        labels = {
+            "tpu/gang-name": name,
+            "tpu/gang-size": str(size),
+            "tpu/gang-min": str(gmin),
+            "scv/number": str(chips),
+            "scv/priority": str(prio),
+        }
+        if deadline is not None:
+            labels["scv/deadline-seconds"] = str(deadline)
+        if extra:
+            labels.update(extra)
+        pods.append(Pod(f"{name}-w{i}", labels=labels))
+    return pods
+
+
+def blocker(name, chips=4, prio=0):
+    return Pod(name, labels={"scv/number": str(chips),
+                             "tpu/accelerator": "tpu",
+                             "scv/priority": str(prio)})
+
+
+def drive(sched, clock, n=40, tick=0.5):
+    for _ in range(n):
+        refresh(sched)
+        while sched.run_one() is not None:
+            pass
+        clock.advance(tick)
+
+
+ELASTIC = SchedulerConfig(elastic_gangs=True)
+
+
+# ---------------------------------------------------------------- labels
+class TestGangMinLabels:
+    def test_parses_min_and_deadline(self):
+        spec = spec_for(Pod("p", labels={
+            "tpu/gang-name": "g", "tpu/gang-size": "4",
+            "tpu/gang-min": "2", "scv/deadline-seconds": "120"}))
+        assert spec.gang_min == 2 and spec.deadline_s == 120
+
+    def test_min_requires_gang(self):
+        with pytest.raises(LabelError):
+            spec_for(Pod("p", labels={"tpu/gang-min": "2"}))
+
+    def test_min_must_not_exceed_size(self):
+        with pytest.raises(LabelError):
+            spec_for(Pod("p", labels={
+                "tpu/gang-name": "g", "tpu/gang-size": "2",
+                "tpu/gang-min": "3"}))
+
+    def test_defaults_are_zero(self):
+        spec = spec_for(Pod("p", labels={"scv/number": "1"}))
+        assert spec.gang_min == 0 and spec.deadline_s == 0
+
+    def test_min_rides_the_spec_class(self):
+        """Two gangs differing only in tpu/gang-min must not share a
+        WorkloadSpec (the memo/batch-key soundness audit)."""
+        a = spec_for(Pod("a", labels={"tpu/gang-name": "g",
+                                      "tpu/gang-size": "4",
+                                      "tpu/gang-min": "2"}))
+        b = spec_for(Pod("b", labels={"tpu/gang-name": "g",
+                                      "tpu/gang-size": "4"}))
+        assert a != b and hash(a) != hash(b)
+
+
+# ------------------------------------------------------ admission at min
+class TestAdmitAtMin:
+    def _fragmented(self, config=None):
+        """4-host slice with 2 hosts fully occupied by equal-priority
+        singles (preemption cannot cure) + a spare standalone node."""
+        nodes = make_v4_slice("s", "2x2x4") + [make_tpu_node("lone", chips=4)]
+        sched, clock = mk_sched(nodes, config or ELASTIC.with_(
+            gang_timeout_s=30.0))
+        blockers = [blocker(f"b{i}") for i in range(2)]
+        for b in blockers:
+            sched.submit(b)
+        drive(sched, clock, n=6)
+        occupied = {b.node for b in blockers if b.node}
+        assert len([n for n in occupied if n.startswith("s-host-")]) >= 1
+        return sched, clock, blockers
+
+    def test_gang_binds_at_min_when_full_does_not_fit(self):
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, ELASTIC)
+        # occupy 2 of the 4 hosts with equal-priority singles
+        occupants = [blocker("b0"), blocker("b1")]
+        for b in occupants:
+            sched.submit(b)
+        drive(sched, clock, n=4)
+        assert sum(1 for b in occupants
+                   if b.node and b.node.startswith("s-host-")) == 2
+        workers = elastic_gang("job", 4, 2)
+        for w in workers:
+            sched.submit(w)
+        drive(sched, clock, n=8)
+        bound = [w for w in workers if w.phase == PodPhase.BOUND]
+        assert len(bound) == 2, [w.phase for w in workers]
+        assert sched.metrics.labeled_counter(
+            "gang_elastic_admissions_total", {"reason": "no-fit"}) == 1
+        # the unplaced members are parked, not failed, and not waiting
+        # at Permit (they are growth members in the queue)
+        assert not sched.waiting
+        assert all(w.phase == PodPhase.PENDING
+                   for w in workers if w not in bound)
+
+    def test_gang_grows_as_chips_free(self):
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, ELASTIC)
+        occupants = [blocker("b0"), blocker("b1")]
+        for b in occupants:
+            sched.submit(b)
+        drive(sched, clock, n=4)
+        workers = elastic_gang("job", 4, 2)
+        for w in workers:
+            sched.submit(w)
+        drive(sched, clock, n=8)
+        assert sum(w.phase == PodPhase.BOUND for w in workers) == 2
+        # capacity frees: each departure wakes a growth member
+        sched.cluster.evict(occupants[0])
+        drive(sched, clock, n=8)
+        assert sum(w.phase == PodPhase.BOUND for w in workers) == 3
+        sched.cluster.evict(occupants[1])
+        drive(sched, clock, n=8)
+        assert all(w.phase == PodPhase.BOUND for w in workers)
+        assert sched.metrics.counters.get("gang_grow_total", 0) == 2
+        assert sched.metrics.counters.get(
+            "gang_elastic_completions_total", 0) == 1
+        # all four members share the slice
+        assert len({w.node for w in workers}) == 4
+        assert all(w.node.startswith("s-host-") for w in workers)
+
+    def test_grow_hint_wakes_on_telemetry_recovery_too(self):
+        """Chips also free by RECOVERING: the growth hint must register
+        NODE_TELEMETRY_UPDATED (like classic gang-permit and the
+        telemetry filter) or a member parked behind unhealthy chips
+        waits out its full hinted backoff after the slice heals."""
+        from yoda_scheduler_tpu.scheduler.elastic import ELASTIC_GROW_HINT
+        from yoda_scheduler_tpu.scheduler.framework import (
+            NODE_ADDED, NODE_TELEMETRY_UPDATED, POD_DELETED)
+
+        sched, clock = mk_sched(make_v4_slice("s", "2x2x4"), ELASTIC)
+        kinds, _ = sched.queue._hints[ELASTIC_GROW_HINT]
+        assert {POD_DELETED, NODE_ADDED, NODE_TELEMETRY_UPDATED} <= kinds
+
+    def test_classic_gang_still_all_or_nothing(self):
+        """No tpu/gang-min label: the elastic knob must change nothing —
+        a gang the cluster cannot host whole binds nobody."""
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, ELASTIC.with_(max_attempts=3))
+        occupants = [blocker("b0"), blocker("b1")]
+        for b in occupants:
+            sched.submit(b)
+        drive(sched, clock, n=4)
+        workers = [Pod(f"c-w{i}", labels={
+            "tpu/gang-name": "c", "tpu/gang-size": "4",
+            "scv/number": "4"}) for i in range(4)]
+        for w in workers:
+            sched.submit(w)
+        drive(sched, clock, n=80, tick=2.0)
+        assert not any(w.phase == PodPhase.BOUND for w in workers)
+
+    def test_growth_member_exhausting_attempts_spares_the_gang(self):
+        """A growth member hitting max_attempts fails ALONE — the
+        reduced-size gang keeps running (gang_doom disarmed)."""
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, ELASTIC.with_(max_attempts=3))
+        occupants = [blocker("b0"), blocker("b1")]
+        for b in occupants:
+            sched.submit(b)
+        drive(sched, clock, n=4)
+        workers = elastic_gang("job", 4, 2)
+        for w in workers:
+            sched.submit(w)
+        drive(sched, clock, n=120, tick=2.0)
+        bound = [w for w in workers if w.phase == PodPhase.BOUND]
+        failed = [w for w in workers if w.phase == PodPhase.FAILED]
+        assert len(bound) == 2 and len(failed) == 2
+        # the bound half is still bound and the gang is not doomed
+        assert "job" not in sched.doomed_gangs
+
+
+# ------------------------------------------------------ deadline pressure
+class TestDeadlinePressure:
+    def _sched(self):
+        nodes = make_v4_slice("s", "2x2x4")
+        return mk_sched(nodes, ELASTIC.with_(gang_timeout_s=30.0))
+
+    def test_tight_deadline_starts_at_min_without_waiting(self):
+        sched, clock = self._sched()
+        workers = elastic_gang("slo", 4, 2, deadline=10)  # < timeout scaled
+        for w in workers[:2]:  # the rest never arrive
+            sched.submit(w)
+        drive(sched, clock, n=4)
+        assert all(w.phase == PodPhase.BOUND for w in workers[:2])
+        assert sched.metrics.labeled_counter(
+            "gang_elastic_admissions_total", {"reason": "deadline"}) == 1
+
+    def test_loose_deadline_waits_for_full_assembly(self):
+        sched, clock = self._sched()
+        # budget comfortably covers another assembly round: wait
+        workers = elastic_gang("slo", 4, 2, deadline=100000)
+        for w in workers[:2]:
+            sched.submit(w)
+        drive(sched, clock, n=4)
+        assert all(w.phase == PodPhase.PENDING for w in workers[:2])
+        assert len(sched.waiting) == 2
+        # the stragglers arrive: classic full assembly completes
+        for w in workers[2:]:
+            sched.submit(w)
+        drive(sched, clock, n=6)
+        assert all(w.phase == PodPhase.BOUND for w in workers)
+
+    def test_deadline_threshold_scales_with_sacrifice_not_inverse(self):
+        """The pressure threshold is gang_timeout_s * r * (min/size):
+        it shrinks as the min-size throughput sacrifice grows, so a
+        bigger sacrifice holds out for full assembly LONGER. (The
+        inverted size/min scaling would make every mid-range deadline
+        pressed immediately — threshold >= the whole budget.)"""
+        from yoda_scheduler_tpu.scheduler.elastic import ElasticGangs
+
+        eg = ElasticGangs(SchedulerConfig(gang_timeout_s=30.0))
+
+        def spec(gmin):
+            return spec_for(Pod("p", labels={
+                "tpu/gang-name": "slo", "tpu/gang-size": "4",
+                "tpu/gang-min": str(gmin), "scv/number": "4",
+                "scv/deadline-seconds": "20"}))
+
+        eg.note_member_seen("slo", 0.0)
+        # min 2/4 (2x sacrifice): threshold 15s — budget 18s holds out,
+        # budget 14s is pressed
+        assert not eg.deadline_pressed(spec(2), 2.0)
+        assert eg.deadline_pressed(spec(2), 6.0)
+        # min 1/4 (4x sacrifice): threshold 7.5s — still holding out at
+        # a remaining budget that already pressed the cheaper sacrifice
+        assert not eg.deadline_pressed(spec(1), 6.0)
+        assert eg.deadline_pressed(spec(1), 13.0)
+
+    def test_no_deadline_waits(self):
+        sched, clock = self._sched()
+        workers = elastic_gang("nod", 4, 2)
+        for w in workers[:2]:
+            sched.submit(w)
+        drive(sched, clock, n=4)
+        assert len(sched.waiting) == 2
+
+    def test_name_reuse_after_completion_starts_deadline_fresh(self):
+        """A gang that assembles FULLY (classic path) must retire its
+        _first_seen deadline anchor at completion: a later gang reusing
+        the name would otherwise inherit a stale anchor, read a huge
+        'waited', and be deadline-pressed into admitting at min on its
+        first eligible cycle even though full assembly fits."""
+        sched, clock = self._sched()
+        first = elastic_gang("reuse", 4, 2, deadline=100000)
+        for w in first:
+            sched.submit(w)
+        drive(sched, clock, n=6)
+        assert all(w.phase == PodPhase.BOUND for w in first)
+        # the job finishes; its pods leave the cluster
+        for w in first:
+            sched.cluster.evict(w)
+        # burn almost the whole reused deadline budget: a stale anchor
+        # would read waited≈99990, remaining≈10 <= the 15s threshold
+        clock.advance(99990.0)
+        # new incarnation, same gang name, same loose deadline: only 2
+        # of 4 submitted — with a fresh anchor it must WAIT for full
+        # assembly, not start at min off the dead gang's clock
+        second = elastic_gang("reuse", 4, 2, deadline=100000)
+        for w in second[:2]:
+            sched.submit(w)
+        drive(sched, clock, n=4)
+        assert all(w.phase == PodPhase.PENDING for w in second[:2])
+        assert sched.metrics.labeled_counter(
+            "gang_elastic_admissions_total", {"reason": "deadline"}) == 0
+
+
+# -------------------------------------------------------- shrink to min
+class TestShrinkToMin:
+    def _running_gang(self, max_attempts=4):
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes,
+                                ELASTIC.with_(max_attempts=max_attempts))
+        workers = elastic_gang("donor", 4, 2)
+        for w in workers:
+            sched.submit(w)
+        drive(sched, clock, n=6)
+        assert all(w.phase == PodPhase.BOUND for w in workers)
+        return sched, clock, workers
+
+    def _bound_members(self, sched, gang):
+        return sum(1 for n in sched.cluster.node_names()
+                   for p in sched.cluster.pods_on(n)
+                   if p.labels.get("tpu/gang-name") == gang)
+
+    def test_preemption_shrinks_gang_to_min_never_below(self):
+        sched, clock, workers = self._running_gang()
+        preemptors = [blocker(f"hi{i}", prio=9) for i in range(3)]
+        for p in preemptors:
+            sched.submit(p)
+        drive(sched, clock, n=120, tick=2.0)
+        # surplus was 2: exactly two preemptors got a host, the third
+        # found no plan (shrinking below min is never offered)
+        assert sum(p.phase == PodPhase.BOUND for p in preemptors) == 2
+        assert self._bound_members(sched, "donor") == 2
+        assert sched.metrics.labeled_counter(
+            "gang_shrink_total", {"reason": "preemption"}) == 2
+
+    def test_obstacle_eviction_never_drops_gang_below_min(self):
+        """Regression: a hostPort-conflict OBSTACLE folded into a plan
+        consumes gang surplus like any capacity pick — a plan whose
+        capacity victim already exhausted the surplus must be refused
+        WHOLE, never allowed to evict the obstacle past tpu/gang-min."""
+        port = ((8080, "TCP", ""),)
+
+        def member(name, prio, ports=()):
+            return Pod(name, labels={
+                "tpu/gang-name": "donor", "tpu/gang-size": "3",
+                "tpu/gang-min": "2", "scv/number": "2",
+                "scv/priority": str(prio)}, host_ports=ports)
+
+        nodes = make_v4_slice("s", "2x2x2")
+        sched, clock = mk_sched(nodes, ELASTIC.with_(max_attempts=3))
+        # host-0: w0 holds the port (prio 5), w1 is the cheap capacity
+        # victim (prio 1) — surplus is 1, so evicting BOTH breaks min
+        w0 = member("donor-w0", 5, port)
+        w1 = member("donor-w1", 1)
+        # host-1: third member + an equal-priority port holder, so no
+        # alternative plan exists there (obstacle not evictable)
+        w2 = member("donor-w2", 5)
+        wall = Pod("wall", labels={"scv/number": "2", "scv/priority": "9",
+                                   "tpu/accelerator": "tpu"},
+                   host_ports=port)
+        sched.cluster.bind(w0, "s-host-0", [(0, 0, 0), (1, 0, 0)])
+        sched.cluster.bind(w1, "s-host-0", [(0, 1, 0), (1, 1, 0)])
+        sched.cluster.bind(w2, "s-host-1", [(0, 0, 1), (1, 0, 1)])
+        sched.cluster.bind(wall, "s-host-1", [(0, 1, 1), (1, 1, 1)])
+        hi = Pod("hi", labels={"scv/number": "2", "scv/priority": "9",
+                               "tpu/accelerator": "tpu"},
+                 host_ports=port)
+        sched.submit(hi)
+        drive(sched, clock, n=60, tick=2.0)
+        # no admissible plan anywhere: host-0's obstacle fold would
+        # overdraw the surplus, host-1's port holder outranks eviction
+        assert hi.phase == PodPhase.FAILED
+        assert self._bound_members(sched, "donor") == 3
+
+    def test_shrunk_gang_regrows_when_capacity_returns(self):
+        # max_attempts=0: the shrunk member keeps retrying as a growth
+        # member until capacity returns (the serve posture)
+        sched, clock, workers = self._running_gang(max_attempts=0)
+        hi = blocker("hi", prio=9)
+        sched.submit(hi)
+        drive(sched, clock, n=30, tick=2.0)
+        assert hi.phase == PodPhase.BOUND
+        assert self._bound_members(sched, "donor") == 3
+        sched.cluster.evict(hi)
+        drive(sched, clock, n=30, tick=2.0)
+        assert self._bound_members(sched, "donor") == 4
+        assert sched.metrics.counters.get("gang_grow_total", 0) >= 1
+
+    def test_elastic_off_gangs_stay_untouchable(self):
+        nodes = make_v4_slice("s", "2x2x4")
+        sched, clock = mk_sched(nodes, SchedulerConfig(max_attempts=3))
+        workers = elastic_gang("donor", 4, 2)  # label present, knob off
+        for w in workers:
+            sched.submit(w)
+        drive(sched, clock, n=6)
+        assert all(w.phase == PodPhase.BOUND for w in workers)
+        hi = blocker("hi", prio=9)
+        sched.submit(hi)
+        drive(sched, clock, n=60, tick=2.0)
+        assert hi.phase == PodPhase.FAILED
+        assert self._bound_members(sched, "donor") == 4
+
+
+# ------------------------------------------------------ defrag controller
+class TestDefragController:
+    def _fragmented_slice(self, config=None):
+        """2-host slice with one stray single on host-0 (blocking a
+        2-host gang) + an empty standalone destination."""
+        nodes = make_v4_slice("s", "2x2x2") + [make_tpu_node("lone",
+                                                             chips=4)]
+        cfg = config or SchedulerConfig(defrag_interval_s=5.0,
+                                        defrag_cooldown_s=60.0)
+        sched, clock = mk_sched(nodes, cfg)
+        stray = Pod("stray", labels={"scv/number": "1",
+                                     "tpu/accelerator": "tpu"})
+        # pin the stray onto a slice host so the slice is dented
+        sched.cluster.bind(stray, "s-host-0", [(0, 0, 0)])
+        return sched, clock, stray
+
+    def test_pass_reassembles_the_slice(self):
+        sched, clock, stray = self._fragmented_slice()
+        gang = [Pod(f"g-w{i}", labels={
+            "tpu/gang-name": "g", "tpu/gang-size": "2",
+            "scv/number": "4"}) for i in range(2)]
+        for w in gang:
+            sched.submit(w)
+        drive(sched, clock, n=40, tick=1.0)
+        # the stray migrated to the standalone node and the gang took
+        # the whole slice
+        assert stray.node == "lone"
+        assert all(w.phase == PodPhase.BOUND for w in gang)
+        assert all(w.node.startswith("s-host-") for w in gang)
+        assert sched.metrics.labeled_counter(
+            "defrag_evictions_total",
+            {"strategy": "slice-conservation"}) == 1
+        assert sched.metrics.counters.get("defrag_passes_total", 0) >= 1
+        kinds = [e["kind"] for e in sched.flight.snapshot()]
+        assert "defrag_pass" in kinds
+
+    def test_no_demand_no_pass(self):
+        sched, clock, stray = self._fragmented_slice()
+        drive(sched, clock, n=20, tick=5.0)
+        assert sched.metrics.counters.get("defrag_passes_total", 0) == 0
+        assert stray.node == "s-host-0"
+
+    def test_cooldown_prevents_rethrash(self):
+        """A pod the loop migrated is immune for the cooldown window —
+        no pod migrates more than once per window."""
+        sched, clock, stray = self._fragmented_slice(
+            SchedulerConfig(defrag_interval_s=5.0,
+                            defrag_cooldown_s=1e6))
+        # an unsatisfiable pending pod keeps the demand gate open
+        sched.submit(Pod("want", labels={"scv/number": "4",
+                                         "scv/memory": "999999999"}))
+        drive(sched, clock, n=40, tick=5.0)
+        assert sched.metrics.counters.get(
+            "pods_descheduled_total", 0) <= 1
+
+    def test_breaker_interlock(self):
+        sched, clock, stray = self._fragmented_slice()
+        sched.submit(Pod("want", labels={"scv/number": "4"}))
+        sched._breaker_until = clock.time() + 1e9  # breaker open
+        sched.defrag.run_pass(clock.time())
+        assert sched.metrics.labeled_counter(
+            "defrag_skips_total", {"reason": "breaker-open"}) == 1
+        assert sched.metrics.counters.get("defrag_passes_total", 0) == 0
+
+    def test_degraded_interlock(self):
+        sched, clock, stray = self._fragmented_slice(
+            SchedulerConfig(defrag_interval_s=5.0,
+                            telemetry_max_age_s=10.0))
+        sched.submit(Pod("want", labels={"scv/number": "4"}))
+        clock.advance(1e5)  # every heartbeat ancient: blackout
+        sched.defrag.run_pass(clock.time())
+        assert sched.metrics.labeled_counter(
+            "defrag_skips_total", {"reason": "degraded"}) == 1
+
+    def test_pin_never_poisons_class_memos(self):
+        """Real-apiserver shape: the migration pin arrives WITHOUT an
+        allocator nomination (eviction destroyed the old incarnation,
+        so Descheduler.run_once nominated nothing). The pinned one-node
+        scan must not land in the class memos — a classmate must still
+        see the open node, and the pin stays one-shot."""
+        nodes = [make_tpu_node("full", chips=1),
+                 make_tpu_node("open", chips=4)]
+        sched, clock = mk_sched(nodes, SchedulerConfig(
+            defrag_interval_s=1e9, telemetry_max_age_s=1e9))
+        filler = Pod("filler", labels={"scv/number": "1"})
+        sched.cluster.bind(filler, "full", [(0, 0, 0)])
+        v = Pod("v", labels={"scv/number": "1"})
+        sched.defrag._pins[v.key] = "full"  # destination taken meanwhile
+        sched.submit(v)
+        assert sched.run_one() is not None  # the pinned cycle fails
+        assert v.phase != PodPhase.BOUND
+        assert not sched.defrag._pins  # consumed one-shot
+        # the narrowed "no feasible node" verdict must NOT be a class
+        # verdict: pre-fix it sat in _unsched_memo and classmates
+        # fast-failed in O(1) while `open` had capacity
+        assert not sched._unsched_memo
+        c = Pod("c", labels={"scv/number": "1"})
+        sched.submit(c)
+        sched.run_one()
+        assert c.phase == PodPhase.BOUND
+        # the victim's own retry is unrestricted after the failed pin
+        drive(sched, clock, n=10)
+        assert v.phase == PodPhase.BOUND and v.node == "open"
+
+    def test_dest_cache_skips_topology_constrained_victims(self):
+        """Affinity/spread verdicts are location-relative: two same-class
+        victims bound in different domains satisfy their terms near
+        DIFFERENT nodes, so their dry-run destination orders must never
+        be shared through dest_cache (the same pods the engine's
+        feas_ok excludes from the feasible-class memo)."""
+        nodes = [make_tpu_node("a", chips=4), make_tpu_node("b", chips=4)]
+        sched, clock = mk_sched(nodes, SchedulerConfig(
+            defrag_interval_s=1e9, telemetry_max_age_s=1e9))
+        desched = sched.defrag.desched
+        snapshot = sched.snapshot()
+        dest_free = {"a": 4, "b": 4}
+        plain = Pod("plain", labels={"scv/number": "1"})
+        cache = {}
+        desched._fits_elsewhere(plain, "a", snapshot, {}, dest_free, cache)
+        assert cache  # unconstrained classes ARE memoised
+        sticky = Pod.from_manifest({
+            "metadata": {"name": "sticky",
+                         "labels": {"scv/number": "1"}},
+            "spec": {"affinity": {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "x"}},
+                     "topologyKey": "zone"}]}}},
+        })
+        assert sticky.pod_affinity
+        cache = {}
+        desched._fits_elsewhere(sticky, "a", snapshot, {}, dest_free,
+                                cache)
+        assert not cache
+        spread = Pod.from_manifest({
+            "metadata": {"name": "sp", "labels": {"scv/number": "1"}},
+            "spec": {"topologySpreadConstraints": [
+                {"maxSkew": 1, "topologyKey": "zone",
+                 "whenUnsatisfiable": "DoNotSchedule",
+                 "labelSelector": {"matchLabels": {"app": "sp"}}}]},
+        })
+        assert spread.topology_spread
+        cache = {}
+        desched._fits_elsewhere(spread, "a", snapshot, {}, dest_free,
+                                cache)
+        assert not cache
+
+    def test_free_for_all_drops_controller_on_pinned_non_owners(self):
+        """Free-for-all ownership is pinned to replica 0: the other
+        replicas must not keep a permanently-refused loop that wakes
+        every interval and grows the not-owner skip counter forever."""
+        store = TelemetryStore()
+        clock = FakeClock(start=1000.0)
+        for m in [make_tpu_node(f"t{i}", chips=4) for i in range(2)]:
+            m.heartbeat = clock.time()
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        fleet = FleetCoordinator(
+            cluster, SchedulerConfig(telemetry_max_age_s=1e9,
+                                     defrag_interval_s=5.0),
+            replicas=3, clock=clock, mode="free-for-all")
+        assert fleet.replicas[0].engine.defrag is not None
+        assert all(r.engine.defrag is None for r in fleet.replicas[1:])
+
+    def test_fleet_runs_defrag_on_shard0_owner_only(self):
+        store = TelemetryStore()
+        clock = FakeClock(start=1000.0)
+        for m in [make_tpu_node(f"t{i}", chips=4) for i in range(4)]:
+            m.heartbeat = clock.time()
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        fleet = FleetCoordinator(
+            cluster, SchedulerConfig(telemetry_max_age_s=1e9,
+                                     defrag_interval_s=5.0),
+            replicas=2, clock=clock, mode="sharded")
+        rng = random.Random(0)
+        fleet.step(rng)  # lease upkeep assigns shards
+        owners = [bool(r.engine.defrag.owner_check())
+                  for r in fleet.replicas]
+        assert owners.count(True) == 1
+        # ownership follows the shard-0 lease
+        owner = owners.index(True)
+        assert 0 in fleet.replicas[owner].owned
+
+
+# ---------------------------------------- satellite: gang-fail quota claim
+class TestGangFailRetiresQuotaClaim:
+    def _quota_sched(self):
+        nodes = make_v4_slice("s", "2x2x4")
+        cfg = SchedulerConfig(
+            gang_timeout_s=10.0, drf_fairness=True,
+            tenant_quotas=(("acme", 1.0, -1),))
+        return mk_sched(nodes, cfg)
+
+    def test_permit_timeout_frees_the_whole_claim(self):
+        """A gang the quota gate ADMITTED holds an engine-local in-flight
+        claim; assembly timing out must retire it for EVERY parked
+        member immediately — not at the 2x-timeout TTL (ISSUE 10
+        satellite regression)."""
+        sched, clock = self._quota_sched()
+        workers = elastic_gang("q", 4, 0, extra={"scv/tenant": "acme"})
+        for w in workers[:2]:  # the rest never arrive
+            sched.submit(w)
+        drive(sched, clock, n=4)
+        assert len(sched.waiting) == 2
+        assert sched.policy.gang_inflight("acme", None,
+                                          clock.time()) != (0, 0)
+        clock.advance(15.0)  # past the permit deadline, well short of TTL
+        sched.check_waiting()
+        assert not sched.waiting
+        assert sched.policy.gang_inflight("acme", None,
+                                          clock.time()) == (0, 0)
+
+    def test_doomed_gang_frees_the_claim(self):
+        sched, clock = self._quota_sched()
+        sched.config = sched.config.with_(max_attempts=2)
+        workers = elastic_gang("d", 4, 0, extra={"scv/tenant": "acme"})
+        workers[3].labels["scv/memory"] = "999999999"  # can never fit
+        for w in workers:
+            sched.submit(w)
+        drive(sched, clock, n=60, tick=2.0)
+        assert all(w.phase == PodPhase.FAILED for w in workers)
+        assert sched.policy.gang_inflight("acme", None,
+                                          clock.time()) == (0, 0)
+
+
+# ------------------------------------------------------------ off parity
+class TestElasticOffParity:
+    def _trace(self, cfg):
+        nodes = (make_v4_slice("s", "2x2x4")
+                 + [make_tpu_node(f"t{i}", chips=4) for i in range(3)])
+        sched, clock = mk_sched(nodes, cfg)
+        rng = random.Random(11)
+        pods = []
+        for i in range(24):
+            if rng.random() < 0.7:
+                pods.append(Pod(f"p{i}", labels={
+                    "scv/number": str(rng.choice((1, 2))),
+                    "tpu/accelerator": "tpu"}))
+            else:
+                pods.append(Pod(f"p{i}", labels={
+                    "scv/memory": str(rng.choice((1000, 4000)))}))
+        gang = [Pod(f"g-w{i}", labels={
+            "tpu/gang-name": "g", "tpu/gang-size": "2",
+            "scv/number": "4"}) for i in range(2)]
+        for p in pods + gang:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=2000)
+        return [(p.name, p.node, p.labels.get("tpu/assigned-chips"))
+                for p in pods + gang]
+
+    def test_knob_off_and_knob_on_without_labels_are_bit_identical(self):
+        """elasticGangs on with NO tpu/gang-min labels in the workload
+        must place bit-identically to the knob being off entirely (and
+        to the from_profile round-trip) — the acceptance criterion the
+        CI elastic-disabled tier-1 leg re-proves."""
+        base = self._trace(SchedulerConfig(
+            telemetry_max_age_s=1e9, max_attempts=3))
+        knob_on = self._trace(SchedulerConfig(
+            telemetry_max_age_s=1e9, max_attempts=3, elastic_gangs=True))
+        roundtrip = self._trace(SchedulerConfig.from_profile({
+            "schedulerName": "yoda-scheduler",
+            "pluginConfig": [{"name": "yoda-tpu", "args": {
+                "telemetryMaxAgeSeconds": 1e9,
+                "elasticGangs": False,
+                "defragIntervalSeconds": 0}}],
+        }).with_(max_attempts=3))
+        assert base == knob_on == roundtrip
+
+    def test_off_profile_carries_no_elastic_state(self):
+        profile, _, gang_permit = default_profile(SchedulerConfig())
+        assert profile.elastic is None
+        assert gang_permit.elastic is None
+        sched, _ = mk_sched([make_tpu_node("t", chips=4)],
+                            SchedulerConfig())
+        assert sched.elastic is None and sched.defrag is None
+
+    def test_config_roundtrip_parses_elastic_block(self):
+        cfg = SchedulerConfig.from_profile({
+            "pluginConfig": [{"name": "yoda-tpu", "args": {
+                "elasticGangs": True,
+                "defragIntervalSeconds": 30,
+                "maxMigrationsPerPass": 2,
+                "defragCooldownSeconds": 120,
+            }}]})
+        assert cfg.elastic_gangs
+        assert cfg.defrag_interval_s == 30
+        assert cfg.max_migrations_per_pass == 2
+        assert cfg.defrag_cooldown_s == 120
+
+
+# -------------------------------------------------------- observability
+class TestElasticObservability:
+    def test_new_families_round_trip_with_help(self):
+        prometheus_client = pytest.importorskip(
+            "prometheus_client",
+            reason="exposition golden tests need the reference parser")
+        from prometheus_client.parser import text_string_to_metric_families
+        from yoda_scheduler_tpu.utils.obs import Metrics
+
+        m = Metrics()
+        m.inc("defrag_evictions_total",
+              labels={"strategy": "slice-conservation"})
+        m.inc("defrag_evictions_total", labels={"strategy": "compaction"})
+        m.inc("gang_grow_total")
+        m.inc("gang_shrink_total", labels={"reason": "preemption"})
+        m.inc("defrag_passes_total")
+        m.inc("defrag_skips_total", labels={"reason": "breaker-open"})
+        m.inc("defrag_errors_total")
+        text = m.render_prometheus()
+        fams = {}
+        for fam in text_string_to_metric_families(text):
+            for s in fam.samples:
+                fams.setdefault(s.name, {})[
+                    frozenset(s.labels.items())] = s.value
+        assert fams["yoda_tpu_defrag_evictions_total"][
+            frozenset({("strategy", "slice-conservation")})] == 1
+        assert fams["yoda_tpu_gang_shrink_total"][
+            frozenset({("reason", "preemption")})] == 1
+        assert fams["yoda_tpu_gang_grow_total"][frozenset()] == 1
+        for name in ("defrag_evictions_total", "gang_grow_total",
+                     "gang_shrink_total", "defrag_passes_total",
+                     "defrag_skips_total", "defrag_errors_total"):
+            assert f"# HELP yoda_tpu_{name}" in text
+            # registered HELP, not the generated fallback one-liner
+            assert Metrics.HELP.get(name), name
+
+    def test_defrag_pass_is_a_trip_kind(self):
+        from yoda_scheduler_tpu.utils.obs import RING_ONLY_TRIPS, TRIP_KINDS
+
+        assert "defrag_pass" in TRIP_KINDS
+        # ...but ring-only: passes are planned recurring behavior, and a
+        # steady cadence must not grow a dump file per rate-limit window
+        assert "defrag_pass" in RING_ONLY_TRIPS
+
+    def test_defrag_pass_never_auto_dumps(self, tmp_path):
+        from yoda_scheduler_tpu.utils.obs import FlightRecorder
+
+        fr = FlightRecorder(dump_dir=str(tmp_path),
+                            min_dump_interval_s=0.0)
+        for i in range(5):
+            fr.record("defrag_pass", evictions=1, pods=[f"p{i}"])
+        assert fr.dumps == [] and list(tmp_path.iterdir()) == []
+        fr.record("breaker_open")  # real faults still land on disk
+        assert len(fr.dumps) == 1

@@ -19,6 +19,12 @@ from bench import per_pod_ratio, run_scale  # noqa: E402
 def main() -> None:
     small = run_scale(125)   # the bench.py large tier as the reference point
     big = run_scale(625)     # 5000 nodes, 25000 pods
+    # active-defragmentation leg (ISSUE 10): the same 5k burst with the
+    # defrag controller consolidating stray singles mid-drain — the
+    # recovered-multi-chip-capacity measurement ROADMAP item 4 asks for
+    # (tpu-2c failures must drop vs the baseline leg; the CI elastic job
+    # fences the same A/B at the 1000-node tier on every push)
+    big_defrag = run_scale(625, defrag=True)
     ratio = per_pod_ratio(small, big)
     node_ratio = big["nodes"] / small["nodes"]
     out = {
@@ -28,6 +34,11 @@ def main() -> None:
         "sublinear": ratio < node_ratio,
         "large_1000": small,
         "huge_5000": big,
+        "huge_5000_defrag": big_defrag,
+        "tpu2c_failed_baseline": big["per_kind"]["tpu-2c"]["failed"],
+        "tpu2c_failed_defrag": big_defrag["per_kind"]["tpu-2c"]["failed"],
+        "tpu2c_recovered": (big["per_kind"]["tpu-2c"]["failed"]
+                            - big_defrag["per_kind"]["tpu-2c"]["failed"]),
     }
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_SCALE5K.json")

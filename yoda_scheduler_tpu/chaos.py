@@ -75,6 +75,13 @@ NETWORK_PARTITION = "NetworkPartition"
 CLOCK_SKEW = "ClockSkew"
 SLOW_APISERVER = "SlowApiServer"
 WEBHOOK_DOWN = "WebhookDown"
+# elastic/defrag kind (ISSUE 10): force the defrag controller's
+# migration pass at a seeded instant — the descheduler EVICTING a pod
+# while another fleet replica concurrently BINDS onto the same node (and
+# while elastic gangs are mid-growth), so the authority's conflict
+# battery and the controller's safety rails are the only thing standing
+# between active defragmentation and a lost pod / double-booked chip.
+DEFRAG_RACE = "DefragRace"
 
 ALL_KINDS = (APISERVER_STORM, BIND_LOST, TELEMETRY_BLACKOUT, PLUGIN_ERROR,
              ENGINE_CRASH)
@@ -90,6 +97,13 @@ FLEET_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH, LEASE_EXPIRY,
 WEBHOOK_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
                  NETWORK_PARTITION, CLOCK_SKEW, SLOW_APISERVER,
                  WEBHOOK_DOWN)
+# the elastic/defrag fuzz's mix (tests/test_chaos.py): DEFRAG_RACE
+# migrations interleaved with the commit-path stressors, replica
+# crashes, and partitions — elastic gangs grow through all of it, and
+# "no gang ever drops below tpu/gang-min from our own migrations" joins
+# the four global invariants
+ELASTIC_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
+                 NETWORK_PARTITION, DEFRAG_RACE)
 
 
 class LostResponseError(ConnectionError):
@@ -131,10 +145,11 @@ class FaultPlan:
         for _ in range(rng.randint(1, max_windows)):
             kind = rng.choice(kinds)
             start = rng.uniform(0.5, horizon_s * 0.6)
-            if kind in (ENGINE_CRASH, REPLICA_CRASH, LEASE_EXPIRY):
-                # a crash / lease revocation is an instant, not an
-                # interval; the driver fires it once when the clock first
-                # passes `start`
+            if kind in (ENGINE_CRASH, REPLICA_CRASH, LEASE_EXPIRY,
+                        DEFRAG_RACE):
+                # a crash / lease revocation / forced defrag pass is an
+                # instant, not an interval; the driver fires it once when
+                # the clock first passes `start`
                 self.windows.append(FaultWindow(kind, start, start))
                 continue
             dur = rng.uniform(1.0, horizon_s * 0.4)

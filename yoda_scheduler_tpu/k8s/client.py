@@ -1898,18 +1898,35 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
         # fleet replicas run their cycles on their OWN threads: a
         # serve-thread descheduler would read live allocator/filter state
         # mid-mutation (and N per-replica copies would N-fold the
-        # eviction pressure). Defragmentation for fleets is future work;
-        # say so instead of racing.
+        # eviction pressure). Fleet-safe defragmentation exists now —
+        # the ENGINE-thread DefragController (defragIntervalSeconds,
+        # scheduler/elastic/defrag.py) runs inside each replica's cycle
+        # loop gated on shard-0 ownership — so point operators at it.
         deschedulers = []
         if any(e.config.deschedule_interval_s > 0
                for e in sched.engines.values()):
             log.warning("descheduleIntervalSeconds is ignored with "
-                        "fleetReplicas > 1 (not yet fleet-safe)")
+                        "fleetReplicas > 1 (the serve-thread pass is "
+                        "not fleet-safe); use defragIntervalSeconds — "
+                        "the engine-thread defrag controller is fleet-"
+                        "aware (shard-0 owner only)")
     else:
+        # an engine running the defrag controller owns migration for its
+        # profile: a serve-thread pass beside it would keep a SECOND
+        # cooldown book, so one pod could be moved twice per window
+        if any(e.config.deschedule_interval_s > 0
+               and e.config.defrag_interval_s > 0
+               for e in sched.engines.values()):
+            log.warning("descheduleIntervalSeconds is ignored where "
+                        "defragIntervalSeconds is set (the engine-thread "
+                        "defrag controller supersedes the serve-thread "
+                        "pass; two passes would not share a cooldown "
+                        "book)")
         deschedulers = [
             (Descheduler(e), e.config.deschedule_interval_s, [0.0])
             for e in sched.engines.values()
             if e.config.deschedule_interval_s > 0
+            and e.config.defrag_interval_s <= 0
         ]
 
     # pod.key -> k8s uid of the incarnation we handled. A deleted pod

@@ -104,6 +104,15 @@ def _valid_policy_objective(objective: str) -> str:
     return objective
 
 
+def _elastic_default() -> bool:
+    """Elastic gangs (scheduler/elastic/): gangs labeled tpu/gang-min may
+    admit at min replicas and grow toward desired as chips free, and
+    bound elastic gangs become shrink-to-min preemption donors. Default
+    OFF; YODA_ELASTIC=1 enables (CI runs a tier-1 leg with it spelled-out
+    off, the same parity discipline as the policy engine)."""
+    return os.environ.get("YODA_ELASTIC", "0").lower() in ("1", "true", "on")
+
+
 def _drf_default() -> bool:
     """DRF fairness layer (tenant-fairness queue ordering + quota gate
     + preemption budgets): default OFF; YODA_DRF=1 enables."""
@@ -217,6 +226,26 @@ class SchedulerConfig:
     # periodic slice-defragmentation pass (scheduler/deschedule.py);
     # 0 disables. Victim protection + budget use the descheduler defaults.
     deschedule_interval_s: float = 0.0
+    # ---- elastic gangs + active defragmentation (scheduler/elastic/) ----
+    # elastic gangs: tpu/gang-min admission-at-min + event-driven growth
+    # + shrink-to-min preemption donors. OFF by default — with the knob
+    # off (or on but no tpu/gang-min labels in the workload) placements
+    # are bit-identical to the classic engine (tests/test_elastic.py
+    # TestElasticOffParity + the CI elastic-disabled tier-1 leg).
+    elastic_gangs: bool = field(default_factory=_elastic_default)
+    # active defragmentation controller (scheduler/elastic/defrag.py): a
+    # closed loop on the ENGINE thread's injectable clock driving
+    # deschedule.py's slice-conservation/compaction strategies through
+    # the victim-drain path — at most maxMigrationsPerPass evictions per
+    # pass, per-pod cooldowns, and a hard interlock (never migrates
+    # while the bind breaker is open or degraded mode is active; in a
+    # fleet, only the shard-0 owner's replica runs it). 0 disables.
+    defrag_interval_s: float = 0.0
+    max_migrations_per_pass: int = 4
+    # per-pod migration cooldown: a pod the defrag loop moved is immune
+    # for this long (the chaos matrix pins "no pod migrated more than
+    # once per cooldown window")
+    defrag_cooldown_s: float = 300.0
     # columnar data plane: evaluate the vectorizable filter predicates and
     # score terms over the whole node table in one numpy call per cycle
     # (scheduler/columnar.py). The scalar per-node path remains wired in
@@ -383,6 +412,15 @@ class SchedulerConfig:
             topology_weight=int(args.get("topologyWeight", defaults.topology_weight)),
             deschedule_interval_s=float(args.get(
                 "descheduleIntervalSeconds", defaults.deschedule_interval_s)),
+            elastic_gangs=bool(args.get(
+                "elasticGangs", defaults.elastic_gangs)),
+            defrag_interval_s=float(args.get(
+                "defragIntervalSeconds", defaults.defrag_interval_s)),
+            max_migrations_per_pass=max(int(args.get(
+                "maxMigrationsPerPass",
+                defaults.max_migrations_per_pass)), 1),
+            defrag_cooldown_s=float(args.get(
+                "defragCooldownSeconds", defaults.defrag_cooldown_s)),
             async_binding=bool(args.get("asyncBinding",
                                         defaults.async_binding)),
             pod_hinted_backoff_s=float(args.get(

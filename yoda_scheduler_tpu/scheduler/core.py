@@ -156,6 +156,10 @@ class Profile:
         # it; None = the pre-policy engine, placements bit-identical.
         # The Scheduler attaches its cluster/metrics/flight at init.
         self.policy = None
+        # elastic-gang controller (scheduler/elastic/): set when the
+        # elasticGangs knob is on; None = classic all-or-nothing gang
+        # admission, placements bit-identical.
+        self.elastic = None
 
 
 def default_profile(config: SchedulerConfig,
@@ -170,8 +174,16 @@ def default_profile(config: SchedulerConfig,
     double-book chips between Reserve and Bind."""
     allocator = allocator or ChipAllocator()
     gangs = gangs or GangCoordinator()
+    # elastic gangs (scheduler/elastic/): built only when the knob asks —
+    # the off default constructs the EXACT pre-elastic plugin set, so
+    # placements stay bit-identical (tests/test_elastic.py)
+    elastic = None
+    if config.elastic_gangs:
+        from .elastic import ElasticGangs
+
+        elastic = ElasticGangs(config)
     gang_permit = GangPermit(gangs, timeout_s=config.gang_timeout_s,
-                             allocator=allocator)
+                             allocator=allocator, elastic=elastic)
     topo = TopologyScore(allocator, weight=config.topology_weight)
     admission = NodeAdmission(allocator)
     # policy engine (scheduler/policy/): built only when a knob asks for
@@ -220,6 +232,11 @@ def default_profile(config: SchedulerConfig,
         permit=[gang_permit],
     )
     profile.policy = policy
+    if elastic is not None:
+        # the deadline decision reads the policy engine's throughput
+        # model when one exists (built just above)
+        elastic.policy = policy
+    profile.elastic = elastic
     return profile, allocator, gang_permit
 
 
@@ -319,6 +336,29 @@ class Scheduler:
                     self.queue.register_plugin(p)
         self.queue.register_hint("victim-drain", (POD_DELETED,),
                                  lambda ev, pod: QUEUE)
+        # elastic gangs (scheduler/elastic/): growth members — members of
+        # a gang already admitted at >= tpu/gang-min — park under this
+        # distinct hint class and wake when capacity frees (a departing
+        # pod, a joining node), the same machinery as victim-drain
+        self.elastic = getattr(profile, "elastic", None)
+        # gang -> (version vector, bound count): growth members re-check
+        # their gang's cluster-truth size on EVERY failed cycle, and the
+        # O(cluster) pod walk must not be paid per wake — the vector
+        # covers binds/unbinds, so a hit is exact
+        self._gang_count_memo: dict[str, tuple] = {}
+        if self.elastic is not None:
+            from .elastic import ELASTIC_GROW_HINT
+            from .framework import NODE_ADDED, NODE_TELEMETRY_UPDATED
+
+            # NODE_TELEMETRY_UPDATED rides along because chips also free
+            # by RECOVERING (the same event classic gang-permit and the
+            # telemetry filter register for): without it a growth member
+            # parked behind unhealthy chips waits out its full hinted
+            # backoff instead of waking when the slice heals
+            self.queue.register_hint(
+                ELASTIC_GROW_HINT,
+                (POD_DELETED, NODE_ADDED, NODE_TELEMETRY_UPDATED),
+                lambda ev, pod: QUEUE)
         # batch scheduling cycles: every distinct plugin (queue sort and
         # binder included) contributes to the scheduling-equivalence key;
         # one NO_BATCH vote makes a pod per-pod-only (framework.Plugin.
@@ -410,6 +450,8 @@ class Scheduler:
         if self.policy is not None:
             self.policy.attach(self.cluster, self.metrics, self.flight,
                                self.clock)
+        if self.elastic is not None:
+            self.elastic.attach(self.metrics, self.clock)
         self.rng = random.Random(self.config.rng_seed)
         self._filter_start = 0  # rotating offset for percentageOfNodesToScore
         # node -> ((telemetry generation, pods version), NodeInfo) — see
@@ -502,6 +544,19 @@ class Scheduler:
         # engine lands back in B's queue, not A's; standalone engines
         # default to their own submit (which rejects foreign names).
         self.victim_router = None
+        # active defragmentation controller (scheduler/elastic/defrag.py):
+        # a closed migration loop on THIS engine's injectable clock,
+        # gated per pass on the breaker/degraded interlock and — in a
+        # fleet — on shard-0 ownership (FleetCoordinator wires
+        # owner_check). None when the knob is off.
+        self.defrag = None
+        if self.config.defrag_interval_s > 0 and self.allocator is not None:
+            from .elastic import DefragController
+
+            self.defrag = DefragController(
+                self, self.config.defrag_interval_s,
+                max_migrations=self.config.max_migrations_per_pass,
+                cooldown_s=self.config.defrag_cooldown_s)
         # shard-lease fencing (scheduler/fleet.py): when set, called as
         # fence_provider(pod, node) right before every bind dispatch.
         # Returns a fencing token to carry on the bind (owned shard), None
@@ -1869,6 +1924,33 @@ class Scheduler:
             if st.code == Code.ERROR:
                 return self._cycle_error(info, trace, st.message)
 
+        # migration-plan pin (scheduler/elastic/defrag.py): a defrag
+        # victim's FIRST re-placement cycle considers ONLY its planned
+        # destination — the dry-run proved it fits there, and unpinned
+        # re-scoring would bounce it straight back into the hole the
+        # migration just opened. One-shot: a failed pinned cycle (the
+        # destination was taken meanwhile) leaves later retries
+        # unrestricted. Never overrides gang narrowing (victims are
+        # never gang members).
+        if (self.defrag is not None
+                and state.read_or(CANDIDATE_NODES_KEY) is None):
+            pin = self.defrag.take_pin(pod.key)
+            if pin is not None:
+                state.write(CANDIDATE_NODES_KEY, frozenset((pin,)))
+                # class memos are unsound under candidate narrowing: the
+                # pinned one-node scan must neither be STORED class-wide
+                # (a classmate would inherit a single-node feasible list
+                # or an O(1) "no feasible node" verdict while the cluster
+                # has capacity) nor SKIPPED via a feasible-memo hit (the
+                # class list ignores the pin). Gang narrowing never hits
+                # this because is_gang already cleared memo_ok; the pin
+                # is the only narrowing a memo-eligible pod can carry.
+                # (On FakeCluster the victim is also allocator-nominated,
+                # which clears memo_ok anyway — this gate is what keeps
+                # the real-apiserver path sound, where eviction destroys
+                # the incarnation and no nomination is placed.)
+                memo_ok = False
+
         # Filter with early-stop (percentageOfNodesToScore)
         nodes = snapshot.list()
         want = self._num_feasible_to_find(len(nodes))
@@ -2104,9 +2186,24 @@ class Scheduler:
                         info, trace,
                         f"waiting for victims on slice {gnom[0]} to "
                         "terminate", rejected_by=("victim-drain",))
+            # elastic GROWTH members (gang already admitted at >= min in
+            # cluster truth) park event-driven instead of preempting:
+            # growth rides capacity as it frees — the defrag controller
+            # and ordinary departures publish the POD_DELETED wakes —
+            # and never evicts anyone to grow an already-running job
+            out = self._elastic_growth_park(info, spec, trace)
+            if out is not None:
+                return out
             # PostFilter: preemption — the plugin plans, the engine evicts
             out = self._run_post_filter(info, trace, state, pod, spec,
                                         snapshot, now)
+            if out is not None:
+                return out
+            # elastic admit-at-min: preemption could not cure this gang
+            # member either — if enough members are already placed
+            # (parked at Permit + bound), start the gang NOW at reduced
+            # size instead of letting the whole assembly time out
+            out = self._elastic_admit_at_min(info, spec, trace)
             if out is not None:
                 return out
             # build the diagnostic bounded: at 1000 nodes a full join of
@@ -2347,10 +2444,7 @@ class Scheduler:
         if self.gang_permit is not None:
             peers_ok = True
             for peer_key in self.gang_permit.peers_to_approve(pod):
-                w = self.waiting.pop(peer_key, None)
-                if w is not None and not self._bind(
-                        w.info, w.node,
-                        CycleTrace(pod=peer_key, started=w.info.enqueued)):
+                if not self._bind_waiting_peer(peer_key):
                     peers_ok = False
             if spec.is_gang and self.allocator is not None and peers_ok:
                 # gang FULLY bound: its slice entitlement (if it preempted
@@ -2441,6 +2535,12 @@ class Scheduler:
             budgets = self.policy.budgets
             state.write("victim_budget_ok",
                         lambda v: budgets.has_budget(tenant_of(v), now))
+        if self.elastic is not None:
+            # elastic shrink-to-min: surplus members of bound elastic
+            # gangs join the victim pools (preempt._make_shrink_ok) —
+            # the cheaper alternative to untouchable gangs, still under
+            # the PDB ledger and the tenant budgets above
+            state.write("elastic_shrinkable", True)
         for p in self.profile.post_filter:
             if only_nodes is not None:
                 nominated, victims, st = p.post_filter(
@@ -2475,6 +2575,19 @@ class Scheduler:
                 for victim in victims:
                     self.cluster.evict(victim)
                     self.metrics.inc("pods_evicted_total")
+                    if self.elastic is not None:
+                        try:
+                            vspec = spec_for(victim)
+                        except LabelError:
+                            vspec = None
+                        if (vspec is not None and vspec.is_gang
+                                and vspec.gang_min > 0):
+                            # shrink-to-min: the donor gang drops one
+                            # member (never below min — the planner's
+                            # surplus accounting guarantees it) and its
+                            # re-placed member will re-grow it
+                            self.elastic.on_member_evicted(
+                                vspec, reason="preemption")
                     if self.policy is not None:
                         # per-tenant disruption attribution: who LOST a
                         # pod to preemption. A DISTINCT family from the
@@ -2691,6 +2804,19 @@ class Scheduler:
             # republish per-tenant shares/breaches
             self.policy.on_bind(pod)
             self.policy.resolved(pod.key)
+        if self.elastic is not None:
+            # elastic-gang bookkeeping: a bind into a gang admitted below
+            # desired size is a GROW (gang_grow_total); reaching desired
+            # retires the growing record. Gang members always bind
+            # synchronously, so this is wire-proven, never dispatch-time.
+            try:
+                bspec = spec_for(pod)
+            except LabelError:
+                bspec = None
+            if bspec is not None and bspec.is_gang and bspec.gang_min > 0:
+                self.elastic.on_member_bound(
+                    self.cluster, bspec,
+                    n_bound=self._bound_members_of(bspec.gang_name))
         if not dispatched_async:
             # Scheduled is posted on WIRE success only (upstream posts it
             # after the binding subresource lands): sync binds and adopted
@@ -2960,7 +3086,8 @@ class Scheduler:
 
     def _unschedulable(self, info: QueuedPodInfo, trace: CycleTrace, reason: str,
                        outcome: str = "unschedulable",
-                       rejected_by: tuple = ()) -> str:
+                       rejected_by: tuple = (),
+                       gang_doom: bool = True) -> str:
         info.last_failure = reason
         # any orderly non-conflict outcome breaks a 409 streak: the
         # conflict counter means CONSECUTIVE optimistic-race losses, not
@@ -2990,7 +3117,11 @@ class Scheduler:
                 # is the whole point of nominatedNodeName semantics.
                 self.allocator.unnominate(info.pod.key)
         if self.config.max_attempts and info.attempts + 1 >= self.config.max_attempts:
-            self._doom_gang_of(info, reason)
+            if gang_doom:
+                self._doom_gang_of(info, reason)
+            # elastic growth members (gang_doom=False) fail ALONE: the
+            # gang keeps running at its reduced size — permanently
+            # failing a grow attempt must not tear the whole job down
             self._fail_permanently(info, reason, trace=trace)
             return "failed"
         for pname in rejected_by:
@@ -3149,11 +3280,122 @@ class Scheduler:
     def _fail_gang(self, gang: str) -> None:
         """Tear a gang down: reject its parked members (reservations roll
         back, pods requeue with backoff) and release any slice entitlement
-        it won by preemption."""
+        it won by preemption. The policy engine's in-flight tenant quota
+        claim — recorded when the quota gate ADMITTED the gang — is
+        retired here too: a failed assembly holds no capacity, so leaving
+        the claim to its TTL would gate same-tenant work against
+        headroom nobody is using."""
         for key in self.gang_permit.fail_gang(gang):
             self._rollback_waiting(key)
         if self.allocator is not None:
             self.allocator.unnominate_gang(gang)
+        if self.policy is not None:
+            self.policy.gang_failed(gang)
+        if self.elastic is not None:
+            self.elastic.reset(gang)
+
+    # ------------------------------------------------------- elastic gangs
+    def _bound_members_of(self, gang: str) -> int:
+        """Cluster-truth bound member count, memoised on the version
+        vector: growth members ask on every failed cycle, and between
+        cluster changes the answer cannot move. Miss (or no versioned
+        backend) falls through to the full pod walk."""
+        from .elastic import bound_member_count
+
+        vers = self._cluster_versions()
+        if vers is None:
+            return bound_member_count(self.cluster, gang)
+        hit = self._gang_count_memo.get(gang)
+        if hit is not None and hit[0] == vers:
+            return hit[1]
+        n = bound_member_count(self.cluster, gang)
+        if len(self._gang_count_memo) > 4096:
+            self._gang_count_memo.clear()  # churn backstop
+        self._gang_count_memo[gang] = (vers, n)
+        return n
+
+    def _elastic_growth_park(self, info: QueuedPodInfo, spec,
+                             trace: CycleTrace) -> str | None:
+        """A gang member found no capacity, but its gang ALREADY runs at
+        >= tpu/gang-min in cluster truth: it is a GROWTH member. Park it
+        under the elastic-grow hint class (woken by POD_DELETED /
+        NODE_ADDED) with the gang-doom path disarmed — a growth member
+        exhausting max_attempts fails alone; the reduced-size gang keeps
+        running. Returns the outcome, or None when not applicable."""
+        if (self.elastic is None or not spec.is_gang
+                or spec.gang_min <= 0
+                or spec.gang_name in self.doomed_gangs):
+            return None
+        if self._bound_members_of(spec.gang_name) < spec.gang_min:
+            return None
+        from .elastic import ELASTIC_GROW_HINT
+
+        return self._unschedulable(
+            info, trace,
+            f"gang {spec.gang_name}: running at reduced size, waiting "
+            "for chips to grow", rejected_by=(ELASTIC_GROW_HINT,),
+            gang_doom=False)
+
+    def _bind_waiting_peer(self, peer_key: str) -> bool:
+        """Bind a gang member parked at Permit off its held reservation —
+        the peer-approve contract, shared by gang completion and elastic
+        admit-at-min so the two paths cannot diverge. True unless the
+        peer existed and its bind failed (a failed bind requeues the
+        member through _bind's ordinary failure path)."""
+        w = self.waiting.pop(peer_key, None)
+        if w is None:
+            return True
+        return self._bind(w.info, w.node,
+                          CycleTrace(pod=peer_key, started=w.info.enqueued))
+
+    def _elastic_admit_at_min(self, info: QueuedPodInfo, spec,
+                              trace: CycleTrace) -> str | None:
+        """A gang member found no capacity and preemption produced no
+        plan. If the gang has >= tpu/gang-min members placed (parked at
+        Permit + bound), admit it AT THE CURRENT SIZE: bind the parked
+        members now — their reservations are consumed, exactly the
+        peer-approve path — and park THIS member (and, next cycles, any
+        other unplaced member) for growth. Returns the outcome, or None
+        when not applicable (the caller then takes the ordinary
+        unschedulable path, assembly keeps waiting for full size)."""
+        if (self.elastic is None or not spec.is_gang
+                or spec.gang_min <= 0 or self.gang_permit is None
+                or spec.gang_name in self.doomed_gangs):
+            return None
+        gang = spec.gang_name
+        waiting = [k for k in self.gang_permit.gangs.waiting_members(gang)
+                   if k in self.waiting]
+        n_bound = self._bound_members_of(gang)
+        if not waiting or n_bound + len(waiting) < spec.gang_min:
+            return None
+        # record the admission FIRST: the members binding right now are
+        # the floor, not growth (on_member_bound decrements the initial
+        # allowance before counting grows)
+        self.elastic.note_admitted_at_min(gang, initial=len(waiting),
+                                          reason="no-fit")
+        for peer_key in self.gang_permit.fail_gang(gang):
+            self._bind_waiting_peer(peer_key)
+        # a peer bind can fail at the wire (outage): _bind requeued it
+        # with backoff, but the gang must not stand "admitted" below min.
+        # Withdraw the elastic record — the requeued members re-enter
+        # CLASSIC assembly (permit counts cluster-truth bound members
+        # toward completeness), so nothing is lost, and no below-min gang
+        # is ever left running under an admitted-at-min banner.
+        if self._bound_members_of(gang) < spec.gang_min:
+            self.elastic.reset(gang)
+            self.flight.record("elastic_admit_aborted", gang=gang,
+                               reason="peer bind failed below min")
+            return self._unschedulable(
+                info, trace,
+                f"gang {gang}: admit-at-min aborted (peer bind failed)",
+                gang_doom=False)
+        from .elastic import ELASTIC_GROW_HINT
+
+        return self._unschedulable(
+            info, trace,
+            f"gang {gang}: admitted at min ({spec.gang_min}/"
+            f"{spec.gang_size}), waiting for chips to grow",
+            rejected_by=(ELASTIC_GROW_HINT,), gang_doom=False)
 
     def _doom_parked_members(self, gang: str, reason: str) -> None:
         """Permanently fail the gang's parked members (doomed-gang path:
@@ -3168,6 +3410,11 @@ class Scheduler:
                 continue
             self._unreserve_waiting(w)
             self._fail_permanently(w.info, reason)
+        if self.policy is not None:
+            # the doomed gang's in-flight tenant quota claim dies with it
+            self.policy.gang_failed(gang)
+        if self.elastic is not None:
+            self.elastic.reset(gang)
 
     def _fail_permanently(self, info: QueuedPodInfo, reason: str,
                           trace: CycleTrace | None = None) -> None:
@@ -3307,6 +3554,17 @@ class Scheduler:
             # queue wake at the breaker deadline)
             self.metrics.inc("breaker_parked_cycles_total")
             return None
+        if self.defrag is not None:
+            # active defragmentation tick (engine thread, injectable
+            # clock): run a migration pass when due — behind the breaker
+            # gate above, so an open circuit never migrates, and guarded
+            # inside against degraded mode / fleet ownership / no demand
+            try:
+                self.defrag.maybe_run(self.clock.time())
+            except Exception:
+                # the controller is best-effort: a planning crash must
+                # not take the scheduling loop down with it
+                self.metrics.inc("defrag_errors_total")
         maxp = self.config.batch_max_pods
         if maxp > 1:
             if self.allocator is None or self.allocator.has_holds():
@@ -3379,6 +3637,20 @@ class Scheduler:
             # an open circuit breaker defers queue work (but never permit
             # deadlines — check_waiting still runs while parked)
             wakes.append(max(nxt, self._breaker_until))
+        if self.defrag is not None:
+            # the defrag pass is a wake source only while pods are
+            # PENDING (its demand gate): a due pass may free exactly the
+            # chips a parked pod needs, and without this wake a
+            # run_until_idle drain would sleep past it. With nothing
+            # pending the controller would no-op, so idle stays idle.
+            # The gate is maybe_run's own (DefragController.demanded —
+            # fleet-wide when wired, so a shard-0 owner with an empty
+            # local queue still wakes for passes other replicas need).
+            # Floored at the breaker deadline like the queue wake above:
+            # run_one returns at the breaker gate BEFORE the defrag tick,
+            # so a due next_at would otherwise spin the wait loop.
+            if self.defrag.demanded():
+                wakes.append(max(self.defrag.next_at, self._breaker_until))
         return min(wakes) if wakes else None
 
     def run_until_idle(self, max_cycles: int = 100_000) -> int:

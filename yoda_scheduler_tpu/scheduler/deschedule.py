@@ -41,9 +41,20 @@ from ..utils.pod import Pod
 
 @dataclass
 class DeschedulePlan:
-    """What a pass would do: victims + the reasons, for operators/tests."""
+    """What a pass would do: victims + the reasons, for operators/tests.
+    `strategies` attributes each victim to the strategy that picked it
+    ("slice-conservation" | "compaction") — the defrag controller's
+    defrag_evictions_total{strategy} label reads it. `destinations`
+    (pod.key -> node) is the MIGRATION PLAN: the standalone node the
+    dry-run proved accepts the victim; run_once nominates the victim
+    onto it so its re-placement cycle lands there instead of re-scoring
+    the cluster — without the pin, the freed hole scores at least as
+    well as anywhere else and the victim bounces straight back into it,
+    churning forever while the pod the migration was FOR never fits."""
     victims: list[Pod] = field(default_factory=list)
     reasons: dict[str, str] = field(default_factory=dict)  # pod.key -> why
+    strategies: dict[str, str] = field(default_factory=dict)
+    destinations: dict[str, str] = field(default_factory=dict)
 
     def __bool__(self) -> bool:
         return bool(self.victims)
@@ -59,6 +70,9 @@ class Descheduler:
         self.max_evictions = max_evictions_per_pass
         self.cooldown_s = cooldown_s
         self._recent: dict[str, float] = {}  # pod.key -> last eviction time
+        # rotating collection offset: successive bounded passes start
+        # their node walk at different positions (see plan's work cap)
+        self._scan_start = 0
 
     # ------------------------------------------------------------------ plan
     def plan(self) -> DeschedulePlan:
@@ -66,6 +80,28 @@ class Descheduler:
 
         plan = DeschedulePlan()
         snapshot = self.sched.snapshot()
+        # destination capacity pre-scan: one walk over the non-slice
+        # nodes (free counts ride the allocator's per-node cache). A
+        # saturated cluster — the common steady state once a drain
+        # consumed everything — has nowhere to migrate anything, and
+        # bailing here keeps the closed defrag loop's no-op passes
+        # O(nodes) cheap instead of paying candidate collection plus
+        # dry-run filter fan-outs for nothing.
+        dest_free: dict[str, int] = {}
+        for ni in snapshot.list():
+            dm = ni.metrics
+            if dm is None or (dm.slice_id and dm.num_hosts > 1):
+                continue
+            f = len(self.sched.allocator.free_coords(ni))
+            if f > 0:
+                dest_free[ni.name] = f
+        if not dest_free:
+            return plan
+        # per-plan destination memo: victims sharing a scheduling class
+        # (the engine's memo key: spec + selectors + namespace) share one
+        # dry-run filter fan-out instead of paying O(nodes) each — the
+        # 1-chip strays a fragmented fleet accumulates are all one class
+        dest_cache: dict = {}
         # Defrag moves are OPTIONAL work: unlike preemption (which may
         # violate a budget when nothing else places the pod), a move that
         # would breach a PodDisruptionBudget is simply not worth making —
@@ -80,7 +116,19 @@ class Descheduler:
         # defrag victim per node per pass — the first eviction may already
         # deliver the enlarged block a second candidate was credited with
         candidates: list[tuple[Pod, str, str, bool]] = []
-        for ni in snapshot.list():
+        # per-pass work bound: collection stops once the pool is 8x the
+        # eviction budget — a 5k-node fleet mid-drain has thousands of
+        # movable strays, and walking every one's block math per pass
+        # would make the closed loop's tick cost O(cluster * strays)
+        # (the rotating start keeps later passes looking at different
+        # nodes, so bounded collection still covers the fleet over time)
+        cap = 8 * self.max_evictions
+        nodes_in_order = snapshot.list()
+        start = self._scan_start % max(len(nodes_in_order), 1)
+        self._scan_start += 1
+        for ni in (nodes_in_order[start:] + nodes_in_order[:start]):
+            if len(candidates) >= cap:
+                break
             m = ni.metrics
             if m is None or m.accelerator != "tpu":
                 continue
@@ -129,6 +177,23 @@ class Descheduler:
                         (p, ni.name,
                          f"defragments {ni.name}: largest free block "
                          f"{current} -> {better} after eviction", True))
+        # round-robin the candidates ACROSS nodes: node-major order spends
+        # the whole eviction budget denting ONE host deep while its
+        # neighbours keep their strays — one victim per host per round
+        # frees a pair (or a whole host) on the most nodes per pass,
+        # which is what both consumers want (2-chip capacity recovery
+        # and gang-slice reassembly both count freed HOSTS, not freed
+        # chips on one host)
+        by_node: dict[str, list] = {}
+        for cand in candidates:
+            by_node.setdefault(cand[1], []).append(cand)
+        interleaved: list[tuple[Pod, str, str, bool]] = []
+        rounds = max((len(v) for v in by_node.values()), default=0)
+        for r in range(rounds):
+            for node_cands in by_node.values():
+                if r < len(node_cands):
+                    interleaved.append(node_cands[r])
+        candidates = interleaved
         # chips already promised to earlier victims of THIS plan, per
         # destination — two victims must not be "proven" to fit in the
         # same free slot
@@ -144,7 +209,8 @@ class Descheduler:
                 continue  # recently moved; don't thrash the workload
             if ledger.would_violate(pod):
                 continue  # optional move never breaches a disruption budget
-            dest = self._fits_elsewhere(pod, node, snapshot, planned)
+            dest = self._fits_elsewhere(pod, node, snapshot, planned,
+                                        dest_free, dest_cache)
             if dest is not None:
                 if is_defrag:
                     defrag_done.add(node)
@@ -154,6 +220,9 @@ class Descheduler:
                     pass
                 plan.victims.append(pod)
                 plan.reasons[pod.key] = reason
+                plan.strategies[pod.key] = ("compaction" if is_defrag
+                                            else "slice-conservation")
+                plan.destinations[pod.key] = dest
                 ledger.consume([pod])
         return plan
 
@@ -181,43 +250,71 @@ class Descheduler:
         return True
 
     def _fits_elsewhere(self, pod: Pod, current_node: str, snapshot,
-                        planned: dict[str, int]) -> str | None:
+                        planned: dict[str, int],
+                        dest_free: dict[str, int],
+                        dest_cache: dict) -> str | None:
         """Dry-run the live filter path: returns the name of a STANDALONE
         node that accepts the pod as things stand (not counting space the
         eviction itself frees, and not counting chips already promised to
         earlier victims of this plan via `planned`). Multi-host slice
         hosts are not destinations — moving a stray from one gang slice to
         another (or around the same slice) just relocates the
-        fragmentation."""
-        from .framework import CycleState
-
-        state = CycleState()
-        state.write("now", self.sched.clock.time())
-        # the live filter path reads the snapshot for inter-pod affinity;
-        # omitting it would silently skip those checks in the dry-run and
-        # evict a pod the real cycle then refuses to place
-        state.write("snapshot", snapshot)
+        fragmentation. The filter fan-out is memoised per scheduling
+        class for this plan (`dest_cache`; the snapshot is frozen, so
+        same-class verdicts are verbatim repeats), while the
+        planned-chips bookkeeping stays per victim."""
         try:
             spec = spec_for(pod)
         except LabelError:
             return None
-        state.write("workload_spec", spec)
-        for ni in snapshot.list():
-            if ni.name == current_node:
+        # _memo_key_of omits hostPorts, so two same-class victims with
+        # different port claims would wrongly share a verdict — such pods
+        # dry-run uncached (same exclusion the batcher applies). And the
+        # anti-affinity SYMMETRY rule makes a bound pod's selector read
+        # ARBITRARY victim labels the class key cannot see, so no verdict
+        # is shareable while any bound pod carries anti-affinity (the
+        # engine gates its unsched/feasible memos identically). The
+        # victim's OWN topology constraints are location-relative too:
+        # two same-class victims bound in different zones satisfy a
+        # required affinity term (or a spread skew) near DIFFERENT
+        # nodes, so their destination orders must not be shared — the
+        # same pods the engine's feas_ok sends to the full scan.
+        cacheable = (not getattr(pod, "host_ports", None)
+                     and not pod.topology_spread
+                     and not pod.pod_affinity
+                     and not pod.pod_anti_affinity
+                     and not snapshot.any_pod_anti_affinity())
+        key = Scheduler._memo_key_of(pod, spec) if cacheable else None
+        order = dest_cache.get(key) if cacheable else None
+        if order is None:
+            from .framework import CycleState
+
+            state = CycleState()
+            state.write("now", self.sched.clock.time())
+            # the live filter path reads the snapshot for inter-pod
+            # affinity; omitting it would silently skip those checks in
+            # the dry-run and evict a pod the real cycle then refuses to
+            # place
+            state.write("snapshot", snapshot)
+            state.write("workload_spec", spec)
+            order = []
+            for ni in snapshot.list():
+                if ni.name not in dest_free:
+                    continue  # slice host, or nothing free
+                ok = True
+                for f in self.sched.profile.filter:
+                    if not f.filter(state, pod, ni).ok:
+                        ok = False
+                        break
+                if ok:
+                    order.append(ni.name)
+            if cacheable:
+                dest_cache[key] = order
+        for name in order:
+            if name == current_node:
                 continue
-            m = ni.metrics
-            if m is None or (m.slice_id and m.num_hosts > 1):
-                continue
-            free = len(self.sched.allocator.free_coords(ni))
-            if free - planned.get(ni.name, 0) < spec.chips:
-                continue
-            ok = True
-            for f in self.sched.profile.filter:
-                if not f.filter(state, pod, ni).ok:
-                    ok = False
-                    break
-            if ok:
-                return ni.name
+            if dest_free[name] - planned.get(name, 0) >= spec.chips:
+                return name
         return None
 
     # --------------------------------------------------------------- execute
@@ -238,8 +335,26 @@ class Descheduler:
             self.sched.cluster.evict(pod)
             self.sched.metrics.inc("pods_descheduled_total")
             self._recent[pod.key] = now
-            if local and not self.sched.submit(pod):
-                self.sched.metrics.inc("deschedule_requeue_failed_total")
+            if local:
+                # enforce the migration plan: nominate the victim onto
+                # the destination the dry-run proved (its next cycle
+                # evaluates that node FIRST and the hold keeps the spot),
+                # and resubmit on THIS engine — the nomination lives in
+                # this engine's allocator, so a fleet's shard routing
+                # must not carry the pod to a replica that cannot see it
+                dest = plan.destinations.get(pod.key)
+                if dest is not None and self.sched.allocator is not None:
+                    try:
+                        spec = spec_for(pod)
+                        self.sched.allocator.nominate(
+                            pod.key, dest, spec.chips, spec.priority,
+                            cpu_millis=pod.cpu_millis,
+                            memory_bytes=pod.memory_bytes,
+                            host_ports=pod.host_ports)
+                    except LabelError:
+                        pass
+                if not self.sched.submit(pod):
+                    self.sched.metrics.inc("deschedule_requeue_failed_total")
         if self._recent and len(self._recent) > 10_000:
             cutoff = now - self.cooldown_s
             self._recent = {k: t for k, t in self._recent.items()

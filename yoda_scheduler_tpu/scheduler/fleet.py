@@ -349,6 +349,30 @@ class FleetCoordinator:
         # replica as its own process row in the Perfetto UI
         engine.spans.pid = idx
         engine.victim_router = self.submit
+        if engine.defrag is not None:
+            # exactly ONE replica runs the defrag loop at a time: N
+            # replicas each migrating the same stray pod would multiply
+            # churn N-fold and race each other's placements. Sharded
+            # fleets key it on shard-0 ownership (lease-backed, so a
+            # crashed owner's successor picks the loop up with the
+            # shard); free-for-all fleets pin it to replica 0.
+            if self.sharded:
+                engine.defrag.owner_check = (lambda r=rep: 0 in r.owned)
+            elif idx != 0:
+                # free-for-all ownership is PINNED to replica 0, so a
+                # non-zero replica's controller could never run — drop it
+                # outright instead of leaving a permanently-refused loop
+                # that wakes every interval and grows the not-owner skip
+                # counter forever (sharded replicas keep theirs because
+                # the shard-0 lease, and the loop with it, can move)
+                engine.defrag = None
+            if engine.defrag is not None:
+                # demand is FLEET-wide: the pod a migration unblocks
+                # usually queues on a different replica than the defrag
+                # owner (advisory cross-thread reads, like tracks())
+                engine.defrag.demand_check = (
+                    lambda: any(len(r.engine.queue) or r.engine.waiting
+                                for r in self.replicas))
         if self.sharded:
             if self._wire_leases:
                 from ..k8s.leaderelect import ShardLeaseManager

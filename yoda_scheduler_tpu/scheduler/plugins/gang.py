@@ -178,10 +178,13 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
         return QUEUE  # capacity events: a slice may now fit the gang
 
     def __init__(self, gangs: GangCoordinator, timeout_s: float = 30.0,
-                 allocator=None) -> None:
+                 allocator=None, elastic=None) -> None:
         self.gangs = gangs
         self.timeout_s = timeout_s
         self.allocator = allocator  # ChipAllocator, for multi-slice planning
+        # ElasticGangs controller (scheduler/elastic/): None = classic
+        # all-or-nothing admission, placements bit-identical
+        self.elastic = elastic
 
     def equivalence_key(self, pod):
         """Batch-cycle contract: gang members carry cross-pod assembly
@@ -343,10 +346,29 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
         # failure, scheduler restart mid-assembly) instead of parking them
         # at 1/N forever
         bound, _, _ = bound_gang_members(state, spec.gang_name)
-        n = n_waiting + len(bound - {pod.key})
+        n_bound = len(bound - {pod.key})
+        n = n_waiting + n_bound
         if n >= spec.gang_size:
             # gang complete: this pod proceeds; the engine approves the rest
             return Status.success(), 0.0
+        if self.elastic is not None and spec.gang_min > 0:
+            self.elastic.note_member_seen(spec.gang_name,
+                                          state.read_or("now"))
+            if n_bound >= spec.gang_min:
+                # GROW: the gang already runs at (at least) min in
+                # cluster truth — assembly is over, each further member
+                # binds the moment it places (the engine counts the
+                # bind via elastic.on_member_bound)
+                return Status.success(), 0.0
+            if (n >= spec.gang_min
+                    and self.elastic.deadline_pressed(
+                        spec, state.read_or("now"))):
+                # deadline/SLO pressure: waiting for full assembly risks
+                # the start deadline — admit at the current (>= min)
+                # size; the engine approves the parked peers
+                self.elastic.note_admitted_at_min(
+                    spec.gang_name, initial=n_waiting, reason="deadline")
+                return Status.success(), 0.0
         return Status.wait(
             f"gang {spec.gang_name}: {n}/{spec.gang_size} members placed"
         ), self.timeout_s

@@ -33,6 +33,19 @@ def _priority(pod: Pod) -> int:
         return 0
 
 
+def _shrinkable_gang_of(pod: Pod) -> str | None:
+    """The pod's gang name when it is an ELASTIC gang member (carries a
+    positive tpu/gang-min) — the only gang members shrink-to-min may
+    consider; None otherwise."""
+    try:
+        spec = spec_for(pod)
+    except LabelError:
+        return None
+    if spec.is_gang and spec.gang_min > 0:
+        return spec.gang_name
+    return None
+
+
 def _evictable(pod: Pod) -> bool:
     """Gang members are never preemption victims: evicting one strands its
     peers bound and holding chips — exactly the partial-gang deadlock
@@ -82,9 +95,22 @@ class PriorityPreemption(PostFilterPlugin):
             snapshot.budgets,
             [p for ni in snapshot.list() for p in ni.pods]
             if snapshot.budgets else ())
+        # elastic shrink-to-min (scheduler/elastic/): bound members of an
+        # elastic gang running ABOVE its tpu/gang-min are preemption
+        # donors — a strictly cheaper plan than the previous only option
+        # (gangs untouchable). Per-plan surplus accounting (`shrink_taken`
+        # consumed at pick time) guarantees no plan ever takes a gang
+        # below its min; conservative across the whole planning pass, so
+        # candidate plans that lose the ranking still count against the
+        # surplus they would have spent.
+        shrink_ok = None
+        shrink_taken: dict[str, int] = {}
+        if state.read_or("elastic_shrinkable"):
+            shrink_ok = self._make_shrink_ok(snapshot, shrink_taken)
         if spec.is_gang:
             return self._gang_post_filter(state, spec, my_prio, pod,
-                                          snapshot, now, ledger)
+                                          snapshot, now, ledger,
+                                          shrink_ok, shrink_taken)
         # per-tenant preemption budgets (scheduler/policy/): a tenant
         # with NO remaining budget contributes no victims, so the
         # planner routes around it toward admissible plans instead of
@@ -95,7 +121,9 @@ class PriorityPreemption(PostFilterPlugin):
         # fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
         def evictable_victim(p: Pod) -> bool:
-            return (_priority(p) < my_prio and _evictable(p)
+            return (_priority(p) < my_prio
+                    and (_evictable(p)
+                         or (shrink_ok is not None and shrink_ok(p)))
                     and (victim_ok is None or victim_ok(p)))
 
         for node in snapshot.list():
@@ -122,12 +150,31 @@ class PriorityPreemption(PostFilterPlugin):
                 continue
             victims = self._plan_node(spec, my_prio, node, pod_key=pod.key,
                                       ledger=ledger, pod=pod, now=now,
-                                      victim_ok=victim_ok)
+                                      victim_ok=victim_ok,
+                                      shrink_ok=shrink_ok,
+                                      shrink_taken=shrink_taken)
             if victims is None:
                 continue  # capacity unreachable even with evictions
             seen_keys = {v.key for v in victims}
-            full = victims + [o for o in obstacles
-                              if o.key not in seen_keys]
+            extra = [o for o in obstacles if o.key not in seen_keys]
+            # affinity obstacles folded into the plan consume gang
+            # surplus too — and must be RE-GATED against the live
+            # surplus here: _plan_node's picks may have exhausted it
+            # since preemption_obstacles admitted the obstacle, and an
+            # unevictable obstacle invalidates the whole node's plan
+            # (evicting around it would repeat every cycle)
+            obstacle_blocked = False
+            for o in extra:
+                g = _shrinkable_gang_of(o)
+                if g is None:
+                    continue
+                if shrink_ok is None or not shrink_ok(o):
+                    obstacle_blocked = True
+                    break
+                shrink_taken[g] = shrink_taken.get(g, 0) + 1
+            if obstacle_blocked:
+                continue
+            full = victims + extra
             if not full:
                 # fits as-is with no conflicts to clear: the
                 # infeasibility has a cause preemption cannot cure
@@ -146,9 +193,37 @@ class PriorityPreemption(PostFilterPlugin):
         state.write("preempt_pdb_violations", best[0][0])
         return best[1], best[2], Status.success()
 
+    @staticmethod
+    def _make_shrink_ok(snapshot: Snapshot, taken: dict):
+        """Shrink-to-min victim predicate over one plan's lifetime:
+        True for a bound elastic-gang member whose gang still has
+        surplus above tpu/gang-min AFTER the members this plan already
+        picked (`taken` is consumed at pick time by _plan_node). Bound
+        counts come from the plan's own snapshot — cluster truth, so
+        fleet replicas and restarts agree — computed lazily once per
+        gang per plan."""
+        counts: dict[str, int] = {}
+
+        def shrink_ok(p: Pod) -> bool:
+            if p.terminating:
+                return False
+            gang = _shrinkable_gang_of(p)
+            if gang is None:
+                return False
+            n = counts.get(gang)
+            if n is None:
+                n = sum(1 for ni in snapshot.list() for q in ni.pods
+                        if q.labels.get(GANG_NAME_LABEL) == gang
+                        and not q.terminating)
+                counts[gang] = n
+            return n - taken.get(gang, 0) > spec_for(p).gang_min
+
+        return shrink_ok
+
     def _gang_post_filter(self, state: CycleState, spec: WorkloadSpec,
                           my_prio: int, pod: Pod, snapshot: Snapshot,
-                          now, ledger: DisruptionLedger
+                          now, ledger: DisruptionLedger,
+                          shrink_ok=None, shrink_taken=None
                           ) -> tuple[str | None, list[Pod], Status]:
         """All-or-nothing slice eviction for a gang (VERDICT r2 item 4b —
         the workload MOST likely to find its slice dented by low-priority
@@ -220,7 +295,9 @@ class PriorityPreemption(PostFilterPlugin):
                 victims = self._plan_node(spec, my_prio, host, pod_key=pod.key,
                                           ledger=ledger, pod=pod, now=now,
                                           victim_ok=state.read_or(
-                                              "victim_budget_ok"))
+                                              "victim_budget_ok"),
+                                          shrink_ok=shrink_ok,
+                                          shrink_taken=shrink_taken)
                 if victims is None:
                     continue  # this host can't reach spec.chips at all
                 # per-host cost leads with this host's own PDB violations
@@ -279,7 +356,8 @@ class PriorityPreemption(PostFilterPlugin):
                    ledger: DisruptionLedger | None = None,
                    pod: Pod | None = None,
                    now: float | None = None,
-                   victim_ok=None) -> list[Pod] | None:
+                   victim_ok=None, shrink_ok=None,
+                   shrink_taken=None) -> list[Pod] | None:
         """Victims on this node that free `spec.chips` qualifying chips AND
         (when `pod` carries container requests and the node reports
         allocatable) enough cpu/memory: [] when the node already fits
@@ -336,9 +414,13 @@ class PriorityPreemption(PostFilterPlugin):
             return []  # fits as-is; nothing to evict here
         # fast reject before sorting: with no evictable lower-priority pod
         # the target is unreachable. This is the common case for every node
-        # during an unschedulable burst.
+        # during an unschedulable burst. Elastic shrink-to-min extends the
+        # pool with surplus members of elastic gangs (re-checked at every
+        # pick so one plan can never take a gang below its min).
         pool = [p for p in node.pods
-                if _priority(p) < my_prio and _evictable(p)
+                if _priority(p) < my_prio
+                and (_evictable(p)
+                     or (shrink_ok is not None and shrink_ok(p)))
                 and (victim_ok is None or victim_ok(p))]
         if not pool:
             return None
@@ -360,6 +442,14 @@ class PriorityPreemption(PostFilterPlugin):
                 return None
             chips_met = len(free & ok_coords) - hold >= spec.chips
             candidates = pool
+            if shrink_ok is not None:
+                # re-gate gang members against the LIVE surplus: an
+                # earlier pick (this node or an earlier host of a gang
+                # plan) may have consumed the last member above min
+                candidates = [p for p in candidates
+                              if _evictable(p) or shrink_ok(p)]
+                if not candidates:
+                    return None
             if chips_met:
                 # only the resource target remains: restrict picks to pods
                 # that actually free some of the short resource — evicting
@@ -381,6 +471,10 @@ class PriorityPreemption(PostFilterPlugin):
                 tracker.consume_one(v)
             pool.remove(v)
             victims.append(v)
+            if shrink_taken is not None:
+                g = _shrinkable_gang_of(v)
+                if g is not None:
+                    shrink_taken[g] = shrink_taken.get(g, 0) + 1
             free = free | v.assigned_chips()
             used_cpu -= v.cpu_millis
             used_mem -= v.memory_bytes
@@ -397,6 +491,10 @@ class PriorityPreemption(PostFilterPlugin):
                              and used_mem + v.memory_bytes + need_mem
                              <= node.allocatable[1]))):
                 victims.remove(v)
+                if shrink_taken is not None:
+                    g = _shrinkable_gang_of(v)
+                    if g is not None and shrink_taken.get(g, 0) > 0:
+                        shrink_taken[g] -= 1
                 free = without
                 used_cpu += v.cpu_millis
                 used_mem += v.memory_bytes

@@ -615,6 +615,15 @@ class PolicyEngine:
         if self.book is not None:
             self.book.refresh()
 
+    def gang_failed(self, gang: str) -> None:
+        """Assembly failed (Permit timeout, doomed gang, external
+        deletion of a parked member): retire the gang's engine-local
+        in-flight quota claim NOW. Without this the claim lingered until
+        its TTL (2x gang_timeout_s), gating same-tenant admissions
+        against headroom the dead assembly no longer holds — the engine
+        calls this from every fail_gang path (ISSUE 10 satellite)."""
+        self._gang_inflight.pop(gang, None)
+
     def note_wait(self, pod, waited_s: float) -> None:
         """Starvation watch: a pod still unbound past the configured
         threshold trips the flight recorder once and counts per tenant

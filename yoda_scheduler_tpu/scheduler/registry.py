@@ -27,12 +27,13 @@ from .plugins import (
     TopologyScore,
 )
 
-# shared-state objects (allocator, gang coordinator, policy engine) are
-# built once per profile and injected into every plugin factory that
-# wants them; `policy` is None unless the config's policy knobs (or an
-# explicitly-enabled policy plugin) ask for one
+# shared-state objects (allocator, gang coordinator, policy engine,
+# elastic-gang controller) are built once per profile and injected into
+# every plugin factory that wants them; `policy`/`elastic` are None
+# unless the config's knobs (or an explicitly-enabled plugin) ask
 Factory = Callable[
-    [SchedulerConfig, ChipAllocator, GangCoordinator, object], object]
+    [SchedulerConfig, ChipAllocator, GangCoordinator, object, object],
+    object]
 
 _REGISTRY: dict[str, Factory] = {}
 
@@ -47,23 +48,26 @@ def registered() -> list[str]:
     return sorted(_REGISTRY)
 
 
-register("priority-sort", lambda cfg, alloc, gangs, pol: PrioritySort())
-register("node-admission", lambda cfg, alloc, gangs, pol: NodeAdmission(alloc))
+register("priority-sort", lambda cfg, alloc, gangs, pol, el: PrioritySort())
+register("node-admission",
+         lambda cfg, alloc, gangs, pol, el: NodeAdmission(alloc))
 register("telemetry-filter",
-         lambda cfg, alloc, gangs, pol: TelemetryFilter(
+         lambda cfg, alloc, gangs, pol, el: TelemetryFilter(
              alloc, gangs, cfg.telemetry_max_age_s))
-register("max-collection", lambda cfg, alloc, gangs, pol: MaxCollection(alloc))
+register("max-collection",
+         lambda cfg, alloc, gangs, pol, el: MaxCollection(alloc))
 register("telemetry-score",
-         lambda cfg, alloc, gangs, pol: TelemetryScore(
+         lambda cfg, alloc, gangs, pol, el: TelemetryScore(
              alloc, cfg.weights, weight=1))
 register("topology-score",
-         lambda cfg, alloc, gangs, pol: TopologyScore(
+         lambda cfg, alloc, gangs, pol, el: TopologyScore(
              alloc, weight=cfg.topology_weight))
 register("gang-permit",
-         lambda cfg, alloc, gangs, pol: GangPermit(
-             gangs, timeout_s=cfg.gang_timeout_s, allocator=alloc))
+         lambda cfg, alloc, gangs, pol, el: GangPermit(
+             gangs, timeout_s=cfg.gang_timeout_s, allocator=alloc,
+             elastic=el))
 register("priority-preemption",
-         lambda cfg, alloc, gangs, pol: PriorityPreemption(alloc, gangs))
+         lambda cfg, alloc, gangs, pol, el: PriorityPreemption(alloc, gangs))
 
 
 def _hetero(cfg, pol):
@@ -89,9 +93,12 @@ def _quota_gate(pol):
 # policy-engine plugins (scheduler/policy/): not in DEFAULT_ENABLED —
 # the knobs (policyObjective / drfFairness / tenants) or an explicit
 # `plugins:` enablement opt a deployment in
-register("heterogeneity-score", lambda cfg, alloc, gangs, pol: _hetero(cfg, pol))
-register("tenant-fairness-sort", lambda cfg, alloc, gangs, pol: _fair_sort(pol))
-register("tenant-quota-gate", lambda cfg, alloc, gangs, pol: _quota_gate(pol))
+register("heterogeneity-score",
+         lambda cfg, alloc, gangs, pol, el: _hetero(cfg, pol))
+register("tenant-fairness-sort",
+         lambda cfg, alloc, gangs, pol, el: _fair_sort(pol))
+register("tenant-quota-gate",
+         lambda cfg, alloc, gangs, pol, el: _quota_gate(pol))
 
 _POLICY_PLUGINS = frozenset({
     "heterogeneity-score", "tenant-fairness-sort", "tenant-quota-gate"})
@@ -157,13 +164,21 @@ def build_profile(config: SchedulerConfig,
         from .policy import PolicyEngine
 
         policy = PolicyEngine(config)
+    # elastic-gang controller (scheduler/elastic/): the knob opts in;
+    # shared by GangPermit and the engine (admission decisions + metrics)
+    elastic = None
+    if config.elastic_gangs:
+        from .elastic import ElasticGangs
+
+        elastic = ElasticGangs(config, policy=policy)
     built: dict[str, object] = {}
 
     def get(name: str):
         if name not in built:
             if name not in _REGISTRY:
                 raise KeyError(f"unknown plugin {name!r}; known: {registered()}")
-            built[name] = _REGISTRY[name](config, alloc, gangs, policy)
+            built[name] = _REGISTRY[name](config, alloc, gangs, policy,
+                                          elastic)
         return built[name]
 
     from .framework import PreFilterPlugin, PreScorePlugin, ReservePlugin
@@ -238,4 +253,5 @@ def build_profile(config: SchedulerConfig,
         permit=permits,
     )
     profile.policy = policy
+    profile.elastic = elastic
     return profile

@@ -35,6 +35,19 @@ GENERATION_LABEL = "tpu/generation"  # pin a TPU generation ("v4", "v5e", ...)
 TOPOLOGY_LABEL = "tpu/topology"
 GANG_NAME_LABEL = "tpu/gang-name"
 GANG_SIZE_LABEL = "tpu/gang-size"
+# elastic gangs (scheduler/elastic/): minimum viable replica count — a
+# gang labeled with it may ADMIT at min members when the full size does
+# not fit, then grow toward tpu/gang-size as chips free. 0/absent keeps
+# the classic all-or-nothing admission. Only meaningful on gang pods and
+# only when the elasticGangs knob is on.
+GANG_MIN_LABEL = "tpu/gang-min"
+# deadline/SLO-aware admission (scheduler/elastic/): seconds after the
+# gang's first member arrives by which the job must be RUNNING. Drives
+# the start-now-at-min vs wait-for-full decision off the policy engine's
+# throughput model (ElasticGangs.deadline_pressed). 0/absent = no
+# deadline pressure (start at min only when the full size provably
+# cannot fit).
+DEADLINE_LABEL = "scv/deadline-seconds"
 
 # Policy-engine labels (scheduler/policy/). The workload CLASS names the
 # job's throughput profile across accelerator generations (Gavel's
@@ -100,6 +113,13 @@ class WorkloadSpec:
     topology: str | None = None      # e.g. "2x2"
     gang_name: str | None = None
     gang_size: int = 0
+    # elastic-gang minimum (tpu/gang-min): 0 = classic all-or-nothing.
+    # A scheduling input only when the elasticGangs knob is on; riding
+    # the spec keeps every spec-keyed surface (class memos, batch keys)
+    # sound — two gangs differing only in min never share a class.
+    gang_min: int = 0
+    # start-deadline seconds (scv/deadline-seconds): 0 = none
+    deadline_s: int = 0
     # declared throughput-profile class (scv/class); None = classless —
     # the heterogeneity model then falls back to a coarse spec-derived
     # class. A scheduling input ONLY when the policy engine is enabled;
@@ -117,6 +137,14 @@ class WorkloadSpec:
         if gang_name is not None and gang_size <= 0:
             raise LabelError(GANG_SIZE_LABEL, labels.get(GANG_SIZE_LABEL, ""),
                              "gang pods must set a positive tpu/gang-size")
+        gang_min = _parse_uint(labels, GANG_MIN_LABEL, 0)
+        if gang_min:
+            if gang_name is None:
+                raise LabelError(GANG_MIN_LABEL, labels[GANG_MIN_LABEL],
+                                 "tpu/gang-min requires tpu/gang-name")
+            if gang_min > gang_size:
+                raise LabelError(GANG_MIN_LABEL, labels[GANG_MIN_LABEL],
+                                 f"must be <= tpu/gang-size ({gang_size})")
         accel = labels.get(ACCELERATOR_LABEL)
         if accel is not None and accel not in ("tpu", "gpu"):
             raise LabelError(ACCELERATOR_LABEL, accel, 'must be "tpu" or "gpu"')
@@ -149,6 +177,8 @@ class WorkloadSpec:
             topology=topo,
             gang_name=gang_name,
             gang_size=gang_size,
+            gang_min=gang_min,
+            deadline_s=_parse_uint(labels, DEADLINE_LABEL, 0),
             workload_class=wclass,
         )
 
@@ -165,6 +195,7 @@ class WorkloadSpec:
             h = hash((self.chips, self.min_free_mb, self.min_clock_mhz,
                       self.priority, self.accelerator, self.tpu_generation,
                       self.topology, self.gang_name, self.gang_size,
+                      self.gang_min, self.deadline_s,
                       self.workload_class))
             object.__setattr__(self, "_hash_memo", h)
         return h
@@ -175,7 +206,8 @@ class WorkloadSpec:
 _SPEC_LABELS = (
     NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
     ACCELERATOR_LABEL, GENERATION_LABEL, TOPOLOGY_LABEL,
-    GANG_NAME_LABEL, GANG_SIZE_LABEL, WORKLOAD_CLASS_LABEL,
+    GANG_NAME_LABEL, GANG_SIZE_LABEL, GANG_MIN_LABEL, DEADLINE_LABEL,
+    WORKLOAD_CLASS_LABEL,
 )
 
 # the complete public label surface (spec inputs + the bind-time chip
@@ -247,6 +279,6 @@ def spec_for(pod) -> WorkloadSpec:
     key = (g(NUMBER_LABEL), g(MEMORY_LABEL), g(CLOCK_LABEL),
            g(PRIORITY_LABEL), g(ACCELERATOR_LABEL), g(GENERATION_LABEL),
            g(TOPOLOGY_LABEL), g(GANG_NAME_LABEL), g(GANG_SIZE_LABEL),
-           g(WORKLOAD_CLASS_LABEL))
+           g(GANG_MIN_LABEL), g(DEADLINE_LABEL), g(WORKLOAD_CLASS_LABEL))
     return memo(pod, "_spec_cache", key,
                 lambda: _intern_spec(WorkloadSpec.from_labels(labels)))

@@ -206,6 +206,30 @@ class Metrics:
         "preemptions_budget_denied_total": "Preemption plans refused by "
                                            "per-tenant budgets, labeled "
                                            "by the denying budget level.",
+        "defrag_evictions_total": "Pods migrated by the active "
+                                  "defragmentation controller, labeled "
+                                  "by strategy (slice-conservation|"
+                                  "compaction).",
+        "defrag_passes_total": "Defragmentation passes executed "
+                               "(including passes that migrated "
+                               "nothing).",
+        "defrag_skips_total": "Defragmentation passes skipped, labeled "
+                              "by reason (breaker-open|degraded|"
+                              "not-owner).",
+        "defrag_errors_total": "Defragmentation passes aborted by a "
+                               "contained controller crash (the engine "
+                               "thread survives; the pass is skipped).",
+        "gang_grow_total": "Elastic-gang members bound into a gang "
+                           "running below its desired size (growth "
+                           "binds).",
+        "gang_shrink_total": "Elastic-gang members evicted from a "
+                             "running gang, labeled by reason "
+                             "(preemption).",
+        "gang_elastic_admissions_total": "Gangs admitted below desired "
+                                         "size, labeled by reason "
+                                         "(no-fit|deadline).",
+        "gang_elastic_completions_total": "Elastic gangs grown back to "
+                                          "their desired size.",
     }
 
     def __init__(self) -> None:
@@ -437,10 +461,23 @@ def export_chrome_trace(rings, path: str | None = None) -> dict:
 # tenant_starvation (a pod unbound past starvationAfterSeconds) are the
 # policy engine's trip kinds: both mark fairness actively failing, the
 # moment the black box should land on disk.
+# defrag_pass (the active defragmentation controller actually MIGRATING
+# workloads — empty passes stay out of the ring) joins them: every
+# migration is the scheduler rearranging running jobs on its own
+# initiative, exactly what an operator reconstructing "why did my pod
+# move" needs the black box to show. Unlike every other trip — all
+# exceptional failure signals that self-limit — defrag passes are
+# PLANNED recurring behavior, so they land in the ring but never
+# auto-dump: the rate limiter bounds dump frequency, not count, and a
+# steady defrag cadence would otherwise grow a new dump file per window
+# indefinitely on a healthy cluster.
 TRIP_KINDS = frozenset({"breaker_open", "invariant_violation",
                         "quarantine", "webhook_deny", "webhook_fail_open",
                         "shard_takeover", "tenant_quota_breach",
-                        "tenant_starvation"})
+                        "tenant_starvation", "defrag_pass"})
+# trips that mark routine (if noteworthy) operation rather than a fault
+# being absorbed: recorded + counted, but no disk dump
+RING_ONLY_TRIPS = frozenset({"defrag_pass"})
 
 
 class FlightRecorder:
@@ -472,7 +509,8 @@ class FlightRecorder:
         # positional-only `kind`: detail keys are free-form event payload
         # and must never collide with the event-kind parameter
         self._buf.append((self._now(), kind, detail or None))
-        if kind in TRIP_KINDS and self.dump_dir:
+        if (kind in TRIP_KINDS and kind not in RING_ONLY_TRIPS
+                and self.dump_dir):
             self.auto_dump(reason=kind)
 
     def snapshot(self) -> list[dict]:
