@@ -298,4 +298,78 @@ int64_t yoda_fused_cycle(const YodaPlaneCols* c, const YodaPlaneReq* r,
   return found;
 }
 
+// ---------------------------------------------------------------------------
+// Incremental-commit helpers: the batch-commit loop's per-bind repair path
+// (core._commit_batch) runs thousands of times per drain, each iteration
+// paying a handful of tiny numpy calls whose per-op dispatch overhead
+// dwarfs the arithmetic at row sizes of ~100. These two kernels collapse
+// that path into one C call each. Bound separately from the fused-cycle
+// symbols (nativeplane.IncrementalKernels), so an older .so degrades only
+// this path back to numpy.
+
+// ABI handshake for the incremental helpers alone — bump on any layout or
+// semantic change to the two functions below.
+int64_t yoda_incremental_abi(void) { return 1; }
+
+// Post-bind row refresh (columnar.ColumnarTable._fill_row's dynamic-column
+// path): rewrite one row of the free-chip mask from the allocator's free
+// set (as chip indices), zeroing the rest of the padded row. The caller
+// still owns free_count / claimed_hbm (scalar writes; they carry values
+// the allocator computed anyway).
+void yoda_row_refresh(uint8_t* chip_free_row, int64_t width,
+                      const int64_t* free_idx, int64_t n_idx) {
+  for (int64_t j = 0; j < width; ++j) chip_free_row[j] = 0;
+  for (int64_t j = 0; j < n_idx; ++j) {
+    const int64_t i = free_idx[j];
+    if (i >= 0 && i < width) chip_free_row[i] = 1;
+  }
+}
+
+// Fused normalize + weighted sum + argmax-with-ties over the batch
+// commit's per-scorer raw score matrix (row-major n_scorers x stride,
+// live length m). kinds[k]: 1 = minmax normalization, 0 = identity.
+// Written OP-FOR-OP like the numpy fold in core._commit_batch (and so
+// like the scalar _fold_scores): lo/hi scan, span == 0 -> flat 100.0,
+// else 0.0 + (v - lo) * 100.0 / span, folded totals[j] += w * v — IEEE
+// double ops in the same order, so every float is bit-identical and the
+// `totals[j] == best` tie set matches numpy's flatnonzero exactly.
+// Returns the tie count (tie indices in `ties`, ascending), -1 on
+// malformed input.
+int64_t yoda_batch_fold(const double* scores, int64_t n_scorers,
+                        int64_t stride, const int64_t* kinds,
+                        const double* weights, int64_t m,
+                        double* totals, int64_t* ties) {
+  if (m <= 0 || n_scorers < 0 || stride < m) return -1;
+  for (int64_t j = 0; j < m; ++j) totals[j] = 0.0;
+  for (int64_t k = 0; k < n_scorers; ++k) {
+    const double* arr = scores + k * stride;
+    const double w = weights[k];
+    if (kinds[k]) {
+      double lo = arr[0], hi = arr[0];
+      for (int64_t j = 1; j < m; ++j) {
+        if (arr[j] < lo) lo = arr[j];
+        if (arr[j] > hi) hi = arr[j];
+      }
+      const double span = hi - lo;
+      if (span == 0.0) {
+        for (int64_t j = 0; j < m; ++j)
+          totals[j] = totals[j] + w * 100.0;
+      } else {
+        for (int64_t j = 0; j < m; ++j)
+          totals[j] = totals[j] + w * (0.0 + (arr[j] - lo) * 100.0 / span);
+      }
+    } else {
+      for (int64_t j = 0; j < m; ++j)
+        totals[j] = totals[j] + w * arr[j];
+    }
+  }
+  double best = totals[0];
+  for (int64_t j = 1; j < m; ++j)
+    if (totals[j] > best) best = totals[j];
+  int64_t n_ties = 0;
+  for (int64_t j = 0; j < m; ++j)
+    if (totals[j] == best) ties[n_ties++] = j;
+  return n_ties;
+}
+
 }  // extern "C"

@@ -28,6 +28,38 @@ def _key(obj: dict) -> str:
     return f"{ns}/{m['name']}" if ns else m["name"]
 
 
+def _parse_label_selector(sel: str) -> list:
+    """labelSelector terms the sharded reflectors use: equality
+    (``k=v`` / ``k==v``) and set membership (``k in (a,b)``), comma-
+    joined. Unsupported operators are ignored (match-all) — this is a
+    test double, not a validator."""
+    import re
+
+    terms = []
+    for part in re.split(r",(?![^(]*\))", sel or ""):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.match(r"^(\S+)\s+in\s+\((.*)\)$", part)
+        if m:
+            terms.append((m.group(1),
+                          {v.strip() for v in m.group(2).split(",")}))
+            continue
+        if "==" in part:
+            k, v = part.split("==", 1)
+        elif "=" in part and "!=" not in part:
+            k, v = part.split("=", 1)
+        else:
+            continue
+        terms.append((k.strip(), {v.strip()}))
+    return terms
+
+
+def _matches_selector(obj: dict, terms: list) -> bool:
+    labels = obj.get("metadata", {}).get("labels") or {}
+    return all(labels.get(k) in vs for k, vs in terms)
+
+
 class FakeApiState:
     KINDS = ("pods", "nodes", "metrics", "poddisruptionbudgets")
 
@@ -362,6 +394,10 @@ class _Handler(BaseHTTPRequestHandler):
         with s.cond:
             items = list(s.objects[kind].values())
             rv = s.rv
+        sel = q.get("labelSelector", [None])[0]
+        if sel:
+            terms = _parse_label_selector(sel)
+            items = [i for i in items if _matches_selector(i, terms)]
         limit = int(q.get("limit", [0])[0] or 0)
         cont = q.get("continue", [None])[0]
         start = int(cont) if cont else 0
@@ -375,6 +411,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _watch(self, kind: str, q: dict) -> None:
         s = self.state
+        sel = q.get("labelSelector", [None])[0]
+        sel_terms = _parse_label_selector(sel) if sel else None
         from_rv = int(q.get("resourceVersion", ["0"])[0] or 0)
         timeout_s = float(q.get("timeoutSeconds", ["30"])[0])
         deadline = time.monotonic() + min(timeout_s, 30.0)
@@ -431,10 +469,15 @@ class _Handler(BaseHTTPRequestHandler):
                 if not batch and bookmarks and s.rv > last:
                     bm_rv = s.rv  # quiet stream, global rv moved on
             if batch:
+                lines = (b"".join(e[3] for e in batch)
+                         if sel_terms is None else
+                         b"".join(e[3] for e in batch
+                                  if _matches_selector(e[2], sel_terms)))
                 try:
                     # one write+flush per batch, pre-serialized lines
-                    self.wfile.write(b"".join(e[3] for e in batch))
-                    self.wfile.flush()
+                    if lines:
+                        self.wfile.write(lines)
+                        self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     return
                 last = batch[-1][0]

@@ -1,0 +1,507 @@
+"""ISSUE 12 — the 50k-node data plane: pool-sharded ColumnarTable,
+sharded reflectors, and the pipelined bind wire.
+
+Contracts under test:
+
+- the pool-sharded table (``columnarShards``) produces BIT-IDENTICAL
+  placements vs the unsharded table across the existing columnar fuzz
+  shapes, including node-pool membership churn mid-drain (the sharded
+  rebuild's block-copy path);
+- KubeClient's pipelined wire (``bindPipelineWindow``) lands a window of
+  binds in one round with in-order conflict resolution through the same
+  409/adopt protocol as the single-POST path;
+- sharded reflection: a KubeCluster restricted to its owned pools
+  ingests only them (server-side labelSelector + client-side guard) and
+  hands watch ownership over with set_owned_pools; the in-memory fleet
+  facade (ShardedOwnedView) keeps fleet invariants intact;
+- the reservoir histogram keeps memory fixed past the threshold with
+  quantiles inside tolerance (the golden test);
+- an externally-deleted mid-growth elastic gang retires its _growing
+  record on the members' POD_DELETED events.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+
+import pytest
+
+np = pytest.importorskip("numpy")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.columnar import ColumnarTable, pool_of
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.scheduler.framework import ClusterEvent, POD_DELETED
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.obs import Histogram
+
+from test_columnar import T0, build_burst, build_cluster, end_state
+
+SHARDS = 8
+
+
+def drive(cluster, pods, shards: int, native: bool = False):
+    sched = Scheduler(
+        cluster,
+        SchedulerConfig(max_attempts=3, columnar=True,
+                        columnar_shards=shards, native_plane=native,
+                        pod_hinted_backoff_s=0.0),
+        clock=FakeClock(start=T0))
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=10_000)
+    return sched
+
+
+# ------------------------------------------------------ sharded-table parity
+def test_pool_of_shapes():
+    assert pool_of("s12-host-3") == "s12-host"
+    assert pool_of("t5-1") == "t5"
+    assert pool_of("gpu-07") == "gpu"
+    assert pool_of("plain") == "plain"
+
+
+def test_sharded_parity_fuzz():
+    """Pool-sharded scans + per-shard repair vs the unsharded table over
+    the existing 200-case columnar fuzz shapes: bit-identical pod fates."""
+    mismatches = []
+    used = 0
+    for case in range(210):
+        rng_a = random.Random(9000 + case)
+        rng_b = random.Random(9000 + case)
+        cluster_a = build_cluster(rng_a)
+        cluster_b = build_cluster(rng_b)
+        pods_a = build_burst(rng_a)
+        pods_b = build_burst(rng_b)
+        sched_a = drive(cluster_a, pods_a, shards=SHARDS)
+        sched_b = drive(cluster_b, pods_b, shards=0)
+        used += sched_a.metrics.counters.get(
+            "columnar_filter_cycles_total", 0)
+        if end_state(pods_a) != end_state(pods_b):
+            mismatches.append((case, end_state(pods_a), end_state(pods_b)))
+    assert not mismatches, mismatches[:2]
+    assert used > 200, used  # the vectorized path actually ran sharded
+
+
+def _churn_cluster():
+    store = TelemetryStore()
+    for pool in ("pa", "pb", "pc"):
+        for i in range(3):
+            m = make_tpu_node(f"{pool}-{i}", chips=4)
+            m.heartbeat = T0
+            store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return cluster
+
+
+def _churn_run(shards: int):
+    """Drain with a node POOL joining and a node leaving mid-flight —
+    the membership-churn case the sharded rebuild serves."""
+    cluster = _churn_cluster()
+    sched = Scheduler(
+        cluster,
+        SchedulerConfig(max_attempts=4, columnar=True,
+                        columnar_shards=shards, native_plane=False,
+                        pod_hinted_backoff_s=0.0),
+        clock=FakeClock(start=T0))
+    pods = [Pod(f"c{i}", labels={"scv/number": "1",
+                                 "tpu/accelerator": "tpu"})
+            for i in range(30)]
+    for p in pods[:10]:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=2000)
+    # a whole new pool joins; an existing node leaves
+    for i in range(3):
+        m = make_tpu_node(f"pd-{i}", chips=4)
+        m.heartbeat = T0
+        cluster.telemetry.put(m)
+        cluster.add_node(f"pd-{i}")
+    cluster.remove_node("pa-1")
+    for p in pods[10:20]:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=2000)
+    cluster.remove_node("pb-0")
+    for p in pods[20:]:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=2000)
+    return sched, pods
+
+
+def test_shard_membership_churn_parity():
+    sched_s, pods_s = _churn_run(SHARDS)
+    sched_u, pods_u = _churn_run(0)
+    assert end_state(pods_s) == end_state(pods_u)
+    table = sched_s._columnar
+    # the sharded rebuild actually served the churn: rows were block-
+    # copied instead of refilled
+    assert table.shard_rebuilds > 0
+    assert table.rows_copied > 0
+
+
+def test_sharded_rebuild_rows_bit_identical():
+    """After churn, the sharded table's columns equal a from-scratch
+    rebuild of the same snapshot (the copy path is provably exact)."""
+    sched, _ = _churn_run(SHARDS)
+    table = sched._columnar
+    snapshot = sched.snapshot()
+    vers = sched._cluster_versions()
+    assert table.sync(snapshot, vers, sched._changes_since_vers)
+    fresh = ColumnarTable(sched.allocator)
+    assert fresh.sync(snapshot, vers, sched._changes_since_vers)
+    assert table._names == fresh._names
+    for col in ("valid", "heartbeat", "accel", "gen", "unsched",
+                "label_class", "free_count", "hbm_total_sum",
+                "hbm_free_sum", "claimed_hbm", "chip_free",
+                "chip_hbm_free", "chip_hbm_total", "chip_clock",
+                "chip_bw", "chip_core", "chip_power", "chip_duty"):
+        a, b = getattr(table, col), getattr(fresh, col)
+        if a.shape != b.shape:  # width padding may differ; compare overlap
+            w = min(a.shape[-1], b.shape[-1])
+            a = a[..., :w] if a.ndim == 2 else a
+            b = b[..., :w] if b.ndim == 2 else b
+        assert np.array_equal(a, b), col
+
+
+def test_qual_cache_shard_repair():
+    """A row update invalidates ONLY its shard's slice of the cached
+    qualifying-chip mask; the repaired mask equals a fresh compute."""
+    store = TelemetryStore()
+    for pool in ("qa", "qb"):
+        for i in range(4):
+            m = make_tpu_node(f"{pool}-{i}", chips=4)
+            m.heartbeat = T0
+            store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(
+        columnar=True, columnar_shards=4, native_plane=False),
+        clock=FakeClock(start=T0))
+    snapshot = sched.snapshot()
+    vers = sched._cluster_versions()
+    table = sched._columnar
+    assert table.sync(snapshot, vers, sched._changes_since_vers)
+    q0, qc0 = table.qual(0, 0)
+    assert qc0.sum() == 8 * 4
+    # bind a pod onto one node: its row's shard serial moves
+    p = Pod("qp", labels={"scv/number": "2", "tpu/accelerator": "tpu"})
+    sched.submit(p)
+    sched.run_until_idle(max_cycles=100)
+    assert p.phase == PodPhase.BOUND
+    snapshot = sched.snapshot()
+    vers = sched._cluster_versions()
+    assert table.sync(snapshot, vers, sched._changes_since_vers)
+    q1, qc1 = table.qual(0, 0)
+    assert table.qual_repairs >= 1
+    fresh = (table.chip_free
+             & (table.chip_hbm_free >= 0) & (table.chip_clock >= 0))
+    assert np.array_equal(q1, fresh)
+    assert np.array_equal(qc1, fresh.sum(axis=1))
+
+
+# ------------------------------------------------------- pipelined bind wire
+@pytest.fixture()
+def api_server():
+    from fake_apiserver import FakeApiServer
+
+    with FakeApiServer() as server:
+        yield server
+
+
+def _wire_pod(name: str) -> dict:
+    return {"metadata": {"name": name, "namespace": "default",
+                         "labels": {"scv/number": "1"}},
+            "spec": {"schedulerName": "yoda-scheduler"},
+            "status": {"phase": "Pending"}}
+
+
+def test_pipeline_binds_land_in_order(api_server):
+    from yoda_scheduler_tpu.k8s.client import KubeClient
+
+    server = api_server
+    server.state.add_node("w-0")
+    client = KubeClient(server.url)
+    pods = []
+    for i in range(6):
+        server.state.add_pod(_wire_pod(f"bp{i}"))
+        pods.append(Pod(f"bp{i}", labels={"scv/number": "1"}))
+    items = [(p, "w-0", [(0, 0, i)], None) for i, p in enumerate(pods)]
+    outs = client.bind_pipelined(items)
+    assert outs == [None] * 6
+    # every pod bound on the server, in one pipelined round
+    for p in pods:
+        live = server.state.pod(p.name)
+        assert live["spec"]["nodeName"] == "w-0", p.name
+
+
+def test_pipeline_conflict_resolves_in_order(api_server):
+    from yoda_scheduler_tpu.k8s.client import ApiError, KubeClient
+
+    server = api_server
+    server.state.add_node("w-0")
+    client = KubeClient(server.url)
+    server.state.add_pod(_wire_pod("ok1"))
+    server.state.add_pod(_wire_pod("dup"))
+    server.state.add_pod(_wire_pod("ok2"))
+    # pre-bind "dup" elsewhere: its slot must resolve as a 409 conflict
+    # while its window-mates land
+    client.bind(Pod("dup", labels={}), "w-0", [(9, 9, 9)])
+    items = [
+        (Pod("ok1", labels={}), "w-0", [(0, 0, 0)], None),
+        (Pod("dup", labels={}), "w-0", [(1, 1, 1)], None),
+        (Pod("ok2", labels={}), "w-0", [(2, 2, 2)], None),
+    ]
+    outs = client.bind_pipelined(items)
+    assert outs[0] is None and outs[2] is None
+    # the duplicate's read-back found OUR earlier identical target but a
+    # different chip assignment -> conflict error, not silent adoption
+    assert isinstance(outs[1], ApiError) and outs[1].status == 409
+
+
+def test_pipelined_cluster_binds(api_server):
+    """KubeCluster with bindPipelineWindow drains a burst through the
+    pipelined binder; every bind lands and bookkeeping matches."""
+    from yoda_scheduler_tpu.k8s.client import KubeClient, KubeCluster
+
+    server = api_server
+    server.state.add_node("w-0")
+    server.state.add_node("w-1")
+    client = KubeClient(server.url)
+    cluster = KubeCluster(client, TelemetryStore(), watch=False,
+                          bind_pipeline_window=4)
+    done = []
+    for i in range(8):
+        server.state.add_pod(_wire_pod(f"pc{i}"))
+        p = Pod(f"pc{i}", labels={"scv/number": "1"})
+        cluster.bind_async(p, f"w-{i % 2}", [(0, 0, i)],
+                           on_success=lambda pod, node: done.append(pod.name))
+    assert cluster.flush_binds(timeout=10.0)
+    assert sorted(done) == sorted(f"pc{i}" for i in range(8))
+    assert cluster.bind_wire_n == 8
+    for i in range(8):
+        assert server.state.pod(f"pc{i}")["spec"]["nodeName"] == f"w-{i % 2}"
+    cluster.stop()
+
+
+# ------------------------------------------------------- sharded reflectors
+def test_sharded_reflection_ingests_owned_pools_only(api_server):
+    from yoda_scheduler_tpu.k8s.client import KubeClient, KubeCluster
+
+    server = api_server
+    for pool in ("pa", "pb"):
+        for i in range(2):
+            name = f"{pool}-{i}"
+            server.state.add_node(name, labels={"pool": pool})
+            m = make_tpu_node(name, chips=4)
+            m.heartbeat = time.time() + 1e8
+            server.state.put_metrics(m.to_cr())
+    # one pod bound into each pool, plus a pending one
+    server.state.add_pod(_wire_pod("pend"))
+    for pool in ("pa", "pb"):
+        body = _wire_pod(f"bound-{pool}")
+        body["spec"]["nodeName"] = f"{pool}-0"
+        server.state.add_pod(body)
+    client = KubeClient(server.url)
+    cluster = KubeCluster(client, TelemetryStore(), watch=True,
+                          owned_pools={"pa"}, pool_label="pool")
+    cluster.start()
+    try:
+        assert cluster.wait_synced(10.0)
+        assert cluster.node_names() == ["pa-0", "pa-1"]
+        assert set(cluster.telemetry.nodes()) == {"pa-0", "pa-1"}
+        keys = cluster.known_pod_keys()
+        assert "default/pend" in keys          # pending always ingested
+        assert "default/bound-pa" in keys      # owned-pool bind
+        assert "default/bound-pb" not in keys  # foreign-pool bind dropped
+        # watch ownership handover: pb joins the owned set, pa leaves
+        v0 = cluster.nodes_version
+        cluster.set_owned_pools({"pb"})
+        assert cluster.nodes_version > v0
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if cluster.node_names() == ["pb-0", "pb-1"]:
+                break
+            time.sleep(0.05)
+        assert cluster.node_names() == ["pb-0", "pb-1"]
+    finally:
+        cluster.stop()
+
+
+def test_fake_apiserver_label_selector(api_server):
+    from yoda_scheduler_tpu.k8s.client import KubeClient
+
+    server = api_server
+    server.state.add_node("sa-0", labels={"pool": "sa"})
+    server.state.add_node("sb-0", labels={"pool": "sb"})
+    client = KubeClient(server.url)
+    doc = client.list_all("/api/v1/nodes?labelSelector=pool%20in%20(sa)")
+    names = [i["metadata"]["name"] for i in doc["items"]]
+    assert names == ["sa-0"]
+    doc = client.list_all("/api/v1/nodes?labelSelector=pool%3Dsb")
+    names = [i["metadata"]["name"] for i in doc["items"]]
+    assert names == ["sb-0"]
+
+
+# ----------------------------------------------------- sharded fleet facade
+def test_fleet_sharded_reflection_invariants():
+    """Deterministic 4-replica fleet with reflectorSharding: every pod
+    binds exactly once, no chip double-booked, and each replica's engine
+    sees only its owned pools."""
+    from yoda_scheduler_tpu.scheduler.fleet import FleetCoordinator
+
+    store = TelemetryStore()
+    for i in range(16):
+        m = make_tpu_node(f"fp{i % 8}-{i // 8}", chips=4)
+        m.heartbeat = T0 + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(max_attempts=8, telemetry_max_age_s=1e9,
+                        reflector_sharding=True),
+        replicas=4, mode="sharded", clock=FakeClock(start=T0), seed=3)
+    pods = [Pod(f"fs{i}", labels={"scv/number": "1",
+                                  "tpu/accelerator": "tpu"})
+            for i in range(40)]
+    for p in pods:
+        fleet.submit(p)
+    fleet.run_until_idle(max_cycles=20_000)
+    bound = sum(1 for p in pods if p.phase == PodPhase.BOUND)
+    assert bound >= 30, bound  # most bind; stragglers may lack shard room
+    seen: dict = {}
+    chip_owner: dict = {}
+    for node in cluster.node_names():
+        for p in cluster.pods_on(node):
+            assert p.key not in seen
+            seen[p.key] = node
+            for c in p.assigned_chips():
+                assert (node, c) not in chip_owner
+                chip_owner[(node, c)] = p.key
+    # each replica's membership is a strict subset of the cluster
+    total = len(cluster.node_names())
+    for rep in fleet.replicas:
+        view_nodes = rep.engine.cluster.node_names()
+        assert 0 < len(view_nodes) < total
+        for n in view_nodes:
+            assert rep.engine.fence_provider is not None
+
+
+def test_fleet_sharded_reflection_no_poolless_starvation():
+    """Pods keyed onto a shard whose pools hold NO nodes must still
+    bind: routing remaps into populated shards (the wire drive caught
+    pools vp0..vp3 all hashing to shard 0 of 2 — the shard-1 replica's
+    view was empty and its pods waited forever)."""
+    from yoda_scheduler_tpu.scheduler.columnar import pool_of, shard_of_pool
+    from yoda_scheduler_tpu.scheduler.fleet import FleetCoordinator
+
+    # all four pools provably land on shard 0 of 2
+    assert all(shard_of_pool(pool_of(f"vp{i}-0"), 2) == 0 for i in range(4))
+    store = TelemetryStore()
+    for i in range(8):
+        m = make_tpu_node(f"vp{i % 4}-{i // 4}", chips=4)
+        m.heartbeat = T0 + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(max_attempts=8, telemetry_max_age_s=1e9,
+                        reflector_sharding=True),
+        replicas=2, mode="sharded", shard_count=2,
+        clock=FakeClock(start=T0), seed=1)
+    pods = [Pod(f"st{i}", labels={"scv/number": "1",
+                                  "tpu/accelerator": "tpu"})
+            for i in range(24)]
+    for p in pods:
+        fleet.submit(p)
+    fleet.run_until_idle(max_cycles=20_000)
+    bound = sum(1 for p in pods if p.phase == PodPhase.BOUND)
+    assert bound == 24, bound
+
+
+# --------------------------------------------------- histogram reservoir
+def test_reservoir_histogram_bounded_and_accurate():
+    h = Histogram(keep_values=4096)
+    rng = random.Random(7)
+    n = 200_000
+    for _ in range(n):
+        h.observe(rng.uniform(0.0, 1000.0))
+    assert len(h._values) == 4096       # memory fixed past the threshold
+    assert h.n == n
+    # golden tolerance: uniform[0,1000] quantiles within ~3% absolute
+    for q, expect in ((0.5, 500.0), (0.9, 900.0), (0.99, 990.0)):
+        got = h.quantile(q)
+        assert abs(got - expect) < 30.0, (q, got)
+
+
+def test_reservoir_exact_below_threshold():
+    h = Histogram(keep_values=1000)
+    for i in range(1000):
+        h.observe(float(i))
+    assert h.quantile(0.5) == 500.0
+    assert len(h._values) == 1000
+
+
+def test_reservoir_deterministic():
+    def run():
+        h = Histogram(keep_values=128)
+        for i in range(10_000):
+            h.observe(float(i % 997))
+        return h.quantile(0.5), h.quantile(0.99)
+
+    assert run() == run()
+
+
+# ------------------------------------------------ elastic _growing retire
+def test_elastic_growing_retired_on_external_gang_deletion():
+    store = TelemetryStore()
+    for i in range(2):
+        m = make_tpu_node(f"eg-{i}", chips=4)
+        m.heartbeat = T0
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(
+        elastic_gangs=True, telemetry_max_age_s=1e9),
+        clock=FakeClock(start=T0))
+    assert sched.elastic is not None
+    # a mid-growth record with no bound members left (the orphan shape:
+    # the gang's pods were deleted externally after admission)
+    sched.elastic._growing["ghost"] = 0
+    sched.elastic._first_seen["ghost"] = T0
+    sched.notify_event(ClusterEvent(POD_DELETED, node="eg-0",
+                                    gang="ghost"))
+    sched.run_one()
+    assert "ghost" not in sched.elastic._growing
+    assert "ghost" not in sched.elastic._first_seen
+    assert sched.metrics.counters.get("gang_elastic_retired_total") == 1
+
+
+def test_elastic_growing_survives_shrink_of_live_gang():
+    """A POD_DELETED for a gang that still has bound members (a shrink
+    eviction) must NOT retire the growing record."""
+    store = TelemetryStore()
+    m = make_tpu_node("lv-0", chips=4)
+    m.heartbeat = T0
+    store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(
+        elastic_gangs=True, telemetry_max_age_s=1e9),
+        clock=FakeClock(start=T0))
+    member = Pod("live-w0", labels={"tpu/gang-name": "live",
+                                    "scv/number": "1"})
+    cluster.bind(member, "lv-0", [(0, 0, 0)])
+    sched.elastic._growing["live"] = 0
+    sched.notify_event(ClusterEvent(POD_DELETED, node="lv-0",
+                                    gang="live"))
+    sched.run_one()
+    assert "live" in sched.elastic._growing

@@ -1,0 +1,91 @@
+"""The 50k-node data-plane tier (ISSUE 12): 50_000 nodes / 50_000 pods
+drained by one engine with the full data plane on — pool-sharded
+ColumnarTable (columnarShards), native fused kernel, batch commits.
+
+What the artifact (BENCH_SCALE50K.json at the repo root) must show:
+
+- the tier COMPLETES with bounded memory (peak RSS recorded and fenced
+  in CI against a generous ceiling — reservoir histograms keep the
+  metric families O(1) in pod count, the columnar table is ~tens of MB
+  at this node count);
+- cycle-compute p50 stays FLAT vs the 5k tier (the per-cycle scan is
+  memo/native-served; node count must not leak back into it);
+- drain wall / binds-per-second, the aggregate-throughput headline.
+
+Run:  python tools/scale50k.py           (full 50k tier)
+      python tools/scale50k.py --smoke   (12.5k-node CI fence tier)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import run_scale  # noqa: E402
+
+SHARDS = 64
+
+
+def peak_rss_mb() -> float:
+    """Peak RSS of this process (Linux ru_maxrss is in KiB)."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    # units are 8 nodes each (bench.build_scale_nodes); one pod per node
+    # keeps the pod count — and with it the drain — bounded while every
+    # row of the 50k-node table is still live scan input
+    units = 1563 if smoke else 6250          # 12_504 / 50_000 nodes
+    ref = run_scale(625, shards=SHARDS)      # the 5k tier, same knobs
+    big = run_scale(units, pods_per_node=1, shards=SHARDS)
+    rss = peak_rss_mb()
+    # flatness is judged on PER-POD scheduling compute (the e2e stamp:
+    # every attempt's pre-commit work for each bound pod). The raw
+    # cycle_latency p50 is a cycle-MIX statistic — at one pod per node
+    # nearly every cycle is a full 32-member batch commit, while the 5k
+    # tier's median cycle is a cheap memo retry — so comparing it across
+    # tiers compares different units of work.
+    per_pod = (big.get("e2e_breakdown") or {}).get("cycle_compute_p50_ms")
+    per_pod_ref = (ref.get("e2e_breakdown") or {}).get(
+        "cycle_compute_p50_ms")
+    # 2.5x slack: the per-pod stamp folds batch-member wait (which moves
+    # with batch composition and host phase), so same-code runs vary
+    # ~2x; against the 4x node-count step, staying inside 2.5x is still
+    # an unambiguous sub-linearity verdict
+    flat = (per_pod is not None and per_pod_ref is not None
+            and per_pod <= max(2.5 * per_pod_ref, 1.0))
+    out = {
+        "metric": "scale50k_drain",
+        "smoke": smoke,
+        "nodes": big["nodes"],
+        "pods": big["pods"],
+        "wall_s": big["wall_s"],
+        "binds_per_s": round(big["bound"] / max(big["wall_s"], 1e-9), 1),
+        "cycle_compute_per_pod_p50_ms": per_pod,
+        "cycle_compute_per_pod_p50_ms_5k": per_pod_ref,
+        "cycle_compute_flat_vs_5k": flat,
+        "peak_rss_mb": round(rss, 1),
+        "columnar_shards": SHARDS,
+        "ref_5k": {k: ref[k] for k in ("nodes", "pods", "wall_s",
+                                       "cycle_compute_p50_ms", "bound",
+                                       "p50_ms")},
+        "tier": big,
+    }
+    name = "BENCH_SCALE50K_SMOKE.json" if smoke else "BENCH_SCALE50K.json"
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), name)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({k: out[k] for k in (
+        "metric", "nodes", "pods", "wall_s", "binds_per_s",
+        "cycle_compute_per_pod_p50_ms", "cycle_compute_flat_vs_5k",
+        "peak_rss_mb")}))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,18 @@ from bench import per_pod_ratio, run_scale  # noqa: E402
 
 def main() -> None:
     small = run_scale(125)   # the bench.py large tier as the reference point
-    big = run_scale(625)     # 5000 nodes, 25000 pods
+    # 5000 nodes, 25000 pods — min wall of three runs, spread recorded:
+    # the shared reference host oscillates between cache/steal phases
+    # worth ~±0.7s on this tier (pure-GIL spin probes stay flat while
+    # memory-heavy runs move), and a latency-capability fence should
+    # measure the code, not the co-tenant. Same discipline as the CI
+    # fences' min-of-2 and bench.py's median-of-5.
+    # columnarShards on, matching the 50k tier (tools/scale50k.py): the
+    # 5k artifact exercises the sharded table it gates on; placements
+    # are bit-identical to unsharded (the shard parity fuzz pins it)
+    runs = [run_scale(625, shards=64) for _ in range(3)]
+    big = min(runs, key=lambda r: r["wall_s"])
+    big["wall_s_runs"] = sorted(r["wall_s"] for r in runs)
     # active-defragmentation leg (ISSUE 10): the same 5k burst with the
     # defrag controller consolidating stray singles mid-drain — the
     # recovered-multi-chip-capacity measurement ROADMAP item 4 asks for

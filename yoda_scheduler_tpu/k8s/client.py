@@ -38,6 +38,7 @@ import urllib.parse
 import urllib.request
 from collections import deque
 
+from ..scheduler.columnar import pool_of
 from ..scheduler.framework import (
     ClusterEvent,
     NODE_ADDED,
@@ -99,6 +100,41 @@ class AmbiguousRequestError(ConnectionError):
 class WatchExpired(Exception):
     """The watch resourceVersion was compacted away (410 Gone): the caller
     must re-list and start a fresh watch."""
+
+
+class _NoCloseReader:
+    """Buffered-reader proxy that ignores close(): successive pipelined
+    HTTPResponse objects share ONE reader (each would otherwise close —
+    and tear the buffer of — the stream the next response needs)."""
+
+    __slots__ = ("_fp",)
+
+    def __init__(self, fp) -> None:
+        self._fp = fp
+
+    def close(self) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self._fp, name)
+
+
+class _PipeReader:
+    """Socket stand-in handed to http.client.HTTPResponse for pipelined
+    response parsing: makefile() returns the SHARED no-close reader, so
+    buffered bytes of the next response survive the previous response's
+    teardown."""
+
+    __slots__ = ("_reader",)
+
+    def __init__(self, fp) -> None:
+        self._reader = _NoCloseReader(fp)
+
+    def makefile(self, *a, **kw):
+        return self._reader
 
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -590,6 +626,20 @@ class KubeClient:
         provably never applied, so one replay is safe (a replay racing a
         still-in-flight original surfaces as 409 and converges through the
         409 recovery above)."""
+        body = self._bind_body(pod, node, assigned_chips, fence)
+        for replay in (False, True):
+            try:
+                self.request(
+                    "POST",
+                    f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}"
+                    "/binding", body)
+                break
+            except ApiError as e:
+                if self._bind_resolve(pod, node, body, e, replay):
+                    break  # landed (our earlier POST / adopted replay)
+
+    @staticmethod
+    def _bind_body(pod: Pod, node: str, assigned_chips, fence) -> dict:
         body = {
             "apiVersion": "v1",
             "kind": "Binding",
@@ -608,82 +658,254 @@ class KubeClient:
             name, holder, epoch = fence
             body["metadata"].setdefault("annotations", {})[
                 "yoda.tpu/fence"] = f"{name}/{holder}/{epoch}"
-        for replay in (False, True):
+        return body
+
+    def _bind_resolve(self, pod: Pod, node: str, body: dict,
+                      e: ApiError, replayed: bool) -> bool:
+        """Resolve a failed binding POST (the 409/ambiguous recovery
+        protocol in `bind`'s docstring, shared with the pipelined wire).
+        True = the bind is provably OURS on the server (treat as
+        success); False = the POST provably never applied and one replay
+        is permitted (only returned when `replayed` is False); raises
+        on genuine conflicts/terminal failures."""
+        ambiguous = (e.status == 0
+                     and isinstance(e.__cause__, AmbiguousRequestError))
+        # a webhook denial (400/403-coded) is a conflict verdict too:
+        # resolve it through the same read-back protocol so the engine
+        # sees the uniform 409 shape
+        if e.status != 409 and not ambiguous and not is_webhook_denial(e):
+            raise e
+        # the confirm GET is the ONE read standing between an ambiguous
+        # bind and a duplicate-bind window, so it gets extra storm
+        # tolerance beyond get_pod's own retry budget: if it still
+        # fails, the raise reaches the engine, whose bound_node_of
+        # adoption resolves the pod once the watch cache catches up
+        live = None
+        for confirm_try in range(3):
             try:
-                self.request(
-                    "POST",
-                    f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}"
-                    "/binding", body)
+                live = self.get_pod(pod.namespace, pod.name)
                 break
-            except ApiError as e:
-                ambiguous = (e.status == 0
-                             and isinstance(e.__cause__,
-                                            AmbiguousRequestError))
-                # a webhook denial (400/403-coded) is a conflict verdict
-                # too: resolve it through the same read-back protocol so
-                # the engine sees the uniform 409 shape
-                if e.status != 409 and not ambiguous \
-                        and not is_webhook_denial(e):
+            except ApiError as ge:
+                # only WIRE-class failures (status 0) and server
+                # brownouts are worth re-probing; a returned 4xx is
+                # deterministic (e.g. RBAC) and re-sleeping on it would
+                # stall the binder for nothing
+                if confirm_try == 2 or ge.status not in (
+                        0, 429, 500, 502, 503, 504):
                     raise
-                # the confirm GET is the ONE read standing between an
-                # ambiguous bind and a duplicate-bind window, so it gets
-                # extra storm tolerance beyond get_pod's own retry
-                # budget: if it still fails, the raise reaches the
-                # engine, whose bound_node_of adoption resolves the pod
-                # once the watch cache catches up
-                live = None
-                for confirm_try in range(3):
-                    try:
-                        live = self.get_pod(pod.namespace, pod.name)
-                        break
-                    except ApiError as ge:
-                        # only WIRE-class failures (status 0) and server
-                        # brownouts are worth re-probing; a returned 4xx
-                        # is deterministic (e.g. RBAC) and re-sleeping on
-                        # it would stall the binder for nothing
-                        if confirm_try == 2 or ge.status not in (
-                                0, 429, 500, 502, 503, 504):
-                            raise
-                        time.sleep(self.retry_backoff_s * (2 ** confirm_try))
-                bound_to = (live or {}).get("spec", {}).get("nodeName")
-                if bound_to == node:
-                    # same node is NOT proof it was OUR bind: a foreign
-                    # replica's same-key win on the same node (fleet
-                    # split-brain) also reads nodeName == node. The chip
-                    # annotation discriminates — our own replay carried
-                    # the identical assignment, a foreign win carries
-                    # theirs — and adopting a foreign assignment as ours
-                    # would overwrite the winner's chips in the cache and
-                    # double-book the physical chips they hold.
-                    want = body["metadata"].get("annotations", {}).get(
-                        ASSIGNED_CHIPS_LABEL)
-                    have = ((live or {}).get("metadata", {}).get(
-                        "annotations") or {}).get(ASSIGNED_CHIPS_LABEL)
-                    # absent `have` stays adoptable: every chip-claiming
-                    # bind attaches the annotation, so a foreign win
-                    # shows up present-and-different; absence just means
-                    # a server/test double that didn't echo annotations
-                    if want and have is not None and have != want:
-                        raise ApiError(
-                            "POST", "binding(conflict)", 409,
-                            f"pod bound to {bound_to!r} with a foreign "
-                            f"chip assignment".encode()) from e
-                    log.info("bind %s -> %s: %s but already ours", pod.key,
-                             node, "ambiguous" if ambiguous else "409")
-                    break
-                if bound_to or not ambiguous:
-                    # keep the authority's own reason (webhook denials
-                    # carry the conflicting chip/fence in the message) —
-                    # the raw body, not str(e), which truncates at 200
-                    reason = getattr(e, "body", b"") or str(e).encode()
-                    detail = (f"pod bound to {bound_to!r}".encode()
-                              if bound_to else b"rejected: " + reason)
-                    raise ApiError("POST", "binding(conflict)", 409,
-                                   detail) from e
-                if replay:
-                    raise  # unbound after a replayed POST: genuine failure
-                log.info("bind %s -> %s: ambiguous failure, pod unbound; "
-                         "replaying POST", pod.key, node)
+                time.sleep(self.retry_backoff_s * (2 ** confirm_try))
+        bound_to = (live or {}).get("spec", {}).get("nodeName")
+        if bound_to == node:
+            # same node is NOT proof it was OUR bind: a foreign replica's
+            # same-key win on the same node (fleet split-brain) also
+            # reads nodeName == node. The chip annotation discriminates —
+            # our own replay carried the identical assignment, a foreign
+            # win carries theirs — and adopting a foreign assignment as
+            # ours would overwrite the winner's chips in the cache and
+            # double-book the physical chips they hold.
+            want = body["metadata"].get("annotations", {}).get(
+                ASSIGNED_CHIPS_LABEL)
+            have = ((live or {}).get("metadata", {}).get(
+                "annotations") or {}).get(ASSIGNED_CHIPS_LABEL)
+            # absent `have` stays adoptable: every chip-claiming bind
+            # attaches the annotation, so a foreign win shows up
+            # present-and-different; absence just means a server/test
+            # double that didn't echo annotations
+            if want and have is not None and have != want:
+                raise ApiError(
+                    "POST", "binding(conflict)", 409,
+                    f"pod bound to {bound_to!r} with a foreign "
+                    f"chip assignment".encode()) from e
+            log.info("bind %s -> %s: %s but already ours", pod.key,
+                     node, "ambiguous" if ambiguous else "409")
+            return True
+        if bound_to or not ambiguous:
+            # keep the authority's own reason (webhook denials carry the
+            # conflicting chip/fence in the message) — the raw body, not
+            # str(e), which truncates at 200
+            reason = getattr(e, "body", b"") or str(e).encode()
+            detail = (f"pod bound to {bound_to!r}".encode()
+                      if bound_to else b"rejected: " + reason)
+            raise ApiError("POST", "binding(conflict)", 409,
+                           detail) from e
+        if replayed:
+            raise e  # unbound after a replayed POST: genuine failure
+        log.info("bind %s -> %s: ambiguous failure, pod unbound; "
+                 "replaying POST", pod.key, node)
+        return False
+
+    # -------------------------------------------------------- pipelined wire
+    def _pipe_conn(self, timeout: float):
+        """Dedicated per-thread pipelining connection: (socket, buffered
+        reader). Separate from the ordinary pooled connection — pipelined
+        traffic shares one persistent reader whose buffer must never be
+        torn by http.client's one-request state machine."""
+        import http.client
+
+        pipe = getattr(self._tlocal, "pipe", None)
+        if pipe is None:
+            if urllib.request.getproxies().get(
+                    urllib.parse.urlsplit(self.base_url).scheme):
+                # pipelining through proxies is a compatibility minefield
+                raise ConnectionError("pipelining unsupported via proxy")
+            u = urllib.parse.urlsplit(self.base_url)
+            port = u.port or (443 if u.scheme == "https" else 80)
+            if u.scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    u.hostname, port, timeout=timeout, context=self._ctx)
+            else:
+                conn = http.client.HTTPConnection(
+                    u.hostname, port, timeout=timeout)
+            conn.connect()
+            import socket as _socket
+
+            try:
+                conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                     _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            pipe = (conn.sock, conn.sock.makefile("rb"))
+            self._tlocal.pipe = pipe
+        pipe[0].settimeout(timeout)
+        return pipe
+
+    def _drop_pipe(self) -> None:
+        pipe = getattr(self._tlocal, "pipe", None)
+        if pipe is not None:
+            self._tlocal.pipe = None
+            for part in pipe[::-1]:
+                try:
+                    part.close()
+                except Exception:
+                    pass
+
+    def pipeline(self, reqs: list, timeout: float = 10.0) -> list:
+        """True HTTP/1.1 pipelining: write every request back-to-back on
+        one persistent connection, then read the responses in order.
+        `reqs` is [(method, path, body | None), ...]; returns a
+        position-aligned list of (status, raw_body) | ApiError. A
+        transport failure marks the failed slot and every LATER one with
+        an AmbiguousRequestError-caused ApiError(0) — those requests may
+        or may not have been applied, exactly the ambiguity contract
+        single-POST callers get — and callers own the per-item recovery.
+        Never retries internally (a replayed non-idempotent request
+        whose first copy landed surfaces as a spurious 409)."""
+        import http.client
+
+        sock, fp = self._pipe_conn(timeout)
+        chunks = []
+        host = urllib.parse.urlsplit(self.base_url).netloc
+        for method, path, body in reqs:
+            data = json.dumps(body).encode() if body is not None else b""
+            lines = [f"{method} {self._base_path + path} HTTP/1.1",
+                     f"Host: {host}", f"Content-Length: {len(data)}"]
+            for k, v in self._headers(method, body).items():
+                lines.append(f"{k}: {v}")
+            chunks.append(("\r\n".join(lines) + "\r\n\r\n").encode()
+                          + data)
+        def _ambiguous(exc) -> ApiError:
+            err = ApiError("PIPELINE", "(batch)", 0, str(exc).encode())
+            err.__cause__ = AmbiguousRequestError(str(exc))
+            return err
+
+        try:
+            sock.sendall(b"".join(chunks))
+        except Exception as e:
+            self._drop_pipe()
+            return [_ambiguous(e)] * len(reqs)
+        out: list = []
+        reader = _PipeReader(fp)
+        for i, (method, _path, _body) in enumerate(reqs):
+            try:
+                resp = http.client.HTTPResponse(reader, method=method)
+                resp.begin()
+                raw = resp.read()
+                out.append((resp.status, raw))
+                if resp.will_close:
+                    # server ended the connection (Connection: close):
+                    # later responses will never arrive
+                    raise ConnectionError("server closed mid-pipeline")
+            except Exception as e:
+                self._drop_pipe()
+                # keep every fully-received slot (a will_close response
+                # was parsed before the raise); everything later is
+                # ambiguous — it may or may not have been applied
+                del out[i + 1:]
+                while len(out) < len(reqs):
+                    out.append(_ambiguous(e))
+                break
+        return out
+
+    def bind_pipelined(self, items: list) -> list:
+        """One pipelined wire round for a WINDOW of binds. `items` is
+        [(pod, node, assigned_chips, fence), ...]; returns a position-
+        aligned list of None (bound) | Exception (terminal failure),
+        with every non-2xx/ambiguous slot resolved IN ORDER through the
+        same 409/adopt read-back protocol the single-POST `bind` runs
+        (_bind_resolve) — in-order conflict resolution, one replay for a
+        provably-unapplied POST. Falls back to per-item `bind` when the
+        transport cannot pipeline (proxied connections)."""
+        reqs = []
+        bodies = []
+        for pod, node, chips, fence in items:
+            body = self._bind_body(pod, node, chips, fence)
+            bodies.append(body)
+            reqs.append((
+                "POST",
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}"
+                "/binding", body))
+        try:
+            results = self.pipeline(reqs)
+        except ConnectionError:
+            results = None
+        outcomes: list = []
+        for i, (pod, node, chips, fence) in enumerate(items):
+            if results is None:
+                try:
+                    self.bind(pod, node, chips, fence=fence)
+                    outcomes.append(None)
+                except Exception as e:
+                    outcomes.append(e)
+                continue
+            res = results[i]
+            try:
+                if isinstance(res, Exception):
+                    e = res
+                else:
+                    status, raw = res
+                    if status < 300:
+                        outcomes.append(None)
+                        continue
+                    if status in _RETRYABLE:
+                        # transient brownout status (429/5xx): the
+                        # server REJECTED this slot without applying it,
+                        # so the ordinary single-POST path — and its
+                        # bounded retry/backoff the raw pipeline write
+                        # skips — owns the recovery, exactly as if the
+                        # bind had never been pipelined
+                        try:
+                            self.bind(pod, node, chips, fence=fence)
+                            outcomes.append(None)
+                        except Exception as e2:
+                            outcomes.append(e2)
+                        continue
+                    e = ApiError("POST", reqs[i][1], status, raw)
+                if self._bind_resolve(pod, node, bodies[i], e, False):
+                    outcomes.append(None)
+                    continue
+                # provably unapplied: the one permitted replay, as an
+                # ordinary retried request (it also restores 429/5xx
+                # retry coverage the raw pipeline write skips)
+                try:
+                    self.request("POST", reqs[i][1], bodies[i])
+                    outcomes.append(None)
+                except ApiError as e2:
+                    outcomes.append(
+                        None if self._bind_resolve(pod, node, bodies[i],
+                                                   e2, True) else e2)
+            except Exception as final:
+                outcomes.append(final)
+        return outcomes
 
     def evict(self, pod: Pod) -> None:
         try:
@@ -794,9 +1016,15 @@ class Reflector:
                  relist_s: float = 300.0, watch_timeout_s: float = 60.0,
                  backoff_s: float = 0.5, max_backoff_s: float = 15.0,
                  optional: bool = False, on_absent=None, metrics=None,
-                 rng=None) -> None:
+                 rng=None, selector: str | None = None) -> None:
         self.client = client
         self.path = path
+        # server-side labelSelector (sharded reflectors): appended to
+        # every LIST and WATCH so the apiserver filters at the source —
+        # the replica's socket never carries foreign-pool objects.
+        # set_selector() rotates it; the running watch loop picks the
+        # change up at its next re-list (bounded by watch_timeout_s).
+        self.selector = selector
         self.on_replace = on_replace
         self.on_event = on_event
         # storm observability (utils.obs.Metrics, optional): re-lists,
@@ -843,10 +1071,27 @@ class Reflector:
         configured ceiling."""
         return min(delay * (0.5 + self._rng.random()), self.max_backoff_s)
 
+    def _sel_path(self) -> str:
+        if not self.selector:
+            return self.path
+        sep = "&" if "?" in self.path else "?"
+        return (f"{self.path}{sep}labelSelector="
+                f"{urllib.parse.quote(self.selector)}")
+
+    def set_selector(self, selector: str | None) -> None:
+        """Rotate the server-side selector (shard-lease handover). The
+        RUNNING watch keeps its old selector until its round ends (up to
+        watch_timeout_s) — promptness comes from the caller's synchronous
+        list_once() (set_owned_pools), which installs the new ownership's
+        objects immediately; zeroing the deadline here covers callers
+        that skip that list (the next loop turn re-lists)."""
+        self.selector = selector
+        self.last_list_at = 0.0
+
     def list_once(self) -> str | None:
         self._inc("reflector_relists_total")
         try:
-            doc = self.client.list_all(self.path)
+            doc = self.client.list_all(self._sel_path())
         except ApiError as e:
             if self.optional and e.status in (403, 404):
                 # denied/missing optional resource: do NOT install an empty
@@ -888,7 +1133,8 @@ class Reflector:
                     relist_due = False
                     t_mark = time.perf_counter_ns()
                     for ev in self.client.watch(
-                            self.path, rv, timeout_s=self.watch_timeout_s):
+                            self._sel_path(), rv,
+                            timeout_s=self.watch_timeout_s):
                         t_now = time.perf_counter_ns()
                         self.read_ns += t_now - t_mark
                         self.events += 1
@@ -957,10 +1203,34 @@ class KubeCluster:
 
     def __init__(self, client: KubeClient, telemetry: TelemetryStore,
                  resync_s: float = 2.0, watch: bool | None = None,
-                 relist_s: float = 300.0, metrics: Metrics | None = None
-                 ) -> None:
+                 relist_s: float = 300.0, metrics: Metrics | None = None,
+                 bind_pipeline_window: int = 0,
+                 owned_pools: "set[str] | None" = None,
+                 pool_label: str | None = None) -> None:
         self.client = client
         self.telemetry = telemetry
+        # windowed bind-wire pipelining (bindPipelineWindow knob): binder
+        # workers drain up to this many queued binds per pass onto one
+        # persistent connection (KubeClient.bind_pipelined), and the
+        # event poster batches its POSTs the same way. 0 = the classic
+        # one-POST-per-worker wire.
+        self.bind_pipeline_window = max(int(bind_pipeline_window), 0)
+        # sharded reflection (reflectorSharding): this replica ingests
+        # only nodes of its OWNED pools (columnar.pool_of naming). Used
+        # by SEPARATE-PROCESS fleet replicas, which construct their own
+        # KubeCluster with their shard's pools (the in-process fleet
+        # shares one watch cache and shards behind it via
+        # fleet.ShardedOwnedView instead — see ARCHITECTURE.md). Nodes
+        # filter both server-side — `pool_label` names the node label
+        # carrying the pool, pushed as a labelSelector on the node
+        # reflector's list/watch — and client-side (the guard that also
+        # covers pods bound to foreign nodes and foreign TpuNodeMetrics,
+        # which field selectors cannot express; pending pods always pass:
+        # intake must see them). set_owned_pools hands watch ownership
+        # over with the shard lease. None = full-cluster ingest.
+        self._owned_pools = (set(owned_pools) if owned_pools is not None
+                             else None)
+        self._pool_label = pool_label
         # ingest observability shared by the reflectors: relists/410s/
         # watch errors land here so apiserver storms are visible as
         # counter slopes (ingest_stats surfaces them)
@@ -1035,7 +1305,8 @@ class KubeCluster:
             self._reflectors = [
                 Reflector(client, "/api/v1/nodes",
                           self._replace_nodes, self._node_event,
-                          relist_s=relist_s, metrics=self.metrics),
+                          relist_s=relist_s, metrics=self.metrics,
+                          selector=self._pool_selector()),
                 Reflector(client, "/api/v1/pods",
                           self._replace_pods, self._pod_event,
                           relist_s=relist_s, metrics=self.metrics),
@@ -1095,36 +1366,127 @@ class KubeCluster:
         while not self._stop.is_set():
             self._event_event.wait(timeout=0.5)
             self._event_event.clear()
+            # re-read per wake: the knob may land after this thread spawns
+            window = max(self.bind_pipeline_window, 1)
             while True:
-                try:
-                    key, ns, name, uid, reason, message, type_ = \
-                        self._event_q.popleft()
-                except IndexError:
+                drained = []
+                while len(drained) < max(window, 1):
+                    try:
+                        drained.append(self._event_q.popleft())
+                    except IndexError:
+                        break
+                if not drained:
                     break
-                seq += 1
-                body = {
-                    "apiVersion": "v1", "kind": "Event",
-                    "metadata": {"name": f"{name}.{seq:x}.{id(self):x}",
-                                 "namespace": ns},
-                    "involvedObject": {"kind": "Pod", "name": name,
-                                       "namespace": ns, "uid": uid},
-                    "reason": reason, "message": message[:1024],
-                    "type": type_, "count": 1,
-                    "source": {"component": "yoda-tpu-scheduler"},
-                }
-                try:
-                    self.client.post_event(ns, body)
-                    with self._lock:
-                        self.events_posted += 1
-                except Exception:
-                    # best-effort: an apiserver brownout must not spin
-                    # this thread hot or back-pressure the engine — but
-                    # un-record the verdict so the pod's NEXT identical
-                    # retry re-posts instead of being deduplicated
-                    # against an event that never landed
-                    with self._lock:
-                        self.events_dropped += 1
-                        self._event_seen.pop(key, None)
+                reqs = []
+                keys = []
+                for key, ns, name, uid, reason, message, type_ in drained:
+                    seq += 1
+                    body = {
+                        "apiVersion": "v1", "kind": "Event",
+                        "metadata": {
+                            "name": f"{name}.{seq:x}.{id(self):x}",
+                            "namespace": ns},
+                        "involvedObject": {"kind": "Pod", "name": name,
+                                           "namespace": ns, "uid": uid},
+                        "reason": reason, "message": message[:1024],
+                        "type": type_, "count": 1,
+                        "source": {"component": "yoda-tpu-scheduler"},
+                    }
+                    keys.append(key)
+                    reqs.append((f"/api/v1/namespaces/{ns}/events", body))
+                results = None
+                if window > 1 and len(reqs) > 1:
+                    # batched Event posting: the whole drain rides one
+                    # pipelined wire round instead of a round-trip per
+                    # event (events are best-effort, so an ambiguous
+                    # slot just counts as dropped and un-records its
+                    # dedup verdict)
+                    try:
+                        results = self.client.pipeline(
+                            [("POST", path, body)
+                             for path, body in reqs])
+                    except Exception:
+                        results = None
+                for i, (path, body) in enumerate(reqs):
+                    try:
+                        if results is not None:
+                            res = results[i]
+                            if isinstance(res, Exception):
+                                raise res
+                            status, raw = res
+                            if status >= 300:
+                                raise ApiError("POST", path, status, raw)
+                        else:
+                            self.client.post_event(
+                                body["metadata"]["namespace"], body)
+                        with self._lock:
+                            self.events_posted += 1
+                    except Exception:
+                        # best-effort: an apiserver brownout must not
+                        # spin this thread hot or back-pressure the
+                        # engine — but un-record the verdict so the
+                        # pod's NEXT identical retry re-posts instead of
+                        # being deduplicated against an event that never
+                        # landed
+                        with self._lock:
+                            self.events_dropped += 1
+                            self._event_seen.pop(keys[i], None)
+
+    # ------------------------------------------------------ sharded reflection
+    def _pool_selector(self) -> str | None:
+        """Server-side labelSelector for the node reflector, when a pool
+        label is configured: `<label> in (p1,p2,...)`."""
+        if self._owned_pools is None or not self._pool_label:
+            return None
+        pools = ",".join(sorted(self._owned_pools)) or "__none__"
+        return f"{self._pool_label} in ({pools})"
+
+    def _pool_ok(self, node: str | None) -> bool:
+        """Does this node belong to an owned pool? (True when sharding
+        is off or the name is unknown/None.)"""
+        if self._owned_pools is None or node is None:
+            return True
+        return pool_of(node) in self._owned_pools
+
+    def set_owned_pools(self, pools: "set[str]") -> None:
+        """Shard-lease handover: replace the owned pool set. Foreign
+        nodes/pods/metrics are purged from the cache NOW (their shard's
+        new owner serves them); newly-owned pools arrive with the forced
+        re-list the selector rotation triggers (bounded by the watch
+        rotation). Bumps the membership version so engine memos rebuild."""
+        self._owned_pools = set(pools)
+        with self._lock:
+            gone = [n for n in self._nodes
+                    if pool_of(n) not in self._owned_pools]
+            for n in gone:
+                self._nodes.discard(n)
+                self._node_meta.pop(n, None)
+                self._bump(n)
+                for key in list(self._by_node.get(n, {})):
+                    self._pods.pop(key, None)
+                self._by_node.pop(n, None)
+            self._nodes_ver += 1
+        for n in gone:
+            self.telemetry.delete(n)
+        sel = self._pool_selector()
+        for r in self._reflectors:
+            if r.path == "/api/v1/nodes":
+                r.set_selector(sel)
+            elif r.path in ("/api/v1/pods", METRICS_PATH):
+                r.last_list_at = 0.0  # client-side filtered: just re-list
+            else:
+                continue
+            # prompt handover: one synchronous LIST installs the new
+            # ownership's objects NOW instead of waiting out the current
+            # watch rotation (the reflector thread's own forced re-list
+            # then resumes watching from the fresh resourceVersion; a
+            # concurrent event apply interleaves exactly like the
+            # periodic resync always has). Best-effort — a brownout here
+            # just leaves the handover to the rotation.
+            try:
+                r.list_once()
+            except Exception:
+                pass
 
     # --------------------------------------------------------- cluster events
     def subscribe(self, cb) -> None:
@@ -1161,6 +1523,9 @@ class KubeCluster:
             return self._changes.changes_since(version)
 
     def _replace_nodes(self, items: list[dict]) -> None:
+        if self._owned_pools is not None:
+            items = [i for i in items
+                     if self._pool_ok(i["metadata"]["name"])]
         names = {i["metadata"]["name"] for i in items}
         metas = {i["metadata"]["name"]: _node_meta_from_api(i) for i in items}
         events: list[ClusterEvent] = []
@@ -1184,7 +1549,7 @@ class KubeCluster:
 
     def _node_event(self, typ: str, obj: dict) -> None:
         name = obj.get("metadata", {}).get("name")
-        if not name:
+        if not name or not self._pool_ok(name):
             return
         events: list[ClusterEvent] = []
         with self._lock:
@@ -1233,7 +1598,10 @@ class KubeCluster:
         fresh: dict[str, Pod] = {}
         for item in items:
             p = _pod_from_api(item)
-            if p is not None:
+            if p is not None and (p.node is None or self._pool_ok(p.node)):
+                # sharded reflection: pods bound to foreign pools are the
+                # bulk of the cache at scale and none of this replica's
+                # business; PENDING pods always pass (intake needs them)
                 fresh[p.key] = p
         events: list[ClusterEvent] = []
         with self._lock:
@@ -1250,8 +1618,9 @@ class KubeCluster:
                 if old.node:
                     new = fresh.get(key)
                     if new is None or new.node != old.node:
-                        events.append(
-                            ClusterEvent(POD_DELETED, node=old.node))
+                        events.append(ClusterEvent(
+                            POD_DELETED, node=old.node,
+                            gang=old.labels.get("tpu/gang-name")))
             for key, p in fresh.items():
                 if p.node:
                     old = self._pods.get(key)
@@ -1275,12 +1644,24 @@ class KubeCluster:
         with self._lock:
             old = self._pods.get(key)
             p = None if typ == "DELETED" else _pod_from_api(obj)
+            if (p is not None and p.node is not None
+                    and not self._pool_ok(p.node)):
+                # bound into a foreign pool (another replica's win): out
+                # of our view — drop any cached incarnation silently (its
+                # departure frees nothing we own, so no capacity event)
+                self._drop_pod(key)
+                p = None
+                old = None
             if p is None:  # deleted, or went terminal: drop from cache
                 self._drop_pod(key)
                 if old is not None and old.node:
                     # a bound pod left: its chips/ports/cpu are free — the
-                    # capacity event parked pods wake on
-                    events.append(ClusterEvent(POD_DELETED, node=old.node))
+                    # capacity event parked pods wake on. The gang label
+                    # rides along for the elastic controller's orphaned-
+                    # growing-record retirement.
+                    events.append(ClusterEvent(
+                        POD_DELETED, node=old.node,
+                        gang=old.labels.get("tpu/gang-name")))
             # events can arrive out of order with our own write-through bind
             # (we update the cache at bind time, the ADDED/MODIFIED event for
             # the pre-bind pod may still be in flight); keep the newer.
@@ -1315,6 +1696,8 @@ class KubeCluster:
         can't diverge on staleness behaviour."""
         seen = set()
         for m in metrics:
+            if not self._pool_ok(m.node):
+                continue
             seen.add(m.node)
             self.telemetry.put(m)
         for node in set(self.telemetry.nodes()) - seen:
@@ -1399,6 +1782,8 @@ class KubeCluster:
 
     def _metrics_event(self, typ: str, obj: dict) -> None:
         m = TpuNodeMetrics.from_cr(obj)
+        if not self._pool_ok(m.node):
+            return
         if typ == "DELETED":
             self.telemetry.delete(m.node)
         else:
@@ -1682,89 +2067,122 @@ class KubeCluster:
     def _bind_loop(self) -> None:
         while True:
             self._bind_event.wait()
+            # window re-read per drain round: the knob may be installed
+            # after the worker threads started (the serve path sets it
+            # from the profile config; a bind dispatched before that
+            # must not freeze window=1 for the process lifetime)
+            window = max(self.bind_pipeline_window, 1)
             while True:
+                batch = []
                 with self._lock:
-                    if not self._bind_q:
+                    while self._bind_q and len(batch) < window:
+                        batch.append(self._bind_q.popleft())
+                    if not batch:
                         if not self._stop.is_set():
                             # leave the event set during shutdown so every
                             # parked worker wakes and exits
                             self._bind_event.clear()
                         break
-                    pod, node, chips, on_fail, on_success, fence = \
-                        self._bind_q.popleft()
-                try:
+                if len(batch) > 1:
+                    # windowed pipelining: one wire round for the whole
+                    # batch, responses (and their 409/ambiguous recovery)
+                    # resolved in order by KubeClient.bind_pipelined
+                    t0 = time.perf_counter_ns()
+                    w0 = time.time()
                     try:
-                        t0 = time.perf_counter_ns()
-                        w0 = time.time()
+                        outs = self.client.bind_pipelined(
+                            [(p, n, c, f)
+                             for p, n, c, _of, _os, f in batch])
+                    except Exception as e:  # defensive: fail the window
+                        outs = [e] * len(batch)
+                    # wire attribution: the window shares one RTT —
+                    # attribute the mean per bind (the aggregate
+                    # bind_wire_ns stays exact)
+                    per_ns = (time.perf_counter_ns() - t0) // len(batch)
+                    for item, err in zip(batch, outs):
+                        self._settle_bind(item, err, per_ns, w0)
+                else:
+                    item = batch[0]
+                    pod, node, chips, _on_fail, _on_success, fence = item
+                    t0 = time.perf_counter_ns()
+                    w0 = time.time()
+                    try:
                         self.client.bind(pod, node, chips, fence=fence)
-                        dt_ns = time.perf_counter_ns() - t0
-                        self.bind_wire_ns += dt_ns
-                        self.bind_wire_n += 1
-                        # per-bind wire attribution: RTT histogram +
-                        # labeled outcome counter + a bind_wire span for
-                        # sampled pods (the async twin of the engine's
-                        # sync-path wire span)
-                        self.metrics.observe("bind_wire_ms", dt_ns / 1e6)
-                        self.metrics.inc("bind_wire_total",
-                                         labels={"outcome": "ok"})
-                        if span_sampled(pod.key, self.trace_sampling):
-                            self.spans.record(
-                                "bind_wire", pod.key, w0,
-                                w0 + dt_ns / 1e9, {"node": node})
-                        if on_success is not None:
-                            try:
-                                on_success(pod, node)
-                            except Exception:
-                                log.exception(
-                                    "bind on_success handler failed")
+                        err = None
                     except Exception as e:
-                        self.metrics.inc(
-                            "bind_wire_total",
-                            labels={"outcome": "conflict"
-                                    if getattr(e, "status", None) == 409
-                                    else "error"})
-                        # roll the optimistic entry back IN PLACE to
-                        # Pending (the cache object is the same one the
-                        # serve loop's intake reads — dropping it would
-                        # hide the pod until the next relist): chips read
-                        # free again, intake sees it again. IDENTITY
-                        # guard: only the exact object bind_async
-                        # installed is reverted — if the watch already
-                        # replaced it (a fresh bound entry = the bind
-                        # actually landed and this failure was the lost
-                        # response; or a new incarnation), the cache is
-                        # authoritative and nothing is rolled back or
-                        # requeued (the serve loop's watch-confirmed
-                        # cleanup releases any stale queue entry).
-                        rolled_back = False
-                        with self._lock:
-                            cur = self._pods.get(pod.key)
-                            if cur is pod and cur.node == node:
-                                self._by_node.get(node, {}).pop(
-                                    pod.key, None)
-                                cur.node = None
-                                cur.phase = PodPhase.PENDING
-                                cur.labels.pop(ASSIGNED_CHIPS_LABEL, None)
-                                self._bump(node)
-                                # the bind never landed: a later rebind's
-                                # watch_confirm must not measure from
-                                # THIS dispatch
-                                self._confirm_t0.pop(pod.key, None)
-                                rolled_back = True
-                        log.warning("async bind %s -> %s failed: %s%s",
-                                    pod.key, node, e,
-                                    "" if rolled_back
-                                    else " (cache superseded; no rollback)")
-                        if rolled_back and on_fail is not None:
-                            try:
-                                on_fail(pod, node, e)
-                            except Exception:
-                                log.exception("bind on_fail handler failed")
-                finally:
-                    with self._lock:
-                        self._bind_inflight -= 1
+                        err = e
+                    self._settle_bind(item, err,
+                                      time.perf_counter_ns() - t0, w0)
             if self._stop.is_set():
                 return
+
+    def _settle_bind(self, item, err, dt_ns: int, w0: float) -> None:
+        """Post-wire bookkeeping for one dispatched bind — identical for
+        the single-POST and pipelined paths: success metrics/spans and
+        on_success, or the in-place optimistic-cache rollback and
+        on_fail."""
+        pod, node, chips, on_fail, on_success, fence = item
+        try:
+            if err is None:
+                self.bind_wire_ns += dt_ns
+                self.bind_wire_n += 1
+                # per-bind wire attribution: RTT histogram + labeled
+                # outcome counter + a bind_wire span for sampled pods
+                # (the async twin of the engine's sync-path wire span)
+                self.metrics.observe("bind_wire_ms", dt_ns / 1e6)
+                self.metrics.inc("bind_wire_total",
+                                 labels={"outcome": "ok"})
+                if span_sampled(pod.key, self.trace_sampling):
+                    self.spans.record("bind_wire", pod.key, w0,
+                                      w0 + dt_ns / 1e9, {"node": node})
+                if on_success is not None:
+                    try:
+                        on_success(pod, node)
+                    except Exception:
+                        log.exception("bind on_success handler failed")
+                return
+            e = err
+            self.metrics.inc(
+                "bind_wire_total",
+                labels={"outcome": "conflict"
+                        if getattr(e, "status", None) == 409
+                        else "error"})
+            # roll the optimistic entry back IN PLACE to Pending (the
+            # cache object is the same one the serve loop's intake reads
+            # — dropping it would hide the pod until the next relist):
+            # chips read free again, intake sees it again. IDENTITY
+            # guard: only the exact object bind_async installed is
+            # reverted — if the watch already replaced it (a fresh bound
+            # entry = the bind actually landed and this failure was the
+            # lost response; or a new incarnation), the cache is
+            # authoritative and nothing is rolled back or requeued (the
+            # serve loop's watch-confirmed cleanup releases any stale
+            # queue entry).
+            rolled_back = False
+            with self._lock:
+                cur = self._pods.get(pod.key)
+                if cur is pod and cur.node == node:
+                    self._by_node.get(node, {}).pop(pod.key, None)
+                    cur.node = None
+                    cur.phase = PodPhase.PENDING
+                    cur.labels.pop(ASSIGNED_CHIPS_LABEL, None)
+                    self._bump(node)
+                    # the bind never landed: a later rebind's
+                    # watch_confirm must not measure from THIS dispatch
+                    self._confirm_t0.pop(pod.key, None)
+                    rolled_back = True
+            log.warning("async bind %s -> %s failed: %s%s",
+                        pod.key, node, e,
+                        "" if rolled_back
+                        else " (cache superseded; no rollback)")
+            if rolled_back and on_fail is not None:
+                try:
+                    on_fail(pod, node, e)
+                except Exception:
+                    log.exception("bind on_fail handler failed")
+        finally:
+            with self._lock:
+                self._bind_inflight -= 1
 
     def flush_binds(self, timeout: float = 10.0) -> bool:
         """Wait for dispatched binds to reach the server (shutdown,
@@ -1843,6 +2261,12 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     from ..scheduler.multi import MultiProfileScheduler
 
     cluster.wait_synced()
+    # windowed bind pipelining (bindPipelineWindow): installed BEFORE
+    # any scheduler exists — the binder/eventer threads also re-read it
+    # per drain round, but nothing should ever dispatch against the
+    # constructor default when a profile configured otherwise
+    cluster.bind_pipeline_window = max(
+        getattr(profiles[0][0], "bind_pipeline_window", 0), 0)
     if len(profiles) == 1 and profiles[0][0].fleet_replicas > 1:
         # scheduler fleet: N engine replicas over the ONE shared watch
         # cache, each on its own thread, committing binds optimistically

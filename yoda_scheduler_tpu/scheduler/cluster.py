@@ -286,7 +286,13 @@ class FakeCluster:
         if need_mb:
             m = self.telemetry.get(node)
             if m is not None:
-                by_coord = {c.coords: c for c in m.chips}
+                # coords index memoised per metrics incarnation (put()
+                # installs a fresh object): rebuilt dicts per bind were
+                # a measurable slice of authority cost at drain scale
+                by_coord = m.__dict__.get("_by_coord")
+                if by_coord is None:
+                    by_coord = {c.coords: c for c in m.chips}
+                    m.__dict__["_by_coord"] = by_coord
                 for c in claimed:
                     chip = by_coord.get(c)
                     if chip is not None and need_mb > chip.hbm_free_mb:
@@ -331,5 +337,9 @@ class FakeCluster:
             # was never bound (or already gone) must not wake every
             # capacity-parked pod for a doomed retry (mirrors
             # KubeCluster._pod_event, which emits POD_DELETED only for
-            # cached pods with a node)
-            self._publish(ClusterEvent(POD_DELETED, node=node))
+            # cached pods with a node). The gang label rides along so
+            # the elastic controller can retire a growing record whose
+            # gang was deleted externally (core._drain_elastic_retires).
+            self._publish(ClusterEvent(
+                POD_DELETED, node=node,
+                gang=pod.labels.get("tpu/gang-name")))

@@ -31,6 +31,8 @@ returns None and the pod takes the scalar path unchanged.
 
 from __future__ import annotations
 
+import zlib
+
 try:  # numpy ships with the jax toolchain this image bakes in, but the
     import numpy as np  # scheduler must degrade to the scalar path without it
 
@@ -42,15 +44,62 @@ except Exception:  # pragma: no cover - exercised only on stripped images
 from ..telemetry.schema import HEALTHY
 
 
+def pool_of(name: str) -> str:
+    """Node -> node-pool key: the name with its trailing replica digits
+    (and separator) stripped — ``s12-host-3`` and ``s12-host-0`` share
+    pool ``s12-host``; ``t5-1`` -> ``t5``. Real fleets name nodes
+    ``<pool>-<ordinal>`` (GKE node pools, TPU slice hosts), so the prefix
+    IS the pool. A name with no digit suffix is its own pool."""
+    stripped = name.rstrip("0123456789")
+    if stripped != name:
+        stripped = stripped.rstrip("-")
+    return stripped or name
+
+
+def shard_of_pool(pool: str, shard_count: int) -> int:
+    """Stable pool -> shard hash (crc32: identical across processes and
+    runs, the same discipline as fleet.shard_of)."""
+    return zlib.crc32(pool.encode()) % max(shard_count, 1)
+
+
 class ColumnarTable:
     """Parallel-array snapshot of the cluster, row-aligned with the
     engine's object snapshot (``snapshot.list()`` order)."""
 
-    def __init__(self, allocator) -> None:
+    def __init__(self, allocator, shards: int = 0) -> None:
         self.allocator = allocator
+        # pool sharding (columnarShards knob, 0 = off): rows carry a
+        # shard id hashed from their node POOL (pool_of), and three
+        # things become O(shard) instead of O(cluster):
+        #   - membership rebuilds: rows of untouched pools are block-
+        #     copied from the previous arrays (one vectorized gather per
+        #     column) instead of re-filled through Python per row;
+        #   - qualifying-chip memo invalidation: a row update bumps only
+        #     its shard's serial, and qual() re-evaluates only the rows
+        #     of moved shards in place of a full-table recompute;
+        #   - change-log row repair: unchanged-shard rows are never
+        #     revisited.
+        # 0 keeps the pre-shard behaviour bit-for-bit (full refill on
+        # membership change, whole-cache qual invalidation).
+        self.shards = max(int(shards), 0)
+        # incremental-commit kernels (nativeplane.IncrementalKernels),
+        # attached by the engine when the native plane is live: the
+        # post-bind row refresh rewrites the free-chip mask row in one C
+        # call instead of a numpy op per column. None = numpy path.
+        self.native_refresh = None
+        self._idx_scratch = None
+        # set by the engine: dirty node names since a version vector,
+        # IGNORING membership movement (the ordinary changes_since
+        # refuses across membership changes; the sharded rebuild needs
+        # exactly that delta to know which surviving rows moved). None /
+        # unattributable -> full rebuild, same as before.
+        self.membership_dirty_fn = None
         self._vers: tuple | None = None
         self._names: list[str] = []
         self.index: dict[str, int] = {}
+        # pool -> shard memo (names repeat across rebuilds; crc32 per
+        # node per rebuild would be pure waste)
+        self._pool_shard: dict[str, int] = {}
         # string interning for accelerator/generation equality masks; -1
         # never appears in a column, so unknown spec strings match nothing
         self._intern: dict[str, int] = {}
@@ -64,12 +113,60 @@ class ColumnarTable:
         self._qual_cache: dict = {}
         self._serial = 0
         self._width = 1
+        # per-shard change serials (sharded mode): qual() caches carry a
+        # snapshot of this vector and repair only the shards that moved
+        self._shard_serials = None
+        self._row_shard = None
         # observability (tests + bench)
         self.rebuilds = 0
         self.row_updates = 0
+        self.shard_rebuilds = 0   # membership rebuilds served sharded
+        self.rows_copied = 0      # rows block-copied instead of refilled
+        self.qual_repairs = 0     # qual() cache entries repaired in place
 
     def __len__(self) -> int:
         return len(self._names)
+
+    # ------------------------------------------------------------- sharding
+    def _shard_id(self, name: str) -> int:
+        pool = pool_of(name)
+        hit = self._pool_shard.get(pool)
+        if hit is None:
+            hit = shard_of_pool(pool, self.shards)
+            if len(self._pool_shard) > 65536:
+                self._pool_shard.clear()
+            self._pool_shard[pool] = hit
+        return hit
+
+    def _row_dirtied(self, i: int) -> None:
+        """One row changed in place: invalidate the qualifying-chip memo
+        at the finest granularity available — the row's SHARD when
+        sharding is on (qual() repairs just that shard's rows), the whole
+        cache otherwise (the pre-shard behaviour)."""
+        self._serial += 1
+        if self._row_shard is not None:
+            self._shard_serials[self._row_shard[i]] += 1
+        else:
+            self._qual_cache.clear()
+
+    def shard_views(self):
+        """Contiguous (shard, start, stop) row runs in table order — the
+        per-shard array views sharded consumers (per-shard scans, the
+        native refresh path) slice the global columns with. Row order is
+        still snapshot order, so concatenating the runs IS the table."""
+        if self._row_shard is None or not len(self._names):
+            return [(0, 0, len(self._names))]
+        out = []
+        rs = self._row_shard
+        start = 0
+        cur = int(rs[0])
+        for i in range(1, len(rs)):
+            s = int(rs[i])
+            if s != cur:
+                out.append((cur, start, i))
+                start, cur = i, s
+        out.append((cur, start, len(rs)))
+        return out
 
     # ------------------------------------------------------------- interning
     def _intern_id(self, s: str) -> int:
@@ -156,6 +253,11 @@ class ColumnarTable:
         self.chip_core = np.zeros((n, width), dtype=np.int64)
         self.chip_power = np.zeros((n, width), dtype=np.int64)
         self.chip_duty = np.zeros((n, width), dtype=np.float64)
+        # native row-refresh scratch + cached base pointers (recomputed
+        # here because every rebuild reallocates the buffers)
+        self._idx_scratch = np.empty(max(width, 1), dtype=np.int64)
+        self._chip_free_base = self.chip_free.ctypes.data
+        self._scratch_ptr = self._idx_scratch.ctypes.data
 
     def _fill_row(self, i: int, ni) -> bool:
         """Recompute one row from a NodeInfo + the allocator's free set.
@@ -223,10 +325,23 @@ class ColumnarTable:
         self.free_count[i] = len(free)
         self.claimed_hbm[i] = ni.claimed_hbm_mb()
         k = len(chips)
-        self.chip_free[i, :k] = [h and (co in free)
-                                 for h, co in self._row_chips[i]]
-        if k < self._width:
-            self.chip_free[i, k:] = False
+        nk = self.native_refresh
+        if nk is not None:
+            # one C call rewrites the whole padded free-mask row (zeroing
+            # included) from the free chip indices — bit-identical to the
+            # numpy writes below, minus their per-op dispatch. Pointers
+            # are cached at _alloc time; the scratch round-trips through
+            # numpy only for the bulk index assign.
+            idx = [j for j, (h, co) in enumerate(self._row_chips[i])
+                   if h and co in free]
+            self._idx_scratch[:len(idx)] = idx
+            nk.refresh_fn(self._chip_free_base + i * self._width,
+                          self._width, self._scratch_ptr, len(idx))
+        else:
+            self.chip_free[i, :k] = [h and (co in free)
+                                     for h, co in self._row_chips[i]]
+            if k < self._width:
+                self.chip_free[i, k:] = False
         return True
 
     # ----------------------------------------------------------------- sync
@@ -242,6 +357,15 @@ class ColumnarTable:
             return len(self._names) == len(snapshot)
         if self._vers is None or vers[2] != self._vers[2] \
                 or len(snapshot) != len(self._names):
+            # membership moved (or first sync): the sharded fast path
+            # refills only the pools the delta touched and block-copies
+            # the rest; everything else rebuilds from scratch
+            if (self.shards and self._vers is not None
+                    and self.membership_dirty_fn is not None):
+                dirty = self.membership_dirty_fn(self._vers)
+                if dirty is not None \
+                        and self._rebuild_sharded(snapshot, vers, dirty):
+                    return True
             return self._rebuild(snapshot, vers)
         _, dirty = changes_since_fn(self._vers)
         if dirty is None:
@@ -256,9 +380,7 @@ class ColumnarTable:
             if ni is None or not self._fill_row(i, ni):
                 return self._rebuild(snapshot, vers)
             self.row_updates += 1
-        if dirty:
-            self._serial += 1
-            self._qual_cache.clear()
+            self._row_dirtied(i)
         self._vers = vers
         return True
 
@@ -289,8 +411,7 @@ class ColumnarTable:
         if not self._fill_row(i, ni):
             return False  # shape outgrew the padding: next sync rebuilds
         self.row_updates += 1
-        self._serial += 1
-        self._qual_cache.clear()
+        self._row_dirtied(i)
         self._vers = new_vers
         return True
 
@@ -303,12 +424,87 @@ class ColumnarTable:
         self._alloc(len(nodes), width)
         self._names = [ni.name for ni in nodes]
         self.index = {name: i for i, name in enumerate(self._names)}
+        self._install_shard_map()
         for i, ni in enumerate(nodes):
             self._fill_row(i, ni)
         self._vers = vers
         self._serial += 1
         self._qual_cache.clear()
         self.rebuilds += 1
+        return True
+
+    def _install_shard_map(self) -> None:
+        if not self.shards:
+            return
+        self._row_shard = np.fromiter(
+            (self._shard_id(n) for n in self._names),
+            dtype=np.int64, count=len(self._names))
+        self._shard_serials = np.zeros(self.shards, dtype=np.int64)
+        self._qual_cache.clear()
+
+    def _rebuild_sharded(self, snapshot, vers, dirty) -> bool:
+        """Membership rebuild at pool granularity: rows whose node
+        SURVIVED the membership change untouched (name present before and
+        after, no change-log entry) are block-copied from the previous
+        arrays with one vectorized gather per column; only new nodes and
+        change-log-dirty rows pay the Python per-row fill. The dirty set
+        comes from membership_dirty_fn — the change logs WITHOUT the
+        membership-version gate — so a copied row is provably
+        bit-identical to what _fill_row would recompute. False = the
+        table shape moved (padding width changed) or the fast path can't
+        serve this delta; the caller runs the full rebuild."""
+        nodes = snapshot.list()
+        width = 1
+        for ni in nodes:
+            if ni.metrics is not None and len(ni.metrics.chips) > width:
+                width = len(ni.metrics.chips)
+        if width != self._width or self._row_shard is None:
+            return False
+        old_index = self.index
+        old_row_gen, old_row_chips = self._row_gen, self._row_chips
+        old_cols = [self.valid, self.heartbeat, self.accel, self.gen,
+                    self.unsched, self.label_class, self.free_count,
+                    self.hbm_total_sum, self.hbm_free_sum,
+                    self.claimed_hbm, self.chip_free, self.chip_hbm_free,
+                    self.chip_hbm_total, self.chip_clock, self.chip_bw,
+                    self.chip_core, self.chip_power, self.chip_duty]
+        self._alloc(len(nodes), width)
+        self._names = [ni.name for ni in nodes]
+        self.index = {name: i for i, name in enumerate(self._names)}
+        self._install_shard_map()
+        new_cols = [self.valid, self.heartbeat, self.accel, self.gen,
+                    self.unsched, self.label_class, self.free_count,
+                    self.hbm_total_sum, self.hbm_free_sum,
+                    self.claimed_hbm, self.chip_free, self.chip_hbm_free,
+                    self.chip_hbm_total, self.chip_clock, self.chip_bw,
+                    self.chip_core, self.chip_power, self.chip_duty]
+        src: list[int] = []
+        dst: list[int] = []
+        fill: list[int] = []
+        for i, ni in enumerate(nodes):
+            j = old_index.get(ni.name)
+            if j is None or ni.name in dirty:
+                fill.append(i)
+            else:
+                src.append(j)
+                dst.append(i)
+        if src:
+            src_a = np.asarray(src, dtype=np.int64)
+            dst_a = np.asarray(dst, dtype=np.int64)
+            for old_c, new_c in zip(old_cols, new_cols):
+                new_c[dst_a] = old_c[src_a]
+            for j, i in zip(src, dst):
+                self._row_gen[i] = old_row_gen[j]
+                self._row_chips[i] = old_row_chips[j]
+        for i in fill:
+            if not self._fill_row(i, nodes[i]):
+                return self._rebuild(snapshot, vers)
+        self._vers = vers
+        self._serial += 1
+        self._qual_cache.clear()
+        self.shard_rebuilds += 1
+        self.rows_copied += len(src)
+        self.row_updates += len(fill)
         return True
 
     # ----------------------------------------------------------------- views
@@ -320,14 +516,33 @@ class ColumnarTable:
         key = (min_free_mb, min_clock_mhz)
         hit = self._qual_cache.get(key)
         if hit is not None:
-            return hit
+            if self._row_shard is None:
+                return hit
+            # sharded repair: entries survive row updates and re-evaluate
+            # ONLY the rows of shards whose serial moved since the entry
+            # was cached — O(shard), not O(cluster), per invalidation
+            q, qc, serials = hit
+            moved = np.flatnonzero(self._shard_serials != serials)
+            if moved.size == 0:
+                return q, qc
+            rows = np.flatnonzero(np.isin(self._row_shard, moved))
+            sub = (self.chip_free[rows]
+                   & (self.chip_hbm_free[rows] >= min_free_mb)
+                   & (self.chip_clock[rows] >= min_clock_mhz))
+            q[rows] = sub
+            qc[rows] = sub.sum(axis=1)
+            serials[moved] = self._shard_serials[moved]
+            self.qual_repairs += 1
+            return q, qc
         q = (self.chip_free
              & (self.chip_hbm_free >= min_free_mb)
              & (self.chip_clock >= min_clock_mhz))
         qc = q.sum(axis=1)
         if len(self._qual_cache) > 16:
             self._qual_cache.clear()
-        self._qual_cache[key] = (q, qc)
+        entry = ((q, qc) if self._row_shard is None
+                 else (q, qc, self._shard_serials.copy()))
+        self._qual_cache[key] = entry
         return q, qc
 
     def rows_for(self, infos):

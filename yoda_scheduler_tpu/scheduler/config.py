@@ -40,6 +40,37 @@ def _columnar_default() -> bool:
         "0", "false", "off")
 
 
+def _columnar_shards_default() -> int:
+    """Pool sharding for the columnar table (scheduler/columnar.py):
+    node pools hash into this many shards, making membership rebuilds,
+    qualifying-chip memo invalidation, and change-log row repair
+    O(shard) instead of O(cluster). 0 (the default) keeps the unsharded
+    table bit-for-bit; env YODA_COLUMNAR_SHARDS overrides."""
+    raw = os.environ.get("YODA_COLUMNAR_SHARDS", "")
+    if not raw:
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
+def _bind_pipeline_default() -> int:
+    """Windowed bind-wire pipelining (k8s/client.py): binder workers
+    drain up to this many queued binds per pass and put them on ONE
+    persistent connection back-to-back (HTTP/1.1 pipelining), reading
+    the responses in order — conflicts resolve through the existing
+    409/adopt protocol, in submission order. 0 (default) keeps the
+    one-POST-per-worker wire; env YODA_BIND_PIPELINE overrides."""
+    raw = os.environ.get("YODA_BIND_PIPELINE", "")
+    if not raw:
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
 def _native_plane_default() -> bool:
     """Opt-out knob for the native data plane (scheduler/nativeplane.py).
     YODA_NATIVE_PLANE=0 restores the numpy columnar path end-to-end —
@@ -252,6 +283,13 @@ class SchedulerConfig:
     # as the fallback (non-vectorizable plugins/pods) and ground truth;
     # False — or env YODA_COLUMNAR=0 — restores it end-to-end.
     columnar: bool = field(default_factory=_columnar_default)
+    # pool-sharded columnar table (scheduler/columnar.py pool_of): node
+    # pools hash into this many shards; membership rebuilds block-copy
+    # untouched pools, and the qualifying-chip memo invalidates (and
+    # repairs) per shard instead of per cluster. 0 (default, or env
+    # YODA_COLUMNAR_SHARDS unset) keeps the unsharded table — placements
+    # are bit-identical either way (tests/test_columnar.py shard fuzz).
+    columnar_shards: int = field(default_factory=_columnar_shards_default)
     # native data plane: run the memo-miss full filter+score scan as ONE
     # GIL-releasing call into the fused C++ kernel (native/fusedplane.cc
     # via scheduler/nativeplane.py), consuming the columnar table's
@@ -279,6 +317,14 @@ class SchedulerConfig:
     # topology, affinity, nominated, and hold-affected pods always take
     # the per-pod cycle regardless of this knob.
     batch_max_pods: int = field(default_factory=_batch_default)
+    # windowed in-flight bind pipelining (k8s/client.py): binder workers
+    # batch up to this many queued binds onto one persistent connection
+    # back-to-back, resolving responses (409s included) in order; Event
+    # posting batches through the same path. 0 (default, or env
+    # YODA_BIND_PIPELINE unset) keeps one POST per worker round-trip —
+    # placements are identical either way (the wire only reorders
+    # latency, never outcomes; parity pinned in tests/test_k8s.py).
+    bind_pipeline_window: int = field(default_factory=_bind_pipeline_default)
     # dispatch the bind POST on a binder worker (upstream kube-scheduler's
     # binding-cycle goroutine) when the cluster backend supports it
     # (KubeCluster.bind_async); the in-memory FakeCluster always binds
@@ -318,6 +364,17 @@ class SchedulerConfig:
     # preferentially and carries a fencing token on binds into them.
     # 0 = one shard per replica.
     shard_leases: int = 0
+    # sharded reflection (scheduler/fleet.py ShardedOwnedView +
+    # k8s/client.py KubeCluster owned-pool filtering): each fleet
+    # replica ingests and maintains scheduling state ONLY for the node
+    # pools its shard leases cover — membership, change events, snapshot
+    # and columnar rows for foreign shards never enter the replica —
+    # with watch ownership handed over alongside the lease on rebalance.
+    # Off (default): every replica keeps the full-cluster view and may
+    # place onto foreign shards optimistically (bit-identical to the
+    # pre-knob fleet). On: a replica can only place within its owned
+    # pools, the trade that makes its ingest O(own shards).
+    reflector_sharding: bool = False
     # "sharded" (leases + shard-affinity scoring + fencing) or
     # "free-for-all" (every replica pulls from the shared intake with no
     # node preference — the A/B baseline with the higher conflict rate)
@@ -423,9 +480,13 @@ class SchedulerConfig:
                 "defragCooldownSeconds", defaults.defrag_cooldown_s)),
             async_binding=bool(args.get("asyncBinding",
                                         defaults.async_binding)),
+            bind_pipeline_window=max(int(args.get(
+                "bindPipelineWindow", defaults.bind_pipeline_window)), 0),
             pod_hinted_backoff_s=float(args.get(
                 "podHintedBackoffSeconds", defaults.pod_hinted_backoff_s)),
             columnar=bool(args.get("columnar", defaults.columnar)),
+            columnar_shards=max(int(args.get(
+                "columnarShards", defaults.columnar_shards)), 0),
             native_plane=bool(args.get("nativePlane",
                                        defaults.native_plane)),
             native_prefetch=bool(args.get("nativePrefetch",
@@ -448,6 +509,8 @@ class SchedulerConfig:
                 "shardLeases", defaults.shard_leases)), 0),
             fleet_mode=_valid_fleet_mode(str(args.get(
                 "fleetMode", defaults.fleet_mode))),
+            reflector_sharding=bool(args.get(
+                "reflectorSharding", defaults.reflector_sharding)),
             shard_rebalance_s=float(args.get(
                 "shardRebalanceSeconds", defaults.shard_rebalance_s)),
             webhook_port=int(args.get(

@@ -84,6 +84,47 @@ from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chi
 # distinguishes "caller supplied no metrics" from "telemetry is None"
 _UNSET = object()
 
+# cached single-label tuples for the per-cycle labeled counters (the
+# values come from small fixed vocabularies — outcomes, planes, plugin
+# names — so the cache stays tiny while saving a dict build + sort per
+# scheduling cycle)
+_LABEL1_CACHE: dict = {}
+
+_EMPTY_SET: frozenset = frozenset()
+
+
+def _numpy_fold(smat, kind_flags, weights, n):
+    """The ONE numpy definition of the normalize+weighted-sum fold:
+    op-for-op `Scheduler._fold_scores` (and the C kernel's
+    yoda_batch_fold) — minmax lo/hi, span == 0 -> flat 100.0, else
+    0.0 + (v - lo) * 100.0 / span, accumulated totals + w * v per
+    scorer in order. The batch-commit loop and the per-cycle fold both
+    fall back to THIS when the native kernel is absent, so the three
+    implementations cannot drift apart one call site at a time. Returns
+    the totals array."""
+    totals = np.zeros(n, dtype=np.float64)
+    for k in range(smat.shape[0]):
+        arr = smat[k, :n]
+        if kind_flags[k]:
+            lowest = arr.min()
+            span = arr.max() - lowest
+            if span == 0:
+                arr = np.full(n, 100.0)
+            else:
+                arr = 0.0 + (arr - lowest) * 100.0 / span
+        totals = totals + float(weights[k]) * arr
+    return totals
+
+
+def _label1(key: str, value: str) -> tuple:
+    hit = _LABEL1_CACHE.get((key, value))
+    if hit is None:
+        if len(_LABEL1_CACHE) > 4096:
+            _LABEL1_CACHE.clear()
+        hit = ((key, value),)
+        _LABEL1_CACHE[(key, value)] = hit
+    return hit
+
 
 class Clock:
     """Injectable time source so tests/benches control backoff and timeouts."""
@@ -378,6 +419,8 @@ class Scheduler:
                 if id(p) not in seen_ids:
                     seen_ids.add(id(p))
                     self._eq_plugins.append(p)
+        # class-level batch-key cache (see _compute_batch_key)
+        self._bkey_class_cache: dict = {}
         if self.config.batch_max_pods > 1:
             self.queue.set_batch_key_fn(self._batch_key)
         # cluster events land in the queue's inbox from ANY thread
@@ -508,31 +551,57 @@ class Scheduler:
         # without cross-thread mutation of the dict.
         self.doomed_gangs: dict[str, str] = {}
         self._gang_revivals: deque = deque()
+        # elastic-gang retirement inbox (PR 10 sliver): POD_DELETED
+        # events carrying a gang label land here from any thread; the
+        # engine thread drains them in run_one and retires a _growing
+        # record whose gang has ZERO bound members left in cluster truth
+        # — an externally-deleted mid-growth gang would otherwise
+        # survive until backstop eviction and miscount grow/admission
+        # metrics when the name is reused.
+        self._elastic_retires: deque = deque()
         # columnar data plane (scheduler/columnar.py): parallel-array twin
         # of the object snapshot, maintained from the same change logs.
         # None when numpy is unavailable, the knob is off, or there is no
         # allocator to source free sets from — every consumer then takes
         # the scalar path (its ground truth) unconditionally.
         self._columnar: ColumnarTable | None = (
-            ColumnarTable(self.allocator)
+            ColumnarTable(self.allocator,
+                          shards=self.config.columnar_shards)
             if HAVE_NUMPY and self.config.columnar
             and self.allocator is not None else None)
+        if self._columnar is not None and self._columnar.shards:
+            # sharded membership rebuilds need the change-log delta
+            # WITHOUT the membership-version gate _changes_since_vers
+            # enforces (that gate exists exactly because per-name logs
+            # can't describe joins — the sharded rebuild handles joins
+            # itself and only needs the surviving rows' dirt)
+            self._columnar.membership_dirty_fn = self._membership_dirty
         # native data plane (scheduler/nativeplane.py): the fused C++
         # kernel running the memo-miss full scan in one GIL-releasing
         # call. Requires the columnar table (it consumes those arrays
         # zero-copy); a missing/stale/unbuildable .so degrades silently
         # to the numpy path — the gauge records which plane is live.
         self._native = None
+        # incremental-commit kernels (nativeplane.IncrementalKernels):
+        # the batch-commit fold and the post-bind columnar row refresh
+        # as single C calls — the "post-bind repair path stops paying
+        # numpy per-op overhead" half of the native plane. Gated on the
+        # same knob; an older .so degrades just these back to numpy.
+        self._incremental = None
         # (tag, FusedResult) from the overlapped scan prefetch, awaiting
         # consume-time validation against the live version vector
         self._prefetched: tuple | None = None
         if self._columnar is not None and self.config.native_plane:
             try:
-                from .nativeplane import FusedPlane
+                from .nativeplane import FusedPlane, IncrementalKernels
 
                 self._native = FusedPlane.load()
+                self._incremental = IncrementalKernels.load()
             except Exception:  # pragma: no cover - defensive: a broken
                 self._native = None  # ctypes env must not kill the engine
+                self._incremental = None
+        if self._columnar is not None and self._incremental is not None:
+            self._columnar.native_refresh = self._incremental
         self.metrics.set_gauge("native_plane_active",
                                1.0 if self._native is not None else 0.0)
         # shared across co-hosted profiles (multi.py) to serialize cycles;
@@ -593,6 +662,12 @@ class Scheduler:
         never enters the hint path."""
         if event.kind != POD_PENDING_ARRIVED:
             self.queue.notify(event)
+        if (self.elastic is not None and event.kind == POD_DELETED
+                and event.gang):
+            # a gang member left the cluster: the engine thread checks
+            # whether the whole gang is gone and retires its elastic
+            # bookkeeping (run_one drains this deque)
+            self._elastic_retires.append(event.gang)
         self.wake.set()
 
     def _on_telemetry_change(self, node: str, old, new) -> None:
@@ -700,6 +775,20 @@ class Scheduler:
         if (pod.pod_affinity or pod.pod_anti_affinity
                 or pod.topology_spread or pod.host_ports):
             return None
+        memo_key = self._memo_key_of(pod, spec)
+        # class-level key cache: every equivalence contribution is a
+        # function of the pod's scheduling CLASS (the framework audit —
+        # "two pods with equal keys are interchangeable" — is exactly
+        # what makes them class-determined). The memo key carries the
+        # class (spec, selectors, tolerations, affinities, resources,
+        # namespace); tenancy rides along explicitly because the DRF
+        # sort keys on the scv/tenant label, which the memo key omits.
+        # A 25k-pod burst of four classes was paying 25k full plugin
+        # walks for four distinct answers.
+        cls_key = (memo_key, pod.labels.get("scv/tenant"))
+        hit = self._bkey_class_cache.get(cls_key)
+        if hit is not None:
+            return hit
         parts = []
         for p in self._eq_plugins:
             eq = getattr(p, "equivalence_key", None)
@@ -710,7 +799,11 @@ class Scheduler:
                 return None
             if k != ():
                 parts.append((getattr(p, "name", type(p).__name__), k))
-        return (self._memo_key_of(pod, spec), tuple(parts))
+        out = (memo_key, tuple(parts))
+        if len(self._bkey_class_cache) > 4096:
+            self._bkey_class_cache.clear()
+        self._bkey_class_cache[cls_key] = out
+        return out
 
     def _cluster_versions(self) -> tuple | None:
         """Version vector over everything a filter verdict can depend on:
@@ -755,14 +848,28 @@ class Scheduler:
         tsince = getattr(self.cluster.telemetry, "changes_since", None)
         if csince is None or tsince is None or self.allocator is None:
             return vers, None, None
-        cdir = getattr(self.cluster, "changes_since_directed", None)
-        if cdir is not None:
-            _, pdirty, pgrew = cdir(cvers[0])
+        # per-log short-circuit: an unchanged version counter means an
+        # empty delta — skip the locked log walk (the commit loop asks
+        # after every bind, when typically only two of the three logs
+        # moved; the counter reads are GIL-atomic ints)
+        if vers[0] == cvers[0]:
+            pdirty = pgrew = _EMPTY_SET
         else:
-            _, pdirty = csince(cvers[0])
-            pgrew = pdirty
-        _, tdirty = tsince(cvers[1])
-        _, adirty, agrew = self.allocator.changes_since_directed(cvers[3])
+            cdir = getattr(self.cluster, "changes_since_directed", None)
+            if cdir is not None:
+                _, pdirty, pgrew = cdir(cvers[0])
+            else:
+                _, pdirty = csince(cvers[0])
+                pgrew = pdirty
+        if vers[1] == cvers[1]:
+            tdirty = _EMPTY_SET
+        else:
+            _, tdirty = tsince(cvers[1])
+        if vers[3] == cvers[3]:
+            adirty = agrew = _EMPTY_SET
+        else:
+            _, adirty, agrew = self.allocator.changes_since_directed(
+                cvers[3])
         if (pdirty is None or tdirty is None or adirty is None
                 or "*" in adirty):
             dirty = grew = None
@@ -772,6 +879,26 @@ class Scheduler:
             grew = pgrew | tdirty | agrew
         self._csv_memo[key] = (dirty, grew)
         return vers, dirty, grew
+
+    def _membership_dirty(self, cvers):
+        """Dirty node names since `cvers` IGNORING membership movement —
+        the sharded columnar rebuild's input (columnar.py): a surviving
+        row absent from this set is provably unchanged and block-copies.
+        None when any log was trimmed or the allocator recorded an
+        unattributable change (the caller then rebuilds in full)."""
+        if cvers is None or self.allocator is None:
+            return None
+        csince = getattr(self.cluster, "changes_since", None)
+        tsince = getattr(self.cluster.telemetry, "changes_since", None)
+        if csince is None or tsince is None:
+            return None
+        _, pdirty = csince(cvers[0])
+        _, tdirty = tsince(cvers[1])
+        _, adirty, _ = self.allocator.changes_since_directed(cvers[3])
+        if pdirty is None or tdirty is None or adirty is None \
+                or "*" in adirty:
+            return None
+        return pdirty | tdirty | adirty
 
     @staticmethod
     def _feas_entry(vers, feasible):
@@ -1335,6 +1462,57 @@ class Scheduler:
                     return fresh
         return self._full_snapshot()
 
+    def _snapshot_one_dirty(self, name: str, prev_vers, vers
+                            ) -> "Snapshot | None":
+        """Commit-loop snapshot: the caller has PROVEN — change-log
+        attribution against `prev_vers` — that `name` is the only node
+        changed since the cached snapshot and membership is unchanged.
+        Rebuild that one NodeInfo and re-wrap: exactly what snapshot()
+        would produce with dirty == {name}, minus its re-walk of the
+        change logs the caller already performed. None = the cached
+        snapshot isn't at `prev_vers` (caller uses the generic path)."""
+        if self._snap is None or prev_vers is None:
+            return None
+        snap, pv0, tv0, nv0 = self._snap
+        if (pv0 != prev_vers[0] or tv0 != prev_vers[1]
+                or nv0 != prev_vers[2] or nv0 != vers[2]):
+            return None
+        infos = snap._node_infos
+        old = infos.get(name)
+        if old is None:
+            return None
+        uncordoned = bool(snap._any_unsched) and old.unschedulable
+        ni = self._make_node_info(name)
+        infos[name] = ni
+        pods_version = getattr(self.cluster, "pods_version", None)
+        if pods_version is not None:
+            self._ni_cache[name] = (
+                (getattr(ni.metrics, "generation", None),
+                 pods_version(name)), ni)
+        fresh = Snapshot(infos, budgets=snap.budgets,
+                         namespaces=snap._namespaces)
+        # flag carries: the generic path's any(...) over dirty, unrolled
+        # for the single node (it reads the post-rebuild info, as here)
+        if snap._any_taints is not None:
+            fresh._any_taints = snap._any_taints or bool(ni.taints)
+        if snap._any_pod_anti is not None:
+            fresh._any_pod_anti = snap._any_pod_anti or any(
+                p.pod_anti_affinity for p in ni.pods)
+        if snap._any_alloc is not None:
+            fresh._any_alloc = (snap._any_alloc
+                                or ni.allocatable is not None)
+        if snap._any_pref_pod is not None:
+            fresh._any_pref_pod = snap._any_pref_pod or any(
+                p.preferred_pod_affinity for p in ni.pods)
+        if snap._any_unsched is not None:
+            if uncordoned:
+                fresh._any_unsched = any(
+                    x.unschedulable for x in infos.values())
+            else:
+                fresh._any_unsched = snap._any_unsched or ni.unschedulable
+        self._snap = (fresh, vers[0], vers[1], nv0)
+        return fresh
+
     def _make_node_info(self, name: str, metrics=_UNSET) -> NodeInfo:
         """One coherent NodeInfo: telemetry + bound pods + node-object meta
         (labels/taints for the admission plugin; backends without node
@@ -1468,7 +1646,29 @@ class Scheduler:
                 finally:
                     self._batch_cursor = None
             leftover = rest[done:]
+            # unschedulable-class batch fast path: when the head's cycle
+            # just recorded (or reconfirmed) the class's no-feasible-node
+            # verdict, its batchmates — same equivalence class, so same
+            # memo key — would each pay a full per-pod cycle only to hit
+            # that same memo at the same version vector. Fail them off
+            # the memo directly (attempts, backoff, traces, and metrics
+            # exactly as the per-pod memo-hit path would), under the same
+            # soundness envelope the memo itself requires; any member the
+            # fast path can't prove eligible falls through to the
+            # ordinary per-pod cycle below.
+            fast_ok = (first in ("unschedulable", "failed")
+                       and not ctx.armed and leftover
+                       and self.defrag is None
+                       and (self.allocator is None
+                            or not self.allocator.has_holds()))
+            if fast_ok:
+                prev = self._snap[0] if self._snap is not None else None
+                fast_ok = prev is None or not prev.any_pod_anti_affinity()
             for i, info in enumerate(leftover):
+                # breaker gate FIRST: a storm that opened the circuit
+                # mid-batch parks the rest attempt-free — the memo fast
+                # path must not burn their attempts while the server is
+                # down (run_one's gate would have held them)
                 if self.clock.time() < self._breaker_until:
                     # the circuit breaker opened mid-batch (a storm is
                     # failing every bind): park the remaining members
@@ -1481,11 +1681,53 @@ class Scheduler:
                         # wait lands in e2e_queue_wait_ms like any park
                         self.queue.requeue_immediate(parked, now=now_park)
                     break
+                if fast_ok and self._batch_fast_fail(info):
+                    continue
                 try:
                     self._schedule_one_locked(info)
                 except Exception as e:
                     self._contain_crash(info, e)
             return first
+
+    def _batch_fast_fail(self, info: QueuedPodInfo) -> bool:
+        """Fail one batchmate off the unschedulable-class memo without a
+        per-pod cycle — bit-identical to the memo-hit path in
+        _schedule_one_locked (same attempts bookkeeping, trace shape,
+        metrics, and requeue/backoff), legal only when THIS pod's memo
+        entry sits exactly at the live version vector. False = not
+        provably eligible; the caller runs the ordinary cycle."""
+        pod = info.pod
+        if pod.phase == PodPhase.BOUND and pod.node:
+            return False  # foreign-bound: the full cycle owns the drop
+        now = self.clock.time()
+        degraded = self._detect_degraded(now)
+        if degraded != self._degraded:
+            return False  # regime flip: the full cycle owns the clears
+        if degraded:
+            # a fast-failed batchmate is a real scheduling cycle run
+            # under the blackout regime: the counter must see it, or a
+            # batched degraded drain undercounts by (batch-1)/batch
+            self.metrics.inc("degraded_cycles_total")
+        try:
+            spec = spec_for(pod)
+        except LabelError:
+            return False
+        if spec.is_gang:
+            return False
+        vers = self._cluster_versions()
+        if vers is None:
+            return False
+        hit = self._unsched_memo.get(self._memo_key_of(pod, spec))
+        if hit is None or hit[0] != vers:
+            return False
+        if info.cycle_started >= 0.0:
+            info.t_cycle += max(now - info.cycle_started, 0.0)
+        info.cycle_started = now
+        trace = CycleTrace(pod=pod.key, started=now)
+        trace.plane = "memo"
+        self.metrics.inc("unsched_memo_hits_total")
+        self._unschedulable(info, trace, hit[1], rejected_by=hit[2])
+        return True
 
     def _commit_batch(self, ctx: _BatchCtx, infos: list[QueuedPodInfo]) -> int:
         """Greedy batch commit: place each classmate against the shared
@@ -1525,6 +1767,38 @@ class Scheduler:
                   getattr(p, "score_inputs", None) == "node+slice_usage",
                   self._normalize_kind(p), getattr(p, "weight", 1))
                  for p in scorers]
+        # per-scorer raw scores as one preallocated row-major matrix in
+        # candidate order, maintained in LOCKSTEP with `candidates` (the
+        # dicts in `raws` stay the score-memo's exit format): the
+        # per-member normalize+weighted-sum fold then runs over array
+        # views — one fused native call (IncrementalKernels.batch_fold)
+        # or a handful of numpy ops — instead of rebuilding an array
+        # from dict lookups per scorer per member. Capacity +1: a member
+        # removes the bound node and re-appends at most one row.
+        n_sc = len(kinds)
+        cap = len(candidates) + 1
+        smat = np.empty((n_sc, cap), dtype=np.float64)
+        for k, (_p, raw, _c, _k, _w) in enumerate(kinds):
+            smat[k, :len(candidates)] = [raw[ni.name] for ni in candidates]
+        fold_kinds = np.asarray(
+            [1 if t[3] == "minmax" else 0 for t in kinds], dtype=np.int64)
+        fold_w = np.asarray([float(t[4]) for t in kinds],
+                            dtype=np.float64)
+        totals_buf = np.empty(cap, dtype=np.float64)
+        ties_buf = np.empty(cap, dtype=np.int64)
+        nk = self._incremental
+        fold_fn = nk.fold_fn if nk is not None else None
+        # buffer pointers captured once — the per-member call passes
+        # plain ints (a ctypes cast per call would cost more than the
+        # numpy ops the fused fold removes)
+        p_smat, p_kinds = smat.ctypes.data, fold_kinds.ctypes.data
+        p_w, p_tot, p_ties = (fold_w.ctypes.data, totals_buf.ctypes.data,
+                              ties_buf.ctypes.data)
+        stride = smat.shape[1]
+        # candidate NAME set maintained in lockstep with the list: the
+        # per-member frozenset then builds off this set instead of
+        # re-walking 100 NodeInfo.name attributes
+        cand_names = {ni.name for ni in candidates}
         for info in infos:
             self._batch_cursor = info  # crash attribution (schedule_batch)
             pod = info.pod
@@ -1539,15 +1813,35 @@ class Scheduler:
             # their fresh snapshots.
             vers, dirty, _grew = self._changes_since_directed(
                 prev_cycle_vers)
-            if (vers is None or dirty is None
-                    or not dirty <= {prev_node}):
+            conflicted = (vers is None or dirty is None
+                          or not dirty <= {prev_node})
+            if conflicted and dirty is not None and vers is not None:
+                # NON-MEMBER dirt cannot conflict: a dirty name outside
+                # this engine's snapshot membership (a sharded-reflection
+                # replica's foreign pools, telemetry for unknown nodes)
+                # is exactly what snapshot()/sync() skip — no candidate,
+                # score, or prescore input can depend on it. Membership
+                # CHANGES can't hide here: they move vers[2], which the
+                # attribution above already turned into dirty=None.
+                snap_infos = (self._snap[0]._node_infos
+                              if self._snap is not None else None)
+                if snap_infos is not None:
+                    conflicted = any(n != prev_node and n in snap_infos
+                                     for n in dirty)
+            if conflicted:
                 self.metrics.inc("batch_conflict_fallbacks_total")
                 self.flight.record("batch_conflict_fallback",
                                    pod=pod.key, prev_node=prev_node)
                 break
             self._csv_memo.clear()
             state.write("now", now)
-            snapshot = self.snapshot()  # incremental: dirty == {prev_node}
+            # incremental: attribution above proved dirty == {prev_node},
+            # so patch the cached snapshot directly (generic fallback
+            # when the cache isn't exactly at the previous vector)
+            snapshot = self._snapshot_one_dirty(prev_node,
+                                                prev_cycle_vers, vers)
+            if snapshot is None:
+                snapshot = self.snapshot()
             state.write("snapshot", snapshot)
             state.write("cycle_versions", vers)
             if snapshot.any_pod_anti_affinity():
@@ -1591,7 +1885,12 @@ class Scheduler:
             for i, ni in enumerate(candidates):
                 if ni.name == prev_node:
                     del candidates[i]
+                    cand_names.discard(prev_node)
+                    lc = len(candidates)
+                    if i < lc:  # keep the score matrix in lockstep
+                        smat[:, i:lc] = smat[:, i + 1:lc + 1]
                     break
+            appended = False
             if len(candidates) < want:
                 st = Status.success()
                 for p in filters:
@@ -1602,6 +1901,8 @@ class Scheduler:
                     break
                 if st.ok:
                     candidates.append(new_prev)
+                    cand_names.add(prev_node)
+                    appended = True
             if not candidates:
                 # the class ran out of known candidates: the per-pod full
                 # scan (and its unschedulable/preemption bookkeeping) owns
@@ -1612,7 +1913,7 @@ class Scheduler:
             # repair completed: per-pod refreshes the feasible entry at
             # exactly this point, so the exit state does too
             mem_feas = (vers, list(candidates))
-            names = frozenset(n.name for n in candidates)
+            names = frozenset(cand_names)
             # ---- prescore outputs: each plugin updates its own memo +
             # cycle-state contribution exactly (MaxCollection maxima,
             # TopologyScore slice usage)
@@ -1640,44 +1941,60 @@ class Scheduler:
             # ---- re-score only what changed: the bound node (if it
             # re-entered) for every scorer, plus its slice-mates for
             # slice-coupled scorers — the score-memo replay rule
-            for p, raw, coupled, _kind, _w in kinds:
+            # candidates needing a fresh score are KNOWN: the re-appended
+            # bound node (its raw entry was just popped) and — for
+            # slice-coupled scorers when its slice's usage moved — that
+            # slice's other members. Scoring exactly those (instead of
+            # scanning every candidate per scorer for a membership check)
+            # computes the same values the scan would.
+            appended_idx = len(candidates) - 1 if appended else None
+            mates = None
+            if slice_moved and any(t[2] for t in kinds):
+                mates = [(idx, node) for idx, node in enumerate(candidates)
+                         if idx != appended_idx
+                         and node.metrics is not None
+                         and node.metrics.slice_id == sid]
+            for k, (p, raw, coupled, _kind, _w) in enumerate(kinds):
                 raw.pop(prev_node, None)
-                for node in candidates:
-                    nm = node.name
-                    if nm in raw and not (
-                            coupled and slice_moved
-                            and node.metrics is not None
-                            and node.metrics.slice_id == sid):
-                        continue
+                row = smat[k]
+                if appended_idx is not None:
+                    node = candidates[appended_idx]
                     s, st = p.score(state, pod, node)
                     if st.code == Code.ERROR:
                         ok = False
                         break
-                    raw[nm] = s
-                if not ok:
-                    break
+                    raw[node.name] = s
+                    row[appended_idx] = s
+                if coupled and mates:
+                    for idx, node in mates:
+                        s, st = p.score(state, pod, node)
+                        if st.code == Code.ERROR:
+                            ok = False
+                            break
+                        raw[node.name] = s
+                        row[idx] = s
+                    if not ok:
+                        break
             if not ok:
                 raws_ok = False  # mid-rescore ERROR: raws are torn
                 break
-            # ---- normalize + weighted sum, vectorized but op-for-op the
-            # scalar fold (elementwise float64 numpy ops are the same IEEE
-            # operations _fold_scores performs per entry)
+            # ---- normalize + weighted sum + tie set, op-for-op the
+            # scalar fold: one fused native call when the incremental
+            # kernel is loaded, the elementwise numpy twin otherwise
+            # (both perform the same IEEE double ops in the same order,
+            # so the tie set — and the seeded tie-break — are identical)
             n = len(candidates)
-            totals = np.zeros(n, dtype=np.float64)
-            for _p, raw, _coupled, kind, w in kinds:
-                arr = np.fromiter((raw[node.name] for node in candidates),
-                                  dtype=np.float64, count=n)
-                if kind == "minmax":
-                    lowest = arr.min()
-                    span = arr.max() - lowest
-                    if span == 0:
-                        arr = np.full(n, 100.0)
-                    else:
-                        arr = 0.0 + (arr - lowest) * 100.0 / span
-                totals = totals + w * arr
-            best = totals.max()
-            best_nodes = [candidates[i].name
-                          for i in np.flatnonzero(totals == best)]
+            n_ties = (fold_fn(p_smat, n_sc, stride, p_kinds, p_w, n,
+                              p_tot, p_ties)
+                      if fold_fn is not None else -1)
+            if n_ties > 0:
+                best_nodes = [candidates[int(ties_buf[j])].name
+                              for j in range(n_ties)]
+            else:
+                totals = _numpy_fold(smat, fold_kinds, fold_w, n)
+                best = totals.max()
+                best_nodes = [candidates[i].name
+                              for i in np.flatnonzero(totals == best)]
             chosen = self.rng.choice(best_nodes)
             # selection complete: candidates/raws/usage are the exact
             # per-pod repair state for THIS member's version vector. The
@@ -2112,13 +2429,35 @@ class Scheduler:
 
         if feasible is None:
             trace.plane = "native" if native_empty else "scalar"
-            order = [(self._filter_start + i) % len(nodes)
-                     for i in range(len(nodes))]
-            if nom is not None:
-                ni = next((i for i in order if nodes[i].name == nom[0]), None)
-                if ni is not None:
-                    order.remove(ni)
-                    order.insert(0, ni)
+            # bounded diagnostics after a kernel-final empty verdict: the
+            # scalar scan's only remaining outputs are the failure REASON
+            # (sorted per-node messages, truncated at ~500 chars) and the
+            # REJECTORS set. The rejectors come exactly from the columnar
+            # masks (first-failing plugin per row, one vectorized pass);
+            # the reason needs only the alphabetically-first nodes up to
+            # the truncation budget — nodes are already in sorted order,
+            # so scanning a prefix builds the identical string a full
+            # walk would. A full FAILING scan advances the rotation by
+            # len(nodes) ≡ 0 (mod n), so the bounded scan advances by 0
+            # too. At 50k nodes this turns an O(cluster) Python walk per
+            # no-fit class into O(truncation).
+            diag_budget = None
+            if native_empty:
+                rej = self._columnar_rejectors(state, pod, filters)
+                if rej is not None:
+                    rejectors |= rej
+                    diag_budget = 1000
+            if diag_budget is not None:
+                order = range(len(nodes))
+            else:
+                order = [(self._filter_start + i) % len(nodes)
+                         for i in range(len(nodes))]
+                if nom is not None:
+                    ni = next((i for i in order
+                               if nodes[i].name == nom[0]), None)
+                    if ni is not None:
+                        order.remove(ni)
+                        order.insert(0, ni)
             # sound candidate narrowing from PreFilter (gang slice
             # membership / chosen slice / plan quotas): nodes outside the
             # set are provably infeasible under predicates preemption
@@ -2126,6 +2465,7 @@ class Scheduler:
             cand = state.read_or(CANDIDATE_NODES_KEY)
             feasible = []
             checked = 0
+            diag_size = 0
             for i in order:
                 node = nodes[i]
                 if cand is not None and node.name not in cand:
@@ -2148,8 +2488,13 @@ class Scheduler:
                         break
                 elif rej is not None:
                     rejectors.add(rej)
-            self._filter_start = ((self._filter_start + checked)
-                                  % max(len(nodes), 1))
+                if diag_budget is not None and not st.ok:
+                    diag_size += len(node.name) + len(st.message) + 2
+                    if diag_size > diag_budget:
+                        break
+            if diag_budget is None:
+                self._filter_start = ((self._filter_start + checked)
+                                      % max(len(nodes), 1))
             if feas_ok and feasible:
                 if len(self._feas_memo) > 256:
                     self._feas_memo.clear()
@@ -2303,6 +2648,12 @@ class Scheduler:
             if self._columnar.sync(snapshot, vers, self._changes_since_vers):
                 col_rows = self._columnar.rows_for(feasible)
         raws: dict[str, dict[str, float]] = {}
+        # per-plugin folds are DEFERRED: when every plugin declares its
+        # normalize shape, the whole stack folds in one fused pass over
+        # the candidate matrix (_fold_all_scores) — op-for-op the same
+        # floats as folding each plugin in turn, minus a Python loop
+        # over ~want candidates per scorer per cycle
+        fold_jobs: list = []
         for p in scorers:
             if nat is not None:
                 nraw = nat.raws.get(p.name)
@@ -2314,7 +2665,7 @@ class Scheduler:
                     # normalize+sum (nat.totals, applied below).
                     raws[p.name] = nraw
                     if nat.totals is None:
-                        self._fold_scores(state, pod, p, nraw, totals)
+                        fold_jobs.append((p, nraw))
                     continue
             raw: dict[str, float] = {}
             if col_rows is not None:
@@ -2326,7 +2677,7 @@ class Scheduler:
                         raw[node.name] = float(arr[i])
                     self.metrics.inc("columnar_score_batches_total")
                     raws[p.name] = raw
-                    self._fold_scores(state, pod, p, raw, totals)
+                    fold_jobs.append((p, raw))
                     continue
             cached = hit[4].get(p.name, {}) if dirty_s is not None else {}
             slice_coupled = (getattr(p, "score_inputs", None)
@@ -2346,9 +2697,11 @@ class Scheduler:
                     return self._cycle_error(info, trace, st.message)
                 raw[name] = s
             raws[p.name] = raw
-            self._fold_scores(state, pod, p, raw, totals)
+            fold_jobs.append((p, raw))
         if nat is not None and nat.totals is not None:
             totals = nat.totals
+        else:
+            self._fold_all_scores(state, pod, fold_jobs, feasible, totals)
         if repairable and vers is not None:
             if len(self._score_memo) > 256:
                 self._score_memo.clear()
@@ -2516,6 +2869,68 @@ class Scheduler:
         p.normalize(state, pod, nraw)
         for name, s in nraw.items():
             totals[name] += w * s
+
+    def _columnar_rejectors(self, state, pod, filters) -> "set[str] | None":
+        """First-failing-plugin attribution for a kernel-final EMPTY
+        verdict, from the columnar masks: plugin p rejects a row iff the
+        row survived every earlier filter and fails p's mask — exactly
+        the scalar chain's early-exit attribution, one vectorized pass
+        per plugin. None when any filter can't vectorize (the caller
+        walks the scalar chain instead). The table already sits at the
+        cycle's version (the native scan synced it)."""
+        table = self._columnar
+        if table is None:
+            return None
+        alive = None
+        out: set[str] = set()
+        for p in filters:
+            fb = getattr(p, "filter_batch", None)
+            bm = fb(state, pod, table) if fb is not None else None
+            if bm is None:
+                return None
+            rejected = ~bm if alive is None else (alive & ~bm)
+            if rejected.any():
+                out.add(p.name)
+            alive = bm if alive is None else (alive & bm)
+        return out
+
+    def _fold_all_scores(self, state, pod, jobs, feasible, totals) -> None:
+        """Fold every deferred (plugin, raw) pair into `totals`. When
+        every plugin declares a vectorizable normalize shape and the
+        candidate set is big enough to matter, ONE pass over the raw
+        matrix — the fused native fold (IncrementalKernels.batch_fold)
+        or its numpy twin — replaces the per-plugin per-candidate Python
+        loops; both perform exactly _fold_scores' IEEE ops in the same
+        order, so every total is bit-identical (the batch parity fuzz
+        pins the same fold in _commit_batch). Undeclared shapes and
+        small sets keep the per-plugin dict fold."""
+        n = len(feasible)
+        if (HAVE_NUMPY and n >= 16 and jobs
+                and all(self._normalize_kind(p) in ("identity", "minmax")
+                        for p, _ in jobs)):
+            names = [ni.name for ni in feasible]
+            smat = np.empty((len(jobs), n), dtype=np.float64)
+            for k, (_p, raw) in enumerate(jobs):
+                smat[k] = [raw[nm] for nm in names]
+            kinds = np.asarray(
+                [1 if self._normalize_kind(p) == "minmax" else 0
+                 for p, _ in jobs], dtype=np.int64)
+            ws = np.asarray([float(getattr(p, "weight", 1))
+                             for p, _ in jobs], dtype=np.float64)
+            nk = self._incremental
+            tot = np.empty(n, dtype=np.float64)
+            if nk is not None:
+                ties = np.empty(n, dtype=np.int64)
+                got = nk.batch_fold(smat, kinds, ws, n, tot, ties)
+            else:
+                got = -1
+            if got <= 0:  # no kernel (or malformed): the numpy twin
+                tot = _numpy_fold(smat, kinds, ws, n)
+            for nm, v in zip(names, tot.tolist()):
+                totals[nm] = v
+            return
+        for p, raw in jobs:
+            self._fold_scores(state, pod, p, raw, totals)
 
     def _run_post_filter(self, info: QueuedPodInfo, trace: CycleTrace,
                          state: CycleState, pod: Pod, spec, snapshot,
@@ -2766,10 +3181,12 @@ class Scheduler:
             rec.record("bind_wire", pod.key, t_wire0, wire_end,
                        {"node": node, "dispatched_async": dispatched_async})
         if self.allocator is not None:
-            self.allocator.complete(pod)  # reservation consumed
             if not dispatched_async:
-                # async dispatch defers this to wire success (on_success)
-                self.allocator.unnominate(pod.key)  # entitlement consumed
+                # reservation + entitlement consumed in one lock round
+                # (async dispatch defers unnominate to wire success)
+                self.allocator.finish_bind(pod)
+            else:
+                self.allocator.complete(pod)  # reservation consumed
         if coords is not None and not dispatched_async:
             # publish the chip assignment on the pod regardless of binder,
             # so allocation accounting sees it next cycle (bind_async set
@@ -2781,8 +3198,12 @@ class Scheduler:
         self.metrics.observe("schedule_latency_ms", e2e_ms)
         # per-class decomposition (gang / multi-chip / gpu / unlabeled ...):
         # aggregate p50 hides class-level regressions behind class mix
-        self.metrics.observe(
-            "schedule_latency_ms_class_" + workload_class(pod), e2e_ms)
+        cls = workload_class(pod)
+        cname = _LABEL1_CACHE.get(("_lat_cls", cls))
+        if cname is None:
+            cname = "schedule_latency_ms_class_" + cls
+            _LABEL1_CACHE[("_lat_cls", cls)] = cname
+        self.metrics.observe(cname, e2e_ms)
         # e2e latency decomposition: the queue/engine stamps partition this
         # pod's enqueue->bind interval into queue-wait (active + backoff),
         # cycle compute (every attempt's pre-commit work), commit
@@ -3103,7 +3524,7 @@ class Scheduler:
                 post(info.pod, "FailedScheduling", reason, type_="Warning")
             except Exception:
                 pass  # observability must never fail the cycle
-        if self.allocator is not None:
+        if self.allocator is not None and self.allocator.has_pod_nominations():
             nom = self.allocator.nomination_of(info.pod.key)
             if (nom is not None and trace.filter_verdicts.get(nom[0]) != "ok"
                     and not any(p.terminating
@@ -3128,7 +3549,7 @@ class Scheduler:
             # per-plugin rejection attribution (labeled metric): which
             # plugin is gating the pending backlog, by name
             self.metrics.inc("filter_rejections_total",
-                             labels={"plugin": pname})
+                             labels=_label1("plugin", pname))
         now = self.clock.time()
         if self.policy is not None:
             # starvation watch: a pod still unbound past the configured
@@ -3242,10 +3663,10 @@ class Scheduler:
         trace.finish(outcome, node=node, reason=reason, now=now)
         self.traces.add(trace)
         self.metrics.inc("scheduling_outcomes_total",
-                         labels={"outcome": outcome})
+                         labels=_label1("outcome", outcome))
         if trace.plane:
             self.metrics.inc("cycle_plane_total",
-                             labels={"plane": trace.plane})
+                             labels=_label1("plane", trace.plane))
         if self._sampled_key(trace.pod):
             attrs = {"outcome": outcome}
             if trace.plane:
@@ -3295,6 +3716,33 @@ class Scheduler:
             self.elastic.reset(gang)
 
     # ------------------------------------------------------- elastic gangs
+    def _drain_elastic_retires(self) -> None:
+        """Retire elastic bookkeeping for gangs whose members were
+        deleted externally (the PR 10 sliver): a POD_DELETED carrying a
+        gang label queued the gang here; if cluster truth now shows ZERO
+        bound members, the gang is gone (or restarting from scratch) and
+        its _growing/_first_seen/_pending_admission records must not
+        survive to miscount grows and admissions when the name is
+        reused. A SHRINK eviction never trips this — the gang keeps
+        >= min members bound, so the count stays positive."""
+        elastic = self.elastic
+        seen: set[str] = set()
+        while self._elastic_retires:
+            try:
+                gang = self._elastic_retires.popleft()
+            except IndexError:
+                break
+            if gang in seen or elastic is None:
+                continue
+            seen.add(gang)
+            if (gang in elastic._growing
+                    or gang in elastic._first_seen
+                    or gang in elastic._pending_admission) \
+                    and self._bound_members_of(gang) == 0:
+                elastic.reset(gang)
+                self.metrics.inc("gang_elastic_retired_total")
+                self.flight.record("elastic_gang_retired", gang=gang)
+
     def _bound_members_of(self, gang: str) -> int:
         """Cluster-truth bound member count, memoised on the version
         vector: growth members ask on every failed cycle, and between
@@ -3547,6 +3995,8 @@ class Scheduler:
                 self.doomed_gangs.pop(self._gang_revivals.popleft(), None)
             except IndexError:
                 break
+        if self._elastic_retires:
+            self._drain_elastic_retires()
         if self.clock.time() < self._breaker_until:
             # circuit open (apiserver error storm): park scheduling — the
             # queue keeps its order and nobody's attempts burn; resumes

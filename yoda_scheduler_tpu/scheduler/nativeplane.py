@@ -114,6 +114,58 @@ class FusedResult:
         self._bufs = out_bufs
 
 
+_INCR_ABI = 1
+
+
+class IncrementalKernels:
+    """ctypes bridge to the incremental-commit helpers (fusedplane.cc):
+    the post-bind columnar row refresh and the batch-commit fold. Bound
+    independently of the fused-cycle kernel so an older .so degrades only
+    these paths back to numpy (loader docstring). Both are bit-identical
+    twins of the numpy forms they replace — the per-op numpy dispatch
+    overhead, not the arithmetic, is what they remove from the post-bind
+    repair path."""
+
+    __slots__ = ("refresh_fn", "fold_fn")
+
+    def __init__(self, lib) -> None:
+        # bound with c_void_p pointer params: callers pass plain ints
+        # (.ctypes.data captured ONCE per buffer) — a ctypes.cast per
+        # call costs more than the numpy ops these kernels replace
+        self.refresh_fn = lib.yoda_row_refresh
+        self.fold_fn = lib.yoda_batch_fold
+
+    @classmethod
+    def load(cls) -> "IncrementalKernels | None":
+        vp = ctypes.c_void_p
+        lib = nativeloader.bind_symbols({
+            "yoda_incremental_abi": (_i64, []),
+            "yoda_row_refresh": (None, [vp, _i64, vp, _i64]),
+            "yoda_batch_fold": (_i64, [vp, _i64, _i64, vp, vp,
+                                       _i64, vp, vp]),
+        })
+        if lib is None or lib.yoda_incremental_abi() != _INCR_ABI:
+            return None
+        return cls(lib)
+
+    def row_refresh(self, chip_free, row: int, scratch, n_idx: int) -> None:
+        """Rewrite `chip_free[row]` (2-D uint8/bool, C-contiguous) from
+        the first `n_idx` chip indices in `scratch` (int64). Convenience
+        form; the hot path calls refresh_fn with cached base pointers."""
+        width = chip_free.shape[1]
+        self.refresh_fn(chip_free.ctypes.data + row * width, width,
+                        scratch.ctypes.data, n_idx)
+
+    def batch_fold(self, smat, kinds, weights, m: int, totals, ties) -> int:
+        """Fold `smat[:, :m]` (row-major float64, stride = smat.shape[1])
+        into `totals[:m]` and write the argmax tie indices; returns the
+        tie count (< 0 = malformed input, caller falls back to numpy)."""
+        return self.fold_fn(
+            smat.ctypes.data, smat.shape[0], smat.shape[1],
+            kinds.ctypes.data, weights.ctypes.data, m,
+            totals.ctypes.data, ties.ctypes.data)
+
+
 class FusedPlane:
     """Loaded fused kernel + its prefetch worker."""
 

@@ -214,19 +214,39 @@ class MaxCollection(PreScorePlugin):
         else:
             # the bound node left the candidate set: re-fold from the
             # remaining recorded tuples (every one is clean — the bind
-            # touched only `name`), exactly the full walk's result
-            if not (names <= set(ccontribs)):
+            # touched only `name`), exactly the full walk's result.
+            # keys() view: set algebra without materializing a set.
+            if not (names <= ccontribs.keys()):
                 return False
-            ccontribs = {n: ccontribs[n] for n in cnames if n in names} \
-                if cnames is not None else {n: ccontribs[n] for n in names}
-            mv6 = [1, 1, 1, 1, 1, 1]
-            for t in ccontribs.values():
-                if t is None:
-                    continue
-                for j in range(6):
-                    if t[j] > mv6[j]:
-                        mv6[j] = t[j]
-            out = tuple(mv6)
+            gone = (cnames - names) if cnames is not None else None
+            dropped = ([ccontribs[n] for n in gone
+                        if ccontribs.get(n) is not None]
+                       if gone is not None else None)
+            if gone is not None:
+                # C-level copy + pop of the few departures beats a keyed
+                # comprehension over ~want entries (max is commutative,
+                # so key order is irrelevant to every later fold)
+                kept = dict(ccontribs)
+                for n in gone:
+                    kept.pop(n, None)
+            else:
+                kept = {n: ccontribs[n] for n in names}
+            if dropped is not None and cmv6 is not None and not any(
+                    t[j] >= cmv6[j] for t in dropped for j in range(6)):
+                # no departing node reached any recorded max, so every
+                # component's max survives in the kept set — the full
+                # re-fold would reproduce cmv6 exactly
+                out = cmv6
+            else:
+                mv6 = [1, 1, 1, 1, 1, 1]
+                for t in kept.values():
+                    if t is None:
+                        continue
+                    for j in range(6):
+                        if t[j] > mv6[j]:
+                            mv6[j] = t[j]
+                out = tuple(mv6)
+            ccontribs = kept
             self.fast_hits += 1
         self._memo[spec] = (vers, ccontribs, names, out)
         state.write(MAX_KEY, MaxValue(*out))

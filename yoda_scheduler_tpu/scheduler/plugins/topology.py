@@ -154,8 +154,12 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
         if vers is None:
             return False
         _, usage, contrib = self._usage_state
+        # usage is COPIED: references escape into cycle state and the
+        # engine's score memo, which must see this member's snapshot.
+        # contrib never leaves this plugin (_usage_state is its only
+        # holder), so the one-key patch mutates it in place — copying
+        # its per-node map per batch member was the hook's main cost.
         usage = dict(usage)
-        contrib = dict(contrib)
         self._patch(usage, contrib, node_info.name, node_info)
         self._usage_state = (vers, usage, contrib)
         state.write(SLICE_USE_KEY, usage)

@@ -34,6 +34,7 @@ import os
 import threading
 import time
 import zlib
+from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -71,15 +72,30 @@ class CycleTrace:
 class Histogram:
     DEFAULT_BOUNDS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000)
 
+    # deterministic xorshift64* state seed for the reservoir: quantiles
+    # of a given observation stream reproduce run-to-run (benches and
+    # the golden test depend on that)
+    _SEED = 0x9E3779B97F4A7C15
+    _M64 = (1 << 64) - 1
+
     def __init__(self, bounds: tuple[float, ...] = DEFAULT_BOUNDS,
                  keep_values: int = 100_000) -> None:
         self.bounds = bounds
         self.counts = [0] * (len(bounds) + 1)
         self.total = 0.0
         self.n = 0
-        # bounded sample for exact quantiles in benches; a long-running
-        # scheduler keeps at most the most recent `keep_values` observations
-        self._values: deque[float] = deque(maxlen=keep_values)
+        # bounded raw-sample store for exact-ish quantiles in benches.
+        # The first `keep_values` observations are kept exactly; past
+        # that the store becomes a FIXED-SIZE uniform reservoir over the
+        # whole stream (Algorithm R, deterministic xorshift indices) —
+        # a 1M-pod drain costs O(keep_values) per family, not O(pods),
+        # and quantiles stay representative of the ENTIRE run instead of
+        # a sliding recency window. Quantile error past the exact phase
+        # is the usual reservoir sampling error (~1/sqrt(keep_values));
+        # the golden test in tests/test_obs.py pins the tolerance.
+        self._cap = max(int(keep_values), 1)
+        self._values: list[float] = []
+        self._rng = self._SEED
         # quantile memo: (observation count at sort time, sorted snapshot).
         # Bench summary blocks ask for several percentiles back to back; a
         # fresh O(n log n) sort of up to 100k retained samples per call was
@@ -89,12 +105,25 @@ class Histogram:
     def observe(self, v: float) -> None:
         self.n += 1
         self.total += v
-        self._values.append(v)
-        for i, b in enumerate(self.bounds):
-            if v <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        vals = self._values
+        if len(vals) < self._cap:
+            vals.append(v)
+        else:
+            # Algorithm R: keep v with probability cap/n, replacing a
+            # uniformly-chosen resident — every observation of the
+            # stream ends up retained with equal probability
+            x = self._rng
+            x = (x ^ (x << 13)) & self._M64
+            x ^= x >> 7
+            x = (x ^ (x << 17)) & self._M64
+            self._rng = x
+            j = x % self.n
+            if j < self._cap:
+                vals[j] = v
+        # bisect_left(bounds, v) = first bucket with v <= bound — the
+        # same bucket the linear scan chose, without walking every bound
+        # for large observations (e2e latencies land in the last buckets)
+        self.counts[bisect_left(self.bounds, v)] += 1
 
     def quantile(self, q: float) -> float:
         if not self._values:
@@ -109,8 +138,9 @@ class Histogram:
         return xs[idx]
 
     def samples(self) -> list[float]:
-        """Retained raw observations (newest keep_values), for cross-
-        histogram aggregation (e.g. one quantile over several profiles)."""
+        """Retained raw observations (exact below keep_values, a uniform
+        whole-stream reservoir past it), for cross-histogram aggregation
+        (e.g. one quantile over several profiles)."""
         return list(self._values)
 
     def merge_from(self, other: "Histogram") -> None:
@@ -121,6 +151,15 @@ class Histogram:
             self.total += other.total
             self.n += other.n
             self._values.extend(other._values)
+            if len(self._values) > self._cap:
+                # deterministic stride downsample back to capacity: the
+                # merged view keeps proportional representation of both
+                # sources (merge feeds bench summaries, not the live
+                # reservoir invariant)
+                step = len(self._values) / self._cap
+                self._values = [self._values[int(i * step)]
+                                for i in range(self._cap)]
+            self._sorted = None
         else:  # different bucketing: replay is the only faithful merge
             for v in other.samples():
                 self.observe(v)
@@ -244,10 +283,16 @@ class Metrics:
         self.labeled_gauges: dict[str, dict[tuple, float]] = {}
 
     @staticmethod
-    def _lkey(labels: dict) -> tuple:
+    def _lkey(labels) -> tuple:
+        # hot-path form: callers may pass an already-sorted ((k, v), ...)
+        # tuple instead of a dict — the engine's per-cycle labeled incs
+        # reuse cached tuples rather than re-sorting a fresh dict each
+        # time (measurable across a 25k-pod drain's outcome counters)
+        if type(labels) is tuple:
+            return labels
         return tuple(sorted(labels.items()))
 
-    def inc(self, name: str, by: int = 1, labels: dict | None = None) -> None:
+    def inc(self, name: str, by: int = 1, labels=None) -> None:
         with self._lock:
             if labels:
                 fam = self.labeled_counters.setdefault(name, {})
