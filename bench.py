@@ -851,6 +851,161 @@ def run_elastic_tier(units: int = 4) -> dict:
     }
 
 
+# ------------------- workload-tier admission (ISSUE 13) --------------------
+def _admission_cluster(nodes=50, chips=4):
+    store = TelemetryStore()
+    now = time.time()
+    for i in range(nodes):
+        m = make_tpu_node(f"adm-{i}", chips=chips)
+        m.heartbeat = now + 1e12  # virtual-clock drain: never stale
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return cluster
+
+
+def _rss_kb() -> int:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+
+def _admission_sched(cluster, **kw):
+    from yoda_scheduler_tpu.scheduler.core import FakeClock
+
+    kw.setdefault("workload_admission", True)
+    kw.setdefault("telemetry_max_age_s", 1e18)
+    cfg = SchedulerConfig(**kw)
+    return Scheduler(cluster, cfg, clock=FakeClock())
+
+
+def _park_workloads(sched, n, pods_per, tenants=8):
+    from yoda_scheduler_tpu.scheduler.workload import Workload
+
+    for i in range(n):
+        sched.submit_workload(Workload(
+            f"wl-{i}", members=1, replicas=pods_per,
+            labels={"scv/number": "1", "scv/tenant": f"t{i % tenants}"}))
+    sched.workloads.tick(sched.clock.time())
+
+
+def _admission_depth_leg(depth, pods_per=100, ticks=40):
+    """Park `depth` workloads against a 200-chip cluster, drive the
+    drain, and report the admission DECISION latency quantiles — the
+    number that must stay flat as the parked backlog deepens."""
+    cluster = _admission_cluster()
+    sched = _admission_sched(cluster)
+    _park_workloads(sched, depth, pods_per)
+    sched.run_until_idle()
+    for _ in range(ticks):  # steady-state blocked re-exams on a full book
+        sched.workloads._pass_vers = None  # force a fresh exam pass
+        sched.workloads.tick(sched.clock.time())
+    h = sched.metrics.histograms.get("workload_admission_decision_ms")
+    return {
+        "parked": sched.workloads.parked_count(),
+        "bound": len(cluster.all_pods()),
+        "decisions": sched.workloads.decisions,
+        "decision_p50_ms": round(h.quantile(0.5), 4),
+        "decision_p99_ms": round(h.quantile(0.99), 4),
+    }
+
+
+def run_admission_tier(n_workloads=10_000, pods_per=100) -> dict:
+    """The million-pod backlog tier (ISSUE 13): 1M queued pods arrive as
+    10k workloads. Measures (a) parked memory — O(1) per workload, the
+    RSS fence; (b) admission decision latency flat 1k -> 10k parked
+    workloads; (c) time-to-first-bind vs the pod-at-a-time intake on the
+    same 100k-pod trace — the 'one admission replaces thousands of queue
+    ops' claim as a recorded fact."""
+    import gc
+
+    from yoda_scheduler_tpu.scheduler.workload import Workload
+
+    out: dict = {"workloads": n_workloads, "pods_per_workload": pods_per,
+                 "total_pods": n_workloads * pods_per}
+
+    # ---- (a) park 1M pods as workloads: wall + peak-RSS delta
+    gc.collect()
+    cluster = _admission_cluster()
+    sched = _admission_sched(cluster)
+    rss0 = _rss_kb()
+    t0 = time.perf_counter()
+    _park_workloads(sched, n_workloads, pods_per)
+    out["park_wall_s"] = round(time.perf_counter() - t0, 3)
+    parked_kb = max(_rss_kb() - rss0, 0)
+    out["parked_rss_mb"] = round(parked_kb / 1024.0, 1)
+    out["parked_bytes_per_workload"] = int(parked_kb * 1024 / n_workloads)
+    out["parked_count"] = sched.workloads.parked_count()
+    # the backlog drains to capacity: 200 chips => 200 bound, the rest
+    # parked at O(1) — run to idle and prove admission stopped exactly
+    # at the capacity line instead of materializing the million
+    t0 = time.perf_counter()
+    sched.run_until_idle()
+    out["drain_wall_s"] = round(time.perf_counter() - t0, 3)
+    out["bound"] = len(cluster.all_pods())
+    out["materialized_pods"] = sched.metrics.counters.get(
+        "workload_materialized_pods_total", 0)
+    out["still_parked"] = sched.workloads.parked_count()
+
+    # ---- (b) decision latency flat with backlog depth
+    out["depth_1k"] = _admission_depth_leg(1_000)
+    out["depth_10k"] = _admission_depth_leg(10_000)
+    p99_small = max(out["depth_1k"]["decision_p99_ms"], 1e-4)
+    out["decision_p99_ratio_10k_vs_1k"] = round(
+        out["depth_10k"]["decision_p99_ms"] / p99_small, 2)
+
+    # ---- (c) time-to-first-bind: 100k pods as pods vs as workloads
+    def ttfb(as_workloads: bool, n_pods=100_000, per=100):
+        first = [None]
+
+        class _Rec(FakeCluster):
+            def bind(self, pod, node, assigned_chips=None, fence=None):
+                super().bind(pod, node, assigned_chips, fence)
+                if first[0] is None:
+                    first[0] = time.perf_counter()
+
+        store = TelemetryStore()
+        now = time.time()
+        for i in range(50):
+            m = make_tpu_node(f"adm-{i}", chips=4)
+            m.heartbeat = now + 1e12
+            store.put(m)
+        c = _Rec(store)
+        c.add_nodes_from_telemetry()
+        s = _admission_sched(c)
+        gc.collect()
+        rss_before = _rss_kb()
+        t_start = time.perf_counter()
+        if as_workloads:
+            for i in range(n_pods // per):
+                s.submit_workload(Workload(
+                    f"tt-{i}", members=1, replicas=per,
+                    labels={"scv/number": "1"}))
+        else:
+            for i in range(n_pods):
+                s.submit(Pod(f"tp-{i}", labels={"scv/number": "1"}))
+        intake_done = time.perf_counter()
+        while first[0] is None and s.run_one() is not None:
+            pass
+        rss_kb = max(_rss_kb() - rss_before, 0)
+        return {
+            "intake_wall_s": round(intake_done - t_start, 3),
+            "ttfb_ms": round(((first[0] or time.perf_counter())
+                              - t_start) * 1e3, 2),
+            "intake_rss_mb": round(rss_kb / 1024.0, 1),
+        }
+
+    # pods leg FIRST: ru_maxrss is a high-water mark, so the later
+    # workload leg can only under-report its (much smaller) delta —
+    # which is the conservative direction for the comparison we make
+    out["ttfb_pods"] = ttfb(False)
+    out["ttfb_workloads"] = ttfb(True)
+    out["ttfb_speedup"] = round(
+        out["ttfb_pods"]["ttfb_ms"]
+        / max(out["ttfb_workloads"]["ttfb_ms"], 1e-6), 1)
+    return out
+
+
 def per_pod_ratio(small: dict, big: dict) -> float:
     """Total scheduler compute per pod, big vs small tier — the
     sub-linearity verdict metric (quantile ratios are incomparable
@@ -1499,6 +1654,14 @@ def main():
             elastic = run_elastic_tier()
         except Exception as e:  # must never sink the run
             elastic = {"error": repr(e)}
+    # workload-tier admission (million-pod backlog as 10k parked
+    # workloads); opt out with YODA_BENCH_NO_ADMISSION=1
+    admission = {}
+    if not os.environ.get("YODA_BENCH_NO_ADMISSION"):
+        try:
+            admission = run_admission_tier()
+        except Exception as e:  # must never sink the run
+            admission = {"error": repr(e)}
     if args.trace_out:
         # dedicated fully-sampled leg: every pod span-traced, exported as
         # one Chrome/Perfetto document — the visual answer to "where does
@@ -1519,6 +1682,7 @@ def main():
         "serve_fleet": serve_fleet,
         "fairness": fairness,
         "elastic": elastic,
+        "admission": admission,
     }
     # only a FULL, error-free run may overwrite the committed artifact: a
     # smoke run (YODA_BENCH_NO_SCALE/NO_SERVE, e.g. ci.yaml's
@@ -1528,7 +1692,8 @@ def main():
     if (scale and serve_scale and "error" not in serve_scale
             and serve_fleet and "error" not in serve_fleet
             and fairness and "error" not in fairness
-            and elastic and "error" not in elastic):
+            and elastic and "error" not in elastic
+            and admission and "error" not in admission):
         full_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
         try:
@@ -1610,6 +1775,19 @@ def main():
             "migrations": s["defrag_on"]["defrag_migrations"],
         }
 
+    def admission_summary(s):
+        if not s or "total_pods" not in s:
+            return s or {}
+        return {
+            "parked_pods_as_workloads":
+                f'{s["total_pods"]}/{s["workloads"]}',
+            "parked_bytes_per_workload": s["parked_bytes_per_workload"],
+            "parked_rss_mb": s["parked_rss_mb"],
+            "decision_p99_ratio_10k_vs_1k":
+                s["decision_p99_ratio_10k_vs_1k"],
+            "ttfb_speedup_vs_pod_intake": s["ttfb_speedup"],
+        }
+
     def fleet_summary(s):
         if not s or "legs" not in s:
             return s or {}
@@ -1645,6 +1823,7 @@ def main():
         "serve_fleet": fleet_summary(serve_fleet),
         "fairness": fairness_summary(fairness),
         "elastic": elastic_summary(elastic),
+        "admission": admission_summary(admission),
         "full_detail": "BENCH_FULL.json",
     }))
 

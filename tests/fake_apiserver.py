@@ -61,7 +61,8 @@ def _matches_selector(obj: dict, terms: list) -> bool:
 
 
 class FakeApiState:
-    KINDS = ("pods", "nodes", "metrics", "poddisruptionbudgets")
+    KINDS = ("pods", "nodes", "metrics", "poddisruptionbudgets",
+             "workloads")
 
     def __init__(self):
         _lock = threading.RLock()
@@ -241,6 +242,11 @@ class FakeApiState:
         manifest.setdefault("status", {"phase": "Pending"})
         return self.upsert("pods", manifest)
 
+    def add_workload(self, manifest: dict) -> dict:
+        manifest.setdefault("metadata", {}).setdefault(
+            "namespace", "default")
+        return self.upsert("workloads", manifest)
+
     def put_metrics(self, cr: dict) -> None:
         cr.setdefault("metadata", {"name": cr.get("metadata", {}).get("name")})
         self.upsert("metrics", cr)
@@ -353,6 +359,8 @@ class _Handler(BaseHTTPRequestHandler):
             kind = "metrics"
         elif base == "/apis/policy/v1/poddisruptionbudgets":
             kind = "poddisruptionbudgets"
+        elif base == "/apis/scheduling.yoda.tpu/v1/workloads":
+            kind = "workloads"
         if kind is not None and method == "GET":
             if q.get("watch", ["false"])[0] == "true":
                 return self._watch(kind, q)
@@ -362,6 +370,11 @@ class _Handler(BaseHTTPRequestHandler):
         # concurrency a real API server enforces)
         if "/tpunodemetrics" in base:
             return self._metrics_verb(method, base, kind)
+        # Workload CRD verbs (workload-tier admission): collection POST,
+        # namespaced item GET/DELETE, and the /status subresource PUT the
+        # scheduler's condition write-back uses
+        if "/workloads" in base:
+            return self._workload_verb(method, base, kind)
 
         if base == "/api/v1/events" and method == "GET":
             with s.cond:
@@ -754,6 +767,58 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, body)
         if method == "DELETE":
             gone = s.remove("metrics", name)
+            code = 200 if gone is not None else 404
+            return self._json(code, {"kind": "Status", "code": code})
+        self._json(405, {"kind": "Status", "code": 405})
+
+    # -------------------------------------------------------- workload verbs
+    def _workload_verb(self, method: str, base: str, collection_kind) -> None:
+        """Workload CRD (scheduling.yoda.tpu/v1): collection POST creates;
+        /apis/scheduling.yoda.tpu/v1/namespaces/<ns>/workloads/<name>
+        GET/DELETE; <...>/status PUT merges status (the scheduler's
+        condition write-back — no resourceVersion fencing: last writer
+        wins, like a controller-runtime status patch)."""
+        s = self.state
+        if collection_kind == "workloads" and method == "POST":
+            body = self._body()
+            body.setdefault("metadata", {}).setdefault(
+                "namespace", "default")
+            key = _key(body)
+            with s.cond:  # re-entrant: upsert under the SAME hold, so
+                # two racing POSTs of one key cannot both pass the
+                # existence check and both 201
+                if key in s.objects["workloads"]:
+                    return self._json(409, {"kind": "Status", "code": 409,
+                                            "message": "already exists"})
+                s.upsert("workloads", body, "ADDED")
+            return self._json(201, body)
+        parts = base.split("/")
+        # '', apis, group, v1, namespaces, ns, workloads, name[, status]
+        if len(parts) < 8 or parts[4] != "namespaces":
+            return self._json(404, {"kind": "Status", "code": 404})
+        ns, name = parts[5], parts[7]
+        sub = parts[8] if len(parts) > 8 else None
+        key = f"{ns}/{name}"
+        if method == "GET":
+            with s.cond:
+                cr = s.objects["workloads"].get(key)
+            if cr is None:
+                return self._json(404, {"kind": "Status", "code": 404})
+            return self._json(200, cr)
+        if method == "PUT" and sub == "status":
+            body = self._body()
+            with s.cond:  # upsert under the SAME hold: a racing
+                # DELETE between check and write would otherwise be
+                # resurrected by the status merge
+                cur = s.objects["workloads"].get(key)
+                if cur is None:
+                    return self._json(404, {"kind": "Status", "code": 404})
+                merged = dict(cur)
+                merged["status"] = body.get("status", body)
+                s.upsert("workloads", merged, "MODIFIED")
+            return self._json(200, merged)
+        if method == "DELETE":
+            gone = s.remove("workloads", key)
             code = 200 if gone is not None else 404
             return self._json(code, {"kind": "Status", "code": code})
         self._json(405, {"kind": "Status", "code": 405})

@@ -769,10 +769,18 @@ class TestCrossTenantBatch:
         tenants = {tenant_of(i.pod) for i in batch}
         assert len(tenants) == 1, tenants
 
-    def test_batched_vs_perpod_placements_identical_with_policy(self):
-        """Cross-tenant batch parity (ISSUE 9 satellite): with the
-        policy engine on, batch cycles place a grouped mixed-tenant
-        trace exactly like per-pod cycles."""
+    def test_batched_vs_perpod_outcomes_with_policy(self):
+        """Cross-tenant batch soundness (ISSUE 9 satellite, amended by
+        ISSUE 13): with exact-at-pop DRF, per-pod cycles re-read shares
+        after EVERY bind while a batch advances one tenant's classmates
+        together — so inter-tenant interleaving (and with it exact node
+        assignment) may differ by up to batchMaxPods, the same
+        batch-granularity fairness trade PR 3 documents for priority
+        bands. (Under PR 9's entry-time sampling both modes froze the
+        order at submit, which is exactly the staleness ISSUE 13
+        deletes.) What must hold in both modes: every pod binds, no
+        tenant's total moves, and quota'd tenants still never batch
+        (the gate's NO_BATCH keeps caps exact)."""
         def run(batch_max):
             cfg = SchedulerConfig(
                 policy_objective="makespan", drf_fairness=True,
@@ -787,9 +795,19 @@ class TestCrossTenantBatch:
             for p in pods:
                 sched.submit(p)
             sched.run_until_idle()
-            return [(p.name, p.node) for p in pods]
+            return pods
 
-        assert run(32) == run(1)
+        batched, perpod = run(32), run(1)
+        for pods in (batched, perpod):
+            assert all(p.node is not None for p in pods)
+
+        def per_tenant(pods):
+            out = {}
+            for p in pods:
+                out[tenant_of(p)] = out.get(tenant_of(p), 0) + 1
+            return out
+
+        assert per_tenant(batched) == per_tenant(perpod)
 
     def test_quotad_tenant_never_batches(self):
         cfg = SchedulerConfig(

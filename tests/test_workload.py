@@ -1,0 +1,1099 @@
+"""Workload-tier admission (ISSUE 13): O(1) parked workloads, one
+admission decision per workload, lazy pod materialization, exact-at-pop
+sharded DRF queues — plus the satellites: the entry-time-sampling
+regression, the withdraw/no-claim-leak pass, the park->admit->
+materialize->bind fuzz (fleet lease handover included), knob-off
+bit-identical parity, and the ADMISSION_RACE chaos fuzz."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from yoda_scheduler_tpu.chaos import (
+    ADMISSION_KINDS,
+    ADMISSION_RACE,
+    APISERVER_STORM,
+    BIND_LOST,
+    ChaosCluster,
+    FaultPlan,
+    LEASE_EXPIRY,
+)
+from yoda_scheduler_tpu.scheduler import (
+    FleetCoordinator,
+    Scheduler,
+    SchedulerConfig,
+)
+from yoda_scheduler_tpu.scheduler.cluster import FakeCluster
+from yoda_scheduler_tpu.scheduler.core import FakeClock, HybridClock
+from yoda_scheduler_tpu.scheduler.queue import (
+    DRFShardedQueue,
+    SchedulingQueue,
+    TenantShareBands,
+)
+from yoda_scheduler_tpu.scheduler.workload import (
+    ADMITTED,
+    PARKED,
+    REJECTED,
+    WITHDRAWN,
+    Workload,
+    WorkloadAdmission,
+)
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore,
+    make_tpu_node,
+    make_v4_slice,
+)
+from yoda_scheduler_tpu.utils.pod import Pod, PodPhase
+
+MAX_AGE = 1e18  # virtual clocks: never stale
+
+
+def _store(standalone=4, chips=4, slices=0, slice_topo="2x2x2"):
+    store = TelemetryStore()
+    now = time.time()
+    metrics = []
+    for i in range(standalone):
+        metrics.append(make_tpu_node(f"t{i}", chips=chips))
+    for s in range(slices):
+        metrics.extend(make_v4_slice(f"s{s}", slice_topo))
+    for m in metrics:
+        m.heartbeat = now
+        store.put(m)
+    return store
+
+
+def _cluster(**kw):
+    c = FakeCluster(_store(**kw))
+    c.add_nodes_from_telemetry()
+    return c
+
+
+def _sched(cluster, **cfg_kw):
+    cfg_kw.setdefault("workload_admission", True)
+    cfg_kw.setdefault("telemetry_max_age_s", MAX_AGE)
+    cfg_kw.setdefault("max_attempts", 0)
+    return Scheduler(cluster, SchedulerConfig(**cfg_kw),
+                     clock=HybridClock())
+
+
+def _wl(name, members=1, replicas=1, chips=1, tenant=None, prio=None,
+        **labels):
+    lab = {"scv/number": str(chips)}
+    if tenant:
+        lab["scv/tenant"] = tenant
+    if prio is not None:
+        lab["scv/priority"] = str(prio)
+    lab.update(labels)
+    return Workload(name, members=members, replicas=replicas, labels=lab)
+
+
+# ===================================================== the Workload object
+class TestWorkloadObject:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Workload("w", members=0)
+        with pytest.raises(ValueError):
+            Workload("w", replicas=0)
+        with pytest.raises(ValueError):
+            Workload("w", labels={"tpu/gang-name": "g"})
+        with pytest.raises(ValueError):
+            Workload("w", labels={"tpu/gang-size": "2"})
+
+    def test_parked_cost_is_o1(self):
+        """The whole point: a million-pod workload is ONE template +
+        two ints — no per-pod state until admission materializes."""
+        w = _wl("big", members=100, replicas=10_000)
+        assert w.total_pods == 1_000_000
+        held = {s: getattr(w, s, None) for s in Workload.__slots__}
+        for v in held.values():
+            assert not isinstance(v, list) or len(v) == 0, held
+
+    def test_member_keys_match_materialize(self):
+        w = _wl("j", members=3, replicas=2, chips=2)
+        gangs, keys = w.member_keys()
+        pods = w.materialize()
+        assert [p.key for p in pods] == keys
+        assert len(pods) == 6
+        assert gangs == ["j-r0", "j-r1"]
+        assert {p.labels["tpu/gang-name"] for p in pods} == set(gangs)
+        assert all(p.labels["tpu/gang-size"] == "3" for p in pods)
+
+    def test_single_member_workload_has_no_gang(self):
+        pods = _wl("solo", members=1, replicas=3).materialize()
+        assert len(pods) == 3
+        assert all("tpu/gang-name" not in p.labels for p in pods)
+
+    def test_demand_is_whole_workload(self):
+        w = _wl("d", members=2, replicas=3, chips=2,
+                **{"scv/memory": "100"})
+        assert w.demand() == (12, 1200)
+
+    def test_cr_roundtrip(self):
+        w = _wl("cr", members=2, replicas=3, chips=4, tenant="acme")
+        w.set_condition("Admitted", "False", "NoCapacity", "waiting", 1.0)
+        w2 = Workload.from_cr(w.to_cr())
+        assert (w2.name, w2.members, w2.replicas) == ("cr", 2, 3)
+        assert w2.labels == w.labels
+        assert w2.state == PARKED
+        assert w2.condition("Admitted")["reason"] == "NoCapacity"
+
+    def test_condition_transition_time_moves_on_status_flip_only(self):
+        w = _wl("c")
+        w.set_condition("Admitted", "False", "NoCapacity", "a", 1.0)
+        w.set_condition("Admitted", "False", "OverQuota", "b", 2.0)
+        assert w.condition("Admitted")["lastTransitionTime"] == 1.0
+        w.set_condition("Admitted", "True", "Admitted", "go", 3.0)
+        assert w.condition("Admitted")["lastTransitionTime"] == 3.0
+
+
+# ====================================================== admission lifecycle
+class TestAdmission:
+    def test_park_admit_materialize_bind(self):
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster)
+        w = _wl("a", replicas=4)
+        assert s.submit_workload(w)
+        # parked until the engine thread drains: lazy by construction
+        assert s.queue.pending() == 0
+        s.run_until_idle()
+        assert w.state == ADMITTED
+        _, keys = w.member_keys()
+        assert all(cluster.bound_node_of(k) for k in keys)
+        assert s.metrics.counters.get(
+            "workload_materialized_pods_total") == 4
+
+    def test_knob_off_refuses_and_costs_nothing(self):
+        s = Scheduler(_cluster(), SchedulerConfig(
+            telemetry_max_age_s=MAX_AGE), clock=HybridClock())
+        assert s.workloads is None
+        assert not s.submit_workload(_wl("x"))
+        assert not s.withdraw_workload("default/x")
+
+    def test_capacity_parks_then_admits_when_freed(self):
+        cluster = _cluster(standalone=1, chips=4)
+        s = _sched(cluster)
+        filler = _wl("filler", replicas=3)
+        blocked = _wl("blocked", replicas=3)
+        s.submit_workload(filler)
+        s.run_until_idle()
+        assert filler.state == ADMITTED
+        s.submit_workload(blocked)
+        s.run_until_idle()
+        assert blocked.state == PARKED
+        assert blocked.condition("Admitted")["reason"] == "NoCapacity"
+        # free the chips: the version movement re-opens the blocked exam
+        for k in filler.member_keys()[1]:
+            p = next(p for p in cluster.all_pods() if p.key == k)
+            cluster.evict(p)
+        s.run_until_idle()
+        assert blocked.state == ADMITTED
+
+    def test_one_decision_per_workload_not_per_pod(self):
+        """Admission cost is per WORKLOAD: a 64-pod workload admits with
+        one decision, not 64 queue operations at the admission tier."""
+        cluster = _cluster(standalone=16, chips=4)
+        s = _sched(cluster)
+        s.submit_workload(_wl("wide", replicas=64))
+        s.run_one()  # one tick admits + the first (batch) cycle runs
+        assert s.workloads.decisions == 1
+        # every member materialized into the queue (or already bound by
+        # the first batch cycle) off that single decision
+        assert s.queue.pending() + len(cluster.all_pods()) == 64
+
+    def test_quota_blocks_whole_workload(self):
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster, drf_fairness=True,
+                   tenant_quotas=(("acme", 0.5, -1),))
+        ok = _wl("fits", replicas=4, tenant="acme")       # 4/8 = cap
+        over = _wl("over", replicas=2, tenant="acme")     # would be 6/8
+        s.submit_workload(ok)
+        s.submit_workload(over)
+        s.run_until_idle()
+        assert ok.state == ADMITTED
+        assert over.state == PARKED
+        assert over.condition("Admitted")["reason"] == "OverQuota"
+
+    def test_quota_impossible_rejects_outright(self):
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster, drf_fairness=True,
+                   tenant_quotas=(("acme", 0.25, -1),))
+        w = _wl("never", replicas=4, tenant="acme")  # 4/8 > 0.25 alone
+        s.submit_workload(w)
+        s.run_until_idle()
+        assert w.state == REJECTED
+        assert "exceeds quota" in w.condition("Admitted")["message"]
+
+    def test_admission_claims_block_concurrent_headroom_share(self):
+        """Two workloads that EACH fit free capacity but not together:
+        the first admission's in-flight claim must gate the second —
+        without claims both would materialize into the same headroom."""
+        cluster = _cluster(standalone=1, chips=4)
+        s = _sched(cluster)
+        a, b = _wl("a", replicas=3), _wl("b", replicas=3)
+        s.submit_workload(a)
+        s.submit_workload(b)
+        # drain the inbox + run ONE admission pass, before any pod binds
+        s.workloads.tick(s.clock.time())
+        states = {a.state, b.state}
+        assert states == {ADMITTED, PARKED}, states
+
+    def test_backpressure_window(self):
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster, max_materialized_pods=4)
+        first = _wl("first", replicas=3)
+        second = _wl("second", replicas=3)
+        s.submit_workload(first)
+        s.submit_workload(second)
+        s.workloads.tick(s.clock.time())
+        assert first.state == ADMITTED
+        assert second.state == PARKED
+        assert second.condition("Admitted")["reason"] == "Backpressure"
+        s.run_until_idle()  # queue drains under the window -> admits
+        assert second.state == ADMITTED
+
+    def test_oversized_workload_admits_into_empty_queue(self):
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster, max_materialized_pods=2)
+        w = _wl("wide", replicas=6)  # wider than the window
+        s.submit_workload(w)
+        s.run_until_idle()
+        assert w.state == ADMITTED  # cap bounds concurrency, not size
+
+    def test_oversized_workload_never_blocks_others_head_of_line(self):
+        """An oversized workload (wider than the window) parks ASIDE
+        like a quota verdict — with any pending intake it could never
+        admit, and head-of-line blocking on it would stall every other
+        admission forever."""
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster, max_materialized_pods=4)
+        huge = _wl("huge", replicas=6, prio=9)  # wider than the window
+        small = _wl("small", replicas=2)
+        s.submit_workload(huge)
+        s.submit_workload(small)
+        # keep the queue non-empty so huge can never see pending == 0
+        s.submit(Pod("steady", labels={"scv/number": "1"}))
+        s.workloads.tick(s.clock.time())
+        assert small.state == ADMITTED, (huge.state, small.state)
+        assert huge.state == PARKED
+        assert huge.condition("Admitted")["reason"] == "Backpressure"
+
+    def test_member_name_collision_rejected_at_admit(self):
+        """Deterministic member names can collide across objects (e.g.
+        workload 'job' with members>1 and workload 'job-r0' both derive
+        pod job-r0-0): once the name is BOUND by someone else, admitting
+        would let a later withdraw of either doom the other's pods —
+        the guard refuses at admission."""
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster)
+        # a foreign bound pod owns the exact name workload "clash"
+        # (replicas=2 -> clash-0, clash-1) will derive
+        cluster.bind(Pod("clash-0", labels={"scv/number": "1"}),
+                     "t1", [(0, 0, 0)])
+        vic = _wl("clash", replicas=2)
+        s.submit_workload(vic)
+        s.run_until_idle()
+        assert vic.state == REJECTED
+        assert "already bound" in vic.condition("Admitted")["message"]
+
+    def test_delete_then_recreate_same_name_schedules_afresh(self):
+        """kubectl delete + apply of the same ns/name: the new CR
+        arrives with a NEW uid — the terminal record must not swallow
+        it (engine dedup) and the fleet claim registry must not fake an
+        'admitted by peer' outcome for it."""
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster)
+        w1 = _wl("job", replicas=2)
+        w1.uid = "uid-1"
+        s.submit_workload(w1)
+        # deleted while still parked (the old incarnation's pods are
+        # gone — a recreate over still-BOUND members is refused by the
+        # name-collision guard instead, by design)
+        s.withdraw_workload(w1.key, "deleted")
+        s.run_one()
+        assert w1.state == WITHDRAWN
+        w2 = _wl("job", replicas=2)
+        w2.uid = "uid-2"
+        s.submit_workload(w2)
+        s.run_until_idle()
+        assert w2.state == ADMITTED, w2.state
+        # fleet: recreate admits for real (claims key on (key, uid))
+        clock = FakeClock()
+        fleet = FleetCoordinator(
+            _cluster(standalone=2, chips=4),
+            SchedulerConfig(workload_admission=True,
+                            telemetry_max_age_s=MAX_AGE),
+            replicas=2, clock=clock)
+        f1 = _wl("fj", replicas=1)
+        f1.uid = "uid-a"
+        fleet.submit_workload(f1)
+        fleet.withdraw_workload(f1.key, "deleted")
+        fleet.run_until_idle()
+        f2 = _wl("fj", replicas=1)
+        f2.uid = "uid-b"
+        fleet.submit_workload(f2)
+        fleet.run_until_idle()
+        got = fleet.workload_of(f2.key)
+        assert got is not None and got.state == ADMITTED
+        assert any(fleet.cluster.bound_node_of(k)
+                   for k in f2.member_keys()[1])
+
+    def test_resolved_registry_bounded(self):
+        s = _sched(_cluster())
+        s.workloads._RESOLVED_CAP = 8
+        for i in range(20):
+            w = _wl(f"r{i}", replicas=1)
+            s.submit_workload(w)
+        s.run_until_idle()
+        assert len(s.workloads._resolved) <= 8
+
+    def test_rate_limit_paces_admissions(self):
+        cluster = _cluster(standalone=8, chips=4)
+        clock = FakeClock()
+        cfg = SchedulerConfig(workload_admission=True,
+                              admission_rate_per_s=1.0,
+                              admission_burst=1,
+                              telemetry_max_age_s=MAX_AGE)
+        s = Scheduler(cluster, cfg, clock=clock)
+        wls = [_wl(f"r{i}", replicas=1) for i in range(3)]
+        for w in wls:
+            s.submit_workload(w)
+        s.workloads.tick(clock.time())
+        assert sum(w.state == ADMITTED for w in wls) == 1
+        s.workloads.tick(clock.time())  # no tokens: pass held back
+        assert sum(w.state == ADMITTED for w in wls) == 1
+        assert s.metrics.labeled_counter(
+            "workload_backpressure_total", {"reason": "rate-limit"}) >= 1
+        clock.advance(1.0)
+        s.workloads.tick(clock.time())
+        assert sum(w.state == ADMITTED for w in wls) == 2
+        clock.advance(10.0)  # tokens cap at burst=1: one more, not two
+        s.workloads.tick(clock.time())
+        assert sum(w.state == ADMITTED for w in wls) == 3
+
+    def test_admission_latency_flat_with_backlog_depth(self):
+        """The O(1)-decision claim, pinned small-scale: the decision
+        cost with 2000 parked workloads stays within noise of the cost
+        with 200 (same tenants, same book) — admission never walks the
+        backlog."""
+        def decide_cost(parked):
+            cluster = _cluster(standalone=1, chips=4)
+            s = _sched(cluster, admission_burst=8)
+            big = _wl("huge", members=1, replicas=500)  # never fits
+            s.submit_workload(big)
+            for i in range(parked):
+                s.submit_workload(
+                    _wl(f"p{i}", replicas=400, tenant=f"t{i % 8}"))
+            s.workloads.tick(s.clock.time())  # park everything
+            t0 = time.perf_counter()
+            for _ in range(20):
+                s.workloads.tick(s.clock.time())
+            return time.perf_counter() - t0
+
+        small, large = decide_cost(200), decide_cost(2000)
+        assert large < small * 8 + 0.05, (small, large)
+
+    def test_restart_adoption_never_rematerializes(self):
+        cluster = _cluster(standalone=2, chips=4)
+        s = _sched(cluster)
+        w = _wl("adopt", replicas=2)
+        s.submit_workload(w)
+        s.run_until_idle()
+        assert w.state == ADMITTED
+        # a restarted scheduler re-lists the CR with Admitted status
+        s2 = _sched(cluster)
+        s2.submit_workload(Workload.from_cr(w.to_cr()))
+        s2.run_until_idle()
+        assert s2.metrics.counters.get("workloads_adopted_total") == 1
+        assert not s2.metrics.counters.get(
+            "workload_materialized_pods_total")
+
+
+# ================================= satellite 1: exact-at-pop DRF regression
+class TestAtPopDRF:
+    def test_sharded_queue_built_only_under_drf(self):
+        drf = _sched(_cluster(), drf_fairness=True)
+        assert isinstance(drf.queue, DRFShardedQueue)
+        plain = Scheduler(_cluster(), SchedulerConfig(
+            telemetry_max_age_s=MAX_AGE), clock=HybridClock())
+        assert type(plain.queue) is SchedulingQueue
+
+    def test_converges_where_entry_time_sampling_fails(self):
+        """THE regression (ISSUE 13 satellite): all pods enter the queue
+        while every share is 0 — an entry-time-sampled key is pure FIFO
+        and drains tenant A completely before tenant B; the at-pop heap
+        re-reads the book after every bind and must alternate."""
+        bind_order = []
+
+        class Recording(FakeCluster):
+            def bind(self, pod, node, assigned_chips=None, fence=None):
+                super().bind(pod, node, assigned_chips, fence)
+                bind_order.append(pod.labels["scv/tenant"])
+
+        cluster = Recording(_store(standalone=2, chips=4))
+        cluster.add_nodes_from_telemetry()
+        cfg = SchedulerConfig(drf_fairness=True, batch_max_pods=1,
+                              telemetry_max_age_s=MAX_AGE, max_attempts=3)
+        s = Scheduler(cluster, cfg, clock=HybridClock())
+        for i in range(3):  # A submitted FIRST: FIFO would drain it first
+            s.submit(Pod(f"a{i}", labels={"scv/number": "1",
+                                          "scv/tenant": "A"}))
+        for i in range(3):
+            s.submit(Pod(f"b{i}", labels={"scv/number": "1",
+                                          "scv/tenant": "B"}))
+        s.run_until_idle()
+        assert len(bind_order) == 6
+        # exact-at-pop: after A's first bind its share exceeds B's, so
+        # the SECOND bind must be B's — entry-time sampling binds A,A
+        assert bind_order[1] != bind_order[0], bind_order
+        assert set(bind_order[:2]) == {"A", "B"}, bind_order
+
+    def test_share_drop_resorts_queue_eagerly(self):
+        """A tenant whose share DROPS while queued must surface — the
+        failure mode a stale-high heap key hides forever."""
+        cluster = _cluster(standalone=2, chips=4)
+        cfg = SchedulerConfig(drf_fairness=True,
+                              telemetry_max_age_s=MAX_AGE, max_attempts=3)
+        s = Scheduler(cluster, cfg, clock=HybridClock())
+        pre = [Pod(f"pre{i}", labels={"scv/number": "1",
+                                      "scv/tenant": "A"})
+               for i in range(4)]
+        for i, p in enumerate(pre):
+            cluster.bind(p, "t0", [(i % 2, i // 2, 0)])
+        cluster.bind(Pod("bpre", labels={"scv/number": "1",
+                                         "scv/tenant": "B"}),
+                     "t1", [(0, 0, 0)])
+        s.policy.book.refresh()
+        pa = Pod("pa", labels={"scv/number": "1", "scv/tenant": "A"})
+        pb = Pod("pb", labels={"scv/number": "1", "scv/tenant": "B"})
+        s.submit(pa)  # A share 0.5 at entry (> B's 0.125)
+        s.submit(pb)
+        # A's bound pods vanish: its live share drops UNDER B's
+        for p in pre:
+            cluster.evict(p)
+        got = s.queue.pop(now=s.clock.time())
+        assert got is not None and got.pod.name == "pa", got
+
+    def test_priority_still_strictly_first(self):
+        cluster = _cluster(standalone=2, chips=4)
+        cfg = SchedulerConfig(drf_fairness=True,
+                              telemetry_max_age_s=MAX_AGE, max_attempts=3)
+        s = Scheduler(cluster, cfg, clock=HybridClock())
+        cluster.bind(Pod("pre", labels={"scv/number": "1",
+                                        "scv/tenant": "rich"}),
+                     "t0", [(0, 0, 0)])
+        s.policy.book.refresh()
+        lo = Pod("lo", labels={"scv/number": "1", "scv/tenant": "poor",
+                               "scv/priority": "1"})
+        hi = Pod("hi", labels={"scv/number": "1", "scv/tenant": "rich",
+                               "scv/priority": "9"})
+        s.submit(lo)
+        s.submit(hi)
+        got = s.queue.pop(now=s.clock.time())
+        assert got.pod.name == "hi"
+
+    def test_bands_structure_exactness_unit(self):
+        """TenantShareBands in isolation: stale entries retire, dirty
+        marks re-key, and the selection is the true live minimum."""
+        shares = {"a": 0.5, "b": 0.3}
+        bands = TenantShareBands(lambda t: shares[t])
+        bands.insert(0, "a", 1, 0, "pa")
+        bands.insert(0, "b", 2, 0, "pb")
+        live = lambda payload, seq: True  # noqa: E731
+        assert bands.next(live)[4] == "pb"
+        shares["a"] = 0.1  # movement reported like the book does
+        bands.mark_dirty("a")
+        assert bands.next(live)[4] == "pa"
+        bands.discard(0, "a")
+        assert bands.next(lambda p, s: p != "pa")[4] == "pb"
+        assert len(bands) == 1
+
+
+# ============================== satellite 2: withdraw / no-claim-leak pass
+class TestWithdraw:
+    def _slice_sched(self, **kw):
+        cluster = FakeCluster(_store(standalone=0, slices=1,
+                                     slice_topo="2x2x4"))
+        cluster.add_nodes_from_telemetry()
+        return cluster, _sched(cluster, **kw)
+
+    def test_withdraw_parked(self):
+        cluster = _cluster(standalone=1, chips=4)
+        s = _sched(cluster)
+        big = _wl("big", replicas=400)
+        s.submit_workload(big)
+        s.run_until_idle()
+        assert big.state == PARKED
+        s.withdraw_workload(big.key, "operator")
+        s.run_one()
+        assert big.state == WITHDRAWN
+        assert s.workloads.parked_count() == 0
+
+    def test_withdrawn_admitted_gang_retires_claims_in_one_pass(self):
+        """The PR 10 gang_failed audit extended to the workload tier:
+        withdraw of an admitted (mid-assembly) workload retires the
+        workload claim, the per-gang quota claims, and every
+        materialized member in ONE pass — nothing left for TTLs."""
+        cluster, s = self._slice_sched(
+            drf_fairness=True, tenant_quotas=(("acme", 1.0, -1),))
+        # one 4-member gang of 4 chips/host exactly fills the 2x2x4
+        # slice; run only a FEW cycles so the gang is still assembling
+        # at Permit when the withdraw lands — the hardest moment
+        w = Workload("gj", members=4, replicas=1,
+                     labels={"scv/number": "4", "scv/tenant": "acme"})
+        s.submit_workload(w)
+        for _ in range(3):
+            s.run_one()
+        assert w.state == ADMITTED
+        assert s.waiting, "gang should be mid-assembly at Permit"
+        assert s.workloads._inflight
+        s.withdraw_workload(w.key, "chaos")
+        s.run_one()
+        assert w.state == WITHDRAWN
+        # the no-claim-leak assertions
+        assert not s.workloads._inflight
+        assert not s.policy._gang_inflight
+        assert s.queue.pending() == 0
+        assert not s.waiting
+
+    def test_withdraw_unknown_key_is_noop(self):
+        s = _sched(_cluster())
+        s.withdraw_workload("default/ghost")
+        s.run_one()
+        assert s.workloads.parked_count() == 0
+
+    def test_rejected_workload_holds_no_claims(self):
+        cluster = _cluster(standalone=1, chips=4)
+        s = _sched(cluster, drf_fairness=True,
+                   tenant_quotas=(("t", 0.25, -1),))
+        w = _wl("nope", replicas=4, tenant="t")
+        s.submit_workload(w)
+        s.run_until_idle()
+        assert w.state == REJECTED
+        assert not s.workloads._inflight
+        assert not s.policy._gang_inflight
+
+
+# ===================== satellite 3: queue-invariant fuzz + knob-off parity
+def _drain(sched, max_cycles=200_000):
+    sched.run_until_idle(max_cycles=max_cycles)
+
+
+class TestParity:
+    def test_knob_on_pod_trace_bit_identical(self):
+        """workloadAdmission=1 with a PURE POD trace (no workloads
+        submitted) must place bit-identically to the knob off — the
+        tier's existence costs default pod intake nothing."""
+        def run(knob):
+            cluster = _cluster(standalone=4, chips=4)
+            cfg = SchedulerConfig(workload_admission=knob,
+                                  telemetry_max_age_s=MAX_AGE,
+                                  max_attempts=3)
+            s = Scheduler(cluster, cfg, clock=HybridClock())
+            pods = [Pod(f"p{i}", labels={
+                "scv/number": str(1 + i % 2)}) for i in range(24)]
+            for p in pods:
+                s.submit(p)
+            _drain(s)
+            return [(p.name, p.node,
+                     tuple(sorted(p.assigned_chips()))) for p in pods]
+
+        assert run(True) == run(False)
+
+    def test_knob_off_env_spelled_out(self, monkeypatch):
+        monkeypatch.setenv("YODA_WORKLOAD_ADMISSION", "0")
+        assert SchedulerConfig().workload_admission is False
+        monkeypatch.setenv("YODA_WORKLOAD_ADMISSION", "1")
+        assert SchedulerConfig().workload_admission is True
+
+    def test_config_roundtrip_parses_admission_block(self):
+        cfg = SchedulerConfig.from_profile({
+            "schedulerName": "yoda-scheduler",
+            "pluginConfig": [{"name": "yoda-tpu", "args": {
+                "workloadAdmission": True,
+                "admissionRatePerSecond": 50,
+                "admissionBurst": 16,
+                "maxMaterializedPods": 10_000,
+            }}]})
+        assert cfg.workload_admission is True
+        assert cfg.admission_rate_per_s == 50.0
+        assert cfg.admission_burst == 16
+        assert cfg.max_materialized_pods == 10_000
+
+
+_FUZZ_SMOKE = 8
+_FUZZ_FULL = 24
+
+
+def _fuzz_seed_params(full, smoke):
+    return [s if s < smoke else pytest.param(s, marks=pytest.mark.slow)
+            for s in range(full)]
+
+
+@pytest.mark.parametrize("seed", _fuzz_seed_params(_FUZZ_FULL, _FUZZ_SMOKE))
+def test_workload_queue_invariant_fuzz(seed, monkeypatch):
+    """Park -> admit -> materialize -> bind under random shapes,
+    withdrawals, and (fleet seeds) shard-lease handover mid-admission:
+    no pod lost, no pod double-materialized, parked workloads hold no
+    pods, withdrawn workloads leak no claims."""
+    rng = random.Random(31_000 + seed)
+    mat_counter: Counter = Counter()
+    orig_mat = Workload.materialize
+
+    def counting(self):
+        mat_counter[self.key] += 1
+        return orig_mat(self)
+
+    monkeypatch.setattr(Workload, "materialize", counting)
+
+    store = _store(standalone=6, chips=4, slices=1, slice_topo="2x2x4")
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    clock = FakeClock()
+    cfg = SchedulerConfig(workload_admission=True,
+                          telemetry_max_age_s=MAX_AGE,
+                          max_materialized_pods=rng.choice((0, 16)),
+                          admission_burst=rng.choice((2, 64)))
+    fleet_n = rng.choice((1, 2, 3))
+    if fleet_n > 1:
+        driver = FleetCoordinator(cluster, cfg, replicas=fleet_n,
+                                  clock=clock, seed=seed)
+    else:
+        driver = Scheduler(cluster, cfg, clock=clock)
+
+    # budget demand under capacity (24 standalone + 16 slice chips) so
+    # every non-withdrawn workload must fully bind
+    wls, chip_budget = [], 30
+    i = 0
+    while chip_budget > 0:
+        i += 1
+        if rng.random() < 0.3 and chip_budget >= 4:
+            w = Workload(f"g{i}", members=rng.choice((2, 4)), replicas=1,
+                         labels={"scv/number": "1"})
+        else:
+            w = _wl(f"w{i}", replicas=rng.randrange(1, 4),
+                    chips=1, tenant=rng.choice(("a", "b", "c")))
+        if w.demand()[0] > chip_budget:
+            break
+        chip_budget -= w.demand()[0]
+        wls.append(w)
+    for w in wls:
+        driver.submit_workload(w)
+
+    withdrawn: set[str] = set()
+    has_gangs = any(w.members > 1 for w in wls)
+    steps = 0
+    idle = False
+    while steps < 60_000 and clock.time() < 600.0:
+        steps += 1
+        if rng.random() < 0.02 and len(withdrawn) < 2 and wls:
+            victim = rng.choice(wls)
+            if victim.key not in withdrawn:
+                withdrawn.add(victim.key)
+                driver.withdraw_workload(victim.key, "fuzz")
+        if fleet_n > 1 and rng.random() < 0.02:
+            # shard-lease handover mid-admission
+            driver.revoke_replica_leases(rng.randrange(fleet_n))
+        if fleet_n > 1:
+            outcome = driver.step(rng)
+        else:
+            outcome = driver.run_one()
+        if outcome is not None:
+            clock.advance(0.01)
+            continue
+        wake = driver.next_wake_at()
+        if wake is None:
+            idle = True
+            break
+        clock.advance(max(wake - clock.time(), 0.01))
+
+    engines = (list(driver.engines.values()) if fleet_n > 1
+               else [driver])
+
+    def accounted(key):
+        return (cluster.bound_node_of(key) is not None
+                or driver.tracks(key)
+                or any(key in e.failed for e in engines))
+
+    bound_keys = {p.key for p in cluster.all_pods()}
+    for w in wls:
+        got = driver.workload_of(w.key) if fleet_n > 1 else w
+        _, keys = w.member_keys()
+        if w.key in withdrawn:
+            assert got.state == WITHDRAWN, (seed, w.key, got.state)
+            continue
+        assert got.state == ADMITTED, (seed, w.key, got.state)
+        # no double materialization — fleet handover included
+        assert mat_counter[w.key] == 1, (seed, w.key, mat_counter[w.key])
+        # NO POD LOST: every materialized member is bound, still in
+        # someone's hands, or explicitly failed — never vanished
+        lost = [k for k in keys if not accounted(k)]
+        assert not lost, (seed, w.key, lost)
+        if idle and not has_gangs:
+            # singles-only seeds have no slice contention: an idle
+            # drain means full convergence, so pin the stronger form
+            missing = [k for k in keys if k not in bound_keys]
+            assert not missing, (seed, w.key, missing)
+    # chip book sane: no chip double-booked
+    owners: dict[tuple, str] = {}
+    for node in cluster.node_names():
+        for p in cluster.pods_on(node):
+            for chip in p.assigned_chips():
+                assert (node, chip) not in owners, (seed, node, chip)
+                owners[(node, chip)] = p.key
+    # no claim held for a withdrawn workload anywhere
+    for e in engines:
+        for key in e.workloads._inflight:
+            assert key not in withdrawn, (seed, key)
+
+
+# =========================== fleet: lease handover mid-admission, targeted
+class TestFleetAdmission:
+    def test_handover_mid_admission_single_materialization(self, monkeypatch):
+        mat_counter: Counter = Counter()
+        orig_mat = Workload.materialize
+        monkeypatch.setattr(
+            Workload, "materialize",
+            lambda self: (mat_counter.update([self.key]),
+                          orig_mat(self))[1])
+        cluster = _cluster(standalone=4, chips=4)
+        clock = FakeClock()
+        cfg = SchedulerConfig(workload_admission=True,
+                              telemetry_max_age_s=MAX_AGE)
+        fleet = FleetCoordinator(cluster, cfg, replicas=2, clock=clock,
+                                 seed=3)
+        wls = [_wl(f"w{i}", replicas=2) for i in range(4)]
+        for w in wls:
+            fleet.submit_workload(w)
+        rng = random.Random(3)
+        # let the owner admit SOME, then yank its leases mid-backlog
+        for _ in range(6):
+            fleet.step(rng)
+            clock.advance(0.05)
+        fleet.revoke_replica_leases(0)
+        fleet.revoke_replica_leases(1)
+        fleet.run_until_idle()
+        for w in wls:
+            got = fleet.workload_of(w.key)
+            assert got is not None and got.state == ADMITTED, w.key
+            assert mat_counter[w.key] == 1, (w.key, mat_counter[w.key])
+            assert all(cluster.bound_node_of(k)
+                       for k in w.member_keys()[1])
+
+    def test_crash_reseeds_parked_set(self):
+        cluster = _cluster(standalone=1, chips=4)
+        clock = FakeClock()
+        cfg = SchedulerConfig(workload_admission=True,
+                              telemetry_max_age_s=MAX_AGE)
+        fleet = FleetCoordinator(cluster, cfg, replicas=2, clock=clock)
+        big = _wl("parked", replicas=400)
+        fleet.submit_workload(big)
+        fleet.run_until_idle()
+        assert fleet.workload_of(big.key).state == PARKED
+        fleet.crash_replica(0)
+        # the re-seed rides the admission inbox; one cycle drains it
+        fleet.replicas[0].engine.run_one()
+        assert fleet.replicas[0].engine.workloads.get(big.key) is not None
+
+    def test_withdraw_blocks_future_admission_fleet_wide(self):
+        cluster = _cluster(standalone=2, chips=4)
+        clock = FakeClock()
+        cfg = SchedulerConfig(workload_admission=True,
+                              telemetry_max_age_s=MAX_AGE)
+        fleet = FleetCoordinator(cluster, cfg, replicas=2, clock=clock)
+        w = _wl("gone", replicas=1)
+        fleet.submit_workload(w)
+        fleet.withdraw_workload(w.key, "operator")
+        fleet.run_until_idle()
+        got = fleet.workload_of(w.key)
+        assert got is not None and got.state == WITHDRAWN
+        assert not any(cluster.bound_node_of(k)
+                       for k in w.member_keys()[1])
+
+
+# ==================== satellite 5: ADMISSION_RACE chaos fuzz (16 in smoke)
+_CHAOS_SMOKE = 16
+_CHAOS_FULL = 32
+
+
+@pytest.mark.parametrize(
+    "seed", _fuzz_seed_params(_CHAOS_FULL, _CHAOS_SMOKE))
+def test_workload_admission_chaos_fuzz(seed, monkeypatch):
+    """ADMISSION_RACE (+ storms, lost binds, lease expiry) against a
+    fleet whose ENTIRE intake is workloads: mid-window a random
+    workload is withdrawn (possibly half-materialized) and the
+    admission owner's leases are revoked. Invariants: every surviving
+    workload admits exactly once and fully binds, withdrawn workloads
+    leak no claims, no chip is double-booked."""
+    rng = random.Random(87_000 + seed)
+    mat_counter: Counter = Counter()
+    orig_mat = Workload.materialize
+    monkeypatch.setattr(
+        Workload, "materialize",
+        lambda self: (mat_counter.update([self.key]), orig_mat(self))[1])
+
+    plan = FaultPlan(seed, horizon_s=15.0, kinds=ADMISSION_KINDS)
+    clock = FakeClock()
+    store = _store(standalone=3, chips=4, slices=1, slice_topo="2x2x4")
+    cluster = ChaosCluster(store, plan=plan, clock=clock)
+    cluster.add_nodes_from_telemetry()
+    n = rng.choice((2, 3))
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(workload_admission=True,
+                        telemetry_max_age_s=MAX_AGE,
+                        breaker_cooldown_s=1.0),
+        replicas=n, clock=clock, seed=seed,
+        validate_fence_locally=bool(rng.getrandbits(1)))
+
+    wls, budget = [], 20  # of 28 chips: withdrawn remnants never wedge it
+    i = 0
+    while budget >= 2:
+        i += 1
+        if rng.random() < 0.4:
+            w = Workload(f"g{i}", members=2, replicas=1,
+                         labels={"scv/number": "1"})
+        else:
+            w = _wl(f"w{i}", replicas=rng.randrange(1, 4), chips=1,
+                    tenant=rng.choice(("a", "b")))
+        if w.demand()[0] > budget:
+            break
+        budget -= w.demand()[0]
+        wls.append(w)
+    for w in wls:
+        fleet.submit_workload(w)
+
+    withdrawn: set[str] = set()
+    has_gangs = any(w.members > 1 for w in wls)
+    fired: set = set()
+    fault_end = plan.fault_end()
+    steps = 0
+    idle = False
+    while steps < 100_000 and clock.time() < 600.0:
+        now = clock.time()
+        steps += 1
+        for wdw in plan.windows:
+            key = (wdw.kind, wdw.start)
+            if wdw.start > now or key in fired:
+                continue
+            if wdw.kind == ADMISSION_RACE:
+                fired.add(key)
+                victim = rng.choice(wls)
+                if victim.key not in withdrawn:
+                    withdrawn.add(victim.key)
+                    fleet.withdraw_workload(victim.key, "admission-race")
+                for idx in range(fleet.n):
+                    fleet.revoke_replica_leases(idx)
+            elif wdw.kind == LEASE_EXPIRY:
+                fired.add(key)
+                fleet.revoke_replica_leases(rng.randrange(fleet.n))
+        if fleet.step(rng) is not None:
+            clock.advance(0.01)
+            continue
+        wake = fleet.next_wake_at()
+        if wake is None:
+            if now >= fault_end:
+                idle = True
+                break
+            clock.advance(0.5)
+        else:
+            clock.advance(max(wake - clock.time(), 0.01))
+
+    def accounted(key):
+        return (cluster.bound_node_of(key) is not None
+                or fleet.tracks(key)
+                or any(key in rep.engine.failed
+                       for rep in fleet.replicas))
+
+    bound_keys = {p.key for p in cluster.all_pods()}
+    for w in wls:
+        got = fleet.workload_of(w.key)
+        _, keys = w.member_keys()
+        if w.key in withdrawn:
+            assert got.state == WITHDRAWN, (seed, w.key, got.state)
+            continue
+        assert got is not None and got.state == ADMITTED, (seed, w.key)
+        assert mat_counter[w.key] == 1, (seed, w.key, mat_counter[w.key])
+        lost = [k for k in keys if not accounted(k)]
+        assert not lost, (seed, w.key, lost)
+        if idle and not has_gangs:
+            missing = [k for k in keys if k not in bound_keys]
+            assert not missing, (seed, w.key, missing)
+    owners: dict[tuple, str] = {}
+    for node in cluster.node_names():
+        for p in cluster.pods_on(node):
+            for chip in p.assigned_chips():
+                assert (node, chip) not in owners, (seed, node, chip)
+                owners[(node, chip)] = p.key
+    for rep in fleet.replicas:
+        for key in rep.engine.workloads._inflight:
+            assert key not in withdrawn, (seed, key)
+
+
+# ========================= satellite 4: the wire surface (CRD + serve feed)
+class TestWire:
+    def test_fake_apiserver_crd_verbs(self):
+        from tests.fake_apiserver import FakeApiServer
+        from yoda_scheduler_tpu.k8s.client import KubeClient
+
+        with FakeApiServer() as api:
+            c = KubeClient(api.url)
+            w = _wl("wire", members=1, replicas=2)
+            c.create_workload(w.to_cr())
+            items = c.list_workloads()
+            assert [i["metadata"]["name"] for i in items] == ["wire"]
+            c.update_workload_status("default", "wire", {
+                "state": "Admitted", "conditions": []})
+            got = c.request(
+                "GET", "/apis/scheduling.yoda.tpu/v1/namespaces/"
+                       "default/workloads/wire")
+            assert got["status"]["state"] == "Admitted"
+            # watch sees the status MODIFIED
+            evs = list(api.state.events["workloads"])
+            assert [e[1] for e in evs] == ["ADDED", "MODIFIED"]
+            c.delete_workload("default", "wire")
+            assert c.list_workloads() == []
+            # status write-back on a deleted CR is a silent no-op
+            c.update_workload_status("default", "wire", {"state": "X"})
+
+    def test_feed_end_to_end_with_status_writeback(self):
+        from tests.fake_apiserver import FakeApiServer
+        from yoda_scheduler_tpu.k8s.client import KubeClient, WorkloadFeed
+
+        with FakeApiServer() as api:
+            client = KubeClient(api.url)
+            cluster = _cluster(standalone=2, chips=4)
+            s = _sched(cluster)
+            feed = WorkloadFeed(client, s, metrics=s.metrics)
+            s.workloads.status_sink = feed.push_status
+            stop = threading.Event()
+            try:
+                client.create_workload(
+                    _wl("served", replicas=2).to_cr())
+                feed.start(stop)
+                deadline = time.time() + 10.0
+                while time.time() < deadline:
+                    s.run_one()
+                    cr = api.state.objects["workloads"].get(
+                        "default/served")
+                    if cr and cr.get("status", {}).get(
+                            "state") == ADMITTED:
+                        break
+                    time.sleep(0.02)
+                else:
+                    pytest.fail("workload never admitted over the wire")
+                w = s.workloads.get("default/served")
+                _, keys = w.member_keys()
+                s.run_until_idle()
+                assert all(cluster.bound_node_of(k) for k in keys)
+                # CR deletion withdraws
+                client.delete_workload("default", "served")
+                deadline = time.time() + 10.0
+                while time.time() < deadline:
+                    s.run_one()
+                    if s.workloads.get("default/served").state \
+                            == WITHDRAWN:
+                        break
+                    time.sleep(0.02)
+                else:
+                    pytest.fail("CR deletion never withdrew")
+            finally:
+                stop.set()
+
+    def test_serve_loop_wire_materialization_end_to_end(self):
+        """The full serve path (run_scheduler_against_cluster): Workload
+        CRs over live HTTP -> admission -> pods POSTed to the apiserver
+        by the materializer (ownerReference'd to the Workload) -> watch
+        intake -> binds land server-side -> /status write-back; the
+        100-pod backlog CR parks with NoCapacity and ZERO pods ever
+        reach the apiserver; CR deletion cleans up."""
+        from tests.fake_apiserver import FakeApiServer
+        from yoda_scheduler_tpu.k8s.client import (
+            KubeClient, run_scheduler_against_cluster)
+        from yoda_scheduler_tpu.telemetry import make_tpu_node
+
+        with FakeApiServer() as api:
+            for i in range(2):
+                api.state.add_node(f"n{i}")
+                m = make_tpu_node(f"n{i}", chips=4)
+                m.heartbeat = time.time() + 1e9
+                api.state.put_metrics(m.to_cr())
+            client = KubeClient(api.url)
+            client.create_workload(_wl("served", replicas=4).to_cr())
+            client.create_workload(_wl("backlog", replicas=100).to_cr())
+            cfg = SchedulerConfig(workload_admission=True,
+                                  telemetry_max_age_s=1e18)
+            stop = threading.Event()
+            t = threading.Thread(
+                target=run_scheduler_against_cluster,
+                args=(KubeClient(api.url), [(cfg, None)]),
+                kwargs={"metrics_port": None, "poll_s": 0.1,
+                        "stop_event": stop}, daemon=True)
+            t.start()
+            try:
+                want = {f"default/served-{i}" for i in range(4)}
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    bound = {k for k, o in
+                             api.state.objects["pods"].items()
+                             if o.get("spec", {}).get("nodeName")}
+                    served = api.state.objects["workloads"].get(
+                        "default/served", {})
+                    backlog = api.state.objects["workloads"].get(
+                        "default/backlog", {})
+                    if (want <= bound
+                            and served.get("status", {}).get(
+                                "state") == ADMITTED
+                            and backlog.get("status", {}).get(
+                                "state") == PARKED):
+                        break
+                    time.sleep(0.1)
+                else:
+                    pytest.fail(f"no convergence: bound={sorted(bound)}")
+                assert len(api.state.objects["pods"]) == 4
+                owner = api.state.objects["pods"]["default/served-0"][
+                    "metadata"]["ownerReferences"][0]
+                assert owner["kind"] == "Workload"
+                assert owner["name"] == "served"
+                assert (backlog["status"]["conditions"][0]["reason"]
+                        == "NoCapacity")
+            finally:
+                stop.set()
+                t.join(timeout=10)
+
+    def test_feed_skips_malformed_and_duplicate_crs(self):
+        class _Sink:
+            def __init__(self):
+                self.got = []
+
+            def submit_workload(self, w):
+                self.got.append(w.key)
+                return True
+
+            def withdraw_workload(self, key, reason):
+                self.got.append(("withdraw", key))
+
+        from collections import deque
+
+        from yoda_scheduler_tpu.k8s.client import WorkloadFeed
+
+        sink = _Sink()
+        feed = WorkloadFeed.__new__(WorkloadFeed)
+        feed.sched = sink
+        feed._seen = set()
+        feed.metrics = None
+        feed._pods_q = deque()
+        feed._pods_evt = threading.Event()
+        cr = _wl("dup").to_cr()
+        feed._apply("ADDED", cr)
+        feed._apply("MODIFIED", cr)  # status echo: no resubmit
+        assert sink.got == ["default/dup"]
+        feed._apply("ADDED", {"metadata": {"name": "bad"},
+                              "spec": {"members": 0}})
+        assert sink.got == ["default/dup"]
+        feed._apply("DELETED", cr)
+        assert sink.got[-1] == ("withdraw", "default/dup")

@@ -82,6 +82,14 @@ WEBHOOK_DOWN = "WebhookDown"
 # battery and the controller's safety rails are the only thing standing
 # between active defragmentation and a lost pod / double-booked chip.
 DEFRAG_RACE = "DefragRace"
+# workload-admission kind (ISSUE 13): at a seeded instant, race the
+# admission tier — withdraw a random workload (possibly mid-admission,
+# its members half-materialized across replicas) and/or revoke the
+# admission owner's leases so the shard-0 handover lands while the
+# parked backlog is being decided. The fleet-wide claim-once registry
+# and the one-pass withdraw retirement are the only things standing
+# between that and a double-materialized workload / leaked quota claim.
+ADMISSION_RACE = "AdmissionRace"
 
 ALL_KINDS = (APISERVER_STORM, BIND_LOST, TELEMETRY_BLACKOUT, PLUGIN_ERROR,
              ENGINE_CRASH)
@@ -104,6 +112,12 @@ WEBHOOK_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
 # the four global invariants
 ELASTIC_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
                  NETWORK_PARTITION, DEFRAG_RACE)
+# the workload-admission fuzz's mix (tests/test_workload.py): admission
+# races + lease churn + the commit-path stressors, over a fleet whose
+# ENTIRE intake is workloads — no pod lost / no double-materialize /
+# no leaked claim join the four global invariants
+ADMISSION_KINDS = (APISERVER_STORM, BIND_LOST, LEASE_EXPIRY,
+                   ADMISSION_RACE)
 
 
 class LostResponseError(ConnectionError):

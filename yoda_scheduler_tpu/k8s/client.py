@@ -591,6 +591,42 @@ class KubeClient:
         doc = self.list_all(METRICS_PATH)
         return [TpuNodeMetrics.from_cr(item) for item in doc.get("items", [])]
 
+    # Workload CRD (workload-tier admission, scheduler/workload.py)
+    def list_workloads(self) -> list[dict]:
+        from ..scheduler.workload import WORKLOADS_PATH
+
+        return self.list_all(WORKLOADS_PATH).get("items", [])
+
+    def create_workload(self, cr: dict) -> dict:
+        from ..scheduler.workload import WORKLOADS_PATH
+
+        return self.request("POST", WORKLOADS_PATH, cr)
+
+    def delete_workload(self, namespace: str, name: str) -> None:
+        from ..scheduler.workload import WORKLOAD_GROUP, WORKLOAD_VERSION
+
+        self.request(
+            "DELETE",
+            f"/apis/{WORKLOAD_GROUP}/{WORKLOAD_VERSION}/namespaces/"
+            f"{namespace}/workloads/{name}")
+
+    def update_workload_status(self, namespace: str, name: str,
+                               status: dict) -> None:
+        """PUT the Workload /status subresource (the admission tier's
+        condition write-back). Best-effort like post_event: a vanished
+        CR (404) is not an error — the workload was deleted."""
+        from ..scheduler.workload import WORKLOAD_GROUP, WORKLOAD_VERSION
+
+        try:
+            self.request(
+                "PUT",
+                f"/apis/{WORKLOAD_GROUP}/{WORKLOAD_VERSION}/namespaces/"
+                f"{namespace}/workloads/{name}/status",
+                {"status": status})
+        except ApiError as e:
+            if e.status != 404:
+                raise
+
     def get_pod(self, namespace: str, name: str) -> dict | None:
         try:
             return self.request(
@@ -2228,6 +2264,278 @@ def _stale_event(old: Pod, new: Pod) -> bool:
     return old.terminating and not new.terminating
 
 
+class WorkloadFeed:
+    """Workload CRD intake + status write-back for the serve loop
+    (workloadAdmission knob): a Reflector on the workloads path feeds
+    CR adds into the scheduler's admission tier (O(1) parked per CR —
+    pods materialize only on admission), CR deletions withdraw, and the
+    tier's condition changes flow back as /status PUTs from a dedicated
+    writer thread (latest-wins per workload, bounded queue, never
+    back-pressures the engine — the post_event discipline).
+
+    On a WIRE backend the scheduler is also the workload's CONTROLLER:
+    an admitted workload's pods must exist on the apiserver before any
+    binding subresource POST can land, so materialization routes
+    through `wire_materializer` — pod manifests (ownerReference'd to
+    the Workload) POST from a dedicated creator thread and flow back
+    through the ordinary pod watch into the scheduling queue, exactly
+    like a Job controller's pods would. A withdraw deletes the
+    UNBOUND members server-side (bound ones stay bound, the gang
+    semantics).
+
+    The workloads resource is OPTIONAL: a cluster without the CRD
+    installed serves the classic pod-at-a-time intake untouched."""
+
+    _QUEUE_CAP = 4096
+
+    def __init__(self, client: KubeClient, sched, metrics=None) -> None:
+        from ..scheduler.workload import WORKLOADS_PATH
+
+        self.client = client
+        self.sched = sched
+        self.metrics = metrics
+        self._seen: set[str] = set()  # keys handed to the scheduler
+        self._status: dict[str, dict] = {}  # key -> latest status doc
+        self._status_order: deque = deque()
+        self._status_evt = threading.Event()
+        # guards _status/_status_order consistency between the engine
+        # thread's push and the writer thread's pop: a check-then-act
+        # interleave could otherwise strand a key in _status with no
+        # order entry, silencing that workload's write-back forever
+        self._status_lock = threading.Lock()
+        # pod create/delete work for the wire-materializer thread:
+        # ("create", manifest) | ("delete", (namespace, name))
+        self._pods_q: deque = deque()
+        self._pods_evt = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.reflector = Reflector(client, WORKLOADS_PATH,
+                                   self._replace, self._event,
+                                   optional=True, metrics=metrics)
+
+    # ----------------------------------------------------------- intake side
+    def _submit(self, w) -> bool:
+        target = getattr(self.sched, "submit_workload", None)
+        if target is not None:
+            return target(w)
+        for e in self.sched.engines.values():  # multi-profile routing
+            if e.submit_workload(w):
+                return True
+        return False
+
+    def _withdraw(self, key: str, obj: dict | None = None) -> None:
+        target = getattr(self.sched, "withdraw_workload", None)
+        if target is not None:
+            target(key, "workload CR deleted")
+        else:
+            for e in self.sched.engines.values():
+                e.withdraw_workload(key, "workload CR deleted")
+        # wire controller duty: the CR's pods were OURS to create, so
+        # they are ours to clean up — unbound members delete, bound
+        # ones stay (the creator thread checks bindings). When the
+        # deletion was only observed as a re-list ABSENCE (no CR body),
+        # the engines' resolved record still knows the shape.
+        w = None
+        if obj is not None:
+            try:
+                from ..scheduler.workload import Workload
+
+                w = Workload.from_cr(obj)
+            except (ValueError, KeyError):
+                w = None
+        if w is None:
+            wl_of = getattr(self.sched, "workload_of", None)
+            if wl_of is not None:
+                w = wl_of(key)
+            else:
+                for e in getattr(self.sched, "engines", {}).values():
+                    wa = e.workloads
+                    w = wa.get(key) if wa is not None else None
+                    if w is not None:
+                        break
+        if w is None:
+            return
+        # only an ADMITTED workload ever had pods created — a parked/
+        # rejected one's delete fan-out would be members x replicas
+        # useless get_pod round-trips against the apiserver. Prefer the
+        # engine's live record for the state (the CR body may carry a
+        # stale status snapshot).
+        state = w.state
+        wl_of = getattr(self.sched, "workload_of", None)
+        live = (wl_of(key) if wl_of is not None else None)
+        if live is None:
+            for e in getattr(self.sched, "engines", {}).values():
+                wa = e.workloads
+                live = wa.get(key) if wa is not None else None
+                if live is not None:
+                    break
+        if live is not None:
+            state = live.state
+        from ..scheduler.workload import ADMITTED, WITHDRAWN
+
+        if state not in (ADMITTED, WITHDRAWN):
+            return
+        for pk in w.member_keys()[1]:
+            ns, name = pk.split("/", 1)
+            self._pods_q.append(("delete", (ns, name)))
+        self._pods_evt.set()
+
+    def _apply(self, typ: str, obj: dict) -> None:
+        from ..scheduler.workload import Workload
+
+        if typ == "DELETED":
+            key = (f"{obj.get('metadata', {}).get('namespace', 'default')}"
+                   f"/{obj.get('metadata', {}).get('name', '')}")
+            if key in self._seen:
+                self._seen.discard(key)
+                self._withdraw(key, obj)
+            return
+        try:
+            w = Workload.from_cr(obj)
+        except (ValueError, KeyError) as e:
+            log.warning("ignoring malformed Workload CR: %s", e)
+            return
+        if w.key in self._seen:
+            return  # spec is immutable once parked; status echoes skip
+        if self._submit(w):
+            self._seen.add(w.key)
+
+    def _replace(self, items: list) -> None:
+        live = set()
+        for item in items:
+            md = item.get("metadata", {})
+            live.add(f"{md.get('namespace', 'default')}/{md.get('name')}")
+            self._apply("ADDED", item)
+        for key in list(self._seen - live):
+            # vanished between watches (compaction window): withdraw
+            self._seen.discard(key)
+            self._withdraw(key)
+
+    def _event(self, typ: str, obj: dict) -> None:
+        self._apply(typ, obj)
+
+    # ------------------------------------------------- wire materialization
+    def wire_materializer(self, pod: Pod) -> bool:
+        """WorkloadAdmission.submit_pod on wire backends: engine-thread,
+        never blocks. The pod manifest queues for the creator thread;
+        the apiserver's watch then delivers it into the ordinary pod
+        intake — the scheduler plays Job-controller for its own
+        workloads, and the bind path stays untouched."""
+        # no cap: dropping a create would leave an Admitted workload
+        # permanently short of members with nothing to retry it. The
+        # queue is bounded upstream by admission itself — only
+        # capacity's worth of demand is ever admitted-but-unbound, so
+        # the backlog here can never exceed the cluster's chip count
+        # worth of small manifests.
+        self._pods_q.append(("create", {
+            "metadata": {
+                "name": pod.name, "namespace": pod.namespace,
+                "labels": dict(pod.labels),
+                "ownerReferences": [{"kind": "Workload",
+                                     "name": getattr(
+                                         pod, "_workload_name", pod.name),
+                                     "controller": True}],
+            },
+            "spec": {"schedulerName": pod.scheduler_name},
+            "status": {"phase": "Pending"},
+        }))
+        self._pods_evt.set()
+        return True
+
+    def _pods_loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            if not self._pods_q:
+                self._pods_evt.wait(timeout=0.2)
+                self._pods_evt.clear()
+                continue
+            try:
+                op, payload = self._pods_q.popleft()
+            except IndexError:
+                continue
+            try:
+                if op == "create":
+                    try:
+                        self.client.request("POST", "/api/v1/pods",
+                                            payload)
+                    except ApiError as e:
+                        if e.status != 409:  # exists: idempotent re-admit
+                            raise
+                else:
+                    ns, name = payload
+                    cur = self.client.get_pod(ns, name)
+                    if cur is None or cur.get("spec", {}).get("nodeName"):
+                        continue  # gone, or bound: stays bound
+                    # check-then-delete: a bind landing in this window
+                    # still gets deleted — acceptable by construction,
+                    # because on a real cluster the Workload CR's
+                    # deletion garbage-collects ALL ownerReference'd
+                    # member pods (bound included); the unbound check
+                    # above is a best-effort courtesy, not a guarantee
+                    self.client.request(
+                        "DELETE",
+                        f"/api/v1/namespaces/{ns}/pods/{name}")
+            except Exception as e:
+                log.warning("workload pod %s failed: %s", op, e)
+                if self.metrics is not None:
+                    self.metrics.inc("workload_pod_create_errors_total")
+
+    # ----------------------------------------------------- status write-back
+    def push_status(self, w) -> None:
+        """WorkloadAdmission.status_sink: engine-thread, never blocks.
+        Latest-wins per workload; past the cap the oldest un-written
+        status is dropped (conditions are observability, not
+        correctness)."""
+        key = w.key
+        doc = {"namespace": w.namespace, "name": w.name,
+               "status": w.status()}
+        with self._status_lock:
+            fresh = key not in self._status
+            if fresh and len(self._status_order) >= self._QUEUE_CAP:
+                # latest wins: make room by dropping the OLDEST queued
+                # write-back, never the fresh terminal state arriving
+                old_key = self._status_order.popleft()
+                self._status.pop(old_key, None)
+                if self.metrics is not None:
+                    self.metrics.inc("workload_status_dropped_total")
+            self._status[key] = doc
+            if fresh:
+                self._status_order.append(key)
+        self._status_evt.set()
+
+    def _status_loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            if not self._status_order:
+                self._status_evt.wait(timeout=0.2)
+                self._status_evt.clear()
+                continue
+            with self._status_lock:
+                try:
+                    key = self._status_order.popleft()
+                except IndexError:
+                    continue
+                doc = self._status.pop(key, None)
+            if doc is None:
+                continue
+            try:
+                self.client.update_workload_status(
+                    doc["namespace"], doc["name"], doc["status"])
+            except Exception as e:
+                log.warning("workload status write-back failed for %s: %s",
+                            key, e)
+                if self.metrics is not None:
+                    self.metrics.inc("workload_status_errors_total")
+
+    def start(self, stop: threading.Event) -> None:
+        for name, target in (("workload-reflector",
+                              lambda: self.reflector.run(stop)),
+                             ("workload-status",
+                              lambda: self._status_loop(stop)),
+                             ("workload-pods",
+                              lambda: self._pods_loop(stop))):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+
 def run_scheduler_against_cluster(client: KubeClient, profiles,
                                   metrics_port: int | None = 10251,
                                   leader_elect: bool = False,
@@ -2290,6 +2598,27 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     # pod's tree is complete: queued/cycle (engine) + bind_wire/
     # watch_confirm (binder + reflector threads)
     cluster.trace_sampling = profiles[0][0].trace_sampling
+
+    # workload-tier admission (scheduler/workload.py): a reflector on
+    # the Workload CRD feeds the admission tier and the tier's condition
+    # changes PUT back to /status — only when the knob asked for the
+    # tier at all (engines without it refuse submissions)
+    if any(e.workloads is not None for e in sched.engines.values()):
+        wl_feed = WorkloadFeed(client, sched,
+                               metrics=next(iter(
+                                   sched.engines.values())).metrics)
+        for e in sched.engines.values():
+            if e.workloads is not None:
+                e.workloads.status_sink = wl_feed.push_status
+                # wire backend: admitted pods must EXIST on the
+                # apiserver before any binding POST can land — the
+                # materializer POSTs them and the pod watch delivers
+                # them back through the ordinary intake (the scheduler
+                # is the workload's controller; WorkloadFeed docstring)
+                e.workloads.submit_pod = wl_feed.wire_materializer
+        wl_feed.start(stop)
+        log.info("workload admission tier serving (CRD list/watch + "
+                 "pod materialization over the wire)")
 
     # restart reconciliation against CLUSTER truth, over the PAGINATED
     # pod read (iter_pods follows continue tokens): bound pods are

@@ -21,6 +21,7 @@ from .multi import MultiProfileScheduler
 from .fleet import FleetCoordinator, LocalLeaseStore
 from .deschedule import Descheduler, DeschedulePlan
 from .cluster import BindConflictError, FakeCluster
+from .workload import Workload, WorkloadAdmission
 
 __all__ = [
     "Status",
@@ -48,4 +49,6 @@ __all__ = [
     "DeschedulePlan",
     "BindConflictError",
     "FakeCluster",
+    "Workload",
+    "WorkloadAdmission",
 ]

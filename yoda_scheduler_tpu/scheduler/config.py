@@ -144,6 +144,19 @@ def _elastic_default() -> bool:
     return os.environ.get("YODA_ELASTIC", "0").lower() in ("1", "true", "on")
 
 
+def _workload_admission_default() -> bool:
+    """Workload-tier admission (scheduler/workload.py): one Workload
+    object describes N gang members x M replicas; admission runs ONCE
+    per workload against the DRF book / hierarchical quotas / live
+    capacity, and pods materialize into the scheduling queue lazily
+    only after their workload admits — a parked workload costs O(1)
+    memory, never O(pods). Default OFF; YODA_WORKLOAD_ADMISSION=1
+    enables (CI runs a tier-1 leg with it spelled-out off, the same
+    parity discipline as the policy engine)."""
+    return os.environ.get("YODA_WORKLOAD_ADMISSION", "0").lower() in (
+        "1", "true", "on")
+
+
 def _drf_default() -> bool:
     """DRF fairness layer (tenant-fairness queue ordering + quota gate
     + preemption budgets): default OFF; YODA_DRF=1 enables."""
@@ -431,6 +444,30 @@ class SchedulerConfig:
     # trips the flight recorder (tenant_starvation) and the per-tenant
     # counter. 0 disables.
     starvation_after_s: float = 300.0
+    # ---- workload-tier admission (scheduler/workload.py) ----
+    # Workload admission above the pod queue: Workloads park in O(1)
+    # until one admission decision (DRF book + hierarchical quotas +
+    # live capacity) materializes their pods into the queue. OFF by
+    # default — placements and queue behaviour bit-identical to the
+    # pod-at-a-time intake (tests/test_workload.py parity + the CI
+    # admission job's knob-off tier-1 leg).
+    workload_admission: bool = field(
+        default_factory=_workload_admission_default)
+    # rate-limited intake: at most this many workload ADMISSIONS per
+    # second (token bucket, admission_burst deep). 0 = unlimited.
+    # Excess pressure parks workloads with a Backpressure condition
+    # instead of flooding the pod queue.
+    admission_rate_per_s: float = 0.0
+    # token-bucket depth AND the per-tick admission exam cap: one
+    # scheduling cycle never spends more than this many admission
+    # decisions, keeping the admission tier O(1)-per-cycle whatever the
+    # parked backlog depth.
+    admission_burst: int = 64
+    # backpressure threshold: no workload admits while the engine holds
+    # at least this many pending pods (queued + backoff) — the knob
+    # that bounds materialized-pod memory at million-pod backlogs.
+    # 0 = unlimited.
+    max_materialized_pods: int = 0
     # lifecycle span tracing (utils/obs.py SpanRing): record the full
     # queued/cycle/bind_wire/watch_confirm span tree for 1-in-N pods
     # (deterministic by pod key). 0 disables, 1 traces every pod; env
@@ -535,6 +572,14 @@ class SchedulerConfig:
                 defaults.preemption_budget_window_s)),
             starvation_after_s=float(args.get(
                 "starvationAfterSeconds", defaults.starvation_after_s)),
+            workload_admission=bool(args.get(
+                "workloadAdmission", defaults.workload_admission)),
+            admission_rate_per_s=float(args.get(
+                "admissionRatePerSecond", defaults.admission_rate_per_s)),
+            admission_burst=max(int(args.get(
+                "admissionBurst", defaults.admission_burst)), 1),
+            max_materialized_pods=max(int(args.get(
+                "maxMaterializedPods", defaults.max_materialized_pods)), 0),
             trace_sampling=max(int(args.get(
                 "traceSampling", defaults.trace_sampling)), 0),
             flight_dump_dir=str(args.get(

@@ -59,7 +59,7 @@ from .framework import (
 
 # sentinel distinguishing "no cached batch key yet" from a cached None
 _BKEY_MISS = object()
-from .queue import SchedulingQueue
+from .queue import DRFShardedQueue, SchedulingQueue
 from .plugins import (
     ChipAllocator,
     FragmentationScore,
@@ -356,14 +356,27 @@ class Scheduler:
         self.profile = profile
         self.clock = clock or Clock()
         self.metrics = Metrics()
-        self.queue = SchedulingQueue(
-            profile.queue_sort.less,
+        qkw = dict(
             initial_backoff_s=self.config.pod_initial_backoff_s,
             max_backoff_s=self.config.pod_max_backoff_s,
             key=getattr(profile.queue_sort, "key", None),
             metrics=self.metrics,
             hinted_backoff_s=self.config.pod_hinted_backoff_s,
         )
+        if getattr(profile.queue_sort, "sharded_drf", False) \
+                and getattr(profile, "policy", None) is not None:
+            # DRF fairness: per-tenant sharded priority bands with
+            # exact-at-pop shares off the live book (queue.py docstring)
+            # — the sort plugin supplies the band inputs, the queue does
+            # the tenant selection
+            from .plugins.sort import pod_priority
+
+            self.queue: SchedulingQueue = DRFShardedQueue(
+                profile.queue_sort.less, policy=profile.policy,
+                tenant_fn=tenant_of, priority_fn=pod_priority,
+                subkey_fn=profile.queue_sort.subkey, **qkw)
+        else:
+            self.queue = SchedulingQueue(profile.queue_sort.less, **qkw)
         # event-driven requeue: register every plugin's EnqueueExtensions
         # (queueing hints) with the queue's event index, plus the engine's
         # own hint for pods waiting on preemption victims to drain
@@ -493,6 +506,15 @@ class Scheduler:
         if self.policy is not None:
             self.policy.attach(self.cluster, self.metrics, self.flight,
                                self.clock)
+        # workload-tier admission (scheduler/workload.py): one decision
+        # per Workload against the DRF book / quotas / live capacity;
+        # pods materialize lazily on admission. None (the default knob)
+        # keeps the pod-at-a-time intake bit-identical.
+        self.workloads = None
+        if self.config.workload_admission:
+            from .workload import WorkloadAdmission
+
+            self.workloads = WorkloadAdmission(self)
         if self.elastic is not None:
             self.elastic.attach(self.metrics, self.clock)
         self.rng = random.Random(self.config.rng_seed)
@@ -651,6 +673,29 @@ class Scheduler:
             self.notify_event(ClusterEvent(GANG_MEMBER_ARRIVED, gang=gang))
         self.queue.add(pod, now=self.clock.time())
         self.metrics.inc("pods_submitted_total")
+        self.wake.set()
+        return True
+
+    def submit_workload(self, w) -> bool:
+        """Accept a Workload into the admission tier (workloadAdmission
+        knob on; scheduler/workload.py). Parked cost is O(1) — pods
+        exist only after the workload admits."""
+        if self.workloads is None \
+                or w.scheduler_name != self.config.scheduler_name:
+            return False
+        self.workloads.submit(w)
+        self.metrics.inc("workloads_inbox_total")
+        self.wake.set()
+        return True
+
+    def withdraw_workload(self, key: str,
+                          reason: str = "withdrawn") -> bool:
+        """Withdraw a workload by key (external CR deletion, operator
+        action): parked ones unpark, admitted ones retire their quota
+        claim and materialized members in one pass."""
+        if self.workloads is None:
+            return False
+        self.workloads.withdraw(key, reason)
         self.wake.set()
         return True
 
@@ -4015,6 +4060,14 @@ class Scheduler:
                 # the controller is best-effort: a planning crash must
                 # not take the scheduling loop down with it
                 self.metrics.inc("defrag_errors_total")
+        if self.workloads is not None:
+            # workload-tier admission pass (engine thread): at most
+            # admissionBurst O(1) decisions, contained like the defrag
+            # tick — an admission crash must not take the loop down
+            try:
+                self.workloads.tick(self.clock.time())
+            except Exception:
+                self.metrics.inc("workload_admission_errors_total")
         maxp = self.config.batch_max_pods
         if maxp > 1:
             if self.allocator is None or self.allocator.has_holds():
@@ -4101,6 +4154,12 @@ class Scheduler:
             # so a due next_at would otherwise spin the wait loop.
             if self.defrag.demanded():
                 wakes.append(max(self.defrag.next_at, self._breaker_until))
+        if self.workloads is not None:
+            nx = self.workloads.next_ready_at(self.clock.time())
+            if nx is not None:
+                # a due admission pass runs inside run_one, which parks
+                # at the breaker gate first — floor like the queue wake
+                wakes.append(max(nx, self._breaker_until))
         return min(wakes) if wakes else None
 
     def run_until_idle(self, max_cycles: int = 100_000) -> int:

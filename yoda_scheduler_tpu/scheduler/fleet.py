@@ -418,6 +418,18 @@ class FleetCoordinator:
         # during bursts). GIL-atomic add/discard; advisory like tracks()
         # itself — the serve loop's seen-uid map is the duplicate guard.
         self._inflight: set[str] = set()
+        # workload-tier admission (scheduler/workload.py): every replica
+        # parks the full workload set (O(1) each), but only the ADMISSION
+        # OWNER — the shard-0 lease holder, the defrag ownership
+        # discipline — materializes; this claim-once registry is the
+        # fleet-wide guard that a lease handover mid-admission can never
+        # double-materialize a workload, and the registry re-seeds a
+        # crashed replica's parked set
+        self._wl_lock = threading.Lock()
+        # (key, uid) -> None: an insertion-ordered dict doubling as a
+        # bounded FIFO set (see _claim_workload)
+        self._wl_claimed: dict[tuple, None] = {}
+        self._wl_registry: dict[str, object] = {}
         self.replicas: list[_Replica] = [
             self._build_replica(i) for i in range(self.n)]
         sub = getattr(cluster, "subscribe", None)
@@ -487,6 +499,25 @@ class FleetCoordinator:
                 engine.defrag.demand_check = (
                     lambda: any(len(r.engine.queue) or r.engine.waiting
                                 for r in self.replicas))
+        if engine.workloads is not None:
+            wa = engine.workloads
+            if self.sharded:
+                # admission follows the shard-0 lease (crash => the
+                # takeover replica inherits the tier with the shard)
+                wa.owner_check = (lambda r=rep: 0 in r.owned)
+            elif idx != 0:
+                # free-for-all ownership pinned to replica 0, like
+                # defrag — non-owners still PARK (so a future sharded
+                # handover needs no state transfer) but never admit
+                wa.owner_check = (lambda: False)
+            wa.admitted_check = self._claim_workload
+            wa.submit_pod = self.submit       # shard-aware gang routing
+            wa.forget_pod = self.forget       # withdraw dooms fleet-wide
+            wa.pending_fn = (
+                # backpressure reads FLEET-wide pending (advisory
+                # GIL-atomic cross-thread reads, like tracks())
+                lambda: sum(r.engine.queue.pending() + len(r.engine.waiting)
+                            for r in self.replicas))
         if self.sharded:
             if self._wire_leases:
                 from ..k8s.leaderelect import ShardLeaseManager
@@ -700,6 +731,89 @@ class FleetCoordinator:
             self.wake.set()
         return ok
 
+    # ------------------------------------------------------ workload tier
+    def _claim_workload(self, w) -> bool:
+        """Fleet-wide admission claim-once (WorkloadAdmission
+        admitted_check): True for exactly the FIRST replica that reaches
+        the admit step — a lease handover mid-admission finds the claim
+        taken and adopts instead of re-materializing. Claims are keyed
+        by (key, uid): a deleted-then-recreated workload (new uid) is a
+        new incarnation and may admit; the registry is FIFO-bounded so
+        a churning serve loop cannot grow it forever."""
+        token = (w.key, getattr(w, "uid", ""))
+        with self._wl_lock:
+            if token in self._wl_claimed:
+                return False
+            self._wl_claimed[token] = None
+            while len(self._wl_claimed) > 65536:
+                self._wl_claimed.pop(next(iter(self._wl_claimed)))
+            return True
+
+    def submit_workload(self, w) -> bool:
+        """Park a Workload on EVERY replica (each copy O(1)): whichever
+        replica holds the shard-0 lease admits; the others' copies make
+        lease handover state-transfer-free. Requires the
+        workloadAdmission knob (engines built without the tier refuse)."""
+        if w.scheduler_name != self.config.scheduler_name:
+            return False
+        if self.replicas[0].engine.workloads is None:
+            return False
+        from .workload import Workload
+
+        with self._wl_lock:
+            self._wl_registry[w.key] = w
+        ok = False
+        for rep in self.replicas:
+            # each replica gets its OWN object — conditions/state are
+            # engine-thread-mutable and must not race across replicas
+            clone = w if self.n == 1 else Workload.from_cr(w.to_cr())
+            if self.threaded:
+                rep.inbox.append(("submit_workload", clone))
+                rep.engine.wake.set()
+                ok = True
+            else:
+                ok = rep.engine.submit_workload(clone) or ok
+        if ok:
+            self.wake.set()
+        return ok
+
+    def withdraw_workload(self, key: str,
+                          reason: str = "withdrawn") -> bool:
+        """Withdraw fleet-wide: the claim registry blocks any future
+        admission, every replica unparks its copy, and the replica that
+        admitted dooms the materialized members (engine withdraw)."""
+        if self.replicas[0].engine.workloads is None:
+            return False
+        with self._wl_lock:
+            w = self._wl_registry.pop(key, None)
+            # block THIS incarnation from any future admission (a
+            # recreated CR arrives with a fresh uid and may admit)
+            self._wl_claimed[(key, getattr(w, "uid", "")
+                              if w is not None else "")] = None
+        for rep in self.replicas:
+            if self.threaded:
+                rep.inbox.append(("withdraw_workload", (key, reason)))
+                rep.engine.wake.set()
+            else:
+                rep.engine.withdraw_workload(key, reason)
+        self.wake.set()
+        return True
+
+    def workload_of(self, key: str):
+        """The most-advanced view of a workload across replicas (tests/
+        status readers): a resolved state wins over a parked copy."""
+        from .workload import PARKED
+
+        best = None
+        for rep in self.replicas:
+            wa = rep.engine.workloads
+            w = wa.get(key) if wa is not None else None
+            if w is None:
+                continue
+            if best is None or (w.state != PARKED and best.state == PARKED):
+                best = w
+        return best
+
     def submit_to(self, idx: int, pod: Pod) -> bool:
         """Chaos hook: queue a pod on a SPECIFIC replica — the split-brain
         injection queues the same pod on two replicas at once."""
@@ -828,6 +942,10 @@ class FleetCoordinator:
                 # it — drop the inflight marker (order matters: removing
                 # first would open a tracked-nowhere window)
                 self._inflight.discard(arg.key)
+            elif op == "submit_workload":
+                rep.engine.submit_workload(arg)
+            elif op == "withdraw_workload":
+                rep.engine.withdraw_workload(*arg)
             else:
                 rep.engine.forget(arg)
 
@@ -883,6 +1001,21 @@ class FleetCoordinator:
         if pods:
             rep.engine.reconcile(
                 [p for p in pods if not self.tracks(p.key)])
+        if rep.engine.workloads is not None and self._wl_registry:
+            # re-seed the fresh incarnation from the WHOLE registry —
+            # claimed entries included: their clones flow through the
+            # admitted_check adopt path (state becomes Admitted,
+            # "admitted by peer replica") so the rebuilt replica holds
+            # a resolved record again and a LATER withdraw can still
+            # run the one-pass member retirement; filtering claimed
+            # entries out left withdrawn-after-crash workloads with no
+            # engine able to doom their materialized members
+            from .workload import Workload
+
+            with self._wl_lock:
+                pending = list(self._wl_registry.values())
+            for w in pending:
+                rep.engine.submit_workload(Workload.from_cr(w.to_cr()))
         return rep
 
     def skew_replica_clock(self, idx: int, skew_s: float) -> None:

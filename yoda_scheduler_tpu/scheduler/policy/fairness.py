@@ -53,7 +53,7 @@ from ..framework import (
     Snapshot,
     Status,
 )
-from ..plugins.sort import PrioritySort, constraint_rank, pod_priority
+from ..plugins.sort import PrioritySort, constraint_rank
 from ...utils.labels import (
     GANG_NAME_LABEL, LabelError, spec_for, tenant_of)
 
@@ -93,6 +93,13 @@ class DRFBook:
         # change logs repair; totals are their fold
         self._node_usage: dict[str, dict[str, tuple[int, int]]] = {}
         self._usage: dict[str, list[int]] = {}  # leaf tenant -> [chips, hbm]
+        # all-tenant totals, maintained delta-wise alongside _usage —
+        # the workload-admission tier's live free-capacity read
+        self._total = [0, 0]
+        # share-movement listeners (queue.TenantShareBands.mark_dirty):
+        # called with each quota LEVEL whose usage moved, or None when
+        # capacity rescaled every share. Engine-thread like refresh().
+        self._share_listeners: list = []
         # hierarchical rollup: every quota LEVEL (the tenant and each
         # path ancestor) -> [chips, hbm], maintained delta-wise in
         # _apply_node so usage_of/dominant_share are O(1) dict reads —
@@ -141,12 +148,16 @@ class DRFBook:
         u[1] += dh
         if not u[0] and not u[1]:
             del self._usage[tenant]
+        self._total[0] += dc
+        self._total[1] += dh
         for level in _ancestors(tenant):
             lv = self._levels.setdefault(level, [0, 0])
             lv[0] += dc
             lv[1] += dh
             if not lv[0] and not lv[1]:
                 del self._levels[level]
+            for cb in self._share_listeners:
+                cb(level)
 
     def _apply_node(self, node: str, fresh: dict) -> None:
         old = self._node_usage.get(node, {})
@@ -168,8 +179,11 @@ class DRFBook:
         self._node_usage = {}
         self._usage = {}
         self._levels = {}
+        self._total = [0, 0]
         for node in self.cluster.node_names():
             self._apply_node(node, self._scan_node(node))
+        for cb in self._share_listeners:
+            cb(None)  # everything may have moved
         self.rebuilds += 1
 
     def refresh(self) -> None:
@@ -216,11 +230,32 @@ class DRFBook:
                     continue
                 chips += len(m.chips)
                 hbm += m.hbm_total_sum
+        changed = (chips, hbm) != self._capacity
         self._cap_key = key
         self._capacity = (chips, hbm)
+        if changed:
+            # every dominant share rescales with the denominators
+            for cb in self._share_listeners:
+                cb(None)
         return True
 
     # --------------------------------------------------------------- queries
+    def add_share_listener(self, cb) -> None:
+        """Register a share-movement callback (cb(level | None)): every
+        quota level whose usage moves is reported, None means capacity
+        rescaled all shares. The exact-at-pop DRF queue and the workload
+        admission tier keep their tenant-share heaps current off this."""
+        self._share_listeners.append(cb)
+
+    def total_usage(self) -> tuple[int, int]:
+        """(chips, hbm_mb) used across ALL tenants — with capacity(),
+        the live free-capacity read workload admission gates on."""
+        return (self._total[0], self._total[1])
+
+    @property
+    def capacity(self) -> tuple[int, int]:
+        return self._capacity
+
     def usage_of(self, tenant: str) -> tuple[int, int]:
         """(chips, hbm_mb) used by `tenant` and every descendant —
         O(1) off the hierarchical rollup _apply_node maintains."""
@@ -387,15 +422,24 @@ class TenantFairnessSort(PrioritySort):
     the LOWER dominant share schedules first — then the existing
     most-constrained/FIFO tie-breaks.
 
-    The share is sampled when the pod (re)enters the active queue (heap
-    keys are computed at entry, the queue's ordering contract); between
-    entries it can go stale, but every non-binding cycle re-enters the
-    pod through backoff and every bind moves the shares, so the order
-    converges like round-based DRF allocation does. The fuzz in
-    tests/test_fuzz_invariants.py pins the convergence + no-starvation
-    outcome, not per-pop optimality."""
+    The tenant-selection half no longer lives in this comparator: PR 9
+    sampled each pod's share AT QUEUE ENTRY (heap keys are computed at
+    entry — the queue's ordering contract) and the order went stale the
+    moment any bind moved the book, converging only round-by-round
+    through backoff re-entries. That stale path is DELETED: the plugin
+    now marks itself `sharded_drf`, and the engine builds a
+    DRFShardedQueue (queue.py) — per-tenant sharded priority bands
+    whose tenant pick reads the LIVE book at pop time through an
+    O(log tenants) share heap. This class contributes the band inputs:
+    the priority, the intra-tenant order (constraint rank, FIFO), and
+    the tenant-carrying equivalence key. less()/key() stay the
+    PrioritySort order so any comparator-mode fallback remains a strict
+    weak order (tests/test_policy.py pins the at-pop convergence a
+    sampled key provably fails)."""
 
     name = "tenant-fairness-sort"
+    # the engine builds the sharded exact-at-pop DRF queue for this sort
+    sharded_drf = True
 
     def __init__(self, policy: "PolicyEngine") -> None:
         self.policy = policy
@@ -406,27 +450,11 @@ class TenantFairnessSort(PrioritySort):
         would advance one tenant's pods on another's share."""
         return (tenant_of(pod),)
 
-    def _share(self, info: QueuedPodInfo) -> float:
-        book = self.policy.book
-        if book is None:
-            return 0.0
-        return book.dominant_share(tenant_of(info.pod))
-
-    def less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
-        pa, pb = pod_priority(a), pod_priority(b)
-        if pa != pb:
-            return pa > pb
-        sa, sb = self._share(a), self._share(b)
-        if sa != sb:
-            return sa < sb
-        ca, cb = constraint_rank(a), constraint_rank(b)
-        if ca != cb:
-            return ca > cb
-        return a.enqueued < b.enqueued
-
-    def key(self, info: QueuedPodInfo):
-        return (-pod_priority(info), self._share(info),
-                -constraint_rank(info), info.enqueued)
+    @staticmethod
+    def subkey(info: QueuedPodInfo):
+        """Intra-tenant order inside a priority band: most-constrained
+        first, then FIFO — the non-tenant half of PrioritySort.key."""
+        return (-constraint_rank(info), info.enqueued)
 
 
 class PreemptionBudgets:

@@ -183,16 +183,23 @@ class SchedulingQueue:
         stint = next(self._seq)  # tie-break AND this activation's epoch
         self._active_ids[id(info)] = stint
         self._n_active += 1
+        self._order_insert(info, stint)
+        if self._key is not None and self._bkey_fn is not None:
+            k = self._bkey_fn(info.pod)
+            if k is not None:
+                heapq.heappush(
+                    self._by_bkey.setdefault(k, []),
+                    (info.enqueued, stint, info))
+                self._bkey_live[k] = self._bkey_live.get(k, 0) + 1
+
+    # ---- ordering layer (overridden by DRFShardedQueue) ----
+    def _order_insert(self, info: QueuedPodInfo, stint: int) -> None:
+        """File an activated pod into the ordering structure. The base
+        queue keeps ONE comparator heap (or list, in comparator-scan
+        mode); DRFShardedQueue files into per-tenant priority bands."""
         if self._key is not None:
             heapq.heappush(self._active,
                            (self._key(info), stint, info))
-            if self._bkey_fn is not None:
-                k = self._bkey_fn(info.pod)
-                if k is not None:
-                    heapq.heappush(
-                        self._by_bkey.setdefault(k, []),
-                        (info.enqueued, stint, info))
-                    self._bkey_live[k] = self._bkey_live.get(k, 0) + 1
         else:
             self._active.append(info)
 
@@ -340,7 +347,12 @@ class SchedulingQueue:
         if self._inbox:
             self._drain_inbox(now)
         self._flush_backoff(now)
-        if not self._n_active or self._key is None:
+        if not self._n_active:
+            return None
+        return self._order_peek()
+
+    def _order_peek(self) -> QueuedPodInfo | None:
+        if self._key is None:
             return None
         while self._active:
             _, stint, info = self._active[0]
@@ -364,22 +376,29 @@ class SchedulingQueue:
             if self._active:
                 del self._active[:]  # no live entries: all stale
             return None
+        info = self._order_pop()
+        if info is None:
+            return None
+        self._consume_active(info, now)
+        return info
+
+    def _order_pop(self) -> QueuedPodInfo | None:
+        """Select (and structurally detach) the next live pod; the caller
+        consumes it. The sharded subclass detaches nothing — its stint
+        check retires entries lazily once _consume_active drops the id."""
         if self._key is not None:
             while self._active:
                 _, stint, info = heapq.heappop(self._active)
                 if self._active_ids.get(id(info)) != stint:
                     continue  # gathered/removed, or a PREVIOUS stint's
                     # entry for a since-requeued pod: stale either way
-                self._consume_active(info, now)
                 return info
             return None
         best_i = 0
         for i in range(1, len(self._active)):
             if self._less(self._active[i], self._active[best_i]):
                 best_i = i
-        info = self._active.pop(best_i)
-        self._consume_active(info, now)
-        return info
+        return self._active.pop(best_i)
 
     def _consume_active(self, info: QueuedPodInfo,
                         now: float | None = None) -> None:
@@ -502,12 +521,10 @@ class SchedulingQueue:
             # entries at pop time — rebuilding + re-heapifying the whole
             # active heap per removal was O(n log n) against churny
             # serve loops
-            for e in self._active:
-                info = e[2]
-                if info.pod.key == pod_key \
-                        and id(info) in self._active_ids:
-                    self._consume_active(info)
-                    removed.append(info)
+            for info in [i for i in self._active_infos()
+                         if i.pod.key == pod_key]:
+                self._consume_active(info)
+                removed.append(info)
         else:
             keep = []
             for q in self._active:
@@ -527,6 +544,11 @@ class SchedulingQueue:
     def contains(self, pod_key: str) -> bool:
         return pod_key in self._key_counts
 
+    def drf_stats(self) -> dict:
+        """Sharded-DRF introspection (bench/tests); the base queue has
+        no tenant shards."""
+        return {}
+
     def next_ready_at(self) -> float | None:
         """Earliest not_before among parked pods (None if active non-empty).
         O(1) amortised: stale heap heads are discarded as encountered.
@@ -543,3 +565,281 @@ class SchedulingQueue:
                 continue
             return nb
         return None
+
+
+class _Band:
+    """One priority band of a TenantShareBands: per-tenant entry heaps
+    plus the tenant-share heap exact-at-pop DRF selection reads."""
+
+    __slots__ = ("tenants", "share_heap", "entry_share", "live", "n_live")
+
+    def __init__(self) -> None:
+        self.tenants: dict[str, list] = {}   # tenant -> [(order, seq, item)]
+        self.share_heap: list = []           # (share, seq, tenant)
+        self.entry_share: dict[str, float] = {}  # tenant -> CURRENT entry
+        self.live: dict[str, int] = {}       # tenant -> live item count
+        self.n_live = 0
+
+
+class TenantShareBands:
+    """Per-tenant sharded priority bands with EXACT-at-pop DRF ordering.
+
+    Items file under (priority band, tenant); selection is: highest
+    priority band first, then — within the band — the tenant with the
+    LOWEST dominant share read from the LIVE DRF book at pop time (the
+    pick-the-poorest rule), then the caller's order key FIFO within the
+    tenant. This replaces PR 9's entry-time share sampling, where a heap
+    key froze the share a pod entered the queue with and ordering went
+    stale the moment any bind moved the book.
+
+    Exactness contract: `share_fn(tenant)` must be O(1) against current
+    truth (DRFBook.dominant_share is — one dict read over the
+    incrementally-maintained rollup), and the book must report share
+    MOVEMENT through `mark_dirty` (DRFBook.add_share_listener wires
+    this). Every live tenant then always has one heap entry carrying its
+    current share: a bind/unbind pushes a fresh entry (O(log T)),
+    superseded and dead entries retire lazily at selection time, and the
+    heap top after fix-ups is provably the true minimum — a tenant whose
+    share DROPPED can never hide behind a stale higher key, which is the
+    failure mode a pop-time-recompute-only scheme has. `mark_dirty(None)`
+    (capacity moved: every share rescales) rebuilds the per-band heaps
+    outright — rare, O(tenants) when it happens.
+
+    Liveness of individual items is the CALLER's: entries are
+    (order_key, seq, payload) and `next(live)` skips entries whose
+    `live(payload, seq)` is False — the same lazy-staleness pattern the
+    scheduling queue's heaps already use. `discard` reports that a live
+    item left (by any route) so tenant/band counts stay truthful.
+    """
+
+    def __init__(self, share_fn: Callable[[str], float]) -> None:
+        self._share = share_fn
+        self._seq = itertools.count()
+        self._bands: dict[int, _Band] = {}
+        self._band_heap: list = []  # heap of -priority
+        self._dirty: set[str] = set()
+        self._all_dirty = False
+        self.n = 0  # live items across all bands
+
+    def __len__(self) -> int:
+        return self.n
+
+    def mark_dirty(self, tenant: str | None) -> None:
+        """A tenant's share moved (or, with None, capacity rescaled every
+        share). Applied at the next selection."""
+        if tenant is None:
+            self._all_dirty = True
+        else:
+            self._dirty.add(tenant)
+
+    def insert(self, prio: int, tenant: str, order_key, seq: int,
+               payload) -> None:
+        band = self._bands.get(prio)
+        if band is None:
+            band = self._bands[prio] = _Band()
+            heapq.heappush(self._band_heap, -prio)
+        heapq.heappush(band.tenants.setdefault(tenant, []),
+                       (order_key, seq, payload))
+        n = band.live.get(tenant, 0)
+        band.live[tenant] = n + 1
+        band.n_live += 1
+        self.n += 1
+        if n == 0:
+            s = self._share(tenant)
+            heapq.heappush(band.share_heap, (s, next(self._seq), tenant))
+            band.entry_share[tenant] = s
+
+    def discard(self, prio: int, tenant: str) -> None:
+        """One live item of (prio, tenant) was consumed/removed by the
+        caller. Tenant heaps whose last live item leaves are dropped
+        whole — their stale entries die with them."""
+        band = self._bands.get(prio)
+        if band is None:
+            return
+        n = band.live.get(tenant, 0) - 1
+        if n <= 0:
+            band.live.pop(tenant, None)
+            band.tenants.pop(tenant, None)
+            band.entry_share.pop(tenant, None)
+        else:
+            band.live[tenant] = n
+        band.n_live -= 1
+        self.n -= 1
+
+    def _apply_dirty(self) -> None:
+        if self._all_dirty:
+            self._all_dirty = False
+            self._dirty.clear()
+            for band in self._bands.values():
+                band.share_heap = []
+                band.entry_share = {}
+                for t, n in band.live.items():
+                    if n > 0:
+                        s = self._share(t)
+                        heapq.heappush(band.share_heap,
+                                       (s, next(self._seq), t))
+                        band.entry_share[t] = s
+            return
+        if not self._dirty:
+            return
+        dirty, self._dirty = self._dirty, set()
+        for t in dirty:
+            s = self._share(t)
+            for band in self._bands.values():
+                if band.live.get(t) and band.entry_share.get(t) != s:
+                    heapq.heappush(band.share_heap,
+                                   (s, next(self._seq), t))
+                    band.entry_share[t] = s
+
+    def next(self, live: Callable) -> tuple | None:
+        """The (prio, tenant, order_key, seq, payload) selection under
+        the band/DRF/FIFO order, or None. Detaches NOTHING: the caller
+        consumes the payload its own way and reports via discard();
+        entries whose live() went False retire here lazily."""
+        self._apply_dirty()
+        while self._band_heap:
+            p = -self._band_heap[0]
+            band = self._bands.get(p)
+            if band is None or band.n_live <= 0:
+                heapq.heappop(self._band_heap)
+                self._bands.pop(p, None)
+                continue
+            got = self._next_in_band(p, band, live)
+            if got is not None:
+                return got
+            # every entry of the top band was stale-dead (live() False
+            # without a discard — callers shouldn't, but never loop)
+            return None
+        return None
+
+    def _next_in_band(self, prio: int, band: _Band, live) -> tuple | None:
+        while band.share_heap:
+            s, _, t = band.share_heap[0]
+            n = band.live.get(t, 0)
+            if n <= 0:
+                heapq.heappop(band.share_heap)
+                if band.entry_share.get(t) == s:
+                    band.entry_share.pop(t, None)
+                continue
+            if band.entry_share.get(t) != s:
+                heapq.heappop(band.share_heap)  # superseded entry
+                continue
+            cur = self._share(t)
+            if cur != s:
+                # moved since the entry was pushed (mark_dirty landed
+                # after the last _apply_dirty): fix up in place
+                heapq.heappop(band.share_heap)
+                heapq.heappush(band.share_heap,
+                               (cur, next(self._seq), t))
+                band.entry_share[t] = cur
+                continue
+            theap = band.tenants.get(t)
+            while theap:
+                order_key, seq, payload = theap[0]
+                if not live(payload, seq):
+                    heapq.heappop(theap)  # consumed elsewhere: stale
+                    continue
+                return (prio, t, order_key, seq, payload)
+            # live count said n > 0 but the heap is empty/stale-only —
+            # a caller consumed without discard(); repair the count
+            band.n_live -= band.live.pop(t, 0)
+            band.tenants.pop(t, None)
+            band.entry_share.pop(t, None)
+        return None
+
+    def live_tenants(self) -> dict[int, dict[str, int]]:
+        """prio -> {tenant: live count} (tests/stats)."""
+        return {p: {t: n for t, n in b.live.items() if n > 0}
+                for p, b in self._bands.items() if b.n_live > 0}
+
+
+class DRFShardedQueue(SchedulingQueue):
+    """SchedulingQueue whose ordering layer is per-tenant sharded
+    priority bands with exact-at-pop DRF (TenantShareBands docstring).
+
+    Built by the engine instead of the base queue when the policy
+    engine's DRF fairness layer is on (TenantFairnessSort marks itself
+    sharded_drf). Everything else — backoff parking, queueing hints,
+    the equivalence-class batch index, removal — is inherited: the band
+    structure only replaces the single comparator heap, and consumption
+    through ANY path (pop, batch gather, removal) flows through
+    _consume_active, which keeps the band counts truthful.
+
+    Shares come from the policy engine's DRF book, read at pop time.
+    The book is attached lazily (the engine wires policy surfaces after
+    queue construction); until then — and whenever no book exists, as in
+    bare-queue tests — every share reads 0.0 and ordering degrades to
+    per-band FIFO across tenants, exactly the no-data posture the
+    entry-time sampler had.
+    """
+
+    def __init__(self, less: LessFn, policy=None, tenant_fn=None,
+                 priority_fn=None, subkey_fn=None, **kw) -> None:
+        super().__init__(less, **kw)
+        self.policy = policy
+        self._tenant_fn = tenant_fn or (lambda pod: pod.namespace)
+        self._prio_fn = priority_fn or (lambda info: 0)
+        self._subkey_fn = subkey_fn or (lambda info: info.enqueued)
+        self._bands = TenantShareBands(self._live_share)
+        self._book_attached = False
+        self.drf_at_pop_reads = 0  # stats: live-share selections made
+
+    # ------------------------------------------------------------- shares
+    def _book(self):
+        return self.policy.book if self.policy is not None else None
+
+    def _live_share(self, tenant: str) -> float:
+        book = self._book()
+        return book.dominant_share(tenant) if book is not None else 0.0
+
+    def _sync_book(self) -> None:
+        """Bring the DRF book (and the band share entries) to current
+        cluster truth before a selection — the exact-at-pop read."""
+        book = self._book()
+        if book is None:
+            return
+        if not self._book_attached:
+            self._book_attached = True
+            book.add_share_listener(self._bands.mark_dirty)
+            self._bands.mark_dirty(None)  # seed every entry fresh
+        book.refresh()
+        self.drf_at_pop_reads += 1
+
+    # ------------------------------------------------------ ordering layer
+    def _order_insert(self, info: QueuedPodInfo, stint: int) -> None:
+        self._bands.insert(self._prio_fn(info),
+                           self._tenant_fn(info.pod),
+                           self._subkey_fn(info), stint, info)
+
+    def _entry_live(self, info, stint) -> bool:
+        return self._active_ids.get(id(info)) == stint
+
+    def _order_peek(self) -> QueuedPodInfo | None:
+        self._sync_book()
+        got = self._bands.next(self._entry_live)
+        return got[4] if got is not None else None
+
+    _order_pop = _order_peek  # consumption happens in _consume_active
+
+    def _consume_active(self, info: QueuedPodInfo,
+                        now: float | None = None) -> None:
+        if id(info) in self._active_ids:
+            # leaving the active set by ANY route (pop, batch gather,
+            # removal): keep the band's tenant counts truthful — the
+            # info's heap entry retires lazily via the stint check
+            self._bands.discard(self._prio_fn(info),
+                                self._tenant_fn(info.pod))
+        super()._consume_active(info, now)
+
+    def _active_infos(self):
+        seen = self._active_ids
+        for band in self._bands._bands.values():
+            for theap in band.tenants.values():
+                for _, stint, info in theap:
+                    if seen.get(id(info)) == stint:
+                        yield info
+
+    def drf_stats(self) -> dict:
+        return {"at_pop_reads": self.drf_at_pop_reads,
+                "bands": {p: dict(t) for p, t in
+                          self._bands.live_tenants().items()}}

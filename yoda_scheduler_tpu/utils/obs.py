@@ -258,6 +258,39 @@ class Metrics:
         "defrag_errors_total": "Defragmentation passes aborted by a "
                                "contained controller crash (the engine "
                                "thread survives; the pass is skipped).",
+        "workloads_parked": "Workloads parked in the admission tier "
+                            "(awaiting quota/capacity/backpressure) — "
+                            "each costs O(1) memory, never O(pods).",
+        "workloads_submitted_total": "Workloads accepted into the "
+                                     "admission tier.",
+        "workload_admissions_total": "Workloads admitted (pods "
+                                     "materialized), per tenant.",
+        "workload_rejections_total": "Workloads rejected or withdrawn, "
+                                     "labeled by reason.",
+        "workload_parked_total": "Workload park verdicts, labeled by "
+                                 "reason (OverQuota|NoCapacity).",
+        "workload_backpressure_total": "Admission passes held back, "
+                                       "labeled by reason (queue-depth|"
+                                       "rate-limit).",
+        "workload_materialized_pods_total": "Pods materialized into the "
+                                            "scheduling queue by "
+                                            "workload admissions.",
+        "workload_admission_decision_ms": "One workload admission "
+                                          "decision's latency, "
+                                          "milliseconds (flat with "
+                                          "backlog depth by design).",
+        "workload_park_wait_ms": "Time a workload sat parked before "
+                                 "admission, milliseconds.",
+        "workload_admission_errors_total": "Admission passes aborted by "
+                                           "a contained tier crash (the "
+                                           "engine thread survives).",
+        "workload_admission_skips_total": "Admission passes skipped, "
+                                          "labeled by reason "
+                                          "(not-owner).",
+        "workload_admission_dedup_total": "Admissions adopted because a "
+                                          "peer replica already "
+                                          "materialized the workload "
+                                          "(fleet handover races).",
         "gang_grow_total": "Elastic-gang members bound into a gang "
                            "running below its desired size (growth "
                            "binds).",
