@@ -910,6 +910,165 @@ def _admission_depth_leg(depth, pods_per=100, ticks=40):
     }
 
 
+# ------------------- closed-loop capacity: diurnal tier (ISSUE 15) --------
+def run_diurnal_tier(horizon_s: float = 600.0, dt: float = 2.0,
+                     period_s: float = 200.0) -> dict:
+    """Sinusoidal serve load + a steady harvest-class training backlog
+    over a provisioner-enabled 2-replica fleet on a virtual clock: the
+    pool must breathe with the day — scale up into the serve peak,
+    harvest the training pods back out of the valley capacity on the
+    way down, and release only empty, cooldown-expired nodes, without
+    ever oscillating inside one hysteresis window. CI fences read:
+    serving bind-latency p99, training goodput, released_nodes > 0,
+    non_empty_releases == 0, and oscillation_pairs == 0."""
+    from yoda_scheduler_tpu.chaos import SimulatedProvider
+    from yoda_scheduler_tpu.scheduler import FleetCoordinator
+    from yoda_scheduler_tpu.scheduler.capacity import (
+        FakeBackend, NodeTemplate)
+    from yoda_scheduler_tpu.scheduler.core import FakeClock
+
+    import math
+
+    HYST = 20.0
+    rng = random.Random(1234)
+    clock = FakeClock()
+    store = TelemetryStore()
+    for i in range(2):
+        m = make_tpu_node(f"base-{i}", chips=4)
+        m.heartbeat = 1e15
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=1e18,
+                        provisioner_interval_s=2.0,
+                        scale_down_cooldown_s=30.0,
+                        provisioner_hysteresis_s=HYST,
+                        provisioner_backoff_s=2.0,
+                        provisioner_backoff_max_s=16.0,
+                        provision_timeout_s=60.0),
+        replicas=2, clock=clock, mode="sharded", seed=0)
+    bad_releases: list = []
+    events: list = []
+
+    class _Audited(SimulatedProvider):
+        def request(self, pool, template, now=None):
+            req = super().request(pool, template, now)
+            events.append(("request", req.requested_at))
+            return req
+
+        def release(self, node, pool):
+            if cluster.pods_on(node):
+                bad_releases.append(node)
+            events.append(("release", self._now()))
+            return super().release(node, pool)
+
+    provider = _Audited(FakeBackend(cluster, orphan_router=fleet.submit),
+                        clock=clock, seed=7, latency_s=(1.0, 4.0))
+    # min 2: a guaranteed valley floor the training backlog soaks;
+    # max 12: the ceiling the serve peak pushes toward
+    fleet.set_capacity_provider(
+        provider,
+        pools=[NodeTemplate(pool="dp", chips=4, min_nodes=2,
+                            max_nodes=12)])
+    # steady training backlog: harvest-class soakers that bind whenever
+    # idle chips exist and yield for free when the fleet shrinks or
+    # serving needs the room
+    n_train = 16
+    training = [Pod(f"train-{i}", labels={
+        "scv/number": "1", "scv/harvest": "1",
+        "tpu/accelerator": "tpu"}) for i in range(n_train)]
+    for p in training:
+        fleet.submit(p)
+    serving: list = []       # live serving pods, oldest first
+    serve_seq = 0
+    submit_at: dict = {}     # key -> submit time (latency measurement)
+    latencies: list = []
+    samples: list = []       # (t, nodes, bound_serve, bound_train)
+
+    def serve_target(t: float) -> int:
+        # peak 24 chips > the 16-chip static+min floor: the crest can
+        # only be served by growing the pool (harvest absorbs the rest)
+        return max(int(round(12 + 12 * math.sin(
+            2 * math.pi * t / period_s - math.pi / 2))), 0)
+
+    def pump_until(deadline: float) -> None:
+        while True:
+            if fleet.step(rng) is not None:
+                for p in list(serving):
+                    if p.key in submit_at and p.phase == PodPhase.BOUND:
+                        latencies.append(
+                            clock.time() - submit_at.pop(p.key))
+                continue
+            wake = fleet.next_wake_at()
+            now = clock.time()
+            if wake is None or wake >= deadline:
+                if deadline > now:
+                    clock.advance(deadline - now)
+                return
+            clock.advance(max(wake - now, 0.05))
+
+    t = 0.0
+    while t < horizon_s:
+        want = serve_target(t)
+        while len(serving) < want:
+            serve_seq += 1
+            p = Pod(f"serve-{serve_seq}", labels={
+                "scv/number": "1", "scv/priority": "6",
+                "tpu/accelerator": "tpu"})
+            serving.append(p)
+            submit_at[p.key] = clock.time()
+            fleet.submit(p)
+        while len(serving) > want:
+            p = serving.pop(0)  # oldest request completes
+            submit_at.pop(p.key, None)
+            fleet.forget(p.key)
+            if p.phase == PodPhase.BOUND:
+                cluster.evict(p)
+        pump_until(t + dt)
+        t += dt
+        samples.append((
+            t, len(cluster.node_names()),
+            sum(1 for p in serving if p.phase == PodPhase.BOUND),
+            sum(1 for p in training if p.phase == PodPhase.BOUND)))
+    # oscillation audit: a request and a release of the same pool
+    # within one hysteresis window = a flap the controller must never
+    # produce (the bench fence pins this at zero)
+    osc = 0
+    seq = sorted(events, key=lambda e: e[1])
+    last: dict = {}
+    for kind, ts in seq:
+        other = "release" if kind == "request" else "request"
+        if other in last and ts - last[other] < HYST:
+            osc += 1
+        last[kind] = ts
+    lat = sorted(latencies)
+
+    def pct(q: float) -> float:
+        return lat[min(int(q * len(lat)), len(lat) - 1)] if lat else 0.0
+
+    node_counts = [s[1] for s in samples]
+    return {
+        "horizon_s": horizon_s,
+        "serve_binds": len(latencies),
+        "serve_bind_p50_s": round(pct(0.50), 3),
+        "serve_bind_p99_s": round(pct(0.99), 3),
+        "training_goodput": round(
+            sum(s[3] for s in samples) / (len(samples) * n_train), 3),
+        "nodes_min": min(node_counts),
+        "nodes_max": max(node_counts),
+        "released_nodes": len(provider.released),
+        "non_empty_releases": len(bad_releases),
+        "provisioned_nodes": len(provider.created),
+        "oscillation_pairs": osc,
+        "harvest_evictions": dict(
+            (dict(k).get("reason"), v) for k, v in
+            fleet.replicas[0].engine.metrics.labeled_counters.get(
+                "harvest_evictions_total", {}).items()),
+    }
+
+
 def run_admission_tier(n_workloads=10_000, pods_per=100) -> dict:
     """The million-pod backlog tier (ISSUE 13): 1M queued pods arrive as
     10k workloads. Measures (a) parked memory — O(1) per workload, the
@@ -1662,6 +1821,14 @@ def main():
             admission = run_admission_tier()
         except Exception as e:  # must never sink the run
             admission = {"error": repr(e)}
+    # closed-loop capacity (diurnal serve + harvest training over a
+    # provisioner-enabled fleet); opt out with YODA_BENCH_NO_CAPACITY=1
+    capacity = {}
+    if not os.environ.get("YODA_BENCH_NO_CAPACITY"):
+        try:
+            capacity = run_diurnal_tier()
+        except Exception as e:  # must never sink the run
+            capacity = {"error": repr(e)}
     if args.trace_out:
         # dedicated fully-sampled leg: every pod span-traced, exported as
         # one Chrome/Perfetto document — the visual answer to "where does
@@ -1683,6 +1850,7 @@ def main():
         "fairness": fairness,
         "elastic": elastic,
         "admission": admission,
+        "capacity": capacity,
     }
     # only a FULL, error-free run may overwrite the committed artifact: a
     # smoke run (YODA_BENCH_NO_SCALE/NO_SERVE, e.g. ci.yaml's
@@ -1693,7 +1861,8 @@ def main():
             and serve_fleet and "error" not in serve_fleet
             and fairness and "error" not in fairness
             and elastic and "error" not in elastic
-            and admission and "error" not in admission):
+            and admission and "error" not in admission
+            and capacity and "error" not in capacity):
         full_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
         try:

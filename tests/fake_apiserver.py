@@ -399,6 +399,35 @@ class _Handler(BaseHTTPRequestHandler):
             return self._lease_verb(method, base)
         if kind is not None and method == "POST" and kind == "pods":
             return self._json(201, s.add_pod(self._body()))
+        if kind is not None and method == "POST" and kind == "nodes":
+            # node create (capacity provisioner's wire path): the object
+            # enters the SAME watch stream every other node uses, so a
+            # scheduler reflector delivers it as an ordinary NODE_ADDED
+            body = self._body()
+            name = body.get("metadata", {}).get("name")
+            if not name:
+                return self._json(422, {"kind": "Status", "code": 422,
+                                        "message": "node needs a name"})
+            with s.cond:
+                if name in s.objects["nodes"]:
+                    return self._json(409, {
+                        "kind": "Status", "code": 409, "reason":
+                        "AlreadyExists",
+                        "message": f'nodes "{name}" already exists'})
+            return self._json(201, s.upsert("nodes", body))
+        if base.startswith("/api/v1/nodes/"):
+            name = base.split("/")[4]
+            if method == "GET":
+                with s.cond:
+                    obj = s.objects["nodes"].get(name)
+                if obj is None:
+                    return self._json(404, {"kind": "Status", "code": 404})
+                return self._json(200, obj)
+            if method == "DELETE":
+                gone = s.remove("nodes", name)
+                if gone is None:
+                    return self._json(404, {"kind": "Status", "code": 404})
+                return self._json(200, gone)
         self._json(404, {"kind": "Status", "code": 404})
 
     # ----------------------------------------------------------- list/watch

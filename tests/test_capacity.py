@@ -1,0 +1,1176 @@
+"""Closed-loop capacity: provisioner control loop + harvest class.
+
+Covers ISSUE 15's acceptance criteria:
+
+- off-parity: provisionerIntervalSeconds=0 (and knob-on with no pools/
+  provider attached) places bit-identically to the pre-capacity engine;
+- scale-up driven by the parked backlog's recorded shapes, bounded by
+  poolBounds, one wave per pool;
+- scale-down: drain-and-consolidate (harvest first, for free), release
+  only EMPTY cooldown-expired nodes through the two-phase cordon path,
+  hysteresis between directions, breaker/degraded interlocks pausing
+  scale-down while scale-up continues;
+- provider misbehaviour: stockout/quota backoff + per-pool breaker,
+  lost-response write-off + adoption (never leaked), flap re-provision;
+- harvest-class safety: evictions bypass preemption budgets, the PDB
+  ledger, and the victim tenant's preemption_victims_total — each
+  pinned against a control test proving the ordinary path DOES charge;
+- a 48-seed fleet fuzz (8-seed tier-1 smoke) over 2-3 replicas x the
+  PROVISIONER_KINDS mix asserting the four global invariants PLUS: no
+  node leaked, no non-empty release, no scale-up/down oscillation
+  within one hysteresis window, and post-fault convergence to a stable
+  fleet size.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from yoda_scheduler_tpu import chaos
+from yoda_scheduler_tpu.chaos import (
+    ChaosCluster,
+    FaultPlan,
+    FaultWindow,
+    LEASE_EXPIRY,
+    NETWORK_PARTITION,
+    PROVIDER_QUOTA_DENIED,
+    PROVIDER_STOCKOUT,
+    PROVISION_FLAP,
+    PROVISION_LOST_RESPONSE,
+    PROVISIONER_KINDS,
+    PartitionableView,
+    REPLICA_CRASH,
+    SimulatedProvider,
+)
+from yoda_scheduler_tpu.scheduler import (
+    FakeCluster, FleetCoordinator, Scheduler, SchedulerConfig)
+from yoda_scheduler_tpu.scheduler.capacity import (
+    FakeBackend, MANAGED_LABEL, NodeTemplate, POOL_LABEL)
+from yoda_scheduler_tpu.scheduler.core import FakeClock, default_profile
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+TICK = 0.05
+
+
+# ------------------------------------------------------------------ helpers
+def mk_capacity_sched(plan=None, seed=0, nodes=(), pools=None,
+                      start=0.0, latency_s=(0.2, 1.0), **cfg_kw):
+    store = TelemetryStore()
+    clock = FakeClock(start=start)
+    for m in nodes:
+        m.heartbeat = clock.time()
+        store.put(m)
+    cluster = (ChaosCluster(store, plan=plan, clock=clock)
+               if plan is not None else FakeCluster(store))
+    cluster.add_nodes_from_telemetry()
+    cfg_kw.setdefault("telemetry_max_age_s", 1e9)
+    cfg_kw.setdefault("provisioner_interval_s", 0.5)
+    cfg_kw.setdefault("scale_down_cooldown_s", 3.0)
+    cfg_kw.setdefault("provisioner_hysteresis_s", 2.0)
+    cfg_kw.setdefault("provisioner_backoff_s", 0.5)
+    cfg_kw.setdefault("provisioner_backoff_max_s", 4.0)
+    cfg_kw.setdefault("provision_timeout_s", 6.0)
+    sched = Scheduler(cluster, SchedulerConfig(**cfg_kw), clock=clock)
+    provider = SimulatedProvider(
+        FakeBackend(cluster, orphan_router=sched.submit),
+        clock=clock, plan=plan, seed=seed, latency_s=latency_s)
+    sched.provisioner.attach_provider(provider)
+    for t in (pools if pools is not None
+              else [NodeTemplate(pool="vp", chips=4, max_nodes=8)]):
+        sched.provisioner.add_pool(t)
+    return sched, clock, cluster, provider
+
+
+def drive(sched, clock, until, budget=200.0):
+    """Run one engine on its virtual clock until `until()` or budget."""
+    while clock.time() < budget:
+        if sched.run_one() is not None:
+            clock.advance(TICK)
+            continue
+        if until():
+            return True
+        wake = sched.next_wake_at()
+        if wake is None:
+            if until():
+                return True
+            clock.advance(0.5)
+        else:
+            clock.advance(max(wake - clock.time(), TICK))
+    return until()
+
+
+def labeled(metrics, family):
+    return {dict(k).get(next(iter(dict(k)))): v
+            for k, v in metrics.labeled_counters.get(family, {}).items()}
+
+
+def all_bound(pods):
+    return lambda: all(p.phase == PodPhase.BOUND for p in pods)
+
+
+def window(kind, start, end=None):
+    return FaultWindow(kind, start, start if end is None else end)
+
+
+def plan_of(*windows):
+    plan = FaultPlan.__new__(FaultPlan)
+    plan.seed = 0
+    plan.horizon_s = max(w.end for w in windows)
+    plan.windows = sorted(windows, key=lambda w: (w.start, w.kind))
+    return plan
+
+
+# ------------------------------------------------------------------- config
+class TestConfig:
+    def test_roundtrip_parses_capacity_block(self):
+        cfg = SchedulerConfig.from_profile({
+            "pluginConfig": [{"name": "yoda-tpu", "args": {
+                "provisionerIntervalSeconds": 15,
+                "poolBounds": {"v4-pool": {"min": 1, "max": 16}},
+                "scaleDownCooldownSeconds": 120,
+                "provisionerHysteresisSeconds": 45,
+                "provisionerBackoffSeconds": 2,
+                "provisionerBackoffMaxSeconds": 30,
+                "provisionTimeoutSeconds": 90,
+            }}]})
+        assert cfg.provisioner_interval_s == 15
+        assert cfg.pool_bounds == (("v4-pool", 1, 16),)
+        assert cfg.scale_down_cooldown_s == 120
+        assert cfg.provisioner_hysteresis_s == 45
+        assert cfg.provisioner_backoff_s == 2
+        assert cfg.provisioner_backoff_max_s == 30
+        assert cfg.provision_timeout_s == 90
+
+    def test_bad_pool_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            SchedulerConfig.from_profile({
+                "pluginConfig": [{"name": "yoda-tpu", "args": {
+                    "poolBounds": {"p": {"min": 5, "max": 2}}}}]})
+
+    def test_pool_bounds_override_template(self):
+        sched, *_ = mk_capacity_sched(
+            pool_bounds=(("vp", 2, 3),),
+            pools=[NodeTemplate(pool="vp", chips=4, max_nodes=99)])
+        pool = sched.provisioner.pools["vp"]
+        assert (pool.min, pool.max) == (2, 3)
+
+
+# ------------------------------------------------------------------- parity
+class TestOffParity:
+    def _trace(self, cfg, attach=False):
+        nodes = [make_tpu_node(f"t{i}", chips=4) for i in range(4)]
+        store = TelemetryStore()
+        clock = FakeClock(start=1000.0)
+        for m in nodes:
+            m.heartbeat = clock.time()
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster, cfg, clock=clock)
+        if attach:
+            provider = SimulatedProvider(FakeBackend(cluster), clock=clock)
+            sched.provisioner.attach_provider(provider)
+        rng = random.Random(7)
+        pods = []
+        for i in range(20):
+            if rng.random() < 0.7:
+                pods.append(Pod(f"p{i}", labels={
+                    "scv/number": str(rng.choice((1, 2))),
+                    "tpu/accelerator": "tpu"}))
+            else:
+                pods.append(Pod(f"p{i}", labels={
+                    "scv/memory": str(rng.choice((1000, 4000)))}))
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=2000)
+        return [(p.name, p.node, p.labels.get("tpu/assigned-chips"))
+                for p in pods]
+
+    def test_knob_off_and_on_without_pools_bit_identical(self):
+        """provisionerIntervalSeconds=0, the knob on with no pools, and
+        the from_profile round-trip all place bit-identically — the
+        acceptance criterion the CI capacity job's knob-off tier-1 leg
+        re-proves (no scv/harvest pods in the workload either way)."""
+        base = self._trace(SchedulerConfig(
+            telemetry_max_age_s=1e9, max_attempts=3))
+        knob_on = self._trace(SchedulerConfig(
+            telemetry_max_age_s=1e9, max_attempts=3,
+            provisioner_interval_s=5.0), attach=True)
+        roundtrip = self._trace(SchedulerConfig.from_profile({
+            "schedulerName": "yoda-scheduler",
+            "pluginConfig": [{"name": "yoda-tpu", "args": {
+                "telemetryMaxAgeSeconds": 1e9,
+                "provisionerIntervalSeconds": 0}}],
+        }).with_(max_attempts=3))
+        assert base == knob_on == roundtrip
+
+    def test_off_engine_carries_no_capacity_state(self):
+        profile, _, _ = default_profile(SchedulerConfig())
+        store = TelemetryStore()
+        cluster = FakeCluster(store)
+        sched = Scheduler(cluster, SchedulerConfig())
+        assert sched.provisioner is None
+
+
+# ----------------------------------------------------------------- scale-up
+class TestScaleUp:
+    def test_demand_provisions_and_pods_bind(self):
+        sched, clock, cluster, provider = mk_capacity_sched()
+        pods = [Pod(f"p{i}", labels={"scv/number": "2",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(6)]
+        for p in pods:
+            sched.submit(p)
+        assert drive(sched, clock, all_bound(pods))
+        assert len(provider.created) == 3  # 6 x 2 chips / 4-chip hosts
+        assert all(cluster.node_names())
+        outcomes = labeled(sched.metrics, "provision_requests_total")
+        assert outcomes.get("ready") == 3
+
+    def test_max_bound_caps_requests(self):
+        sched, clock, cluster, provider = mk_capacity_sched(
+            pools=[NodeTemplate(pool="vp", chips=4, max_nodes=2)])
+        pods = [Pod(f"p{i}", labels={"scv/number": "4",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(5)]
+        for p in pods:
+            sched.submit(p)
+        drive(sched, clock,
+              lambda: sum(p.phase == PodPhase.BOUND for p in pods) >= 2,
+              budget=60.0)
+        # let the leftover demand re-park and the next passes refuse it
+        t0 = clock.time()
+        while clock.time() < t0 + 15.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert len(provider.created) == 2  # never past max
+        skips = labeled(sched.metrics, "provisioner_skips_total")
+        assert skips.get("pool-at-max", 0) >= 1
+
+    def test_min_floor_maintained_without_demand(self):
+        sched, clock, cluster, provider = mk_capacity_sched(
+            pools=[NodeTemplate(pool="vp", chips=4, min_nodes=2,
+                                max_nodes=4)])
+        assert drive(sched, clock,
+                     lambda: len(cluster.node_names()) == 2, budget=30.0)
+        # stable: no further growth past min with zero demand
+        t0 = clock.time()
+        while clock.time() < t0 + 10.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert len(cluster.node_names()) == 2
+
+    def test_one_wave_at_a_time(self):
+        """No new requests while a wave is in flight: the backlog is not
+        re-counted into duplicate capacity during provider latency."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            latency_s=(5.0, 5.0))
+        pods = [Pod(f"p{i}", labels={"scv/number": "4",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(2)]
+        for p in pods:
+            sched.submit(p)
+        t0 = clock.time()
+        while clock.time() < t0 + 4.0:  # latency not yet elapsed
+            sched.run_one()
+            clock.advance(0.25)
+        pool = sched.provisioner.pools["vp"]
+        assert len(pool.in_flight) == 2  # one node per pending 4-chip pod
+        assert drive(sched, clock, all_bound(pods))
+        assert len(provider.created) == 2
+
+    def test_shape_routing_honours_generation(self):
+        sched, clock, cluster, provider = mk_capacity_sched(
+            pools=[NodeTemplate(pool="v4p", chips=4, generation="v4"),
+                   NodeTemplate(pool="v5p", chips=8, generation="v5e")])
+        pod = Pod("g", labels={"scv/number": "1", "tpu/generation": "v5e"})
+        sched.submit(pod)
+        assert drive(sched, clock, all_bound([pod]))
+        assert provider.created and provider.created[0].startswith("v5p-")
+        assert not [n for n in provider.created if n.startswith("v4p-")]
+
+    def test_slice_pool_provisions_whole_slice_for_parked_gang(self):
+        """Gang demand routes to a SLICE pool and one request delivers
+        every host — the parked members wake on the NODE_ADDED events
+        and the gang assembles on the fresh slice."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            pools=[NodeTemplate(pool="sl", chips=4, hosts=2,
+                                slice_topology="2x2x2", max_nodes=8)])
+        gang = [Pod(f"g-w{i}", labels={
+            "scv/number": "4", "tpu/gang-name": "g",
+            "tpu/gang-size": "2"}) for i in range(2)]
+        for p in gang:
+            sched.submit(p)
+        assert drive(sched, clock, all_bound(gang))
+        assert len(provider.created) == 2  # both hosts of ONE slice
+        assert {p.node for p in gang} == set(provider.created)
+        # one request unit for the whole gang, not one per member
+        outcomes = labeled(sched.metrics, "provision_requests_total")
+        assert outcomes.get("ready") == 1
+
+    def test_slice_pool_never_releases_partial_slice(self):
+        """A node-granular surplus must not split an empty slice: with
+        min bound 1 (nodes) over one 2-host slice, the surplus of 1
+        rounds DOWN to zero whole slices and nothing releases — the
+        degraded 1-host remnant could never host the gangs the pool
+        exists for."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            pools=[NodeTemplate(pool="sl", chips=4, hosts=2,
+                                slice_topology="2x2x2", min_nodes=1,
+                                max_nodes=8)],
+            scale_down_cooldown_s=0.5, provisioner_hysteresis_s=0.5)
+        gang = [Pod(f"g-w{i}", labels={
+            "scv/number": "4", "tpu/gang-name": "g",
+            "tpu/gang-size": "2"}) for i in range(2)]
+        for p in gang:
+            sched.submit(p)
+        assert drive(sched, clock, all_bound(gang))
+        for p in gang:
+            cluster.evict(p)
+            sched.forget(p.key)
+        t0 = clock.time()
+        while clock.time() < t0 + 15.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert not provider.released, \
+            "released part of a slice against a node-granular surplus"
+        assert len(cluster.node_names()) == 2
+
+    def test_bind_on_one_armed_slice_host_hands_whole_slice_back(self):
+        """A bind landing on ONE host of a cordoned, release-armed
+        slice hands the WHOLE slice back — releasing the other hosts
+        would leave a degraded remnant under the surviving pod."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            pools=[NodeTemplate(pool="sl", chips=4, hosts=2,
+                                slice_topology="2x2x2", max_nodes=8)],
+            scale_down_cooldown_s=0.5, provisioner_hysteresis_s=0.5)
+        gang = [Pod(f"g-w{i}", labels={
+            "scv/number": "4", "tpu/gang-name": "g",
+            "tpu/gang-size": "2"}) for i in range(2)]
+        for p in gang:
+            sched.submit(p)
+        assert drive(sched, clock, all_bound(gang))
+        hosts = sorted(cluster.node_names())
+        for p in gang:
+            cluster.evict(p)
+            sched.forget(p.key)
+        pool = sched.provisioner.pools["sl"]
+        drive(sched, clock, lambda: len(pool.pending_release) == 2,
+              budget=clock.time() + 30.0)
+        assert len(pool.pending_release) == 2
+        # a fleet peer's optimistic bind lands on one armed host
+        late = Pod("late", labels={"scv/number": "1",
+                                   "tpu/accelerator": "tpu"})
+        cluster.bind(late, hosts[0], [(0, 0, 0)])
+        t0 = clock.time()
+        while clock.time() < t0 + 5.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert not provider.released, \
+            "released hosts of a slice whose peer took a bind"
+        assert set(hosts) <= set(cluster.node_names())
+        assert not pool.pending_release
+
+    def test_no_provider_no_ops(self):
+        store = TelemetryStore()
+        clock = FakeClock()
+        cluster = FakeCluster(store)
+        sched = Scheduler(cluster, SchedulerConfig(
+            telemetry_max_age_s=1e9, provisioner_interval_s=0.5,
+            max_attempts=2), clock=clock)
+        pod = Pod("p", labels={"scv/number": "1"})
+        sched.submit(pod)
+        drive(sched, clock, lambda: pod.phase == PodPhase.FAILED,
+              budget=30.0)
+        assert pod.phase == PodPhase.FAILED  # no capacity ever appears
+        assert sched.provisioner.busy() is False
+
+
+# --------------------------------------------------------------- scale-down
+class TestScaleDown:
+    def _loaded(self, **kw):
+        sched, clock, cluster, provider = mk_capacity_sched(**kw)
+        pods = [Pod(f"p{i}", labels={"scv/number": "2",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(6)]
+        for p in pods:
+            sched.submit(p)
+        assert drive(sched, clock, all_bound(pods))
+        assert len(provider.created) == 3
+        return sched, clock, cluster, provider, pods
+
+    def test_consolidates_and_releases_only_empty(self):
+        sched, clock, cluster, provider, pods = self._loaded()
+        for p in pods[:4]:
+            cluster.evict(p)
+            sched.forget(p.key)
+        released_nonempty = []
+        orig_release = provider.release
+
+        def audited(node, pool):
+            if cluster.pods_on(node):
+                released_nonempty.append(node)
+            return orig_release(node, pool)
+
+        provider.release = audited
+        drive(sched, clock, lambda: len(provider.released) >= 2,
+              budget=120.0)
+        assert len(provider.released) >= 2
+        assert not released_nonempty, "released a NON-EMPTY node"
+        assert sched.metrics.counters.get(
+            "provisioner_drain_evictions_total", 0) >= 1
+        # surviving pods still bound, exactly once
+        assert all(p.phase == PodPhase.BOUND for p in pods[4:])
+        # scale-down trips are RING-only: recorded, never auto-dumped
+        kinds = [e["kind"] for e in sched.flight.snapshot()]
+        assert "pool_scaledown" in kinds
+        assert not sched.flight.dumps
+
+    def test_bind_during_cordon_keeps_node(self):
+        sched, clock, cluster, provider, pods = self._loaded()
+        for p in pods:
+            cluster.evict(p)
+            sched.forget(p.key)
+        # wait until at least one node is cordoned pending release
+        prov = sched.provisioner
+        pool = prov.pools["vp"]
+        drive(sched, clock, lambda: bool(pool.pending_release),
+              budget=60.0)
+        target = next(iter(pool.pending_release))
+        # a pod lands on the cordoned node before the release pass
+        # (models a fleet peer's in-flight optimistic bind)
+        late = Pod("late", labels={"scv/number": "1",
+                                   "tpu/accelerator": "tpu"})
+        cluster.bind(late, target, [(0, 0, 0)])
+        t0 = clock.time()
+        while clock.time() < t0 + 5.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert target in cluster.node_names(), \
+            "released a node that took a bind mid-cordon"
+        assert target not in provider.released
+
+    def test_hysteresis_blocks_release_after_scale_up(self):
+        sched, clock, cluster, provider, pods = self._loaded(
+            provisioner_hysteresis_s=50.0, scale_down_cooldown_s=0.5)
+        t_up = clock.time()
+        for p in pods:
+            cluster.evict(p)
+            sched.forget(p.key)
+        t0 = clock.time()
+        while clock.time() < t0 + 10.0:
+            sched.run_one()
+            clock.advance(0.5)
+        assert not provider.released, \
+            "released within the hysteresis window of a scale-up"
+        drive(sched, clock, lambda: len(provider.released) >= 3,
+              budget=t_up + 120.0)
+        assert len(provider.released) == 3  # released after the window
+
+    def test_breaker_pauses_scale_down_not_scale_up(self):
+        sched, clock, cluster, provider, pods = self._loaded(
+            scale_down_cooldown_s=0.5, provisioner_hysteresis_s=0.5)
+        for p in pods:
+            cluster.evict(p)
+            sched.forget(p.key)
+        sched._breaker_until = clock.time() + 30.0  # circuit open
+        t0 = clock.time()
+        while clock.time() < t0 + 10.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert not provider.released
+        skips = labeled(sched.metrics, "provisioner_skips_total")
+        assert skips.get("breaker-open", 0) >= 1
+
+    def test_scale_up_wave_completes_through_open_breaker(self):
+        """Scale-up continues degraded: a wave issued for recorded
+        demand polls, completes, and delivers its nodes WHILE the
+        apiserver circuit is open (the capacity tick runs before the
+        breaker gate in run_one)."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            latency_s=(2.0, 2.0))
+        pod = Pod("p", labels={"scv/number": "4", "tpu/accelerator": "tpu"})
+        sched.submit(pod)
+        pool = sched.provisioner.pools["vp"]
+        drive(sched, clock, lambda: bool(pool.in_flight), budget=30.0)
+        assert pool.in_flight and not provider.created
+        # storm: circuit opens before the provider answers
+        sched._breaker_until = clock.time() + 30.0
+        t0 = clock.time()
+        while clock.time() < t0 + 5.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert sched._breaker_until > clock.time()  # still open
+        assert provider.created, \
+            "scale-up stalled behind the apiserver breaker"
+        assert not pool.in_flight  # the result was polled degraded
+
+    def test_degraded_mode_pauses_scale_down(self):
+        sched, clock, cluster, provider, pods = self._loaded(
+            telemetry_max_age_s=5.0, scale_down_cooldown_s=0.5,
+            provisioner_hysteresis_s=0.5)
+        for p in pods:
+            cluster.evict(p)
+            sched.forget(p.key)
+        chaos.blackout(cluster.telemetry, clock.time(), 5.0)
+        t0 = clock.time()
+        while clock.time() < t0 + 6.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert not provider.released
+        skips = labeled(sched.metrics, "provisioner_skips_total")
+        assert skips.get("degraded", 0) >= 1
+        # feed revives -> scale-down resumes
+        chaos.revive(cluster.telemetry, clock.time())
+        drive(sched, clock, lambda: len(provider.released) >= 1,
+              budget=clock.time() + 60.0)
+        assert provider.released
+
+
+# ----------------------------------------------------------- provider chaos
+class TestProviderFaults:
+    def test_stockout_backs_off_and_opens_breaker(self):
+        plan = plan_of(window(PROVIDER_STOCKOUT, 0.0, 60.0))
+        sched, clock, cluster, provider = mk_capacity_sched(
+            plan=plan, latency_s=(0.1, 0.2))
+        pod = Pod("p", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(pod)
+        pool = sched.provisioner.pools["vp"]
+        drive(sched, clock, lambda: pool.breaker_until > clock.time(),
+              budget=59.0)
+        assert pool.breaker_until > clock.time(), "breaker never opened"
+        opens = labeled(sched.metrics, "provisioner_breaker_opens_total")
+        assert opens.get("vp", 0) >= 1
+        kinds = [e["kind"] for e in sched.flight.snapshot()]
+        assert "provisioner_breaker_open" in kinds
+        outcomes = labeled(sched.metrics, "provision_requests_total")
+        assert outcomes.get("stockout", 0) >= 3
+        # backoff grew between attempts (exponential with jitter)
+        assert pool.backoff_s > sched.provisioner.backoff_s / 2
+        # window closes -> the pool recovers and the pod binds
+        assert drive(sched, clock, all_bound([pod]), budget=200.0)
+
+    def test_quota_denied_counts_distinctly(self):
+        plan = plan_of(window(PROVIDER_QUOTA_DENIED, 0.0, 5.0))
+        sched, clock, cluster, provider = mk_capacity_sched(
+            plan=plan, latency_s=(0.1, 0.2))
+        pod = Pod("p", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(pod)
+        assert drive(sched, clock, all_bound([pod]), budget=100.0)
+        outcomes = labeled(sched.metrics, "provision_requests_total")
+        assert outcomes.get("quota-denied", 0) >= 1
+        assert outcomes.get("ready") == 1
+
+    def test_lost_response_written_off_then_adopted(self):
+        plan = plan_of(window(PROVISION_LOST_RESPONSE, 0.0, 1.0))
+        sched, clock, cluster, provider = mk_capacity_sched(
+            plan=plan, latency_s=(0.3, 0.4), provision_timeout_s=6.0)
+        pod = Pod("p", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(pod)
+        assert drive(sched, clock, all_bound([pod]), budget=100.0)
+        assert provider.lost_nodes, "fault never fired"
+        # the node was adopted (membership reconciliation), never leaked
+        assert sched.metrics.counters.get(
+            "provisioner_nodes_adopted_total", 0) >= 1
+        lost = provider.lost_nodes[0]
+        assert lost in cluster.node_names()
+        assert lost in sched.provisioner._known
+
+    def test_write_off_charges_backoff_when_node_never_comes(self):
+        """A lost response whose node ALSO never materialises (request
+        vanished provider-side) is written off and backs the pool off."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            latency_s=(0.1, 0.2), provision_timeout_s=2.0)
+        pod = Pod("p", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(pod)
+
+        # a provider that swallows the first request whole
+        orig_poll = provider.poll
+        swallowed = []
+
+        def leaky_poll(now=None):
+            results = orig_poll(now)
+            if not swallowed and results:
+                swallowed.append(results[0])
+                node = results[0].node
+                if node is not None:
+                    provider.backend.destroy(node)
+                    provider.created.remove(node)
+                return results[1:]
+            return results
+
+        provider.poll = leaky_poll
+        assert drive(sched, clock, all_bound([pod]), budget=100.0)
+        outcomes = labeled(sched.metrics, "provision_requests_total")
+        assert outcomes.get("written-off", 0) >= 1
+
+    def test_flap_reprovisions_without_oscillation(self):
+        plan = plan_of(window(PROVISION_FLAP, 0.0, 1.0))
+        sched, clock, cluster, provider = mk_capacity_sched(
+            plan=plan, latency_s=(0.2, 0.3))
+        pod = Pod("p", labels={"scv/number": "1", "tpu/accelerator": "tpu"})
+        sched.submit(pod)
+        assert drive(sched, clock,
+                     lambda: all_bound([pod])() and not provider._flaps,
+                     budget=100.0)
+        assert provider.flapped, "fault never fired"
+        # the flapped node was replaced; our own loop never released
+        assert not provider.released
+        assert pod.node in cluster.node_names()
+
+
+# ------------------------------------------------------------ harvest class
+class TestHarvestSafety:
+    def _one_node(self, **cfg_kw):
+        nodes = [make_tpu_node("t0", chips=4)]
+        store = TelemetryStore()
+        clock = FakeClock(start=1000.0)
+        for m in nodes:
+            m.heartbeat = clock.time()
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        cfg_kw.setdefault("telemetry_max_age_s", 1e9)
+        sched = Scheduler(cluster, SchedulerConfig(**cfg_kw), clock=clock)
+        return sched, clock, cluster
+
+    _TENANTS = (("acme", 0.0, 0),)  # preemptionBudget 0: no victims EVER
+
+    def test_harvest_eviction_bypasses_preemption_budget(self):
+        """An acme tenant with preemption budget 0 can never lose an
+        ordinary pod — but its HARVEST pods are evicted for free, and
+        the eviction counts harvest_evictions_total, not the tenant's
+        preemption_victims_total."""
+        sched, clock, cluster = self._one_node(
+            drf_fairness=True, tenant_quotas=self._TENANTS)
+        filler = [Pod(f"h{i}", labels={
+            "scv/number": "2", "scv/harvest": "1", "scv/tenant": "acme",
+            "tpu/accelerator": "tpu"}) for i in range(2)]
+        for p in filler:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=200)
+        assert all(p.phase == PodPhase.BOUND for p in filler)
+        vip = Pod("vip", labels={"scv/number": "4", "scv/priority": "9",
+                                 "tpu/accelerator": "tpu"})
+        sched.submit(vip)
+        assert drive(sched, clock, all_bound([vip]), budget=2000.0)
+        assert labeled(sched.metrics, "harvest_evictions_total") \
+            .get("preemption", 0) == 2
+        # the harvested tenant lost NOTHING it was protected for
+        assert "preemption_victims_total" not in \
+            sched.metrics.labeled_counters
+        assert "preemptions_budget_denied_total" not in \
+            sched.metrics.labeled_counters
+
+    def test_control_ordinary_victim_is_budget_blocked(self):
+        """The control for the test above — identical scenario minus
+        scv/harvest: budget 0 means the plan is refused and the vip pod
+        stays pending. Proves the harvest assertions would fail if
+        harvest evictions routed through the ordinary victim path."""
+        sched, clock, cluster = self._one_node(
+            drf_fairness=True, tenant_quotas=self._TENANTS,
+            max_attempts=3)
+        filler = [Pod(f"o{i}", labels={
+            "scv/number": "2", "scv/tenant": "acme",
+            "tpu/accelerator": "tpu"}) for i in range(2)]
+        for p in filler:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=200)
+        vip = Pod("vip", labels={"scv/number": "4", "scv/priority": "9",
+                                 "tpu/accelerator": "tpu"})
+        sched.submit(vip)
+        drive(sched, clock, lambda: vip.phase == PodPhase.FAILED,
+              budget=2000.0)
+        assert vip.phase != PodPhase.BOUND
+        assert all(p.phase == PodPhase.BOUND for p in filler)
+        assert "harvest_evictions_total" not in \
+            sched.metrics.labeled_counters
+
+    def test_harvest_eviction_never_touches_pdb_ledger(self):
+        """A PDB covering harvest pods records no violation when they
+        are harvested (the planner excludes them from the ledger)."""
+        from yoda_scheduler_tpu.utils.pdb import DisruptionBudget
+
+        sched, clock, cluster = self._one_node()
+        cluster.set_pdbs([DisruptionBudget(
+            name="b", match_labels=frozenset({("app", "soak")}.union(())),
+            min_available=2)])
+        filler = [Pod(f"h{i}", labels={
+            "scv/number": "2", "scv/harvest": "1", "app": "soak",
+            "tpu/accelerator": "tpu"}) for i in range(2)]
+        for p in filler:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=200)
+        vip = Pod("vip", labels={"scv/number": "4", "scv/priority": "9",
+                                 "tpu/accelerator": "tpu"})
+        sched.submit(vip)
+        assert drive(sched, clock, all_bound([vip]), budget=2000.0)
+        assert sched.metrics.counters.get(
+            "preempt_pdb_violations_total", 0) == 0
+
+    def test_control_ordinary_victim_counts_pdb_violation(self):
+        from yoda_scheduler_tpu.utils.pdb import DisruptionBudget
+
+        sched, clock, cluster = self._one_node()
+        cluster.set_pdbs([DisruptionBudget(
+            name="b", match_labels=frozenset({("app", "soak")}),
+            min_available=2)])
+        filler = [Pod(f"o{i}", labels={
+            "scv/number": "2", "app": "soak",
+            "tpu/accelerator": "tpu"}) for i in range(2)]
+        for p in filler:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=200)
+        vip = Pod("vip", labels={"scv/number": "4", "scv/priority": "9",
+                                 "tpu/accelerator": "tpu"})
+        sched.submit(vip)
+        assert drive(sched, clock, all_bound([vip]), budget=2000.0)
+        assert sched.metrics.counters.get(
+            "preempt_pdb_violations_total", 0) >= 1
+
+    def test_harvest_only_plan_beats_tenant_eviction(self):
+        """Plan cost never counts harvest victims: a node clearable by
+        harvesting two pods beats a node that would evict one ordinary
+        tenant pod (found in review: len(full) let the tenant plan win
+        on victim count)."""
+        nodes = [make_tpu_node("a", chips=4), make_tpu_node("b", chips=4)]
+        store = TelemetryStore()
+        clock = FakeClock(start=1000.0)
+        for m in nodes:
+            m.heartbeat = clock.time()
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster, SchedulerConfig(
+            telemetry_max_age_s=1e9), clock=clock)
+        for i in range(2):
+            h = Pod(f"h{i}", labels={"scv/number": "2",
+                                     "scv/harvest": "1",
+                                     "tpu/accelerator": "tpu"})
+            cluster.bind(h, "a", [(i % 2, i // 2, 0), (1 - i % 2, 1, 0)])
+        t = Pod("tenant", labels={"scv/number": "4",
+                                  "tpu/accelerator": "tpu"})
+        cluster.bind(t, "b", [(0, 0, 0), (1, 0, 0), (0, 1, 0),
+                              (1, 1, 0)])
+        vip = Pod("vip", labels={"scv/number": "4", "scv/priority": "9",
+                                 "tpu/accelerator": "tpu"})
+        sched.submit(vip)
+        assert drive(sched, clock, all_bound([vip]), budget=2000.0)
+        assert vip.node == "a", "plan evicted a tenant beside free harvest"
+        assert t.phase == PodPhase.BOUND
+        assert labeled(sched.metrics, "harvest_evictions_total") \
+            .get("preemption", 0) == 2
+
+    def test_harvest_pod_never_preempts(self):
+        """Harvest pods soak idle capacity only: a pending harvest pod
+        plans no evictions, even against lower-priority (or fellow
+        harvest) residents — otherwise two harvest pods sharing one
+        slot would evict each other forever."""
+        sched, clock, cluster = self._one_node()
+        resident = Pod("r", labels={"scv/number": "4",
+                                    "tpu/accelerator": "tpu"})
+        sched.submit(resident)
+        sched.run_until_idle(max_cycles=100)
+        assert resident.phase == PodPhase.BOUND
+        hungry = Pod("h", labels={"scv/number": "4", "scv/harvest": "1",
+                                  "scv/priority": "9"})
+        sched.submit(hungry)
+        t0 = clock.time()
+        while clock.time() < t0 + 20.0:
+            sched.run_one()
+            clock.advance(0.5)
+        assert hungry.phase == PodPhase.PENDING
+        assert resident.phase == PodPhase.BOUND
+        assert sched.metrics.counters.get("pods_evicted_total", 0) == 0
+
+    def test_harvest_lifecycle_soak_then_shock_absorber(self):
+        """The whole harvest contract in one pass: the fleet never
+        GROWS for harvest (they park), harvest soaks idle chips the
+        moment ordinary load departs, and when the pool shrinks the
+        harvest pods are the first evicted — for free, back to parked,
+        never lost."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            scale_down_cooldown_s=0.5, provisioner_hysteresis_s=0.5)
+        pods = [Pod(f"p{i}", labels={"scv/number": "2",
+                                     "tpu/accelerator": "tpu"})
+                for i in range(4)]
+        for p in pods:
+            sched.submit(p)
+        assert drive(sched, clock, all_bound(pods))
+        assert len(provider.created) == 2
+        # harvest arrives into a FULL fleet: parks, and the fleet does
+        # not grow for it
+        harvest = [Pod(f"h{i}", labels={
+            "scv/number": "2", "scv/harvest": "1",
+            "tpu/accelerator": "tpu"}) for i in range(2)]
+        for p in harvest:
+            sched.submit(p)
+        t0 = clock.time()
+        while clock.time() < t0 + 8.0:
+            sched.run_one()
+            clock.advance(0.25)
+        assert len(provider.created) == 2, "fleet grew for harvest"
+        assert all(p.phase == PodPhase.PENDING for p in harvest)
+        # ordinary load departs: harvest soaks the idle chips
+        for p in pods:
+            cluster.evict(p)
+            sched.forget(p.key)
+        assert drive(sched, clock, all_bound(harvest), budget=400.0)
+        # with only harvest resident, scale-down drains them for free
+        # and releases the emptied nodes
+        drive(sched, clock, lambda: len(provider.released) >= 2,
+              budget=500.0)
+        assert len(provider.released) == 2
+        assert labeled(sched.metrics, "harvest_evictions_total") \
+            .get("scale-down", 0) >= 2
+        # evicted harvest pods are parked again, tracked, never lost
+        assert all(p.phase == PodPhase.PENDING for p in harvest)
+        assert all(sched.tracks(p.key) for p in harvest)
+        assert sched.metrics.counters.get(
+            "provisioner_drain_evictions_total", 0) == 0
+
+
+# ---------------------------------------------------------------- wire path
+class TestWirePath:
+    def test_node_post_delete_roundtrip(self):
+        from fake_apiserver import FakeApiServer
+        from yoda_scheduler_tpu.k8s.client import ApiError, KubeClient
+
+        with FakeApiServer() as server:
+            client = KubeClient(server.url)
+            client.create_node("cap-1", labels={POOL_LABEL: "cap",
+                                                MANAGED_LABEL: "1"})
+            assert "cap-1" in client.list_nodes()
+            with pytest.raises(ApiError) as e:
+                client.create_node("cap-1")
+            assert e.value.status == 409
+            client.delete_node("cap-1")
+            assert "cap-1" not in client.list_nodes()
+            client.delete_node("cap-1")  # idempotent: 404 tolerated
+
+    def test_provisioned_node_wakes_parked_gang_member_end_to_end(self):
+        """The wire-path satellite: a WireBackend-provisioned node
+        enters through the ORDINARY reflector intake (node watch ->
+        NODE_ADDED -> queue hint), waking a gang parked for capacity —
+        over real localhost HTTP, zero injected transports."""
+        from fake_apiserver import FakeApiServer
+        from yoda_scheduler_tpu.k8s.client import (
+            KubeClient, run_scheduler_against_cluster)
+        from yoda_scheduler_tpu.scheduler.capacity import WireBackend
+
+        def wait_for(cond, timeout=15.0, step=0.02):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if cond():
+                    return True
+                time.sleep(step)
+            return False
+
+        with FakeApiServer() as server:
+            server.state.add_node("n1")
+            server.state.put_metrics(make_tpu_node("n1", chips=4).to_cr())
+            for i in range(2):
+                server.state.add_pod({
+                    "metadata": {"name": f"g-w{i}", "namespace": "default",
+                                 "labels": {"scv/number": "4",
+                                            "tpu/gang-name": "g",
+                                            "tpu/gang-size": "2"},
+                                 "ownerReferences": [{
+                                     "kind": "Job", "name": "g",
+                                     "controller": True}]},
+                    "spec": {"schedulerName": "yoda-scheduler"},
+                    "status": {"phase": "Pending"},
+                })
+            client = KubeClient(server.url)
+            stop = threading.Event()
+            t = threading.Thread(
+                target=run_scheduler_against_cluster,
+                args=(client, [(SchedulerConfig(gang_timeout_s=30.0),
+                                None)]),
+                kwargs={"metrics_port": None, "leader_elect": False,
+                        "poll_s": 0.05, "stop_event": stop},
+                daemon=True)
+            t.start()
+            try:
+                # gangs pin to multi-host slices; the lone standalone
+                # node can never host them — both members park
+                time.sleep(0.6)
+                bound = lambda n: (server.state.pod(n) or {}).get(
+                    "spec", {}).get("nodeName")
+                assert not bound("g-w0") and not bound("g-w1")
+                # the provider delivers a whole slice over the wire;
+                # the scheduler's reflector must bring its hosts back
+                # as ordinary NODE_ADDED events and complete the gang
+                backend = WireBackend(KubeClient(server.url))
+                names = backend.create(
+                    "cap-1",
+                    NodeTemplate(pool="cap", chips=4, hosts=2,
+                                 slice_topology="2x2x2"),
+                    time.time())
+                assert len(names) == 2
+                assert wait_for(lambda: bound("g-w0") and bound("g-w1")), \
+                    "provisioned slice never woke the parked gang"
+                assert {bound("g-w0"), bound("g-w1")} == set(names)
+            finally:
+                stop.set()
+                t.join(timeout=5.0)
+
+
+# ------------------------------------------------- seeded provisioner fuzz
+_CAP_SMOKE = 8
+_CAP_FULL = 48
+
+
+def _cap_seed_params():
+    return [s if s < _CAP_SMOKE
+            else pytest.param(s, marks=pytest.mark.slow)
+            for s in range(_CAP_FULL)]
+
+
+class _AuditedProvider(SimulatedProvider):
+    """SimulatedProvider that audits the release invariant at the only
+    instant it can be judged exactly: a release of a node with bound
+    pods is recorded (and still executed, so the fuzz also surfaces the
+    downstream damage)."""
+
+    def __init__(self, *a, cluster=None, **kw):
+        super().__init__(*a, **kw)
+        self._cluster = cluster
+        self.bad_releases: list = []
+        self.events: list = []  # ("request"|"release", t, pool)
+
+    def request(self, pool, template, now=None):
+        req = super().request(pool, template, now)
+        self.events.append(("request", req.requested_at, pool))
+        return req
+
+    def release(self, node, pool):
+        if self._cluster is not None and self._cluster.pods_on(node):
+            self.bad_releases.append(node)
+        self.events.append(("release", self._now(), pool))
+        return super().release(node, pool)
+
+
+def _cap_workload(rng: random.Random) -> list:
+    """Deliberately unsatisfiable on the initial 1-node fleet (4 chips):
+    convergence REQUIRES the provisioner to deliver through the faults.
+    Mixed 1/2-chip pods plus a few harvest pods, total <= the pool max
+    (6 nodes x 4 chips + 4 initial = 28 chips). Harvest pods are
+    allowed to END PARKED: the fleet never grows for them (the class
+    contract), so when scale-down consolidates they may have no home —
+    they must still be TRACKED (never lost)."""
+    pods = []
+    chips_left = rng.randint(12, 20)
+    i = 0
+    while chips_left > 0:
+        i += 1
+        n = rng.choice((1, 1, 2))
+        n = min(n, chips_left)
+        labels = {"tpu/accelerator": "tpu", "scv/number": str(n)}
+        if rng.random() < 0.2:
+            labels["scv/harvest"] = "1"
+        pods.append(Pod(f"c{i}", labels=labels))
+        chips_left -= n
+    rng.shuffle(pods)
+    return pods
+
+
+def _is_harvest_pod(p) -> bool:
+    return p.labels.get("scv/harvest") == "1"
+
+
+def _drive_cap_fleet(fleet, plan, pods, rng, views, provider):
+    """Drive to convergence, then through a SETTLE window: parked
+    harvest pods keep backoff timers alive forever (by design — the
+    fleet never grows for them), so termination is 'workload done'
+    (non-harvest bound, harvest bound-or-parked) followed by 8 virtual
+    seconds with no membership or release movement."""
+    clock = fleet.clock
+    cluster = fleet.cluster
+    fired: set = set()
+    active: dict = {}
+    fault_end = plan.fault_end()
+    budget = 300.0 + fault_end
+    cycles = 0
+    settle_since = None
+    settle_sig = None
+    SETTLE = 8.0
+    while True:
+        now = clock.time()
+        assert now < budget, (
+            f"capacity drive did not converge by t={now:.1f}: pending "
+            f"{[p.name for p in pods if p.phase == PodPhase.PENDING]}")
+        cycles += 1
+        assert cycles < 300_000, "capacity drive cycle budget exhausted"
+        for w in plan.windows:
+            key = (w.kind, w.start)
+            if w.start > now or key in fired:
+                continue
+            if w.kind == REPLICA_CRASH:
+                fired.add(key)
+                fleet.crash_replica(rng.randrange(fleet.n), pods)
+            elif w.kind == LEASE_EXPIRY:
+                fired.add(key)
+                fleet.revoke_replica_leases(rng.randrange(fleet.n))
+            elif w.kind == NETWORK_PARTITION:
+                fired.add(key)
+                idx = rng.randrange(fleet.n)
+                views[idx].freeze()
+                active[key] = (w.end, views[idx].thaw)
+        for key in list(active):
+            end, undo = active[key]
+            if now >= end:
+                undo()
+                del active[key]
+        done = (now >= fault_end and not active
+                and not provider._pending and not provider._flaps
+                and all(p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                        or (p.phase == PodPhase.PENDING
+                            and _is_harvest_pod(p))
+                        for p in pods))
+        if done:
+            sig = (tuple(sorted(cluster.node_names())),
+                   len(provider.released), len(provider.created))
+            if sig != settle_sig:
+                settle_sig = sig
+                settle_since = now
+            elif now - settle_since >= SETTLE:
+                return
+        else:
+            settle_sig = settle_since = None
+        if fleet.step(rng) is not None:
+            clock.advance(TICK)
+            continue
+        wake = fleet.next_wake_at()
+        if wake is None:
+            clock.advance(0.5)
+        else:
+            clock.advance(max(min(wake - clock.time(), 1.0), TICK))
+
+
+@pytest.mark.parametrize("seed", _cap_seed_params())
+def test_provisioner_chaos_fuzz(seed):
+    """One seeded capacity scenario end to end: a 2-3 replica sharded
+    fleet whose workload is satisfiable ONLY through provisioning,
+    under the PROVISIONER_KINDS mix (stockouts, quota denials, lost
+    responses, flaps, storms, lost binds, partitions, lease expiry,
+    replica crashes). At convergence the four global invariants hold
+    fleet-wide PLUS the capacity four: no node leaked (every
+    provider-created node is in the cluster and known to the pool book,
+    or was released/flapped), no non-empty node released, no pool both
+    scaled up and down within one hysteresis window, and the fleet size
+    stays stable once faults end and the backlog is drained."""
+    from test_chaos import _assert_invariants
+
+    HYST = 3.0
+    rng = random.Random(90_000 + seed)
+    plan = FaultPlan(seed, horizon_s=20.0, kinds=PROVISIONER_KINDS,
+                     max_windows=3)
+    clock = FakeClock()
+    store = TelemetryStore()
+    m = make_tpu_node("t0", chips=4)
+    m.heartbeat = 1e8
+    store.put(m)
+    cluster = ChaosCluster(store, plan=plan, clock=clock)
+    cluster.add_nodes_from_telemetry()
+    n_replicas = rng.choice((2, 3))
+    views: dict = {}
+
+    def wrap(c, idx):
+        v = PartitionableView(c)
+        views[idx] = v
+        return v
+
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=1e9,
+                        breaker_cooldown_s=1.0,
+                        provisioner_interval_s=1.0,
+                        scale_down_cooldown_s=4.0,
+                        provisioner_hysteresis_s=HYST,
+                        provisioner_backoff_s=0.5,
+                        provisioner_backoff_max_s=4.0,
+                        provision_timeout_s=8.0),
+        replicas=n_replicas, clock=clock, mode="sharded", seed=seed,
+        validate_fence_locally=bool(rng.getrandbits(1)),
+        cluster_wrapper=wrap)
+    provider = _AuditedProvider(
+        FakeBackend(cluster, orphan_router=fleet.submit),
+        clock=clock, plan=plan, seed=seed, latency_s=(0.2, 1.5),
+        flap_after_s=2.0, cluster=cluster)
+    fleet.set_capacity_provider(
+        provider, pools=[NodeTemplate(pool="vp", chips=4, max_nodes=6)])
+    pods = _cap_workload(rng)
+    for p in pods:
+        fleet.submit(p)
+    _drive_cap_fleet(fleet, plan, pods, rng, views, provider)
+    tag = f"seed {seed}"
+    # non-harvest pods must ALL be bound (workload sized satisfiable);
+    # harvest pods may legitimately end parked — the fleet never grows
+    # for them — but must still be TRACKED by some replica (never lost)
+    ordinary = [p for p in pods if not _is_harvest_pod(p)]
+    harvest = [p for p in pods if _is_harvest_pod(p)]
+    bound_harvest = [p for p in harvest if p.phase == PodPhase.BOUND]
+    _assert_invariants(ordinary + bound_harvest, store, cluster,
+                       f"capacity-{seed}", sched=fleet)
+    for p in harvest:
+        if p.phase == PodPhase.BOUND:
+            continue
+        assert p.phase == PodPhase.PENDING, (
+            f"{tag}: harvest pod {p.name} in {p.phase}")
+        assert any(r.engine.tracks(p.key) for r in fleet.replicas), (
+            f"{tag}: parked harvest pod {p.name} LOST (tracked nowhere)")
+    # capacity invariant 1: no non-empty release, audited at the
+    # release instant
+    assert not provider.bad_releases, (
+        f"{tag}: released non-empty nodes {provider.bad_releases}")
+    # capacity invariant 2: no node leaked — every provider-created
+    # node is either live in the cluster AND known to the current
+    # owner's pool book, or left through release/flap
+    live = set(cluster.node_names())
+    gone = set(provider.released) | set(provider.flapped)
+    for n in provider.created:
+        assert (n in live) != (n in gone), (
+            f"{tag}: node {n} neither live nor accounted gone")
+    owners = [r.engine.provisioner for r in fleet.replicas
+              if r.engine.provisioner is not None
+              and (r.engine.provisioner.owner_check is None
+                   or r.engine.provisioner.owner_check())]
+    managed_live = {n for n in live
+                    if n.startswith("vp-")}
+    for prov in owners:
+        assert managed_live <= prov._known, (
+            f"{tag}: owner book missing "
+            f"{managed_live - prov._known}")
+    # capacity invariant 3: no scale-up/scale-down oscillation within
+    # one hysteresis window (per pool, across the whole fleet's life)
+    events = sorted(provider.events, key=lambda e: e[1])
+    last = {}
+    for kind, t, pool in events:
+        other = ("release" if kind == "request" else "request")
+        prev = last.get((other, pool))
+        if prev is not None:
+            assert t - prev >= HYST - 1e-6, (
+                f"{tag}: {other}@{prev:.2f} then {kind}@{t:.2f} "
+                f"inside one hysteresis window")
+        last[(kind, pool)] = t
+    # capacity invariant 4: post-fault convergence to a STABLE fleet
+    # size — once idle, membership must not move over a trailing
+    # window longer than cooldown + hysteresis
+    stable_set = set(cluster.node_names())
+    t0 = clock.time()
+    while clock.time() < t0 + 10.0:
+        if fleet.step(rng) is not None:
+            clock.advance(TICK)
+        else:
+            wake = fleet.next_wake_at()
+            clock.advance(0.5 if wake is None
+                          else max(min(wake - clock.time(), 0.5), TICK))
+    assert set(cluster.node_names()) == stable_set, (
+        f"{tag}: fleet size still moving after convergence "
+        f"({stable_set} -> {set(cluster.node_names())})")
+    # bounds held throughout: never past the pool max
+    assert len(managed_live) <= 6

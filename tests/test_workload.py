@@ -151,6 +151,59 @@ class TestWorkloadObject:
         assert w.condition("Admitted")["lastTransitionTime"] == 3.0
 
 
+class TestReplicaStatus:
+    """ISSUE 15 satellite (PR 13 honest follow-up): the Workload CR's
+    /status carries per-replica partial-gang progress, so a half-bound
+    workload is observable without grepping engine metrics."""
+
+    def test_half_bound_workload_reports_per_replica_progress(self):
+        # two 2-host slices (one dented by blockers) plus gang-useless
+        # standalone capacity: enough free chips to ADMIT both
+        # replicas, but only one gang can assemble — exactly the
+        # half-bound state the satellite makes observable
+        cluster = _cluster(standalone=2, chips=4, slices=2,
+                           slice_topo="2x2x2")
+        s = _sched(cluster, gang_timeout_s=1e6)
+        for i, host in enumerate(("s1-host-0", "s1-host-1")):
+            blocker = Pod(f"blk{i}", labels={"scv/number": "3",
+                                             "tpu/accelerator": "tpu"})
+            cluster.bind(blocker, host,
+                         [(0, 0, i), (1, 0, i), (0, 1, i)])
+        pushed = []
+        w = _wl("j", members=2, replicas=2, chips=4)
+        assert s.submit_workload(w)
+        s.workloads.status_sink = pushed.append
+        for _ in range(500):
+            if s.run_one() is None:
+                break
+        s.workloads.tick(s.clock.time())  # claim prune -> refresh
+        st = w.status()
+        assert st["state"] == "Admitted"
+        # the pinned write-back shape: one row per replica index
+        assert [r["index"] for r in st["replicas"]] == [0, 1]
+        by_idx = {r["index"]: r for r in st["replicas"]}
+        assert by_idx[0] == {"index": 0, "boundMembers": 2,
+                             "materializedMembers": 2}
+        assert by_idx[1]["boundMembers"] == 0
+        # r1's members exist (materialized, parked pending capacity)
+        assert by_idx[1]["materializedMembers"] == 2
+        # the progress flowed through the latest-wins status writer
+        assert any(pw.status().get("replicas") for pw in pushed)
+
+    def test_status_rows_survive_cr_roundtrip(self):
+        w = _wl("rt", members=2, replicas=1)
+        w.state = "Admitted"
+        w.set_condition("Admitted", "True", "Admitted", "ok", 1.0)
+        w.replica_status = [{"index": 0, "boundMembers": 1,
+                             "materializedMembers": 2}]
+        w2 = Workload.from_cr(w.to_cr())
+        assert w2.replica_status == w.replica_status
+
+    def test_unadmitted_workload_has_no_replica_rows(self):
+        w = _wl("p")
+        assert "replicas" not in w.status()
+
+
 # ====================================================== admission lifecycle
 class TestAdmission:
     def test_park_admit_materialize_bind(self):

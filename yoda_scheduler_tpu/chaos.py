@@ -82,6 +82,19 @@ WEBHOOK_DOWN = "WebhookDown"
 # battery and the controller's safety rails are the only thing standing
 # between active defragmentation and a lost pod / double-booked chip.
 DEFRAG_RACE = "DefragRace"
+# capacity-provisioner kinds (ISSUE 15), attacking the closed capacity
+# loop through its provider: a STOCKOUT window denies every capacity
+# request after full provisioning latency (the cloud said no), a QUOTA
+# window denies them as policy (retrying harder is wrong — backoff and
+# breaker must absorb it), a LOST_RESPONSE window creates the node but
+# never answers (the request is written off; the arriving node must be
+# ADOPTED through membership reconciliation, never leaked), and a FLAP
+# window delivers the node then yanks it shortly after (orphaned pods
+# requeue; the loop must re-provision without fleet-size oscillation).
+PROVIDER_STOCKOUT = "ProviderStockout"
+PROVIDER_QUOTA_DENIED = "ProviderQuotaDenied"
+PROVISION_LOST_RESPONSE = "ProvisionLostResponse"
+PROVISION_FLAP = "ProvisionFlap"
 # workload-admission kind (ISSUE 13): at a seeded instant, race the
 # admission tier — withdraw a random workload (possibly mid-admission,
 # its members half-materialized across replicas) and/or revoke the
@@ -118,6 +131,15 @@ ELASTIC_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
 # no leaked claim join the four global invariants
 ADMISSION_KINDS = (APISERVER_STORM, BIND_LOST, LEASE_EXPIRY,
                    ADMISSION_RACE)
+# the capacity fuzz's mix (tests/test_capacity.py): all four provider
+# kinds plus the fleet stressors — partitions freeze a replica's view of
+# arriving nodes, lease expiry / replica crashes move provisioner
+# ownership mid-wave (the takeover's membership reconciliation is what
+# stands between a crashed owner's in-flight requests and a leaked node)
+PROVISIONER_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
+                     LEASE_EXPIRY, NETWORK_PARTITION, PROVIDER_STOCKOUT,
+                     PROVIDER_QUOTA_DENIED, PROVISION_LOST_RESPONSE,
+                     PROVISION_FLAP)
 
 
 class LostResponseError(ConnectionError):
@@ -512,6 +534,147 @@ def revive(store, now: float) -> None:
 
     for m in store.list():
         store.put(dataclasses.replace(m, heartbeat=now))
+
+
+class SimulatedProvider:
+    """Fault-injected capacity provider (scheduler/capacity/ provider
+    contract) for the chaos harness and benches: seeded provisioning-
+    latency draws on the engine's injectable clock, with each request's
+    FATE decided deterministically from the fault plan at request time:
+
+    - healthy: the node is created (through the given backend adapter —
+      FakeBackend or WireBackend, i.e. the ordinary intake) after the
+      drawn latency and a ``ready`` result is delivered at the next
+      poll.
+    - PROVIDER_STOCKOUT / PROVIDER_QUOTA_DENIED: full latency, then a
+      denial result — the provisioner's backoff/breaker must absorb it.
+    - PROVISION_LOST_RESPONSE: the node IS created on schedule but no
+      result ever arrives — the write-off + adoption path's analogue of
+      the lost bind response.
+    - PROVISION_FLAP: a ready result, then the provider yanks the node
+      ``flap_after_s`` later (orphaned pods routed back through the
+      backend's orphan router).
+
+    The provider assigns request ids, so fleet replicas sharing one
+    provider can never collide; a result whose request the (possibly
+    freshly taken-over) provisioner does not recognise exercises the
+    adoption path by construction."""
+
+    def __init__(self, backend, clock=None, plan: FaultPlan | None = None,
+                 seed: int = 0, latency_s: tuple = (0.2, 1.5),
+                 flap_after_s: float = 2.0, flight=None) -> None:
+        from .scheduler.capacity import ProvisionRequest, ProvisionResult
+
+        self._Request = ProvisionRequest
+        self._Result = ProvisionResult
+        self.backend = backend
+        self.clock = clock
+        self.plan = plan
+        self.rng = random.Random(seed)
+        self.latency_s = latency_s
+        self.flap_after_s = flap_after_s
+        self.flight = flight
+        self._seq = 0
+        self._pending: list = []   # (ready_at, req, fate)
+        self._flaps: list = []     # (due_at, node)
+        self.injected: dict[str, int] = {}
+        self.created: list[str] = []
+        self.released: list[str] = []
+        self.flapped: list[str] = []
+        self.lost_nodes: list[str] = []  # created, response never sent
+
+    def _now(self) -> float:
+        return self.clock.time() if self.clock is not None else 0.0
+
+    def _count(self, kind: str, **detail) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        if self.flight is not None:
+            self.flight.record("fault_injected", fault=kind, **detail)
+
+    def _fate(self, now: float) -> str:
+        if self.plan is None:
+            return "ready"
+        for kind in (PROVIDER_STOCKOUT, PROVIDER_QUOTA_DENIED,
+                     PROVISION_LOST_RESPONSE, PROVISION_FLAP):
+            if self.plan.active(kind, now):
+                return kind
+        return "ready"
+
+    # ------------------------------------------------------------ contract
+    def request(self, pool: str, template, now: float | None = None):
+        now = self._now() if now is None else now
+        self._seq += 1
+        req = self._Request(id=self._seq, pool=pool, template=template,
+                            requested_at=now)
+        fate = self._fate(now)
+        if fate not in ("ready", PROVISION_FLAP):
+            # a flap's observable fault is the YANK — counting it here
+            # too would double-book one fault (and a node released
+            # before its flap deadline never flaps at all)
+            self._count(fate, pool=pool, request=req.id)
+        ready_at = now + self.rng.uniform(*self.latency_s)
+        self._pending.append((ready_at, req, fate))
+        return req
+
+    def next_event_at(self, now: float) -> float | None:
+        """Earliest pending completion or flap — the provisioner's
+        next_wake_at contribution on a virtual clock."""
+        times = ([t for t, _, _ in self._pending]
+                 + [t for t, _ in self._flaps])
+        return min(times) if times else None
+
+    def poll(self, now: float | None = None) -> list:
+        now = self._now() if now is None else now
+        results: list = []
+        keep: list = []
+        for ready_at, req, fate in self._pending:
+            if ready_at > now:
+                keep.append((ready_at, req, fate))
+                continue
+            if fate == PROVIDER_STOCKOUT:
+                results.append(self._Result(
+                    req.id, req.pool, "stockout",
+                    detail="chaos: no capacity for shape"))
+                continue
+            if fate == PROVIDER_QUOTA_DENIED:
+                results.append(self._Result(
+                    req.id, req.pool, "quota-denied",
+                    detail="chaos: project quota exceeded"))
+                continue
+            name = f"{req.pool}-{req.id}"
+            names = self.backend.create(name, req.template, now)
+            self.created.extend(names)
+            if fate == PROVISION_LOST_RESPONSE:
+                # node real, answer gone: the caller writes the request
+                # off and must adopt the node when it shows up
+                self.lost_nodes.extend(names)
+                continue
+            results.append(self._Result(req.id, req.pool, "ready",
+                                        node=names[0],
+                                        nodes=tuple(names)))
+            if fate == PROVISION_FLAP:
+                self._flaps.append((now + self.flap_after_s, names))
+        self._pending = keep
+        due = [f for f in self._flaps if f[0] <= now]
+        if due:
+            self._flaps = [f for f in self._flaps if f[0] > now]
+            for _, names in due:
+                for name in names:
+                    self._count(PROVISION_FLAP, node=name)
+                    self.backend.destroy(name)
+                    self.flapped.append(name)
+        return results
+
+    def release(self, node: str, pool: str) -> bool:
+        # a released node's pending flap is cancelled: the caller gave
+        # the node back, so yanking it later would destroy a node that
+        # no longer exists and double-book it as released AND flapped
+        self._flaps = [(t, [n for n in names if n != node])
+                       for t, names in self._flaps]
+        self._flaps = [(t, names) for t, names in self._flaps if names]
+        self.backend.destroy(node)
+        self.released.append(node)
+        return True
 
 
 class _CrashWindow:

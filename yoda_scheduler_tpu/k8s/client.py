@@ -989,6 +989,31 @@ class KubeClient:
         doc = self.list_all("/api/v1/nodes")
         return [i["metadata"]["name"] for i in doc.get("items", [])]
 
+    def create_node(self, name: str, labels: dict | None = None,
+                    taints: list | None = None) -> dict:
+        """POST a node object (the capacity provisioner's wire path —
+        on a real cluster the cloud provider's node controller does
+        this; against the fake apiserver the provisioner's WireBackend
+        is the controller). The scheduler itself never consumes the
+        response: the node comes back through the ordinary reflector
+        watch like any other membership change."""
+        obj: dict = {"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": name}}
+        if labels:
+            obj["metadata"]["labels"] = dict(labels)
+        if taints:
+            obj["spec"] = {"taints": list(taints)}
+        return self.request("POST", "/api/v1/nodes", obj)
+
+    def delete_node(self, name: str) -> None:
+        """DELETE a node; 404 tolerated (already gone — releases are
+        idempotent by construction)."""
+        try:
+            self.request("DELETE", f"/api/v1/nodes/{name}")
+        except ApiError as e:
+            if e.status != 404:
+                raise
+
 
 def _pod_from_api(item: dict) -> Pod | None:
     """API pod object -> Pod, or None for terminal phases. Chip assignment

@@ -193,6 +193,27 @@ def _freeze_classes(classes) -> tuple:
                  for c, gens in classes)
 
 
+def _freeze_pool_bounds(bounds) -> tuple:
+    """Normalise a config `poolBounds:` mapping ({pool: {min: 1,
+    max: 16}}) into the frozen ((pool, min, max), ...) tuple the
+    dataclass carries. Accepts the frozen form unchanged."""
+    if not bounds:
+        return ()
+    if isinstance(bounds, dict):
+        out = []
+        for name, body in sorted(bounds.items()):
+            body = body or {}
+            lo = int(body.get("min", 0))
+            hi = int(body.get("max", 64))
+            if lo < 0 or hi < lo:
+                raise ValueError(
+                    f"poolBounds[{name!r}]: need 0 <= min <= max, "
+                    f"got min={lo} max={hi}")
+            out.append((str(name), lo, hi))
+        return tuple(out)
+    return tuple((str(n), int(lo), int(hi)) for n, lo, hi in bounds)
+
+
 def _valid_fleet_mode(mode: str) -> str:
     """Reject unknown fleetMode values at config-load time: the sharded/
     free-for-all A/B is the whole point of the knob, and a typo
@@ -468,6 +489,35 @@ class SchedulerConfig:
     # that bounds materialized-pod memory at million-pod backlogs.
     # 0 = unlimited.
     max_materialized_pods: int = 0
+    # ---- closed-loop capacity (scheduler/capacity/) ----
+    # node-provisioner control loop: scale node pools up per accelerator
+    # shape off the pending backlog's recorded unschedulability, scale
+    # down by drain-and-consolidate (harvest pods first) and release
+    # only empty, cooldown-expired nodes. 0 (the default) never
+    # constructs the loop — placements bit-identical (tests/
+    # test_capacity.py parity + the CI capacity job's knob-off tier-1
+    # leg, the defrag/workload-tier discipline).
+    provisioner_interval_s: float = 0.0
+    # per-pool fleet-size bounds: ((pool, min, max), ...) — config
+    # `poolBounds: {v4-pool: {min: 1, max: 16}}`. Pools without an
+    # entry use the template's own bounds (default 0..64). The
+    # provisioner never releases below min and never requests past max.
+    pool_bounds: tuple = ()
+    # a node must sit EMPTY this long before scale-down may release it
+    # (and no release at all within one hysteresis window of the pool's
+    # last scale-up — flapping demand must never oscillate the fleet)
+    scale_down_cooldown_s: float = 300.0
+    provisioner_hysteresis_s: float = 60.0
+    # provider-failure exponential backoff (stockouts, quota denials,
+    # written-off requests): initial doubling to the max, seeded jitter;
+    # breakerThreshold consecutive failures open the pool's circuit
+    # breaker for provisioner_backoff_max_s
+    provisioner_backoff_s: float = 5.0
+    provisioner_backoff_max_s: float = 60.0
+    # an in-flight capacity request unanswered past this is WRITTEN OFF
+    # (failure-path backoff applies); a node that arrives later anyway
+    # is adopted through membership reconciliation, never leaked
+    provision_timeout_s: float = 120.0
     # lifecycle span tracing (utils/obs.py SpanRing): record the full
     # queued/cycle/bind_wire/watch_confirm span tree for 1-in-N pods
     # (deterministic by pod key). 0 disables, 1 traces every pod; env
@@ -580,6 +630,25 @@ class SchedulerConfig:
                 "admissionBurst", defaults.admission_burst)), 1),
             max_materialized_pods=max(int(args.get(
                 "maxMaterializedPods", defaults.max_materialized_pods)), 0),
+            provisioner_interval_s=float(args.get(
+                "provisionerIntervalSeconds",
+                defaults.provisioner_interval_s)),
+            pool_bounds=_freeze_pool_bounds(args.get(
+                "poolBounds", defaults.pool_bounds)),
+            scale_down_cooldown_s=float(args.get(
+                "scaleDownCooldownSeconds",
+                defaults.scale_down_cooldown_s)),
+            provisioner_hysteresis_s=float(args.get(
+                "provisionerHysteresisSeconds",
+                defaults.provisioner_hysteresis_s)),
+            provisioner_backoff_s=float(args.get(
+                "provisionerBackoffSeconds",
+                defaults.provisioner_backoff_s)),
+            provisioner_backoff_max_s=float(args.get(
+                "provisionerBackoffMaxSeconds",
+                defaults.provisioner_backoff_max_s)),
+            provision_timeout_s=float(args.get(
+                "provisionTimeoutSeconds", defaults.provision_timeout_s)),
             trace_sampling=max(int(args.get(
                 "traceSampling", defaults.trace_sampling)), 0),
             flight_dump_dir=str(args.get(

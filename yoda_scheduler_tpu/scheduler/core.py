@@ -76,7 +76,8 @@ from .plugins import (
 from .plugins.prescore import MAX_KEY
 from .plugins.topology import SLICE_USE_KEY
 from ..utils.labels import (
-    GANG_NAME_LABEL, LabelError, spec_for, tenant_of, workload_class)
+    GANG_NAME_LABEL, LabelError, is_harvest, spec_for, tenant_of,
+    workload_class)
 from ..utils.obs import (
     CycleTrace, FlightRecorder, Metrics, SpanRing, TraceLog, span_sampled)
 from ..utils.pod import ASSIGNED_CHIPS_LABEL, Pod, PodPhase, format_assigned_chips
@@ -648,6 +649,21 @@ class Scheduler:
                 self, self.config.defrag_interval_s,
                 max_migrations=self.config.max_migrations_per_pass,
                 cooldown_s=self.config.defrag_cooldown_s)
+        # closed-loop capacity provisioner (scheduler/capacity/): a
+        # control loop on THIS engine's injectable clock scaling node
+        # pools up off the pending backlog's recorded shapes and down by
+        # drain-and-release — ticked BEFORE the breaker gate (scale-up
+        # continues through apiserver storms; its scale-down half gates
+        # itself on the breaker/degraded interlocks). None when the
+        # knob is off (placements bit-identical). The provider attaches
+        # post-construction (attach_provider) — until then every pass
+        # no-ops.
+        self.provisioner = None
+        if self.config.provisioner_interval_s > 0:
+            from .capacity import CapacityProvisioner
+
+            self.provisioner = CapacityProvisioner(
+                self, self.config.provisioner_interval_s)
         # shard-lease fencing (scheduler/fleet.py): when set, called as
         # fence_provider(pod, node) right before every bind dispatch.
         # Returns a fencing token to carry on the bind (owned shard), None
@@ -3010,6 +3026,13 @@ class Scheduler:
                 nominated, victims, st = p.post_filter(
                     state, pod, snapshot, trace.filter_verdicts)
             if st.ok and nominated is not None:
+                # harvest-class victims (scv/harvest) are evicted for
+                # FREE: they never pass through the budget gate below,
+                # never charge a tenant's rolling budget, and count
+                # harvest_evictions_total{reason} instead of the
+                # per-tenant victim attribution — the planner already
+                # kept them out of the PDB ledger
+                budgeted = [v for v in victims if not is_harvest(v)]
                 # per-tenant preemption budgets (scheduler/policy/): a
                 # plan that would overdraw ANY victim tenant's rolling
                 # budget is refused whole — the preemptor stays
@@ -3018,7 +3041,7 @@ class Scheduler:
                 # half charged; the PDB ledger already ranked plans
                 # below the budget layer, so both protections hold.
                 if (self.policy is not None
-                        and not self.policy.budgets.admits(victims, now)):
+                        and not self.policy.budgets.admits(budgeted, now)):
                     # admits() counted the denial per budget level
                     # (preemptions_budget_denied_total{tenant})
                     self.flight.record(
@@ -3031,10 +3054,14 @@ class Scheduler:
                 # would race it (same contract as Descheduler.run_once)
                 local = getattr(self.cluster, "supports_local_requeue", False)
                 if self.policy is not None:
-                    self.policy.budgets.charge(victims, now)
+                    self.policy.budgets.charge(budgeted, now)
                 for victim in victims:
+                    victim_harvest = is_harvest(victim)
                     self.cluster.evict(victim)
                     self.metrics.inc("pods_evicted_total")
+                    if victim_harvest:
+                        self.metrics.inc("harvest_evictions_total",
+                                         labels={"reason": "preemption"})
                     if self.elastic is not None:
                         try:
                             vspec = spec_for(victim)
@@ -3048,12 +3075,14 @@ class Scheduler:
                             # re-placed member will re-grow it
                             self.elastic.on_member_evicted(
                                 vspec, reason="preemption")
-                    if self.policy is not None:
+                    if self.policy is not None and not victim_harvest:
                         # per-tenant disruption attribution: who LOST a
                         # pod to preemption. A DISTINCT family from the
                         # flat plan counter below — mixing victim-count
                         # labels into preemptions_total would make
-                        # sum() over that family read plans + victims
+                        # sum() over that family read plans + victims.
+                        # Harvest victims counted above instead: the
+                        # harvested tenant did not "lose" protected work
                         self.metrics.inc("preemption_victims_total",
                                          labels={"tenant": tenant_of(victim)})
                     if local:
@@ -4042,6 +4071,17 @@ class Scheduler:
                 break
         if self._elastic_retires:
             self._drain_elastic_retires()
+        if self.provisioner is not None:
+            # capacity tick BEFORE the breaker gate: an apiserver storm
+            # must not stop scale-up (pending work still needs homes
+            # when the wire heals); the pass's scale-down half checks
+            # the breaker/degraded interlocks itself. Contained like
+            # the defrag tick — a controller crash never takes the
+            # scheduling loop down.
+            try:
+                self.provisioner.maybe_run(self.clock.time())
+            except Exception:
+                self.metrics.inc("provisioner_errors_total")
         if self.clock.time() < self._breaker_until:
             # circuit open (apiserver error storm): park scheduling — the
             # queue keeps its order and nobody's attempts burn; resumes
@@ -4160,6 +4200,10 @@ class Scheduler:
                 # a due admission pass runs inside run_one, which parks
                 # at the breaker gate first — floor like the queue wake
                 wakes.append(max(nx, self._breaker_until))
+        if self.provisioner is not None and self.provisioner.busy():
+            # NOT floored at the breaker: the capacity tick runs before
+            # the breaker gate in run_one (scale-up continues degraded)
+            wakes.append(self.provisioner.next_at)
         return min(wakes) if wakes else None
 
     def run_until_idle(self, max_cycles: int = 100_000) -> int:

@@ -60,6 +60,34 @@ class DeschedulePlan:
         return bool(self.victims)
 
 
+def movable(pod: Pod, sched, protect_priority: int) -> bool:
+    """THE eviction-safety predicate for optional (non-preemption)
+    moves — shared by the descheduler's strategies and the capacity
+    provisioner's scale-down drains, so a new protection rule added
+    here applies to both."""
+    if pod.terminating:
+        return False  # already draining; nothing to gain by re-evicting
+    if pod.scheduler_name != sched.config.scheduler_name:
+        # another profile's pod: evicting it here would strand it
+        # (our submit() rejects foreign schedulerNames)
+        return False
+    if not getattr(sched.cluster, "supports_local_requeue", False) \
+            and not pod.has_controller:
+        # on a real cluster evict() is a permanent API DELETE; a bare
+        # (controllerless) pod would be destroyed, not rescheduled —
+        # upstream k8s-descheduler refuses ownerless victims the same way
+        return False
+    try:
+        spec = spec_for(pod)
+    except LabelError:
+        return False
+    if spec.is_gang:
+        return False  # moving one member breaks the gang
+    if spec.priority >= protect_priority:
+        return False
+    return True
+
+
 class Descheduler:
     def __init__(self, sched: Scheduler,
                  protect_priority: int = 5,
@@ -227,27 +255,7 @@ class Descheduler:
         return plan
 
     def _movable(self, pod: Pod) -> bool:
-        if pod.terminating:
-            return False  # already draining; nothing to gain by re-evicting
-        if pod.scheduler_name != self.sched.config.scheduler_name:
-            # another profile's pod: evicting it here would strand it
-            # (our submit() rejects foreign schedulerNames)
-            return False
-        if not getattr(self.sched.cluster, "supports_local_requeue", False) \
-                and not pod.has_controller:
-            # on a real cluster evict() is a permanent API DELETE; a bare
-            # (controllerless) pod would be destroyed, not rescheduled —
-            # upstream k8s-descheduler refuses ownerless victims the same way
-            return False
-        try:
-            spec = spec_for(pod)
-        except LabelError:
-            return False
-        if spec.is_gang:
-            return False  # moving one member breaks the gang
-        if spec.priority >= self.protect_priority:
-            return False
-        return True
+        return movable(pod, self.sched, self.protect_priority)
 
     def _fits_elsewhere(self, pod: Pod, current_node: str, snapshot,
                         planned: dict[str, int],

@@ -430,6 +430,12 @@ class FleetCoordinator:
         # bounded FIFO set (see _claim_workload)
         self._wl_claimed: dict[tuple, None] = {}
         self._wl_registry: dict[str, object] = {}
+        # capacity provisioner (scheduler/capacity/): ONE provider +
+        # pool-template set shared by every replica incarnation, so a
+        # crash rebuild re-wires identically and the takeover owner's
+        # membership reconciliation adopts the dead owner's arrivals
+        self._cap_provider = None
+        self._cap_pools: tuple = ()
         self.replicas: list[_Replica] = [
             self._build_replica(i) for i in range(self.n)]
         sub = getattr(cluster, "subscribe", None)
@@ -513,11 +519,30 @@ class FleetCoordinator:
             wa.admitted_check = self._claim_workload
             wa.submit_pod = self.submit       # shard-aware gang routing
             wa.forget_pod = self.forget       # withdraw dooms fleet-wide
+            wa.tracks_pod = self.tracks       # progress sees every shard
             wa.pending_fn = (
                 # backpressure reads FLEET-wide pending (advisory
                 # GIL-atomic cross-thread reads, like tracks())
                 lambda: sum(r.engine.queue.pending() + len(r.engine.waiting)
                             for r in self.replicas))
+        if engine.provisioner is not None:
+            # exactly ONE replica runs the capacity loop at a time —
+            # the defrag ownership discipline: sharded fleets key it on
+            # the shard-0 lease (crash => takeover inherits the loop and
+            # re-adopts the dead owner's arriving nodes by label);
+            # free-for-all pins replica 0 and drops the rest outright
+            if self.sharded:
+                engine.provisioner.owner_check = (lambda r=rep: 0 in r.owned)
+            elif idx != 0:
+                engine.provisioner = None
+            if engine.provisioner is not None:
+                # demand is FLEET-wide: the starved shape usually parks
+                # on a different replica than the loop's owner
+                # (advisory GIL-atomic cross-thread reads, like defrag)
+                engine.provisioner.demand_fn = (
+                    lambda: [i for r in self.replicas
+                             for i in r.engine.queue.parked_infos()])
+                self._wire_provisioner(engine)
         if self.sharded:
             if self._wire_leases:
                 from ..k8s.leaderelect import ShardLeaseManager
@@ -534,6 +559,29 @@ class FleetCoordinator:
             engine.fence_provider = self._make_fence_provider(rep)
         rep.engine = engine
         return rep
+
+    # ------------------------------------------------------ capacity loop
+    def set_capacity_provider(self, provider, pools=()) -> None:
+        """Attach the (single, shared) capacity provider and pool
+        templates to every replica's provisioner — and remember them so
+        crash-rebuilt incarnations re-wire identically."""
+        self._cap_provider = provider
+        self._cap_pools = tuple(pools)
+        for rep in self.replicas:
+            if rep.engine.provisioner is not None:
+                self._wire_provisioner(rep.engine)
+
+    def _wire_provisioner(self, engine) -> None:
+        # membership/occupancy reads go to the UNSHARDED cluster: under
+        # reflectorSharding the engine's own backend is an owned-pools
+        # view that may not even see the managed pools (the
+        # bound_node_of global-truth discipline)
+        engine.provisioner.truth = self.cluster
+        if self._cap_provider is not None:
+            engine.provisioner.attach_provider(self._cap_provider)
+        for template in self._cap_pools:
+            if template.pool not in engine.provisioner.pools:
+                engine.provisioner.add_pool(template)
 
     def _make_fence_provider(self, rep: _Replica):
         def provider(pod, node):

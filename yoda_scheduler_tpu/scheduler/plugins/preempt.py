@@ -15,7 +15,8 @@ because their memory is only released once they actually terminate.
 from __future__ import annotations
 
 from ..framework import CycleState, NodeInfo, PostFilterPlugin, Snapshot, Status
-from ...utils.labels import GANG_NAME_LABEL, LabelError, WorkloadSpec, spec_for
+from ...utils.labels import (
+    GANG_NAME_LABEL, LabelError, WorkloadSpec, is_harvest, spec_for)
 from ...utils.pdb import DisruptionLedger
 from ...utils.pod import Pod
 from .admission import admissible, preemption_obstacles
@@ -31,6 +32,15 @@ def _priority(pod: Pod) -> int:
         return spec_for(pod).priority
     except LabelError:
         return 0
+
+
+def _victim_rank(pod: Pod) -> tuple[int, int]:
+    """Victim ordering key: harvest-class pods (scv/harvest) ALWAYS rank
+    below every ordinary pod — they soak idle capacity and are evicted
+    for free, so a plan takes them first regardless of their nominal
+    scv/priority. With no harvest pods in a pool this orders exactly
+    like bare _priority (the parity the harvest-off placements rely on)."""
+    return (0 if is_harvest(pod) else 1, _priority(pod))
 
 
 def _shrinkable_gang_of(pod: Pod) -> str | None:
@@ -85,6 +95,14 @@ class PriorityPreemption(PostFilterPlugin):
                     only_nodes: set | None = None
                     ) -> tuple[str | None, list[Pod], Status]:
         spec: WorkloadSpec = state.read("workload_spec")
+        if spec.harvest:
+            # harvest pods soak IDLE capacity only — they never evict
+            # anything. (Also load-bearing: harvest victims are
+            # evictable by ANY preemptor, so a harvest preemptor could
+            # displace a harvest peer at equal priority and the two
+            # would evict each other forever.)
+            return None, [], Status.unschedulable(
+                f"harvest pod {pod.key} never preempts")
         now = state.read_or("now")
         my_prio = _priority(pod)
         # PDB allowance accounting over the whole cluster's bound pods
@@ -121,10 +139,14 @@ class PriorityPreemption(PostFilterPlugin):
         # fewest victims, then lowest max victim priority
         best: tuple[tuple, str, list[Pod]] | None = None
         def evictable_victim(p: Pod) -> bool:
-            return (_priority(p) < my_prio
+            # harvest pods are evictable by ANY preemptor (priority
+            # irrelevant — they exist to yield) and never consume a
+            # tenant's preemption budget
+            return ((_priority(p) < my_prio or is_harvest(p))
                     and (_evictable(p)
                          or (shrink_ok is not None and shrink_ok(p)))
-                    and (victim_ok is None or victim_ok(p)))
+                    and (victim_ok is None or is_harvest(p)
+                         or victim_ok(p)))
 
         for node in snapshot.list():
             if only_nodes is not None and node.name not in only_nodes:
@@ -179,8 +201,16 @@ class PriorityPreemption(PostFilterPlugin):
                 # fits as-is with no conflicts to clear: the
                 # infeasibility has a cause preemption cannot cure
                 continue
-            key = (ledger.violations_for(full), len(full),
-                   max(_priority(v) for v in full), node.name)
+            # harvest victims are FREE: they never weigh a plan's PDB
+            # violation count, its victim count, or its max-victim-
+            # priority cost (a plan that only harvests always beats one
+            # that evicts tenants — counting them in the size term
+            # would let a one-tenant-victim plan outrank a two-harvest
+            # plan)
+            charged = [v for v in full if not is_harvest(v)]
+            key = (ledger.violations_for(charged), len(charged),
+                   max((_priority(v) for v in charged), default=-1),
+                   node.name)
             if best is None or key < best[0]:
                 best = (key, node.name, full)
         if best is None:
@@ -302,8 +332,11 @@ class PriorityPreemption(PostFilterPlugin):
                     continue  # this host can't reach spec.chips at all
                 # per-host cost leads with this host's own PDB violations
                 # so the `need`-cheapest hosts prefer non-violating ones
-                plans.append((ledger.violations_for(victims), len(victims),
-                              max((_priority(v) for v in victims), default=-1),
+                # (harvest victims free in every cost term, as in the
+                # single-pod path)
+                hc = [v for v in victims if not is_harvest(v)]
+                plans.append((ledger.violations_for(hc), len(hc),
+                              max((_priority(v) for v in hc), default=-1),
                               host.name, victims))
             if len(plans) < need:
                 continue  # not enough viable hosts even with evictions
@@ -317,9 +350,10 @@ class PriorityPreemption(PostFilterPlugin):
                 continue
             # slice cost uses the COMBINED victim set: per-budget demand
             # aggregates across hosts, so two hosts each within allowance
-            # can still violate together
-            key = (ledger.violations_for(victims), len(victims),
-                   max(_priority(v) for v in victims), sid)
+            # can still violate together (harvest victims stay free)
+            charged = [v for v in victims if not is_harvest(v)]
+            key = (ledger.violations_for(charged), len(charged),
+                   max((_priority(v) for v in charged), default=-1), sid)
             if best is None or key < best[0]:
                 best = (key, chosen[0][3], victims)
         if best is None:
@@ -418,10 +452,10 @@ class PriorityPreemption(PostFilterPlugin):
         # pool with surplus members of elastic gangs (re-checked at every
         # pick so one plan can never take a gang below its min).
         pool = [p for p in node.pods
-                if _priority(p) < my_prio
+                if (_priority(p) < my_prio or is_harvest(p))
                 and (_evictable(p)
                      or (shrink_ok is not None and shrink_ok(p)))
-                and (victim_ok is None or victim_ok(p))]
+                and (victim_ok is None or is_harvest(p) or victim_ok(p))]
         if not pool:
             return None
         if len(ok_coords) - hold < spec.chips:
@@ -432,7 +466,7 @@ class PriorityPreemption(PostFilterPlugin):
         # copy that each pick consumes — a static snapshot would let two
         # same-budget picks drain an allowance of one without either
         # looking protected, taking an avoidable violation.
-        pool.sort(key=_priority)
+        pool.sort(key=_victim_rank)
         tracker = (ledger.tracker()
                    if ledger is not None and ledger.budgets else None)
         victims: list[Pod] = []
@@ -464,11 +498,17 @@ class PriorityPreemption(PostFilterPlugin):
                 if not candidates:
                     return None
             if tracker is None:
-                v = min(candidates, key=_priority)
+                v = min(candidates, key=_victim_rank)
             else:
+                # harvest pods never touch the PDB ledger: their
+                # eviction is free by contract, so they neither read a
+                # budget's allowance nor consume it
                 v = min(candidates,
-                        key=lambda p: (tracker.would_violate(p), _priority(p)))
-                tracker.consume_one(v)
+                        key=lambda p: ((False if is_harvest(p)
+                                        else tracker.would_violate(p)),
+                                       _victim_rank(p)))
+                if not is_harvest(v):
+                    tracker.consume_one(v)
             pool.remove(v)
             victims.append(v)
             if shrink_taken is not None:
@@ -482,7 +522,7 @@ class PriorityPreemption(PostFilterPlugin):
         # turned out unnecessary — early chip-driven picks can be
         # superseded by later resource-driven ones. Highest priority
         # reprieved first (spare the most valuable workloads).
-        for v in sorted(victims, key=_priority, reverse=True):
+        for v in sorted(victims, key=_victim_rank, reverse=True):
             without = free - v.assigned_chips()
             if (len(without & ok_coords) - hold >= spec.chips
                     and (not need_cpu and not need_mem

@@ -223,6 +223,14 @@ class SchedulingQueue:
     def pending(self) -> int:
         return len(self)
 
+    def parked_infos(self) -> list:
+        """Snapshot of every pod currently parked in backoff — the
+        capacity provisioner's demand surface (each carries the spec
+        shape and the backoff stamp of its last failed cycle).
+        Engine-thread exact; advisory (GIL-atomic dict copy) when a
+        fleet coordinator reads a peer replica's queue."""
+        return list(self._parked.values())
+
     # ------------------------------------------------------------ parked lot
     def _park(self, info: QueuedPodInfo) -> None:
         heapq.heappush(self._backoff,

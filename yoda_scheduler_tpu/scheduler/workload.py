@@ -72,7 +72,8 @@ class Workload:
 
     __slots__ = ("name", "namespace", "labels", "members", "replicas",
                  "scheduler_name", "created", "state", "conditions",
-                 "resource_version", "uid", "parked_at", "_spec")
+                 "resource_version", "uid", "parked_at", "replica_status",
+                 "_spec")
 
     def __init__(self, name: str, members: int = 1, replicas: int = 1,
                  labels: dict | None = None, namespace: str = "default",
@@ -100,6 +101,11 @@ class Workload:
         # delete+recreate of the same ns/name is distinguished by
         self.uid = ""
         self.parked_at = created
+        # per-replica partial-gang progress (status.replicas): a
+        # half-bound workload is observable from the CR alone, no
+        # engine-metric grepping. Maintained by the admission tier off
+        # the in-flight claim's unbound remainder; [] until admitted.
+        self.replica_status: list[dict] = []
         self._spec = None
 
     @property
@@ -229,8 +235,11 @@ class Workload:
         return cr
 
     def status(self) -> dict:
-        return {"state": self.state,
-                "conditions": [dict(c) for c in self.conditions]}
+        st = {"state": self.state,
+              "conditions": [dict(c) for c in self.conditions]}
+        if self.replica_status:
+            st["replicas"] = [dict(r) for r in self.replica_status]
+        return st
 
     @classmethod
     def from_cr(cls, cr: dict) -> "Workload":
@@ -249,6 +258,7 @@ class Workload:
         if st.get("state"):
             w.state = st["state"]
             w.conditions = [dict(c) for c in st.get("conditions", [])]
+            w.replica_status = [dict(r) for r in st.get("replicas", [])]
         return w
 
 
@@ -310,6 +320,7 @@ class WorkloadAdmission:
         self.admitted_check = None
         self.submit_pod = engine.submit
         self.forget_pod = engine.forget
+        self.tracks_pod = engine.tracks
         self.pending_fn = (lambda: engine.queue.pending()
                            + len(engine.waiting))
         # wire hook: called with a Workload whose status changed (the
@@ -603,7 +614,9 @@ class WorkloadAdmission:
         the per-pod quota gate remains the exact enforcement either
         way. O(outstanding unbound members) per tick, and outstanding
         claims are capacity-bounded — admission stops while they hold
-        headroom."""
+        headroom. Bind progress observed here also refreshes the
+        workload's per-replica status (boundMembers moves as the claim's
+        unbound remainder drains) through the latest-wins writer."""
         if not self._inflight:
             return
         bn = getattr(self.engine.cluster, "bound_node_of", None)
@@ -613,9 +626,37 @@ class WorkloadAdmission:
                 continue
             if bn is None:
                 continue
+            before = len(claim[3])
             claim[3] = [k for k in claim[3] if bn(k) is None]
+            if len(claim[3]) != before:
+                w = self._resolved.get(key)
+                if w is not None:
+                    self._refresh_progress(w)
+                    self._push_status(w)
             if not claim[3]:
                 del self._inflight[key]
+
+    def _refresh_progress(self, w: Workload) -> None:
+        """Recompute status.replicas from cluster truth: per replica
+        index, how many member pods are BOUND and how many exist at all
+        (bound or still tracked pending). O(members) — paid only when a
+        claim's unbound remainder actually moved."""
+        bn = getattr(self.engine.cluster, "bound_node_of", None)
+        if bn is None:
+            return
+        rows = []
+        for r in range(w.replicas):
+            bound = mat = 0
+            for m in range(w.members):
+                k = f"{w.namespace}/{w.pod_name(r, m)}"
+                if bn(k) is not None:
+                    bound += 1
+                    mat += 1
+                elif self.tracks_pod(k):
+                    mat += 1
+            rows.append({"index": r, "boundMembers": bound,
+                         "materializedMembers": mat})
+        w.replica_status = rows
 
     # -------------------------------------------------------------- outcomes
     def _admit(self, w: Workload, now: float) -> None:
@@ -669,6 +710,7 @@ class WorkloadAdmission:
                                  [p.key for p in pods]]
         for p in pods:
             self.submit_pod(p)
+        self._refresh_progress(w)
         self.metrics.inc("workload_admissions_total",
                          labels={"tenant": w.tenant})
         self.metrics.inc("workload_materialized_pods_total", len(pods))

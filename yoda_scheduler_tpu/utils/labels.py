@@ -49,6 +49,15 @@ GANG_MIN_LABEL = "tpu/gang-min"
 # cannot fit).
 DEADLINE_LABEL = "scv/deadline-seconds"
 
+# Harvest capacity class (scheduler/capacity/): a pod labeled
+# ``scv/harvest: "1"`` soaks otherwise-idle chips and is EVICTED FOR
+# FREE — outside every disruption protection (preemption budgets, the
+# PDB ledger, victim-priority ordering) and first out the door when the
+# capacity provisioner drains a node for scale-down. Riding the
+# WorkloadSpec keeps every spec-keyed surface (class memos, batch keys)
+# sound: a harvest pod and its non-harvest twin never share a class.
+HARVEST_LABEL = "scv/harvest"
+
 # Policy-engine labels (scheduler/policy/). The workload CLASS names the
 # job's throughput profile across accelerator generations (Gavel's
 # job-type axis, arXiv:2008.09213) — it rides the WorkloadSpec so every
@@ -120,6 +129,10 @@ class WorkloadSpec:
     gang_min: int = 0
     # start-deadline seconds (scv/deadline-seconds): 0 = none
     deadline_s: int = 0
+    # harvest capacity class (scv/harvest): evicted for free — outside
+    # preemption budgets and the PDB ledger, first victim of scale-down
+    # drains. False/absent = ordinary pod.
+    harvest: bool = False
     # declared throughput-profile class (scv/class); None = classless —
     # the heterogeneity model then falls back to a coarse spec-derived
     # class. A scheduling input ONLY when the policy engine is enabled;
@@ -167,6 +180,14 @@ class WorkloadSpec:
         if wclass is not None and not wclass:
             raise LabelError(WORKLOAD_CLASS_LABEL, wclass,
                              "must be a non-empty class name")
+        harvest_raw = labels.get(HARVEST_LABEL)
+        harvest = False
+        if harvest_raw is not None:
+            if harvest_raw in ("1", "true", "True"):
+                harvest = True
+            elif harvest_raw not in ("0", "false", "False"):
+                raise LabelError(HARVEST_LABEL, harvest_raw,
+                                 'must be "1"/"true" or "0"/"false"')
         return cls(
             chips=_parse_uint(labels, NUMBER_LABEL, 1),
             min_free_mb=_parse_uint(labels, MEMORY_LABEL, 0),
@@ -179,6 +200,7 @@ class WorkloadSpec:
             gang_size=gang_size,
             gang_min=gang_min,
             deadline_s=_parse_uint(labels, DEADLINE_LABEL, 0),
+            harvest=harvest,
             workload_class=wclass,
         )
 
@@ -195,7 +217,7 @@ class WorkloadSpec:
             h = hash((self.chips, self.min_free_mb, self.min_clock_mhz,
                       self.priority, self.accelerator, self.tpu_generation,
                       self.topology, self.gang_name, self.gang_size,
-                      self.gang_min, self.deadline_s,
+                      self.gang_min, self.deadline_s, self.harvest,
                       self.workload_class))
             object.__setattr__(self, "_hash_memo", h)
         return h
@@ -207,7 +229,7 @@ _SPEC_LABELS = (
     NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
     ACCELERATOR_LABEL, GENERATION_LABEL, TOPOLOGY_LABEL,
     GANG_NAME_LABEL, GANG_SIZE_LABEL, GANG_MIN_LABEL, DEADLINE_LABEL,
-    WORKLOAD_CLASS_LABEL,
+    HARVEST_LABEL, WORKLOAD_CLASS_LABEL,
 )
 _SPEC_LABEL_SET = frozenset(_SPEC_LABELS)
 
@@ -237,6 +259,17 @@ def workload_class(pod) -> str:
     if ACCELERATOR_LABEL in pod.labels or NUMBER_LABEL in pod.labels:
         return "tpu-single"
     return "unlabeled"
+
+
+def is_harvest(pod) -> bool:
+    """Whether the pod belongs to the harvest capacity class (evicted
+    for free — see HARVEST_LABEL). Malformed labels read as non-harvest:
+    a pod that cannot declare its class never gets the weaker
+    protections removed by accident."""
+    try:
+        return spec_for(pod).harvest
+    except LabelError:
+        return False
 
 
 def tenant_of(pod) -> str:

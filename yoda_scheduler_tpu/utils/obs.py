@@ -291,6 +291,43 @@ class Metrics:
                                           "peer replica already "
                                           "materialized the workload "
                                           "(fleet handover races).",
+        "provision_requests_total": "Capacity-provider request results, "
+                                    "labeled by outcome (ready|stockout|"
+                                    "quota-denied|written-off).",
+        "provisioner_scale_ups_total": "Node requests issued by the "
+                                       "capacity provisioner, per pool.",
+        "provisioner_nodes_released_total": "Empty, cooldown-expired "
+                                            "nodes released by "
+                                            "scale-down, per pool.",
+        "provisioner_nodes_adopted_total": "Provisioned nodes adopted "
+                                           "by membership "
+                                           "reconciliation (response "
+                                           "lost or requester crashed) "
+                                           "— never leaked.",
+        "provisioner_drain_evictions_total": "Ordinary pods migrated "
+                                             "off a node being drained "
+                                             "for scale-down (each "
+                                             "with a dry-run-proven "
+                                             "destination).",
+        "provisioner_breaker_opens_total": "Per-pool provider circuit "
+                                           "breaker openings "
+                                           "(consecutive stockout/"
+                                           "quota/write-off failures).",
+        "provisioner_skips_total": "Provisioner actions skipped, "
+                                   "labeled by reason (not-owner|"
+                                   "breaker-open|degraded|hysteresis|"
+                                   "pool-backoff|pool-breaker-open|"
+                                   "pool-at-max|drain-blocked).",
+        "provisioner_errors_total": "Capacity passes aborted by a "
+                                    "contained controller crash (the "
+                                    "engine thread survives).",
+        "pool_nodes": "Managed node count per pool (gauge).",
+        "harvest_evictions_total": "Harvest-class (scv/harvest) pods "
+                                   "evicted for free, labeled by reason "
+                                   "(preemption|scale-down) — never "
+                                   "counted against preemption budgets "
+                                   "or the victim tenant's "
+                                   "preemption_victims_total.",
         "gang_grow_total": "Elastic-gang members bound into a gang "
                            "running below its desired size (growth "
                            "binds).",
@@ -549,13 +586,22 @@ def export_chrome_trace(rings, path: str | None = None) -> dict:
 # auto-dump: the rate limiter bounds dump frequency, not count, and a
 # steady defrag cadence would otherwise grow a new dump file per window
 # indefinitely on a healthy cluster.
+# provisioner_breaker_open (a node pool's capacity provider failing
+# consistently — stockouts, quota denials, lost responses — so the
+# closed capacity loop stopped asking) dumps like breaker_open: it is
+# the capacity plane actively failing. pool_scaledown (the provisioner
+# releasing an empty, cooldown-expired node) is the defrag_pass shape:
+# planned recurring behavior an operator reconstructing "where did my
+# node go" needs in the ring, but never a dump file per window on a
+# healthy diurnal cluster.
 TRIP_KINDS = frozenset({"breaker_open", "invariant_violation",
                         "quarantine", "webhook_deny", "webhook_fail_open",
                         "shard_takeover", "tenant_quota_breach",
-                        "tenant_starvation", "defrag_pass"})
+                        "tenant_starvation", "defrag_pass",
+                        "provisioner_breaker_open", "pool_scaledown"})
 # trips that mark routine (if noteworthy) operation rather than a fault
 # being absorbed: recorded + counted, but no disk dump
-RING_ONLY_TRIPS = frozenset({"defrag_pass"})
+RING_ONLY_TRIPS = frozenset({"defrag_pass", "pool_scaledown"})
 
 
 class FlightRecorder:
