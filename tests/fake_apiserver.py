@@ -428,6 +428,26 @@ class _Handler(BaseHTTPRequestHandler):
                 if gone is None:
                     return self._json(404, {"kind": "Status", "code": 404})
                 return self._json(200, gone)
+            if method == "PATCH":
+                # strategic-merge-lite, like the pod PATCH: shallow-merge
+                # spec (cordon's unschedulable, taints) and
+                # metadata.labels, then republish through upsert so the
+                # change rides the ordinary node watch stream
+                body = self._body()
+                with s.cond:
+                    obj = s.objects["nodes"].get(name)
+                    if obj is None:
+                        return self._json(404, {"kind": "Status",
+                                                "code": 404})
+                    obj = json.loads(json.dumps(obj))  # deep copy
+                if "spec" in body:
+                    obj.setdefault("spec", {}).update(body["spec"] or {})
+                if "metadata" in body:
+                    labels = (body["metadata"] or {}).get("labels")
+                    if labels is not None:
+                        obj.setdefault("metadata", {}).setdefault(
+                            "labels", {}).update(labels)
+                return self._json(200, s.upsert("nodes", obj))
         self._json(404, {"kind": "Status", "code": 404})
 
     # ----------------------------------------------------------- list/watch

@@ -1174,3 +1174,70 @@ def test_provisioner_chaos_fuzz(seed):
         f"({stable_set} -> {set(cluster.node_names())})")
     # bounds held throughout: never past the pool max
     assert len(managed_live) <= 6
+
+
+# -------------------------------------- wire-backend cordon (ISSUE 16)
+class TestWireCordonPreference:
+    """The provisioner's two-phase scale-down cordons through the
+    backend's REAL cordon verb when one exists (KubeCluster ->
+    KubeClient.cordon_node, a spec.unschedulable PATCH every replica
+    sees via the watch), falling back to set_node_meta for local
+    clusters, and to nothing (emptiness-gated release only) for
+    backends that can do neither."""
+
+    def test_prefers_backend_cordon_node(self):
+        sched, clock, cluster, provider = mk_capacity_sched()
+        calls = []
+
+        def cordon_node(node, on=True):
+            calls.append((node, on))
+            # mirror the watch settling the flag into the local book
+            labels, taints = cluster.node_meta(node)
+            cluster.set_node_meta(node, labels=labels, taints=taints,
+                                  unschedulable=on)
+
+        cluster.cordon_node = cordon_node
+        sched.provisioner._cordon("x", True)
+        sched.provisioner._cordon("x", False)
+        assert calls == [("x", True), ("x", False)]
+
+    def test_failed_wire_cordon_is_contained_and_counted(self):
+        sched, clock, cluster, provider = mk_capacity_sched()
+
+        def cordon_node(node, on=True):
+            raise RuntimeError("apiserver down")
+
+        cluster.cordon_node = cordon_node
+        sched.provisioner._cordon("x", True)  # must not raise
+        assert sched.metrics.counters.get(
+            "provision_cordon_errors_total") == 1
+
+    def test_two_phase_scale_down_cordons_through_the_wire_verb(self):
+        """End to end: surplus nodes get cordoned via the backend verb
+        (phase 1) and released only after the cooldown (phase 2)."""
+        sched, clock, cluster, provider = mk_capacity_sched(
+            scale_down_cooldown_s=0.5, provisioner_hysteresis_s=0.5)
+        calls = []
+        orig_meta = cluster.set_node_meta
+
+        def cordon_node(node, on=True):
+            calls.append((node, on))
+            labels, taints = cluster.node_meta(node)
+            orig_meta(node, labels=labels, taints=taints,
+                      unschedulable=on)
+
+        cluster.cordon_node = cordon_node
+        pods = [Pod(f"w{i}", labels={"scv/number": "4"}) for i in range(3)]
+        for p in pods:
+            sched.submit(p)
+        assert drive(sched, clock, all_bound(pods))
+        for p in pods:
+            cluster.evict(p)
+            sched.forget(p.key)
+        t0 = clock.time()
+        while clock.time() < t0 + 20.0 and not provider.released:
+            sched.run_one()
+            clock.advance(0.25)
+        assert provider.released, "scale-down never released a node"
+        assert any(on for _n, on in calls), \
+            "release path never cordoned through the wire verb"

@@ -435,6 +435,64 @@ def test_fleet_chaos_fuzz(seed):
     assert all(v >= 0 for v in stats["authority_rejections"].values())
 
 
+# ----------------------------- parallel-heads chaos fuzz (ISSUE 16)
+_HEADS_SMOKE = 8
+_HEADS_FULL = 48
+
+
+def _heads_seed_params():
+    return [s if s < _HEADS_SMOKE
+            else pytest.param(s, marks=pytest.mark.slow)
+            for s in range(_HEADS_FULL)]
+
+
+@pytest.mark.parametrize("seed", _heads_seed_params())
+def test_heads_chaos_fuzz(seed):
+    """One seeded scenario with INTRA-replica parallel heads crossed
+    with the fleet fault grammar: 2-3 replicas, each running 2-4
+    scheduling heads over one shared queue and allocator, race
+    optimistic commits against storms, lost binds, replica crashes,
+    lease expiry mid-bind, and split-brain windows. Heads multiply the
+    commit-race surface INSIDE each replica (same-queue pops, shared
+    reservations, per-head dispatch) on top of the inter-replica races
+    the fleet fuzz covers — and the same four invariants must hold at
+    convergence: no pod lost, no double bind, no chip/HBM
+    oversubscription, full convergence. The deterministic step driver
+    seeds the head interleave (HeadSet.step shuffles per fleet rng), so
+    a failing seed replays bit-exact."""
+    rng = random.Random(70_000 + seed)
+    plan = FaultPlan(seed, horizon_s=20.0, kinds=FLEET_KINDS)
+    clock = FakeClock()
+    store = _fleet(rng)
+    cluster = ChaosCluster(store, plan=plan, clock=clock)
+    cluster.add_nodes_from_telemetry()
+    n_replicas = rng.choice((2, 3))
+    n_heads = rng.choice((2, 3, 4))
+    mode = rng.choice(("sharded", "free-for-all"))
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=MAX_AGE,
+                        breaker_cooldown_s=1.0,
+                        schedule_heads=n_heads),
+        replicas=n_replicas, clock=clock, mode=mode, seed=seed,
+        validate_fence_locally=bool(rng.getrandbits(1)))
+    assert all(r.headset is not None and r.headset.n == n_heads
+               for r in fleet.replicas)
+    pods = _workload(rng)
+    for p in pods:
+        fleet.submit(p)
+    _drive_fleet(fleet, plan, pods, rng)
+    _assert_invariants(pods, store, cluster, f"heads-{seed}", sched=fleet)
+    # every replica's heads still share ONE allocator after any
+    # crash-rebuilds (the rebuilt headset re-wires the sharing)
+    for rep in fleet.replicas:
+        assert all(h.allocator is rep.engine.allocator
+                   for h in rep.headset.heads)
+    stats = fleet.fleet_stats()
+    assert all(v >= 0 for v in stats["authority_rejections"].values())
+    assert "heads" in stats
+
+
 # ----------------------- elastic/defrag chaos fuzz (ISSUE 10 satellite)
 _EL_SMOKE = 8
 _EL_FULL = 48

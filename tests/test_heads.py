@@ -1,0 +1,330 @@
+"""Intra-replica parallel scheduling heads (scheduler/heads.py).
+
+Pins the three contracts the HeadSet makes:
+
+- scheduleHeads=1 (the default) is the classic loop BIT-IDENTICAL: no
+  queue lock, no filter, no workers — placements match a plain engine
+  chip-for-chip (the YODA_SCHEDULE_HEADS=1 CI leg re-runs tier-1 under
+  the knob to hold this at suite scale).
+- scheduleHeads>1 shares ONE chip allocator across heads (the multi.py
+  co-hosted-profiles contract): a head's Reserve is visible to every
+  sibling BEFORE the wire round-trip, so same-node concurrent picks
+  stop colliding and the authority's 409 is the cross-replica backstop,
+  not the intra-replica common path.
+- work segregation rides the queue's `exclude` predicate: worker heads
+  never pop gang pods, excluded pods are DEFERRED not consumed (heap
+  re-push / DRF top-only defer), and the bounded per-head dispatch
+  window caps async binds in flight per head.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from yoda_scheduler_tpu.scheduler import (
+    FakeCluster,
+    FleetCoordinator,
+    HeadSet,
+    Scheduler,
+    SchedulerConfig,
+)
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore, make_gpu_node, make_tpu_node, make_v4_slice)
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+
+
+# ------------------------------------------------------------------ fixtures
+def _rig(n_standalone=3):
+    store = TelemetryStore()
+    metrics = list(make_v4_slice("s0", "2x2x4"))
+    for i in range(n_standalone):
+        metrics.append(make_tpu_node(f"t{i}", chips=4))
+    metrics.append(make_gpu_node("g0", cards=8))
+    for m in metrics:
+        m.heartbeat = 0.0
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return store, cluster
+
+
+def _workload(seed, n_tpu=18, n_gpu=5):
+    rng = random.Random(seed)
+    pods = [Pod(f"c{i}", labels={"tpu/accelerator": "tpu",
+                                 "scv/number": "1"}) for i in range(n_tpu)]
+    pods += [Pod(f"g{i}", labels={"tpu/accelerator": "gpu",
+                                  "scv/number": "1"}) for i in range(n_gpu)]
+    rng.shuffle(pods)
+    return pods
+
+
+def _placements(pods):
+    return {p.key: (p.node, tuple(sorted(p.assigned_chips())))
+            for p in pods}
+
+
+def _cfg(**kw):
+    return SchedulerConfig(telemetry_max_age_s=1e9, **kw)
+
+
+def _drive_headset(hs, pods, seed=0, budget=5000):
+    rng = random.Random(seed)
+    clock = hs.primary.clock
+    for _ in range(budget):
+        if all(p.phase in (PodPhase.BOUND, PodPhase.FAILED) for p in pods):
+            return
+        if hs.step(rng) is None:
+            wake = hs.next_wake_at()
+            clock.advance(max((wake or clock.time() + 0.1)
+                              - clock.time(), 0.01))
+    raise AssertionError("headset drive budget exhausted")
+
+
+# ------------------------------------------------------------ 1-head parity
+def test_schedule_heads_one_is_bit_identical_to_classic_engine():
+    _s, base_cluster = _rig()
+    base = Scheduler(base_cluster, _cfg(), clock=FakeClock())
+    base_pods = _workload(7)
+    for p in base_pods:
+        base.submit(p)
+    base.run_until_idle()
+
+    _s, cluster = _rig()
+    eng = Scheduler(cluster, _cfg(schedule_heads=1), clock=FakeClock())
+    hs = HeadSet(eng, 1)
+    pods = _workload(7)
+    for p in pods:
+        eng.submit(p)
+    _drive_headset(hs, pods)
+    assert _placements(pods) == _placements(base_pods)
+    # and NOTHING was armed: no queue lock, no filter, no workers
+    assert eng.queue._mh_lock is None
+    assert eng.head_filter is None
+    assert hs.heads == [eng]
+
+
+def test_schedule_heads_one_under_fleet_is_bit_identical():
+    _s, base_cluster = _rig()
+    base = Scheduler(base_cluster, _cfg(), clock=FakeClock())
+    base_pods = _workload(11)
+    for p in base_pods:
+        base.submit(p)
+    base.run_until_idle()
+
+    _s, cluster = _rig()
+    fleet = FleetCoordinator(cluster, _cfg(schedule_heads=1),
+                             replicas=1, clock=FakeClock())
+    pods = _workload(11)
+    for p in pods:
+        fleet.submit(p)
+    fleet.run_until_idle()
+    assert _placements(pods) == _placements(base_pods)
+    assert fleet.replicas[0].headset is None
+
+
+# --------------------------------------------------------- multi-head drain
+@pytest.mark.parametrize("heads", [2, 4])
+def test_multi_head_deterministic_drain_binds_all(heads):
+    _s, cluster = _rig()
+    eng = Scheduler(cluster, _cfg(schedule_heads=heads), clock=FakeClock())
+    hs = HeadSet(eng, heads)
+    pods = _workload(3)
+    for p in pods:
+        eng.submit(p)
+    _drive_headset(hs, pods, seed=heads)
+    assert all(p.phase == PodPhase.BOUND for p in pods)
+    # every head shares the PRIMARY's allocator (and therefore sees
+    # sibling reservations pre-commit)
+    assert all(h.allocator is eng.allocator for h in hs.heads)
+    st = hs.stats()
+    assert st["pods_scheduled_total"] == len(pods)
+    assert sum(st["per_head_binds"]) == len(pods)
+    # shared-allocator reservations make intra-process chip collisions
+    # structurally impossible in the deterministic interleave
+    assert st["bind_conflicts_total"] == 0
+    # no chip double-booked in cluster truth
+    seen = {}
+    for p in pods:
+        for c in p.assigned_chips():
+            key = (p.node, c)
+            assert key not in seen, f"{key} owned by {seen[key]} and {p.name}"
+            seen[key] = p.name
+
+
+def test_multi_head_same_seed_same_placements():
+    results = []
+    for _ in range(2):
+        _s, cluster = _rig()
+        eng = Scheduler(cluster, _cfg(schedule_heads=3), clock=FakeClock())
+        hs = HeadSet(eng, 3)
+        pods = _workload(5)
+        for p in pods:
+            eng.submit(p)
+        _drive_headset(hs, pods, seed=42)
+        results.append(_placements(pods))
+    assert results[0] == results[1]
+
+
+def test_multi_head_threaded_drain_no_double_bind():
+    _s, cluster = _rig()
+    eng = Scheduler(cluster, _cfg(schedule_heads=4), clock=None)
+    hs = HeadSet(eng, 4)
+    pods = _workload(9, n_tpu=24, n_gpu=8)
+    for p in pods:
+        eng.submit(p)
+    stop = threading.Event()
+    hs.start_workers(stop)
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            eng.run_one()
+            if all(p.phase in (PodPhase.BOUND, PodPhase.FAILED)
+                   for p in pods):
+                break
+            time.sleep(0.001)
+    finally:
+        stop.set()
+        hs.join()
+    bound = [p for p in pods if p.phase == PodPhase.BOUND]
+    assert bound, "threaded drain bound nothing"
+    # cluster truth: each pod bound at most once, chips disjoint per node
+    seen_pod, seen_chip = {}, {}
+    for node in cluster.node_names():
+        for q in cluster.pods_on(node):
+            assert q.key not in seen_pod, f"{q.key} double-bound"
+            seen_pod[q.key] = node
+            for c in q.assigned_chips():
+                key = (node, c)
+                assert key not in seen_chip, f"chip {key} double-booked"
+                seen_chip[key] = q.key
+
+
+# ------------------------------------------------------------- segregation
+def test_worker_heads_never_pop_gang_pods():
+    _s, cluster = _rig()
+    eng = Scheduler(cluster, _cfg(schedule_heads=2), clock=FakeClock())
+    hs = HeadSet(eng, 2)
+    worker = hs.heads[1]
+    gang = [Pod(f"m{i}", labels={"tpu/accelerator": "tpu",
+                                 "scv/number": "1",
+                                 "tpu/gang-name": "g1",
+                                 "tpu/gang-size": "2"})
+            for i in range(2)]
+    for p in gang:
+        eng.submit(p)
+    # the WORKER alone can never bind a gang member
+    for _ in range(50):
+        worker.run_one()
+    assert all(p.phase == PodPhase.PENDING for p in gang)
+    # the full headset (primary included) drains it
+    _drive_headset(hs, gang, seed=1)
+    assert all(p.phase == PodPhase.BOUND for p in gang)
+
+
+def test_excluded_pod_is_deferred_not_consumed():
+    _s, cluster = _rig()
+    eng = Scheduler(cluster, _cfg(), clock=FakeClock())
+    eng.queue.enable_multi_head()
+    a = Pod("a", labels={"tpu/accelerator": "tpu", "scv/number": "1"})
+    b = Pod("b", labels={"tpu/accelerator": "tpu", "scv/number": "1"})
+    eng.queue.add(a, now=0.0)
+    eng.queue.add(b, now=0.0)
+    got = eng.queue.pop(now=1.0, exclude=lambda i: i.pod.name == "a")
+    assert got is not None and got.pod.name == "b"
+    # "a" was deferred, not dropped: a later unfiltered pop returns it
+    got2 = eng.queue.pop(now=1.0)
+    assert got2 is not None and got2.pod.name == "a"
+
+
+# ------------------------------------------------------- dispatch window
+class _StallCluster(FakeCluster):
+    """bind_async parks the commit until the test flushes it — the
+    in-flight window is then directly observable."""
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.parked = []
+
+    def bind_async(self, pod, node, assigned_chips=None, on_fail=None,
+                   on_success=None, fence=None):
+        self.parked.append((pod, node, assigned_chips, on_fail,
+                            on_success, fence))
+
+    def flush_one(self):
+        pod, node, chips, on_fail, on_success, fence = self.parked.pop(0)
+        try:
+            self.bind(pod, node, chips, fence=fence)
+        except Exception as e:
+            if on_fail:
+                on_fail(pod, node, e)
+            return
+        if on_success:
+            on_success(pod, node)
+
+
+def test_head_dispatch_depth_bounds_inflight_binds():
+    store, _ = _rig()
+    cluster = _StallCluster(store)
+    cluster.add_nodes_from_telemetry()
+    eng = Scheduler(cluster, _cfg(head_dispatch_depth=2,
+                                  batch_max_pods=1), clock=None)
+    for i in range(6):
+        eng.submit(Pod(f"p{i}", labels={"tpu/accelerator": "tpu",
+                                        "scv/number": "1"}))
+    done = threading.Event()
+
+    def drive():
+        for _ in range(20):
+            eng.run_one()
+        done.set()
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    # the engine thread is PARKED on the window semaphore with exactly
+    # `head_dispatch_depth` dispatches outstanding
+    assert len(cluster.parked) == 2
+    assert not done.is_set()
+    # flushing frees window slots one for one
+    while not done.is_set() or cluster.parked:
+        if cluster.parked:
+            cluster.flush_one()
+        time.sleep(0.005)
+    t.join(timeout=5.0)
+    assert len([p for p in cluster.all_pods()]) == 6
+
+
+# ------------------------------------------------------------------ config
+def test_schedule_heads_env_and_profile_knobs(monkeypatch):
+    monkeypatch.setenv("YODA_SCHEDULE_HEADS", "4")
+    monkeypatch.setenv("YODA_HEAD_DISPATCH", "8")
+    cfg = SchedulerConfig()
+    assert cfg.schedule_heads == 4
+    assert cfg.head_dispatch_depth == 8
+    monkeypatch.delenv("YODA_SCHEDULE_HEADS")
+    monkeypatch.delenv("YODA_HEAD_DISPATCH")
+    assert SchedulerConfig().schedule_heads == 1
+    cfg = SchedulerConfig.from_profile({"pluginConfig": [
+        {"name": "yoda-tpu",
+         "args": {"scheduleHeads": 3, "headDispatchDepth": 5}}]})
+    assert cfg.schedule_heads == 3
+    assert cfg.head_dispatch_depth == 5
+
+
+def test_fleet_composes_heads_per_replica():
+    _s, cluster = _rig()
+    fleet = FleetCoordinator(cluster, _cfg(schedule_heads=2),
+                             replicas=2, clock=FakeClock())
+    assert all(r.headset is not None and r.headset.n == 2
+               for r in fleet.replicas)
+    pods = _workload(13)
+    for p in pods:
+        fleet.submit(p)
+    fleet.run_until_idle()
+    assert all(p.phase == PodPhase.BOUND for p in pods)
+    stats = fleet.fleet_stats()
+    assert "heads" in stats
+    assert stats["pods_scheduled_total"] == len(pods)

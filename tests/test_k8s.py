@@ -703,3 +703,49 @@ class TestTlsVerification:
         with pytest.raises(Exception):
             KubeClient("https://apiserver.invalid:6443",
                        ca_file="/nonexistent/ca.crt")
+
+
+# ---------------------------------------------- node cordon wire (ISSUE 16)
+def test_cordon_node_patches_spec_unschedulable(client, api):
+    """KubeClient.cordon_node is kubectl cordon: a node PATCH flipping
+    spec.unschedulable (merge-patch; labels/taints untouched)."""
+    client.cordon_node("n1")
+    method, path, body = api.requests[-1]
+    assert (method, path.partition("?")[0]) == ("PATCH", "/api/v1/nodes/n1")
+    assert body == {"spec": {"unschedulable": True}}
+    client.cordon_node("n1", on=False)
+    assert api.requests[-1][2] == {"spec": {"unschedulable": False}}
+
+
+def test_kube_cluster_cordon_delegates_to_client(client, api):
+    store = TelemetryStore()
+    cluster = KubeCluster(client, store)
+    cluster.cordon_node("n1")
+    method, path, _ = api.requests[-1]
+    assert (method, path.partition("?")[0]) == ("PATCH", "/api/v1/nodes/n1")
+
+
+def test_cordon_round_trips_against_live_apiserver():
+    """PATCH verb end to end on the fake apiserver: the flag lands on
+    the stored node object, survives alongside existing labels, rides
+    the watch stream (resourceVersion bump), and a missing node 404s."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tests")
+    from fake_apiserver import FakeApiServer
+
+    from yoda_scheduler_tpu.k8s.client import ApiError
+
+    with FakeApiServer() as srv:
+        srv.state.add_node("n1", labels={"pool": "gold"})
+        c = KubeClient(srv.url)
+        obj = c.cordon_node("n1")
+        assert obj["spec"]["unschedulable"] is True
+        assert obj["metadata"]["labels"] == {"pool": "gold"}
+        obj = c.cordon_node("n1", on=False)
+        assert obj["spec"]["unschedulable"] is False
+        try:
+            c.cordon_node("ghost")
+            assert False, "cordon of a missing node must 404"
+        except ApiError as e:
+            assert e.status == 404

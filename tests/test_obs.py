@@ -388,3 +388,64 @@ class TestFleetMergedMetrics:
                    for k in fams["yoda_tpu_bind_wire_total"])
         assert "yoda_tpu_bind_wire_ms_bucket" in fams
         assert "yoda_tpu_watch_confirm_ms_count" in fams
+
+
+# ------------------------------------------- long-run memory guard (ISSUE 16)
+class TestLongRunMemoryGuard:
+    """A serve process at equilibrium runs indefinitely: every
+    observability layer it keeps hot (reservoir histograms, span rings,
+    cycle-trace ring, flight recorder, metrics registries) must hold a
+    BOUNDED footprint while pods keep flowing through bind -> complete ->
+    rebind forever. The guard churns one engine through thousands of
+    full lifecycles at trace_sampling=1 (worst-case span volume) and
+    fences (a) every ring at its capacity and (b) the process RSS
+    high-water delta across the sustained window."""
+
+    def _churn(self, sched, clock, pods, binds_target):
+        cluster = sched.cluster
+        bound = 0
+        while bound < binds_target:
+            for p in pods:
+                if p.phase == PodPhase.PENDING and not sched.tracks(p.key):
+                    sched.submit(p)
+            progressed = sched.run_one()
+            clock.advance(0.05)
+            done = [p for p in pods if p.phase == PodPhase.BOUND]
+            bound += len(done)
+            for p in done:
+                cluster.evict(p)  # completion -> capacity event -> rebind
+            if progressed is None and not done:
+                clock.advance(0.5)
+        return bound
+
+    def test_obs_rings_and_rss_bounded_over_sustained_window(self):
+        import resource
+
+        sched, clock = mk_sched(n_nodes=4, chips=4)
+        sched.flight.record("probe")  # ring in use from the start
+        pods = [Pod(f"p{i}", labels={"tpu/accelerator": "tpu",
+                                     "scv/number": "1"})
+                for i in range(8)]
+        # warm phase: fill every ring/reservoir to steady shape
+        self._churn(sched, clock, pods, binds_target=600)
+        warm_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # sustained window: 3x the warm work. Unbounded growth in any
+        # obs layer (or the engine's memos under churn) shows up as an
+        # RSS high-water delta well past the fence.
+        self._churn(sched, clock, pods, binds_target=1800)
+        end_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        delta_mb = (end_kb - warm_kb) / 1024.0
+        assert delta_mb < 48.0, (
+            f"sustained serve window grew RSS high-water by "
+            f"{delta_mb:.1f}MB — an observability layer is unbounded")
+        # every ring sits at or under its construction-time capacity
+        assert len(sched.spans._buf) <= sched.spans._buf.maxlen
+        assert len(sched.flight._buf) <= sched.flight._buf.maxlen
+        assert len(sched.traces._buf) <= sched.traces._buf.maxlen
+        for name, h in sched.metrics.histograms.items():
+            assert len(h._values) <= h._cap, (
+                f"histogram {name} reservoir exceeded its cap")
+        # the reservoir kept sampling (not frozen): the biggest families
+        # saw every observation in n even though _values stays capped
+        lat = sched.metrics.histograms.get("schedule_latency_ms")
+        assert lat is not None and lat.n >= 2400

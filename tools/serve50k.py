@@ -1,0 +1,164 @@
+"""The steady-state serve tier at 50k nodes (ISSUE 16): open-loop seeded
+Poisson arrivals held at equilibrium against the full shipped fleet
+config (sharded reflectors + pipelined bind wire + intra-replica
+scheduling heads), with latency measured AFTER warmup, at equilibrium —
+the drain benches measure peak throughput with no sustained-latency
+story; a server at equilibrium is a different regime.
+
+What the artifact (BENCH_SERVE50K.json at the repo root) must show,
+honestly:
+
+- the measured serve CEILING at 50k nodes (arrivals deliberately outrun
+  the fleet; the backlog delta says it saturated), single-head and
+  full-fleet, plus the bottleneck (named again in PERFORMANCE.md): the
+  GIL serializes the pure-Python scoring path, which equilibrium churn
+  (every bind/complete bumps the version vector and voids the score
+  memos) keeps on the per-pod worst case;
+- a TRUE equilibrium at 50k nodes at the arrival rate the process
+  sustains: post-warmup e2e percentiles, zero backlog growth;
+- the 80%-utilization SLO leg at the tier where arrival capacity and
+  chip capacity meet, holding post-warmup p99 under the 1s target;
+- the per-head scaling curve (1/2/4 heads) in BOTH wire regimes:
+  synchronous binds (heads overlap wire RTTs — the regime heads exist
+  for) and async pipelined binds (the wire never blocks, so the
+  GIL-bound compute path gains nothing and conflicts cost a little) —
+  reported as measured, not as hoped.
+
+Run:  python tools/serve50k.py           (full 50k tier)
+      python tools/serve50k.py --smoke   (12.5k-node CI fence tier)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import run_serve_steady  # noqa: E402
+
+TARGET_BINDS_PER_S = 10_000.0
+SLO_P99_MS = 1000.0
+
+
+def peak_rss_mb() -> float:
+    """Peak RSS of this process (Linux ru_maxrss is in KiB)."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _slim(r: dict) -> dict:
+    keep = ("binds_per_s", "e2e_p50_ms", "e2e_p95_ms", "e2e_p99_ms",
+            "backlog_end", "unbound_in_window", "utilization_measured",
+            "bind_conflicts", "conflict_retries",
+            "head_conflict_retry_rate", "per_head_binds_r0",
+            "double_bound", "chip_double_booked", "nodes", "replicas",
+            "schedule_heads", "arrival_per_s_target", "service_s",
+            "pipeline_window", "reflector_sharding", "async_binding")
+    return {k: r[k] for k in keep if k in r}
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    units = 1563 if smoke else 6250          # 12_504 / 50_000 nodes
+    legs: dict = {}
+
+    # --- ceiling probes: arrivals outrun the fleet on purpose ---------
+    legs["ceiling_h1"] = _slim(run_serve_steady(
+        n_replicas=1, heads=1, units=units, arrival_per_s=2000.0,
+        warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
+    legs["ceiling_fleet_r4"] = _slim(run_serve_steady(
+        n_replicas=4, heads=1, units=units, arrival_per_s=2000.0,
+        warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
+    legs["ceiling_fleet_r4h4"] = _slim(run_serve_steady(
+        n_replicas=4, heads=4, units=units, arrival_per_s=2000.0,
+        warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
+    ceiling = max(legs["ceiling_h1"]["binds_per_s"],
+                  legs["ceiling_fleet_r4"]["binds_per_s"],
+                  legs["ceiling_fleet_r4h4"]["binds_per_s"])
+
+    # --- true equilibrium at the big tier -----------------------------
+    # arrival at ~35% of the measured ceiling: the ceiling probe's long
+    # service time sees little completion churn, while equilibrium's 4s
+    # service voids the score memos every window (measured: the
+    # churn-limited sustained rate is ~45% of the probe ceiling), so
+    # the honest equilibrium arrival sits under THAT — the utilization
+    # knob is service_s * arrival / chips, a small slice of 150k chips,
+    # which is exactly the story the ceiling legs tell
+    eq_arrival = max(50.0, round(0.35 * ceiling, 0))
+    chips_total = units * 24
+    legs["equilibrium_50k"] = _slim(run_serve_steady(
+        n_replicas=1, heads=1, units=units, arrival_per_s=eq_arrival,
+        warmup_s=4.0, measure_s=12.0,
+        utilization=4.0 * eq_arrival / chips_total, seed=1))
+
+    # --- 80%-utilization SLO leg --------------------------------------
+    # the tier where arrival capacity meets chip capacity: 240 chips at
+    # 300 pods/s with ~0.64s service holds measured utilization ~0.8
+    # and must keep post-warmup p99 under the 1s SLO
+    legs["equilibrium_80util"] = _slim(run_serve_steady(
+        n_replicas=2, heads=2, units=30, arrival_per_s=300.0,
+        warmup_s=3.0, measure_s=8.0, utilization=0.8,
+        wire_pace_ms=2.0, seed=2))
+
+    # --- per-head scaling curve, both wire regimes --------------------
+    curve: dict = {"sync_wire": {}, "async_pipelined": {}}
+    for h in (1, 2, 4):
+        # synchronous binds: every cycle blocks a full 4ms RTT — the
+        # regime parallel heads exist for (overlapped wire waits)
+        curve["sync_wire"][f"h{h}"] = _slim(run_serve_steady(
+            n_replicas=1, heads=h, units=30, arrival_per_s=600.0,
+            warmup_s=2.0, measure_s=6.0, utilization=0.6,
+            wire_pace_ms=4.0, pipeline_window=0, reflector_sharding=False,
+            head_dispatch_depth=0, async_binding=False, seed=7))
+        # async pipelined binds at the CPU-bound tier: the wire never
+        # blocks, the GIL serializes scoring, so extra heads only add
+        # contention — measured and reported as-is
+        curve["async_pipelined"][f"h{h}"] = _slim(run_serve_steady(
+            n_replicas=1, heads=h, units=units if smoke else 1563,
+            arrival_per_s=1200.0, warmup_s=2.0, measure_s=6.0,
+            utilization=0.8, seed=7))
+
+    s1 = curve["sync_wire"]
+    headline = legs["equilibrium_80util"]
+    out = {
+        "metric": "serve50k_steady",
+        "smoke": smoke,
+        "nodes": units * 8,
+        "chips": chips_total,
+        "measured_ceiling_binds_per_s": ceiling,
+        "target_binds_per_s": TARGET_BINDS_PER_S,
+        "target_met": ceiling >= TARGET_BINDS_PER_S,
+        "bottleneck": (
+            "GIL-serialized Python scoring under equilibrium churn: "
+            "~1-3ms CPU per pod at this node count (topology pre_score "
+            "+ batch fold dominate), and every bind/complete bumps the "
+            "version vector so score memos cannot hold at equilibrium. "
+            "Parallel heads and replicas share the one interpreter "
+            "lock, so the async-pipelined ceiling is a single head's; "
+            "heads pay off when cycles BLOCK on the wire (sync "
+            "fencing postures) — see head_scaling.sync_wire."),
+        "slo_80util_p99_ms": headline["e2e_p99_ms"],
+        "slo_80util_met": (headline["e2e_p99_ms"] is not None
+                           and headline["e2e_p99_ms"] < SLO_P99_MS),
+        "head_speedup_sync_wire_h4_vs_h1": round(
+            s1["h4"]["binds_per_s"] / max(s1["h1"]["binds_per_s"], 1e-9),
+            2),
+        "legs": legs,
+        "head_scaling": curve,
+        "peak_rss_mb": round(peak_rss_mb(), 1),
+    }
+    name = "BENCH_SERVE50K_SMOKE.json" if smoke else "BENCH_SERVE50K.json"
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), name)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({k: out[k] for k in (
+        "metric", "nodes", "measured_ceiling_binds_per_s", "target_met",
+        "slo_80util_p99_ms", "slo_80util_met",
+        "head_speedup_sync_wire_h4_vs_h1", "peak_rss_mb")}))
+
+
+if __name__ == "__main__":
+    main()

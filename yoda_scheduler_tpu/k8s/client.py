@@ -1014,6 +1014,16 @@ class KubeClient:
             if e.status != 404:
                 raise
 
+    def cordon_node(self, name: str, on: bool = True) -> dict:
+        """PATCH spec.unschedulable — kubectl cordon/uncordon. The
+        two-phase scale-down drain (capacity/provisioner.py) marks a
+        release candidate unschedulable through this before waiting out
+        its pods; the flag comes back through the reflector watch and
+        the admission plugin (NodeUnschedulable) starts filtering the
+        node fleet-wide, not just on the cordoning replica."""
+        return self.request("PATCH", f"/api/v1/nodes/{name}",
+                            {"spec": {"unschedulable": bool(on)}})
+
 
 def _pod_from_api(item: dict) -> Pod | None:
     """API pod object -> Pod, or None for terminal phases. Chip assignment
@@ -2016,6 +2026,14 @@ class KubeCluster:
         with self._lock:
             meta = self._node_meta.get(name)
             return bool(meta[3]) if meta is not None else False
+
+    def cordon_node(self, name: str, on: bool = True) -> None:
+        """Cordon/uncordon through the API (capacity provisioner's
+        two-phase scale-down). The PATCH's effect comes back through the
+        node reflector like any other spec change — the local meta cache
+        is NOT updated here, so the admission plugin flips exactly when
+        the watch confirms, the same settle discipline as binds."""
+        self.client.cordon_node(name, on)
 
     def pods_version(self, node: str) -> int:
         with self._lock:

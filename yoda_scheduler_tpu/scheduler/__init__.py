@@ -19,6 +19,7 @@ from .config import SchedulerConfig, ScoreWeights
 from .core import Scheduler
 from .multi import MultiProfileScheduler
 from .fleet import FleetCoordinator, LocalLeaseStore
+from .heads import HeadSet
 from .deschedule import Descheduler, DeschedulePlan
 from .cluster import BindConflictError, FakeCluster
 from .workload import Workload, WorkloadAdmission
@@ -44,6 +45,7 @@ __all__ = [
     "Scheduler",
     "MultiProfileScheduler",
     "FleetCoordinator",
+    "HeadSet",
     "LocalLeaseStore",
     "Descheduler",
     "DeschedulePlan",

@@ -737,9 +737,23 @@ class CapacityProvisioner:
 
     def _cordon(self, node: str, on: bool) -> None:
         c = self._cluster()
+        cordon = getattr(c, "cordon_node", None)
+        if cordon is not None:
+            # wire backends (KubeCluster -> KubeClient.cordon_node): a
+            # spec.unschedulable PATCH, exactly kubectl cordon — the flag
+            # returns through the reflector watch so EVERY replica's
+            # admission plugin starts filtering the node, not just ours
+            try:
+                cordon(node, on)
+            except Exception:
+                # best-effort like the rest of the release path: a failed
+                # cordon leaves the node schedulable; the emptiness gate
+                # below still guards the actual delete
+                self.sched.metrics.inc("provision_cordon_errors_total")
+            return
         setter = getattr(c, "set_node_meta", None)
         if setter is None:
-            return  # wire backends: release gates on emptiness alone
+            return  # backend can't cordon: release gates on emptiness alone
         labels, taints = c.node_meta(node)
         setter(node, labels=labels, taints=taints,
                allocatable=c.node_allocatable(node)

@@ -114,6 +114,34 @@ def _fleet_default() -> int:
         return 1
 
 
+def _schedule_heads_default() -> int:
+    """Default head count for intra-replica parallel scheduling
+    (scheduler/heads.py). YODA_SCHEDULE_HEADS=<n> runs n scheduling
+    heads inside ONE engine process, each pulling from the shared queue
+    and committing optimistically; unset/1/non-integer keeps the classic
+    single loop (whose placements stay bit-identical)."""
+    raw = os.environ.get("YODA_SCHEDULE_HEADS", "")
+    if not raw:
+        return 1
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        return 1
+
+
+def _head_dispatch_depth_default() -> int:
+    """Default per-head async-bind dispatch window. YODA_HEAD_DISPATCH
+    =<n> caps each head at n in-flight dispatched binds; unset/0 keeps
+    the classic unbounded dispatch."""
+    raw = os.environ.get("YODA_HEAD_DISPATCH", "")
+    if not raw:
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
 def _policy_objective_default() -> str:
     """Default objective for the policy engine's heterogeneity scorer
     (scheduler/policy/). Unset = the policy engine stays OUT of the
@@ -393,6 +421,26 @@ class SchedulerConfig:
     # drop / local retry). 1 (or env YODA_FLEET unset) keeps the classic
     # single engine, bit-identical placements included.
     fleet_replicas: int = field(default_factory=_fleet_default)
+    # intra-replica parallel scheduling (scheduler/heads.py): run this
+    # many scheduling HEADS inside one engine process, all pulling from
+    # the SAME scheduling queue (multi-head pop, no double-consume) and
+    # committing optimistically against the shared authority — a losing
+    # head's 409 resolves through the fleet's existing foreign-bind /
+    # node-claim machinery, attempt-free, entirely in-process. Each head
+    # keeps its own allocator/memos/columnar table (single-writer row
+    # refresh per head; the native plane's GIL-releasing scans are what
+    # actually parallelize). 1 (or env YODA_SCHEDULE_HEADS unset) keeps
+    # the classic loop, bit-identical placements included. Composes
+    # with fleet_replicas: each replica runs its own head set.
+    schedule_heads: int = field(default_factory=_schedule_heads_default)
+    # bounded per-head dispatch queue: at most this many async binds
+    # in flight per head before the head's next dispatch blocks (the
+    # generalization of the one-deep scan prefetch — wire commit
+    # overlaps cycle compute up to this depth, and one head can never
+    # fill the shared wire window and starve its siblings). 0 (default,
+    # or env YODA_HEAD_DISPATCH unset) = unbounded, classic behaviour.
+    head_dispatch_depth: int = field(
+        default_factory=_head_dispatch_depth_default)
     # shard leases: node pools hash into this many shards, each backed by
     # a lease (yoda-shard-<i>); a replica schedules its owned shards
     # preferentially and carries a fencing token on binds into them.
@@ -592,6 +640,10 @@ class SchedulerConfig:
                 "breakerCooldownSeconds", defaults.breaker_cooldown_s)),
             fleet_replicas=max(int(args.get(
                 "fleetReplicas", defaults.fleet_replicas)), 1),
+            schedule_heads=max(int(args.get(
+                "scheduleHeads", defaults.schedule_heads)), 1),
+            head_dispatch_depth=max(int(args.get(
+                "headDispatchDepth", defaults.head_dispatch_depth)), 0),
             shard_leases=max(int(args.get(
                 "shardLeases", defaults.shard_leases)), 0),
             fleet_mode=_valid_fleet_mode(str(args.get(

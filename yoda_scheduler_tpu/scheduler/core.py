@@ -443,6 +443,22 @@ class Scheduler:
         # serve loop sleep until an event or submission arrives instead
         # of polling.
         self.wake = threading.Event()
+        # Intra-replica parallel heads (scheduler/heads.py). head_filter
+        # is the queue-segregation predicate: pop/peek skip entries it
+        # claims for another head. route_events=False on worker heads
+        # keeps N heads from funneling every cluster event into the ONE
+        # shared queue N times (memos self-invalidate off the version
+        # vector at cycle time, so workers need no event routing — only
+        # the wake). Both stay inert (None/True) on a classic engine.
+        self.head_filter = None
+        self.route_events = True
+        # bounded per-head dispatch window: generalizes the one-deep
+        # scan prefetch so wire commit overlaps cycle compute beyond
+        # depth 1 without letting a slow wire build an unbounded pile of
+        # in-flight binds per head. 0 = classic unbounded dispatch.
+        self._dispatch_sem = (
+            threading.BoundedSemaphore(self.config.head_dispatch_depth)
+            if self.config.head_dispatch_depth > 0 else None)
         sub = getattr(cluster, "subscribe", None)
         if sub is not None:
             sub(self.notify_event)
@@ -721,7 +737,7 @@ class Scheduler:
         Intake signals (PodPendingArrived) only wake the serve loop — a
         pending pod's arrival cannot cure a parked pod's rejection, so it
         never enters the hint path."""
-        if event.kind != POD_PENDING_ARRIVED:
+        if event.kind != POD_PENDING_ARRIVED and self.route_events:
             self.queue.notify(event)
         if (self.elastic is not None and event.kind == POD_DELETED
                 and event.gang):
@@ -1381,7 +1397,7 @@ class Scheduler:
         now = self.clock.time()
         if now < self._breaker_until:
             return
-        info = self.queue.peek(now)
+        info = self.queue.peek(now, exclude=self.head_filter)
         if info is None:
             return
         pod = info.pod
@@ -3189,12 +3205,39 @@ class Scheduler:
                     # _bind_results (the queue itself is engine-thread
                     # only).
                     dispatched_async = True
-                    bind_async(
-                        pod, node, coords,
-                        on_fail=lambda p, n, e, _info=info:
-                            self._bind_results.append((_info, n, e)),
-                        on_success=self._async_bind_succeeded,
-                        **fence_kw)
+                    on_fail = (lambda p, n, e, _info=info:
+                               self._bind_results.append((_info, n, e)))
+                    on_success = self._async_bind_succeeded
+                    sem = self._dispatch_sem
+                    if sem is not None:
+                        # bounded dispatch window: block until a slot
+                        # frees (wire completion releases it). Release
+                        # exactly once per dispatch — through whichever
+                        # callback fires, or on a SYNCHRONOUS dispatch
+                        # exception (pipelined backends raise 409s
+                        # through dispatch itself, before any callback).
+                        sem.acquire()
+                        released = [False]
+
+                        def _rel():
+                            if not released[0]:
+                                released[0] = True
+                                sem.release()
+
+                        on_fail = (lambda p, n, e, _info=info,
+                                   _inner=on_fail:
+                                   (_rel(), _inner(p, n, e)) and None)
+                        on_success = (lambda p, n, _inner=on_success:
+                                      (_rel(), _inner(p, n)) and None)
+                        try:
+                            bind_async(pod, node, coords, on_fail=on_fail,
+                                       on_success=on_success, **fence_kw)
+                        except Exception:
+                            _rel()
+                            raise
+                    else:
+                        bind_async(pod, node, coords, on_fail=on_fail,
+                                   on_success=on_success, **fence_kw)
                 else:
                     self.cluster.bind(pod, node, coords, **fence_kw)
         except Exception as e:
@@ -4117,7 +4160,8 @@ class Scheduler:
                 maxp = 1
         if maxp > 1:
             infos = self.queue.pop_batch(now=self.clock.time(),
-                                         max_pods=maxp)
+                                         max_pods=maxp,
+                                         exclude=self.head_filter)
             if not infos:
                 return None
             self.metrics.observe("batch_size", len(infos))
@@ -4130,7 +4174,8 @@ class Scheduler:
                 i.cycle_started = started
             outcome = self.schedule_batch(infos)
         else:
-            info = self.queue.pop(now=self.clock.time())
+            info = self.queue.pop(now=self.clock.time(),
+                                  exclude=self.head_filter)
             if info is None:
                 return None
             started = self.clock.time()

@@ -296,7 +296,8 @@ class ShardScore(ScorePlugin):
 class _Replica:
     __slots__ = ("idx", "engine", "identity", "owned", "next_renew",
                  "thread", "incarnation", "manager", "inbox",
-                 "clock_skew", "next_rebalance", "absent_since", "view")
+                 "clock_skew", "next_rebalance", "absent_since", "view",
+                 "headset")
 
     def __init__(self, idx: int, engine: Scheduler, identity: str) -> None:
         self.idx = idx
@@ -325,6 +326,18 @@ class _Replica:
         # reflectorSharding: the replica's owned-pools facade (None when
         # the knob is off) — lease changes bump its membership version
         self.view: ShardedOwnedView | None = None
+        # intra-replica parallel scheduling (scheduler/heads.py): None
+        # when scheduleHeads <= 1 (the classic one-loop replica)
+        self.headset = None
+
+    def memo_reset(self) -> None:
+        """Shard ownership changed: drop every head's score-class memo
+        (ShardScore reads the owned set by reference, so all heads
+        scored against the old set)."""
+        if self.headset is not None:
+            self.headset.clear_score_memos()
+        else:
+            self.engine._score_memo.clear()
 
 
 class FleetCoordinator:
@@ -558,6 +571,31 @@ class FleetCoordinator:
                     rebalance=self.rebalance_s > 0)
             engine.fence_provider = self._make_fence_provider(rep)
         rep.engine = engine
+        if cfg.schedule_heads > 1:
+            # intra-replica parallel heads (scheduler/heads.py): workers
+            # share the replica's (possibly wrapped/sharded) backend and
+            # fence with the replica's leases. Worker profiles replicate
+            # the replica's shape — including ShardScore over the SAME
+            # owned dict, so a lease move steers every head at once.
+            from .heads import HeadSet
+
+            def _worker_profile(wcfg, alloc, gangs, _rep=rep):
+                # alloc/gangs are the REPLICA's shared instances (see
+                # heads.py: per-head allocators double-book chips)
+                if self._enabled is None:
+                    p, _a, _g = default_profile(wcfg, allocator=alloc,
+                                                gangs=gangs)
+                else:
+                    p = build_profile(wcfg, self._enabled,
+                                      allocator=alloc, gangs=gangs)
+                if self.sharded and not self.config.reflector_sharding:
+                    p.score.append(ShardScore(
+                        self.shard_count, _rep.owned,
+                        weight=self.shard_weight))
+                return p
+
+            rep.headset = HeadSet(engine, cfg.schedule_heads,
+                                  worker_profile_fn=_worker_profile)
         return rep
 
     # ------------------------------------------------------ capacity loop
@@ -600,7 +638,7 @@ class FleetCoordinator:
                 # expired/stolen since the cycle started: ONE clean abort,
                 # then the shard leaves `owned` and retries go unfenced
                 rep.owned.pop(s, None)
-                rep.engine._score_memo.clear()
+                rep.memo_reset()
                 return FENCE_LOST
             return token
         return provider
@@ -623,7 +661,7 @@ class FleetCoordinator:
             rep.owned.clear()
             rep.owned.update(rep.manager.owned)
             if rep.owned != before:
-                rep.engine._score_memo.clear()
+                rep.memo_reset()
                 if rep.view is not None:
                     rep.view.note_ownership_change()
             rep.next_renew = now + self.renew_period_s
@@ -708,7 +746,7 @@ class FleetCoordinator:
             # shard ownership is a score input outside every version
             # vector: the score-class memo must not replay stale
             # shard-affinity raws
-            rep.engine._score_memo.clear()
+            rep.memo_reset()
             if rep.view is not None:
                 # sharded reflection: the watch-ownership handover rides
                 # the lease — membership version bump makes the engine
@@ -937,13 +975,20 @@ class FleetCoordinator:
         if rng is not None:
             rng.shuffle(order)
         for rep in order:
-            outcome = rep.engine.run_one()
+            if rep.headset is not None:
+                # seeded head interleave inside the replica — the chaos
+                # fuzz's commit order stays a pure function of the seed
+                outcome = rep.headset.step(rng)
+            else:
+                outcome = rep.engine.run_one()
             if outcome is not None:
                 return outcome
         return None
 
     def next_wake_at(self) -> float | None:
-        wakes = [w for w in (r.engine.next_wake_at()
+        wakes = [w for w in ((r.headset.next_wake_at()
+                              if r.headset is not None
+                              else r.engine.next_wake_at())
                              for r in self.replicas) if w is not None]
         return min(wakes) if wakes else None
 
@@ -975,6 +1020,11 @@ class FleetCoordinator:
                                  daemon=True, name=f"fleet-{rep.idx}")
             rep.thread = t
             t.start()
+            if rep.headset is not None:
+                # worker heads get their own threads; the replica loop
+                # above keeps driving the primary (intake, controllers,
+                # lease upkeep stay on the replica thread)
+                rep.headset.start_workers(stop)
 
     def _drain_inbox(self, rep: _Replica) -> None:
         """Apply cross-thread submit/forget requests on the replica's own
@@ -1087,7 +1137,12 @@ class FleetCoordinator:
     # ------------------------------------------------------------ reporting
     @property
     def engines(self) -> dict[str, Scheduler]:
-        return {f"replica-{r.idx}": r.engine for r in self.replicas}
+        out = {f"replica-{r.idx}": r.engine for r in self.replicas}
+        for r in self.replicas:
+            if r.headset is not None:
+                for i, h in enumerate(r.headset.heads[1:], start=1):
+                    out[f"replica-{r.idx}-head-{i}"] = h
+        return out
 
     @property
     def metrics(self):
@@ -1120,10 +1175,18 @@ class FleetCoordinator:
         agg = {k: 0 for k in keys}
         per_replica = []
         for r in self.replicas:
-            c = r.engine.metrics.counters
-            per_replica.append({k: c.get(k, 0) for k in keys})
+            # a replica's share is the sum over its heads (one engine in
+            # the classic case; scheduleHeads engines otherwise)
+            engines = (r.headset.heads if r.headset is not None
+                       else (r.engine,))
+            row = {k: 0 for k in keys}
+            for e in engines:
+                c = e.metrics.counters
+                for k in keys:
+                    row[k] += c.get(k, 0)
+            per_replica.append(row)
             for k in keys:
-                agg[k] += c.get(k, 0)
+                agg[k] += row[k]
         out = dict(agg)
         # async dispatch counts optimistically; a later 409 records a
         # correction — the share is committed binds, not dispatches
@@ -1134,6 +1197,10 @@ class FleetCoordinator:
             - p["async_bind_conflict_corrections_total"]
             for p in per_replica]
         out["shards_owned"] = [sorted(r.owned) for r in self.replicas]
+        if any(r.headset is not None for r in self.replicas):
+            out["heads"] = {f"replica-{r.idx}": r.headset.stats()
+                            for r in self.replicas
+                            if r.headset is not None}
         out["authority_rejections"] = dict(
             getattr(self.cluster, "bind_conflicts", {}) or {})
         return out

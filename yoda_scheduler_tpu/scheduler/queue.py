@@ -18,6 +18,24 @@ the filter chain. The backoff deadline stays as the timer fallback, so a
 pod whose rejecting plugins have no hint coverage behaves exactly as
 before.
 
+Multi-head pop (intra-replica parallel scheduling, scheduler/heads.py):
+`enable_multi_head()` arms a reentrant lock around every public entry
+point, so N scheduling heads inside one process can pop/requeue/notify
+against the SAME queue without double-consuming — pop's consume step
+(_consume_active dropping the live stint id) is atomic under the lock,
+and a pod handed to one head is structurally gone for every other.
+`pop`/`pop_batch`/`peek` additionally accept an `exclude` predicate:
+worker heads pass one that defers gang pods (gang-assembly state is
+head-local, the same reason fleet routing keys gangs to one replica)
+and foreign-head nominees to the head that owns their state. Exclusion
+is exact in the heap queue (skipped entries are re-pushed verbatim, so
+ordering never shifts); the sharded-DRF queue defers only at the
+selection head (returns None when the DRF pick is excluded — the band
+structure cannot skip without corrupting tenant counts), which at worst
+delays one worker pop until the owning head drains its pod.
+Single-head queues never take the lock and never see a predicate:
+the classic path is bit-identical.
+
 Equivalence-class batch pop (batch scheduling cycles): when the engine
 registers a batch-key function (set_batch_key_fn), pop_batch extends the
 ordinary head pop to up to `max_pods` ACTIVE pods sharing the head's
@@ -143,6 +161,36 @@ class SchedulingQueue:
         # entries in a long-running serve daemon.
         self._by_bkey: dict = {}
         self._bkey_live: dict = {}
+        # multi-head lock (module docstring): None until enable_multi_head
+        self._mh_lock = None
+
+    # ------------------------------------------------------------ multi-head
+    _MH_GUARDED = ("add", "pop", "pop_batch", "peek", "requeue_backoff",
+                   "requeue_immediate", "remove", "on_event",
+                   "next_ready_at", "parked_infos", "set_batch_key_fn",
+                   "register_plugin", "register_hint")
+
+    def enable_multi_head(self) -> None:
+        """Arm the queue for concurrent heads: every public entry point
+        (the _MH_GUARDED set — notify stays lock-free, its deque append
+        is GIL-atomic by design) runs under one reentrant lock.
+        Idempotent; irreversible for the queue's lifetime. Single-head
+        queues never call this, so the classic path carries no lock."""
+        if self._mh_lock is not None:
+            return
+        import functools
+        import threading
+
+        self._mh_lock = lock = threading.RLock()
+        for name in self._MH_GUARDED:
+            fn = getattr(self, name)
+
+            def locked(*a, _fn=fn, **kw):
+                with lock:
+                    return _fn(*a, **kw)
+
+            functools.update_wrapper(locked, fn)
+            setattr(self, name, locked)
 
     # --------------------------------------------------------- hint registry
     def register_plugin(self, plugin) -> None:
@@ -343,23 +391,26 @@ class SchedulingQueue:
             self._metrics.inc("requeue_wakeups_total", woken)
         return woken
 
-    def peek(self, now: float | None = None) -> QueuedPodInfo | None:
+    def peek(self, now: float | None = None,
+             exclude=None) -> QueuedPodInfo | None:
         """Highest-priority READY pod without consuming it — the
         overlapped-prefetch dispatcher asks what the next cycle will
         schedule. Engine-thread-only, like pop. Drains the inbox and
         backoff flush exactly as pop would (so the answer matches the
         next pop), but burns no attempt and leaves the entry queued.
         Comparator-scan mode (no heap key) returns None: peeking there
-        would cost a full scan per cycle for a hint."""
+        would cost a full scan per cycle for a hint. `exclude` follows
+        pop's multi-head contract, except peek never re-orders: a head
+        whose top pod is excluded simply sees None."""
         now = time.time() if now is None else now
         if self._inbox:
             self._drain_inbox(now)
         self._flush_backoff(now)
         if not self._n_active:
             return None
-        return self._order_peek()
+        return self._order_peek(exclude)
 
-    def _order_peek(self) -> QueuedPodInfo | None:
+    def _order_peek(self, exclude=None) -> QueuedPodInfo | None:
         if self._key is None:
             return None
         while self._active:
@@ -367,15 +418,22 @@ class SchedulingQueue:
             if self._active_ids.get(id(info)) != stint:
                 heapq.heappop(self._active)  # stale entry: discard
                 continue
+            if exclude is not None and exclude(info):
+                return None  # top belongs to another head: no prefetch
             return info
         return None
 
-    def pop(self, now: float | None = None) -> QueuedPodInfo | None:
+    def pop(self, now: float | None = None,
+            exclude=None) -> QueuedPodInfo | None:
         """Pop the highest-priority ready pod (None if all are backing off).
 
         Heap pop when the sort plugin provides a key; otherwise a
         comparator selection scan (the framework contract only guarantees a
-        strict weak order via `less`)."""
+        strict weak order via `less`). `exclude(info) -> bool` is the
+        multi-head segregation predicate (module docstring): excluded
+        LIVE entries are skipped without being consumed — exact skip
+        (re-pushed verbatim) in heap mode, selection-scan skip in
+        comparator mode."""
         now = time.time() if now is None else now
         if self._inbox:
             self._drain_inbox(now)
@@ -384,28 +442,48 @@ class SchedulingQueue:
             if self._active:
                 del self._active[:]  # no live entries: all stale
             return None
-        info = self._order_pop()
+        info = self._order_pop(exclude)
         if info is None:
             return None
         self._consume_active(info, now)
         return info
 
-    def _order_pop(self) -> QueuedPodInfo | None:
+    def _order_pop(self, exclude=None) -> QueuedPodInfo | None:
         """Select (and structurally detach) the next live pod; the caller
         consumes it. The sharded subclass detaches nothing — its stint
         check retires entries lazily once _consume_active drops the id."""
         if self._key is not None:
-            while self._active:
-                _, stint, info = heapq.heappop(self._active)
-                if self._active_ids.get(id(info)) != stint:
-                    continue  # gathered/removed, or a PREVIOUS stint's
-                    # entry for a since-requeued pod: stale either way
-                return info
-            return None
-        best_i = 0
-        for i in range(1, len(self._active)):
-            if self._less(self._active[i], self._active[best_i]):
+            stash = None
+            try:
+                while self._active:
+                    entry = heapq.heappop(self._active)
+                    _, stint, info = entry
+                    if self._active_ids.get(id(info)) != stint:
+                        continue  # gathered/removed, or a PREVIOUS stint's
+                        # entry for a since-requeued pod: stale either way
+                    if exclude is not None and exclude(info):
+                        # live but owned by another head: set it aside and
+                        # keep looking — the finally re-push restores the
+                        # exact tuples, so ordering is untouched
+                        if stash is None:
+                            stash = []
+                        stash.append(entry)
+                        continue
+                    return info
+                return None
+            finally:
+                if stash:
+                    for entry in stash:
+                        heapq.heappush(self._active, entry)
+        best_i = -1
+        for i in range(len(self._active)):
+            if exclude is not None and exclude(self._active[i]):
+                continue
+            if best_i < 0 or self._less(self._active[i],
+                                        self._active[best_i]):
                 best_i = i
+        if best_i < 0:
+            return None
         return self._active.pop(best_i)
 
     def _consume_active(self, info: QueuedPodInfo,
@@ -432,15 +510,19 @@ class SchedulingQueue:
                     self._bkey_live[k] = n
 
     def pop_batch(self, now: float | None = None,
-                  max_pods: int = 1) -> list[QueuedPodInfo]:
+                  max_pods: int = 1,
+                  exclude=None) -> list[QueuedPodInfo]:
         """Pop the head plus up to max_pods-1 ACTIVE pods sharing its
         scheduling-equivalence key (module docstring: same-class gather in
         FIFO order, never across a priority boundary). Degrades to a
         single-pod pop when batching is off, the head's class is
         unbatchable, or the sort plugin provides no heap key (the
-        comparator-scan mode has no cheap per-key index)."""
+        comparator-scan mode has no cheap per-key index). `exclude`
+        applies to the head pop as in pop(); the class gather STOPS at
+        the first excluded live classmate (no reorder within the class
+        FIFO — the other head will gather its own batch)."""
         now = time.time() if now is None else now
-        head = self.pop(now)
+        head = self.pop(now, exclude)
         if head is None:
             return []
         if (max_pods <= 1 or self._bkey_fn is None
@@ -456,6 +538,8 @@ class SchedulingQueue:
             if self._active_ids.get(id(info)) != stint:
                 heapq.heappop(heap)  # stale: popped/removed/requeued
                 continue
+            if exclude is not None and exclude(info):
+                break  # classmate owned by another head: leave it queued
             heapq.heappop(heap)
             self._consume_active(info, now)
             batch.append(info)
@@ -822,10 +906,20 @@ class DRFShardedQueue(SchedulingQueue):
     def _entry_live(self, info, stint) -> bool:
         return self._active_ids.get(id(info)) == stint
 
-    def _order_peek(self) -> QueuedPodInfo | None:
+    def _order_peek(self, exclude=None) -> QueuedPodInfo | None:
         self._sync_book()
         got = self._bands.next(self._entry_live)
-        return got[4] if got is not None else None
+        if got is None:
+            return None
+        info = got[4]
+        if exclude is not None and exclude(info):
+            # Top-only defer (module docstring): the DRF pick belongs to
+            # another head, so this head sits the cycle out. We must NOT
+            # dig past it — TenantShareBands.next() retires entries its
+            # live() callback disowns, so lying about liveness to skip a
+            # pod would corrupt the band's tenant counts (pod loss).
+            return None
+        return info
 
     _order_pop = _order_peek  # consumption happens in _consume_active
 
