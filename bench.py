@@ -1216,6 +1216,7 @@ class PipelinedPacedCluster(PacedCluster):
         self._rtt_threads: list | None = None
         self._rtt_inflight = 0
         self._rtt_lock = threading.Lock()
+        self._rtt_stop = False
 
     def bind(self, pod, node, assigned_chips=None, fence=None):
         # sync path (gang members): plain paced bind
@@ -1253,6 +1254,10 @@ class PipelinedPacedCluster(PacedCluster):
             try:
                 pod, node, on_success = self._rtt_q.popleft()
             except IndexError:
+                if self._rtt_stop:
+                    # drained + told to stop: exit. Checked only on the
+                    # empty-queue path so queued completions drain first.
+                    return
                 self._rtt_event.clear()
                 if self._rtt_q:
                     # an append raced the clear: re-arm so no queued
@@ -1276,6 +1281,19 @@ class PipelinedPacedCluster(PacedCluster):
                     return True
             time.sleep(0.005)
         return False
+
+    def shutdown(self) -> None:
+        """Release the RTT workers. Without this every bench leg leaks
+        its `window` daemon threads — and each thread's bound self pins
+        the ENTIRE cluster (nodes, bindings, telemetry) for the life of
+        the process, so a multi-leg artifact run accretes gigabytes of
+        dead cluster state and its later legs measure the heap, not the
+        scheduler (observed: the same leg ran 8x slower at position ~15
+        of tools/serve50k.py than in a fresh process)."""
+        self._rtt_stop = True
+        self._rtt_event.set()
+        for t in (self._rtt_threads or ()):
+            t.join(timeout=2.0)
 
 
 def _fleet_workload(units: int) -> list[Pod]:
@@ -1386,6 +1404,9 @@ def _run_fleet_measured(n_replicas, mode, units, wire_pace_ms, seed,
     flush = getattr(cluster, "flush_binds", None)
     if flush is not None:
         flush(timeout=5.0)  # drain overlapped RTTs before the invariant sweep
+    shut = getattr(cluster, "shutdown", None)
+    if shut is not None:
+        shut()  # leaked RTT workers pin the cluster for the process life
     bound = sum(1 for p in pods if p.phase == PodPhase.BOUND)
     stats = fleet.fleet_stats()
     # fleet-wide invariant re-check straight off the cluster book: every
@@ -1675,6 +1696,9 @@ def _run_serve_steady_nogc(n_replicas, heads, units, arrival_per_s,
         flush = getattr(cluster, "flush_binds", None)
         if flush is not None:
             flush(timeout=5.0)
+        shut = getattr(cluster, "shutdown", None)
+        if shut is not None:
+            shut()  # leaked RTT workers pin the cluster for the process life
 
         w0, w1 = t0 + warmup_s, t0 + horizon_s
         window_lat = [l for (ta, l) in lat_all if w0 <= ta < w1]
@@ -1708,6 +1732,16 @@ def _run_serve_steady_nogc(n_replicas, heads, units, arrival_per_s,
         heads_stats = stats.get("heads", {})
         per_head = (heads_stats.get("replica-0", {}).get("per_head_binds")
                     if heads_stats else None)
+        # equilibrium memo churn (satellite): at steady state the score
+        # memo should mostly HIT — its hit-rate is the measured fraction
+        # of cycles that skipped the full rescore walk
+        memo_hits = memo_misses = 0
+        for r in fleet.replicas:
+            for e in (r.headset.heads if r.headset is not None
+                      else (r.engine,)):
+                c = e.metrics.counters
+                memo_hits += c.get("score_memo_hits_total", 0)
+                memo_misses += c.get("score_memo_misses_total", 0)
         return {
             "replicas": n_replicas,
             "schedule_heads": heads,
@@ -1739,6 +1773,10 @@ def _run_serve_steady_nogc(n_replicas, heads, units, arrival_per_s,
                 stats["bind_conflict_retries_total"]
                 / max(window_commits, 1), 4),
             "per_head_binds_r0": per_head,
+            "score_memo_hits": memo_hits,
+            "score_memo_misses": memo_misses,
+            "score_memo_hit_rate": round(
+                memo_hits / max(memo_hits + memo_misses, 1), 4),
             "double_bound": double_bound,
             "chip_double_booked": chip_conflicts,
             "wire_pace_ms": wire_pace_ms,
@@ -1929,6 +1967,165 @@ def _run_serve_scale_nogc(n_nodes: int, n_pods: int):
             "native": native,
             "events": events,
             "e2e_breakdown": breakdown,
+        }
+
+
+def run_serve_procs(procs: int = 2, heads: int = 1, units: int = 150,
+                    n_pods: int = 3000, pace_ms: float = 0.0,
+                    pipeline_window: int = 16, timeout_s: float = 300.0):
+    """Process-fleet serve throughput over the REAL transport: `procs`
+    OS processes (scheduler/fleet.py ProcessFleet), each one replica
+    slot with its own interpreter/GIL/watch cache, against one live
+    fake apiserver — the off-GIL leg of the 50k ceiling. The parent
+    POSTs pods over the wire (optionally paced), measures the aggregate
+    bind rate from the AUTHORITY's binding book, and verifies the
+    fleet invariants (no pod bound twice, no chip double-booked) from
+    server state rather than any scheduler's self-report."""
+    import sys
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from fake_apiserver import FakeApiServer
+
+    from yoda_scheduler_tpu.k8s.client import KubeClient
+    from yoda_scheduler_tpu.scheduler.fleet import ProcessFleet
+
+    with FakeApiServer() as server:
+        far = time.time() + 1e8
+        # the build_scale_nodes unit mix (slice + standalone + GPU), put
+        # on the wire: units=6250 -> the 50k-node tier
+        n_nodes = 0
+        for i in range(units):
+            for m in make_v4_slice(f"s{i}", "2x2x4"):
+                m.heartbeat = far
+                server.state.add_node(m.node)
+                server.state.put_metrics(m.to_cr())
+                n_nodes += 1
+            for j in range(2):
+                m = make_tpu_node(f"t{i}-{j}", chips=4)
+                m.heartbeat = far
+                server.state.add_node(m.node)
+                server.state.put_metrics(m.to_cr())
+                m = make_gpu_node(f"g{i}-{j}", cards=8)
+                m.heartbeat = far
+                server.state.add_node(m.node)
+                server.state.put_metrics(m.to_cr())
+                n_nodes += 2
+
+        cfg = SchedulerConfig(telemetry_max_age_s=1e9,
+                              fleet_processes=procs,
+                              schedule_heads=heads,
+                              bind_pipeline_window=pipeline_window,
+                              reflector_sharding=procs > 1)
+        fleet = ProcessFleet(server.url, cfg, procs=procs, poll_s=0.02)
+        samples: list[tuple[float, int]] = []
+        stop = threading.Event()
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout=timeout_s)
+
+            def monitor():
+                while not stop.is_set():
+                    samples.append((time.perf_counter(),
+                                    len(server.state.bindings)))
+                    time.sleep(0.02)
+
+            mon = threading.Thread(target=monitor, daemon=True)
+            mon.start()
+            loadgen = KubeClient(server.url)
+            t0 = time.perf_counter()
+            for i in range(n_pods):
+                loadgen.request("POST", "/api/v1/pods", {
+                    "metadata": {"name": f"pp{i}", "namespace": "default",
+                                 "labels": {"scv/number": str(1 + i % 2),
+                                            "tpu/accelerator": "tpu"},
+                                 "ownerReferences": [{
+                                     "kind": "ReplicaSet", "name": "rs",
+                                     "controller": True}]},
+                    "spec": {"schedulerName": "yoda-scheduler"},
+                    "status": {"phase": "Pending"},
+                })
+                if pace_ms > 0:
+                    time.sleep(pace_ms / 1000.0)
+            deadline = time.monotonic() + timeout_s
+            last_n, last_t = 0, time.monotonic()
+            while (len(server.state.bindings) < n_pods
+                   and time.monotonic() < deadline):
+                n = len(server.state.bindings)
+                if n > last_n:
+                    last_n, last_t = n, time.monotonic()
+                elif time.monotonic() - last_t > 15.0:
+                    # drain stalled (fragmentation-stranded tail in a
+                    # near-capacity run): the window rate is already
+                    # measured, don't burn the whole timeout
+                    break
+                time.sleep(0.05)
+            wall = time.perf_counter() - t0
+            stop.set()
+            mon.join(timeout=5)
+            agg = fleet.aggregate()
+            per = fleet.scrape()
+        finally:
+            stop.set()
+            fleet.stop()
+
+        with server.state.cond:
+            bindings = list(server.state.bindings)
+            pods = {k: dict(p) for k, p in
+                    server.state.objects["pods"].items()}
+        # invariants from the AUTHORITY book, not scheduler self-reports
+        names = [b.get("metadata", {}).get("name", "") for b in bindings]
+        double_bound = len(names) - len(set(names))
+        chip_owners: dict = {}
+        chip_conflicts = 0
+        for key, pod in pods.items():
+            node = pod.get("spec", {}).get("nodeName")
+            claim = pod.get("metadata", {}).get(
+                "annotations", {}).get("tpu/assigned-chips", "")
+            if not node or not claim:
+                continue
+            for c in claim.split(";"):
+                if c and (node, c) in chip_owners:
+                    chip_conflicts += 1
+                chip_owners[(node, c)] = key
+        bound = len(bindings)
+        # steady-window rate: the 10%..90% slice of the drain, so child
+        # watch-cache warmup and the last-pod tail don't flatter or
+        # punish the aggregate
+        lo_c, hi_c = int(bound * 0.1), int(bound * 0.9)
+        t_lo = next((t for t, c in samples if c >= lo_c), None)
+        t_hi = next((t for t, c in samples if c >= hi_c), None)
+        window_rate = (round((hi_c - lo_c) / (t_hi - t_lo), 1)
+                       if t_lo is not None and t_hi is not None
+                       and t_hi > t_lo else None)
+        return {
+            "procs": procs,
+            "schedule_heads": heads,
+            "nodes": n_nodes,
+            "pods": n_pods,
+            "bound": bound,
+            "wall_s": round(wall, 2),
+            "binds_per_s": round(bound / wall, 1) if wall else 0.0,
+            "binds_per_s_window": window_rate,
+            "pace_ms": pace_ms,
+            "pipeline_window": pipeline_window,
+            "host_cpus": os.cpu_count(),
+            # committed binds per slot = scheduled - async 409 corrections
+            # (the fleet_stats discipline), read from each child's
+            # /metrics — the shared-nothing aggregation plane
+            "per_proc_binds": [
+                int(ProcessFleet.series_sum(d, "pods_scheduled_total")
+                    - ProcessFleet.series_sum(
+                        d, "async_bind_conflict_corrections_total"))
+                for d in per],
+            "bind_conflicts": int(ProcessFleet.series_sum(
+                agg, "bind_conflicts_total")),
+            "foreign_bind_conflicts": int(ProcessFleet.series_sum(
+                agg, "foreign_bind_conflicts_total")),
+            "restarts": fleet.restarts,
+            "double_bound": double_bound,
+            "chip_double_booked": chip_conflicts,
         }
 
 

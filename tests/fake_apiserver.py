@@ -96,6 +96,14 @@ class FakeApiState:
         # pair with compact() to force the 410 re-list path instead)
         self.watch_epochs: dict[str, int] = {k: 0 for k in self.KINDS}
         self.uid_seq = 0
+        # bound-pod index: node name -> set of pod keys assigned there
+        # (maintained by upsert/remove under self.cond). _bind_conflict's
+        # chip-overlap check used to scan EVERY pod under the state lock
+        # — O(all pods) per bind serialized the whole server once tens of
+        # thousands of pods accumulated (the multiprocess serve bench
+        # regime); with the index it scans only the target node's pods.
+        self.pods_by_node: dict[str, set[str]] = {}
+        self._pod_node: dict[str, str] = {}
         # graceful deletion: DELETE sets metadata.deletionTimestamp and
         # emits MODIFIED (the pod keeps running with its nodeName, as a real
         # kubelet does for terminationGracePeriodSeconds); the test then
@@ -147,14 +155,34 @@ class FakeApiState:
             typ = typ or ("MODIFIED" if k in self.objects[kind] else "ADDED")
             obj = self._stamp(kind, obj, typ)
             self.objects[kind][k] = obj
+            if kind == "pods":
+                self._index_pod(k, obj)
             self.kind_conds[kind].notify_all()
             self.cond.notify_all()
             return obj
+
+    def _index_pod(self, key: str, obj: dict) -> None:
+        # caller holds self.cond
+        node = obj.get("spec", {}).get("nodeName") or None
+        prev = self._pod_node.get(key)
+        if prev == node:
+            return
+        if prev is not None:
+            self.pods_by_node.get(prev, set()).discard(key)
+        if node is None:
+            self._pod_node.pop(key, None)
+        else:
+            self._pod_node[key] = node
+            self.pods_by_node.setdefault(node, set()).add(key)
 
     def remove(self, kind: str, key: str) -> dict | None:
         with self.cond:
             obj = self.objects[kind].pop(key, None)
             if obj is not None:
+                if kind == "pods":
+                    node = self._pod_node.pop(key, None)
+                    if node is not None:
+                        self.pods_by_node.get(node, set()).discard(key)
                 self._stamp(kind, obj, "DELETED")
                 self.kind_conds[kind].notify_all()
                 self.cond.notify_all()
@@ -635,14 +663,17 @@ class _Handler(BaseHTTPRequestHandler):
         if not claim:
             return None
         claimed = {c for c in claim.split(";") if c}
-        for other in s.objects["pods"].values():
-            if other.get("spec", {}).get("nodeName") != node:
+        # by-node index: only pods already assigned to the TARGET node
+        # can hold a conflicting chip claim (full-table scans here
+        # serialized every bind behind O(all pods) work under the lock)
+        for okey in s.pods_by_node.get(node, ()):
+            other = s.objects["pods"].get(okey)
+            if other is None:
                 continue
             theirs = other.get("metadata", {}).get(
                 "annotations", {}).get("tpu/assigned-chips", "")
             overlap = claimed & {c for c in theirs.split(";") if c}
             if overlap:
-                okey = _key(other)
                 return (f"chip claim conflict on {node}: {sorted(overlap)} "
                         f"already owned by {okey}")
         need_mb = int(pod.get("metadata", {}).get("labels", {}).get(

@@ -31,6 +31,7 @@ from yoda_scheduler_tpu.scheduler import (
     SchedulerConfig,
 )
 from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.scheduler.queue import DRFShardedQueue
 from yoda_scheduler_tpu.telemetry import (
     TelemetryStore, make_gpu_node, make_tpu_node, make_v4_slice)
 from yoda_scheduler_tpu.utils import Pod, PodPhase
@@ -237,6 +238,106 @@ def test_excluded_pod_is_deferred_not_consumed():
     # "a" was deferred, not dropped: a later unfiltered pop returns it
     got2 = eng.queue.pop(now=1.0)
     assert got2 is not None and got2.pod.name == "a"
+
+
+# --------------------------------------- segregation x sharded DRF queue
+class TestDRFShardedDefer:
+    """Multi-head `exclude` against the DRFShardedQueue (satellite:
+    deferred entries keep their exact DRF position and are never
+    double-popped — the sharded queue's top-only defer contract)."""
+
+    def _drf_eng(self):
+        _s, cluster = _rig()
+        eng = Scheduler(cluster, _cfg(drf_fairness=True),
+                        clock=FakeClock())
+        assert isinstance(eng.queue, DRFShardedQueue)
+        eng.queue.enable_multi_head()
+        return eng
+
+    @staticmethod
+    def _pod(name, tenant):
+        return Pod(name, labels={"tpu/accelerator": "tpu",
+                                 "scv/number": "1",
+                                 "scv/tenant": tenant})
+
+    def test_excluded_drf_pick_defers_whole_cycle(self):
+        """The sharded queue must NOT dig past an excluded DRF pick
+        (that would corrupt the bands' tenant counts): the head sits
+        the cycle out, and the deferred entry keeps its exact
+        position for the next eligible pop."""
+        eng = self._drf_eng()
+        a = self._pod("a", "acme")
+        b = self._pod("b", "bux")
+        eng.queue.add(a, now=0.0)
+        eng.queue.add(b, now=0.5)
+        pick = eng.queue.peek(now=1.0)
+        assert pick is not None
+        first = pick.pod.name
+        # a head that doesn't own the DRF pick gets None — top-only
+        # defer, never the runner-up from another tenant's band
+        got = eng.queue.pop(now=1.0,
+                            exclude=lambda i: i.pod.name == first)
+        assert got is None
+        # nothing was consumed and the band counts stayed truthful
+        assert len(eng.queue) == 2
+        live = eng.queue.drf_stats()["bands"]
+        assert sum(n for t in live.values() for n in t.values()) == 2
+        # the deferred entry kept its exact DRF position: an
+        # unfiltered pop returns the very pod the defer skipped
+        got2 = eng.queue.pop(now=1.0)
+        assert got2 is not None and got2.pod.name == first
+        got3 = eng.queue.pop(now=1.0)
+        assert got3 is not None and got3.pod.name != first
+        assert eng.queue.pop(now=1.0) is None
+
+    def test_interleaved_heads_partition_exactly_once(self):
+        """Two heads with complementary exclude predicates draining a
+        mixed-tenant backlog: every pod is popped exactly once by the
+        head that owns it — no double-pop, no loss, even though each
+        deferred cycle returns None to the non-owning head."""
+        eng = self._drf_eng()
+        pods = [self._pod(f"p{i}", "acme" if i % 3 else "bux")
+                for i in range(12)]
+        for i, p in enumerate(pods):
+            eng.queue.add(p, now=0.1 * i)
+        owns = lambda info, h: hash(info.pod.name) % 2 == h
+        popped: dict[int, list[str]] = {0: [], 1: []}
+        idle = 0
+        for cycle in range(200):
+            head = cycle % 2
+            got = eng.queue.pop(
+                now=10.0, exclude=lambda i, h=head: not owns(i, h))
+            if got is None:
+                idle += 1
+                if idle > 4 and not len(eng.queue):
+                    break
+                continue
+            idle = 0
+            assert owns(got, head)  # segregation honored
+            popped[head].append(got.pod.name)
+        drained = popped[0] + popped[1]
+        assert sorted(drained) == sorted(p.name for p in pods)
+        assert len(set(drained)) == len(pods)  # exactly once
+        assert len(eng.queue) == 0
+
+    def test_defer_preserves_at_pop_share_order(self):
+        """A defer must not perturb exact-at-pop DRF: after tenant
+        shares diverge (acme holds bound chips), the pick is the poor
+        tenant's pod both before and after an interposed defer."""
+        eng = self._drf_eng()
+        cluster = eng.cluster
+        for i in range(3):
+            cluster.bind(self._pod(f"pre{i}", "acme"), "t0", [(i, 0, 0)])
+        eng.policy.book.refresh()
+        rich = self._pod("rich", "acme")
+        poor = self._pod("poor", "free")
+        eng.queue.add(rich, now=0.0)
+        eng.queue.add(poor, now=0.5)
+        # DRF pick is the poor tenant despite FIFO favoring rich
+        assert eng.queue.pop(
+            now=1.0, exclude=lambda i: i.pod.name == "poor") is None
+        got = eng.queue.pop(now=1.0)
+        assert got is not None and got.pod.name == "poor"
 
 
 # ------------------------------------------------------- dispatch window

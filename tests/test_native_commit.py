@@ -1,0 +1,218 @@
+"""Native commit plane (nativeCommit knob): parity + degradation.
+
+The contract under test (native/commitplane.cc via
+nativeplane.CommitKernels and TopologyScore.score_batch): with the
+commit plane armed, every pod's fate must be bit-identical to the
+scalar/columnar/fused engines — the kernel mirrors `_packing` op-for-op,
+the _SliceUsage array map returns the same tuples the dict did, and the
+in-place contribution patch never changes a published usage snapshot.
+A missing .so must degrade ONLY the kernel half (pure-Python in-place
+patch stays on) without touching placements.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+np = pytest.importorskip("numpy")
+
+from yoda_scheduler_tpu.scheduler import Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.scheduler.nativeplane import CommitKernels
+from yoda_scheduler_tpu.scheduler.plugins.topology import _SliceUsage
+
+from test_columnar import T0, build_burst, build_cluster, end_state
+
+COMMIT_NATIVE = CommitKernels.load() is not None
+
+require_commit = pytest.mark.skipif(
+    not COMMIT_NATIVE, reason="libyodaplace.so lacks commit ABI (make native)")
+
+
+def drive(cluster, pods, *, nc: bool, native: bool = False,
+          columnar: bool = True):
+    sched = Scheduler(
+        cluster,
+        # explicit knobs: pin each plane regardless of the CI pass's env
+        SchedulerConfig(max_attempts=3, columnar=columnar,
+                        native_plane=native, native_commit=nc,
+                        pod_hinted_backoff_s=0.0),
+        clock=FakeClock(start=T0))
+    for p in pods:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=10_000)
+    return sched
+
+
+# ------------------------------------------------------------------ the fuzz
+def test_parity_fuzz_commit_plane():
+    """Randomized (cluster, burst) cases driven through four engines —
+    commit plane on/off, atop both the columnar and fused-native scan
+    planes — with identical seeds: every pod's fate must be
+    bit-identical. When the library carries the commit ABI the batch
+    path must also actually ENGAGE (a silently-falling-back plane would
+    pass parity vacuously)."""
+    mismatches = []
+    batches = 0
+    for case in range(60):
+        rngs = [random.Random(41_000 + case) for _ in range(4)]
+        clusters = [build_cluster(r) for r in rngs]
+        bursts = [build_burst(r) for r in rngs]
+        on = drive(clusters[0], bursts[0], nc=True)
+        drive(clusters[1], bursts[1], nc=False)
+        drive(clusters[2], bursts[2], nc=True, native=True)
+        drive(clusters[3], bursts[3], nc=False, native=True)
+        batches += on.metrics.counters.get("columnar_score_batches_total", 0)
+        a, b, c, d = (end_state(p) for p in bursts)
+        if not (a == b == c == d):
+            mismatches.append((case, a, b))
+    assert not mismatches, mismatches[:2]
+    if COMMIT_NATIVE:
+        assert batches > 100, batches
+
+
+def test_commit_plane_scalar_parity():
+    """Commit plane vs the pure-scalar engine (no columnar table at all)
+    — the ground truth of ground truths."""
+    for case in range(20):
+        rngs = [random.Random(43_000 + case) for _ in range(2)]
+        clusters = [build_cluster(r) for r in rngs]
+        bursts = [build_burst(r) for r in rngs]
+        drive(clusters[0], bursts[0], nc=True)
+        drive(clusters[1], bursts[1], nc=False, columnar=False)
+        a, b = (end_state(p) for p in bursts)
+        assert a == b, case
+
+
+def test_degrades_without_library(monkeypatch):
+    """nativeCommit with no loadable .so: the pure-Python half still
+    arms (in-place patch + array usage map) and placements are
+    unchanged; score_batch returns None (scalar loop owns scoring)."""
+    monkeypatch.setenv("YODA_PLACEMENT_LIB", "/nonexistent/lib.so")
+    import yoda_scheduler_tpu.utils.nativeloader as nl
+    monkeypatch.setattr(nl, "load_library", lambda: None)
+    rngs = [random.Random(44_777) for _ in range(2)]
+    clusters = [build_cluster(r) for r in rngs]
+    bursts = [build_burst(r) for r in rngs]
+    on = drive(clusters[0], bursts[0], nc=True)
+    drive(clusters[1], bursts[1], nc=False)
+    assert end_state(bursts[0]) == end_state(bursts[1])
+    assert on.metrics.gauges.get("native_commit_active") == 0.0
+
+
+# ------------------------------------------------------------- direct kernel
+@require_commit
+def test_topo_pack_matches_packing_arithmetic():
+    """yoda_topo_pack vs a literal transcription of TopologyScore's
+    `_packing` + blend: bit-equal on 500 random rows covering every
+    branch (standalone / gang / multi-host, zero totals, zero chips,
+    invalid rows)."""
+    ck = CommitKernels.load()
+
+    def packing(multi, u, t, f, c, gang):
+        if not multi:
+            node_used = 1.0 - f / c if c else 0.0
+            return 50.0 + 50.0 * node_used
+        if gang:
+            return 100.0 * (t - u) / t if t else 0.0
+        slice_used = u / t if t else 0.0
+        node_used = 1.0 - f / c if c else 0.0
+        return 100.0 * (0.5 * slice_used + 0.5 * node_used)
+
+    rng = random.Random(9)
+    for _ in range(500):
+        m = rng.randrange(1, 33)
+        cont = np.array([rng.uniform(0, 100) for _ in range(m)])
+        used = np.array([rng.randrange(0, 64) for _ in range(m)],
+                        dtype=np.int64)
+        total = np.array([rng.choice([0, 4, 8, 16, 64]) for _ in range(m)],
+                         dtype=np.int64)
+        free = np.array([rng.randrange(0, 5) for _ in range(m)],
+                        dtype=np.int64)
+        chip = np.array([rng.choice([0, 4, 8]) for _ in range(m)],
+                        dtype=np.int64)
+        multi = np.array([rng.randrange(2) for _ in range(m)],
+                         dtype=np.uint8)
+        valid = np.array([1 if rng.random() > 0.1 else 0 for _ in range(m)],
+                         dtype=np.uint8)
+        gang = rng.randrange(2)
+        cf = rng.choice([0.0, 0.25, 0.5, 0.9, 1.0])
+        out = np.zeros(m)
+        ck.topo_pack(cont.ctypes.data, used.ctypes.data, total.ctypes.data,
+                     free.ctypes.data, chip.ctypes.data, multi.ctypes.data,
+                     valid.ctypes.data, m, gang, cf, out.ctypes.data)
+        for j in range(m):
+            exp = (cf * cont[j] + (1.0 - cf) *
+                   packing(multi[j], int(used[j]), int(total[j]),
+                           int(free[j]), int(chip[j]), gang)) \
+                if valid[j] else 0.0
+            assert out[j] == exp, (j, out[j], exp)
+
+
+# ---------------------------------------------------------------- the view
+def test_slice_usage_quacks_like_dict():
+    """_SliceUsage must be observationally identical to the dict it
+    replaces for every live consumer: .get (one- and two-arg),
+    __setitem__, truthiness, and copy-on-write isolation."""
+    rng = random.Random(5)
+    view, ref = _SliceUsage.empty(cap=2), {}
+    sids = [f"slice-{i}" for i in range(150)]
+    for _ in range(2000):
+        sid = rng.choice(sids)
+        op = rng.random()
+        if op < 0.6:
+            ut = (rng.randrange(-8, 64), rng.choice([0, 4, 8, 64]))
+            view[sid] = ut
+            ref[sid] = ut
+        elif op < 0.9:
+            assert view.get(sid) == ref.get(sid)
+            assert view.get(sid, (0, 0)) == ref.get(sid, (0, 0))
+        else:
+            assert bool(view) == bool(ref)
+            assert len(view) == len(ref)
+    for sid in sids:
+        assert view.get(sid) == ref.get(sid), sid
+    # COW: a copy diverges without touching its parent (the memo contract)
+    snap = view.copy()
+    before = {s: view.get(s) for s in sids}
+    snap["slice-3"] = (999, 999)
+    snap["brand-new"] = (1, 2)
+    assert {s: view.get(s) for s in sids} == before
+    assert view.get("brand-new") is None  # interned later than this view
+    assert snap.get("slice-3") == (999, 999)
+    assert snap.get("brand-new") == (1, 2)
+
+
+# ------------------------------------------------------------------- knobs
+def test_config_knobs(monkeypatch):
+    monkeypatch.delenv("YODA_NATIVE_COMMIT", raising=False)
+    monkeypatch.delenv("YODA_GIL_SWITCH_MS", raising=False)
+    assert SchedulerConfig().native_commit is False
+    assert SchedulerConfig().gil_switch_interval_ms == 1.0
+    monkeypatch.setenv("YODA_NATIVE_COMMIT", "1")
+    monkeypatch.setenv("YODA_GIL_SWITCH_MS", "2.5")
+    assert SchedulerConfig().native_commit is True
+    assert SchedulerConfig().gil_switch_interval_ms == 2.5
+    monkeypatch.setenv("YODA_GIL_SWITCH_MS", "garbage")
+    assert SchedulerConfig().gil_switch_interval_ms == 1.0
+    cfg = SchedulerConfig.from_profile({"pluginConfig": [{
+        "name": "yoda-tpu",
+        "args": {"nativeCommit": False, "gilSwitchIntervalMs": 0,
+                 "fleetProcesses": 2}}]})
+    assert cfg.native_commit is False
+    assert cfg.gil_switch_interval_ms == 0.0
+    assert cfg.fleet_processes == 2
+
+
+def test_memo_churn_counters():
+    """Satellite: the score-memo churn is a measured number — hit and
+    miss counters move under a steady burst (bench.run_serve_steady
+    derives the equilibrium hit-rate from these)."""
+    rng = random.Random(48_123)
+    cluster = build_cluster(rng)
+    sched = drive(cluster, build_burst(rng), nc=False)
+    c = sched.metrics.counters
+    assert c.get("score_memo_hits_total", 0) + \
+        c.get("score_memo_misses_total", 0) > 0

@@ -466,6 +466,72 @@ class TestAdmission:
             "workload_materialized_pods_total")
 
 
+# ============================ process-fleet handover re-derivation (ISSUE 17)
+class TestHandoverRederivation:
+    """A process-fleet lease handover reaches _admit with an EMPTY
+    coordinator-local claim registry even though the dead owner already
+    materialized the workload — the inheriting slot must re-derive
+    in-flight claims from cluster truth instead of duplicating pods."""
+
+    def test_fully_materialized_members_adopted(self):
+        cluster = _cluster()
+        # the dead owner materialized AND bound every member before
+        # dying; only the apiserver remembers
+        for i in range(2):
+            cluster.bind(Pod(f"ho-{i}", labels={"scv/number": "1"}),
+                         "t0", [(i, 0, 0)])
+        s = _sched(cluster)
+        w = _wl("ho", replicas=2)
+        s.submit_workload(w)
+        s.run_until_idle()
+        assert w.state == ADMITTED
+        assert s.metrics.counters.get(
+            "workload_handover_adoptions_total") == 1
+        # adopted, never re-materialized: no duplicate member pods
+        assert not s.metrics.counters.get(
+            "workload_materialized_pods_total")
+        assert any("adopted from cluster truth" in str(c)
+                   for c in w.conditions)
+
+    def test_partial_handover_completes_the_remainder(self):
+        """The dead owner created SOME members (still pending, visible
+        via the cluster's known-pod surface): the inheritor materializes
+        only the missing ones and charges the claim per-pod, never
+        duplicating what cluster truth already holds."""
+        cluster = _cluster()
+        s = _sched(cluster)
+        # wire-cluster surface: KubeCluster exposes known_pod_keys();
+        # emulate the dead owner's pending member on the FakeCluster
+        cluster.known_pod_keys = lambda: {"default/part-0"}
+        w = _wl("part", replicas=3)
+        s.submit_workload(w)
+        s.run_until_idle()
+        assert w.state == ADMITTED
+        assert s.metrics.counters.get(
+            "workload_handover_completions_total") == 1
+        # only the two MISSING members were materialized
+        assert s.metrics.counters.get(
+            "workload_materialized_pods_total") == 2
+        materialized = {p.key for p in cluster.all_pods()}
+        assert "default/part-0" not in materialized
+        assert {"default/part-1", "default/part-2"} <= materialized
+
+    def test_foreign_bound_member_still_rejected(self):
+        """Re-derivation must not weaken the destructive-collision
+        guard: SOME members bound by a foreign workload (not all) is
+        still a loud rejection, not a partial adoption."""
+        cluster = _cluster()
+        cluster.bind(Pod("col-0", labels={"scv/number": "1"}),
+                     "t0", [(0, 0, 0)])
+        s = _sched(cluster)
+        w = _wl("col", replicas=2)
+        s.submit_workload(w)
+        s.run_until_idle()
+        assert w.state == REJECTED
+        assert not s.metrics.counters.get(
+            "workload_handover_adoptions_total")
+
+
 # ================================= satellite 1: exact-at-pop DRF regression
 class TestAtPopDRF:
     def test_sharded_queue_built_only_under_drf(self):

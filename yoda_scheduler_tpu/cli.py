@@ -621,8 +621,16 @@ def cmd_serve(args) -> int:
     # negligible switch overhead at this thread count. Process-scoped
     # on purpose — set here, not in the library serve loop, so embedding
     # callers (bench, tests) choose their own interpreter settings.
-    sys.setswitchinterval(0.001)
+    # Knob (gilSwitchIntervalMs / YODA_GIL_SWITCH_MS): the quantum
+    # matters less as the hot path moves into GIL-releasing kernels
+    # (nativePlane scans, nativeCommit folds) — a cycle blocked in C
+    # yields the lock regardless of the interval — so operators running
+    # the native planes can raise it back toward the 5ms default and
+    # shed the context-switch overhead; 0 leaves the interpreter alone.
     profiles = load_profiles(args.config)
+    gil_ms = profiles[0][0].gil_switch_interval_ms
+    if gil_ms > 0:
+        sys.setswitchinterval(gil_ms / 1000.0)
     from .k8s.client import KubeClient, run_scheduler_against_cluster
 
     client = KubeClient.from_env(

@@ -2583,7 +2583,8 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
                                   metrics_port: int | None = 10251,
                                   leader_elect: bool = False,
                                   poll_s: float = 1.0,
-                                  stop_event: threading.Event | None = None) -> int:
+                                  stop_event: threading.Event | None = None,
+                                  proc_incarnation: int = 0) -> int:
     """The serve loop: leader-elect (optional), watch pending pods for
     EVERY configured profile, run scheduling cycles, bind through the API
     server. `profiles` is a list of (SchedulerConfig, enablement) pairs
@@ -2601,14 +2602,15 @@ def run_scheduler_against_cluster(client: KubeClient, profiles,
     cluster = KubeCluster(client, telemetry)
     cluster.start()
     try:
-        return _serve(client, cluster, profiles, metrics_port, poll_s, stop)
+        return _serve(client, cluster, profiles, metrics_port, poll_s, stop,
+                      proc_incarnation=proc_incarnation)
     finally:
         cluster.stop()  # join reflector threads; no orphaned watchers
 
 
 def _serve(client: KubeClient, cluster: KubeCluster, profiles,
            metrics_port, poll_s: float, stop: threading.Event,
-           out: dict | None = None) -> int:
+           out: dict | None = None, proc_incarnation: int = 0) -> int:
     from ..scheduler.multi import MultiProfileScheduler
 
     cluster.wait_synced()
@@ -2618,15 +2620,37 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     # constructor default when a profile configured otherwise
     cluster.bind_pipeline_window = max(
         getattr(profiles[0][0], "bind_pipeline_window", 0), 0)
-    if len(profiles) == 1 and profiles[0][0].fleet_replicas > 1:
+    cfg0 = profiles[0][0]
+    # fleet_proc_index >= 0 is the opt-in (ProcessFleet always sets it;
+    # a 1-process fleet still runs the slot architecture so the scaling
+    # curve's procs=1 leg measures the same code it scales)
+    proc_slot = (len(profiles) == 1 and cfg0.fleet_processes >= 1
+                 and cfg0.fleet_proc_index >= 0)
+    if proc_slot:
+        # process fleet (fleetProcesses): THIS process serves exactly one
+        # replica slot of an N-process fleet — FleetCoordinator keeps the
+        # fleet-wide shard/lease/identity math while building only slot
+        # fleet_proc_index. Intake below additionally partitions by
+        # accepts(), so sibling processes never race for the same pod
+        # (and the authority's 409 is the backstop if they ever do).
+        from ..scheduler.fleet import FleetCoordinator
+
+        sched = FleetCoordinator(cluster, cfg0, enabled=profiles[0][1],
+                                 replicas=cfg0.fleet_processes,
+                                 proc_index=cfg0.fleet_proc_index,
+                                 proc_incarnation=proc_incarnation)
+        sched.start(stop)
+        log.info("process-fleet slot %d/%d serving (incarnation %d)",
+                 cfg0.fleet_proc_index, cfg0.fleet_processes,
+                 proc_incarnation)
+    elif len(profiles) == 1 and cfg0.fleet_replicas > 1:
         # scheduler fleet: N engine replicas over the ONE shared watch
         # cache, each on its own thread, committing binds optimistically
         # (scheduler/fleet.py). Multi-profile configs keep the classic
         # co-hosted engines — a fleet is per-schedulerName.
         from ..scheduler.fleet import FleetCoordinator
 
-        sched = FleetCoordinator(cluster, profiles[0][0],
-                                 enabled=profiles[0][1])
+        sched = FleetCoordinator(cluster, cfg0, enabled=profiles[0][1])
         sched.start(stop)
         log.info("scheduler fleet: %d replicas (%s mode)",
                  sched.n, sched.mode)
@@ -2731,10 +2755,13 @@ def _serve(client: KubeClient, cluster: KubeCluster, profiles,
     seen: dict[str, str] = {}
     log.info("scheduler profiles %s serving against %s",
              list(sched.engines), client.base_url)
+    # process-fleet intake partition: only pods that hash to THIS slot
+    # (FleetCoordinator.accepts; identity-true for every other mode)
+    accepts = getattr(sched, "accepts", None) or (lambda p: True)
     while not stop.is_set():
         try:
             pending = [p for p in cluster.pending_pods()
-                       if sched.claims(p.scheduler_name)]
+                       if sched.claims(p.scheduler_name) and accepts(p)]
             pending_keys = {p.key for p in pending}
             for pod in pending:
                 if sched.tracks(pod.key):

@@ -545,6 +545,12 @@ class ColumnarTable:
         self._qual_cache[key] = entry
         return q, qc
 
+    def name_at(self, row: int) -> str:
+        """Node name for a table row (the inverse of `index`) — batch
+        scorers that must re-enter object-keyed memos (allocator
+        contiguity, slice usage) map their row indices back here."""
+        return self._names[row]
+
     def rows_for(self, infos):
         """Row indices for a list of NodeInfos; None when any name is
         unknown to the table (callers fall back to the scalar path)."""

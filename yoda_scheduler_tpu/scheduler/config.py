@@ -84,6 +84,47 @@ def _native_prefetch_default() -> bool:
         "0", "false", "off")
 
 
+def _native_commit_default() -> bool:
+    """Opt-in knob for the native COMMIT plane (scheduler/nativeplane.py
+    CommitKernels): topology packing scored in one GIL-releasing call,
+    the batch-commit candidate-removal shift fused with the score fold,
+    and the slice-usage patch carried on columnar arrays instead of
+    per-member dict copies. Default OFF; YODA_NATIVE_COMMIT=1 enables —
+    placements are bit-identical either way (parity fuzz in
+    tests/test_native_commit.py; CI runs tier-1 under both values)."""
+    return os.environ.get("YODA_NATIVE_COMMIT", "0").lower() in (
+        "1", "true", "on")
+
+
+def _fleet_procs_default() -> int:
+    """Process-fleet width (scheduler/fleet.py ProcessFleet): run this
+    many scheduler PROCESSES against the wire apiserver, nothing shared
+    but the authority (each process = one fleet replica with a global
+    index: sharded reflection, per-shard leases, fenced binds, 409
+    adoption). 0/1 (default, or env YODA_FLEET_PROCS unset) keeps the
+    in-process topology."""
+    raw = os.environ.get("YODA_FLEET_PROCS", "")
+    if not raw:
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
+def _gil_switch_default() -> float:
+    """Serve-path GIL switch interval in milliseconds (cli.cmd_serve used
+    to hardcode 1ms). YODA_GIL_SWITCH_MS overrides; 0 leaves the
+    interpreter default (5ms) untouched."""
+    raw = os.environ.get("YODA_GIL_SWITCH_MS", "")
+    if not raw:
+        return 1.0
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return 1.0
+
+
 def _trace_sampling_default() -> int:
     """Default pod sampling rate for lifecycle span tracing (utils/obs.py
     SpanRing): spans are recorded for 1-in-N pods (deterministic by pod
@@ -366,6 +407,15 @@ class SchedulerConfig:
     # change-log version vector (stale -> discarded and counted). Only
     # meaningful with the native plane active.
     native_prefetch: bool = field(default_factory=_native_prefetch_default)
+    # native COMMIT plane (scheduler/nativeplane.py CommitKernels over
+    # native/commitplane.cc): the per-pod Python left on the hot path
+    # after the fused scan — topology packing/blend per candidate, the
+    # batch-commit candidate-removal shift + score fold, the per-member
+    # slice-usage patch — runs as GIL-releasing C calls (arrays in,
+    # arrays out, op-for-op the scalar arithmetic). Off (default, or
+    # env YODA_NATIVE_COMMIT unset): the Python/numpy paths run
+    # end-to-end, bit-identical placements (the CI parity leg).
+    native_commit: bool = field(default_factory=_native_commit_default)
     # fragmentation-aware packing weight (plugins/score.py
     # FragmentationScore): steer 1-chip pods away from nodes whose free
     # set is down to its LAST pair, so 2-chip jobs keep finding pairs
@@ -421,6 +471,26 @@ class SchedulerConfig:
     # drop / local retry). 1 (or env YODA_FLEET unset) keeps the classic
     # single engine, bit-identical placements included.
     fleet_replicas: int = field(default_factory=_fleet_default)
+    # process fleet (scheduler/fleet.py ProcessFleet): run this many
+    # scheduler PROCESSES against the wire apiserver — each child is a
+    # full fleet replica with a GLOBAL index (identity, rng seed,
+    # preferred shards, gang routing all span the process fleet), its
+    # own sharded reflection and per-shard fenced leases, nothing
+    # shared but the authority. The parent supervises lifecycle
+    # (crash-restart re-enters through Scheduler.reconcile) and
+    # aggregates the per-process /metrics endpoints by scrape. 0/1
+    # keeps in-process topologies (fleetReplicas / scheduleHeads).
+    fleet_processes: int = field(default_factory=_fleet_procs_default)
+    # global index of THIS process within the process fleet (stamped by
+    # ProcessFleet on its children; -1 = not a process-fleet member).
+    # Drives the fleet coordinator's replica_base so identities, seeds
+    # and preferred shards are fleet-global, not per-process.
+    fleet_proc_index: int = -1
+    # serve-path GIL switch interval in ms (sys.setswitchinterval at
+    # cmd_serve startup): 1ms keeps watch-ingest p99 low when Python
+    # threads contend; matters less as scans/commits release the GIL
+    # (nativePlane/nativeCommit). 0 leaves the interpreter default.
+    gil_switch_interval_ms: float = field(default_factory=_gil_switch_default)
     # intra-replica parallel scheduling (scheduler/heads.py): run this
     # many scheduling HEADS inside one engine process, all pulling from
     # the SAME scheduling queue (multi-head pop, no double-consume) and
@@ -626,6 +696,8 @@ class SchedulerConfig:
                                        defaults.native_plane)),
             native_prefetch=bool(args.get("nativePrefetch",
                                           defaults.native_prefetch)),
+            native_commit=bool(args.get("nativeCommit",
+                                        defaults.native_commit)),
             fragmentation_weight=int(args.get(
                 "fragmentationWeight", defaults.fragmentation_weight)),
             batch_max_pods=max(int(args.get(
@@ -640,6 +712,13 @@ class SchedulerConfig:
                 "breakerCooldownSeconds", defaults.breaker_cooldown_s)),
             fleet_replicas=max(int(args.get(
                 "fleetReplicas", defaults.fleet_replicas)), 1),
+            fleet_processes=max(int(args.get(
+                "fleetProcesses", defaults.fleet_processes)), 0),
+            fleet_proc_index=int(args.get(
+                "fleetProcIndex", defaults.fleet_proc_index)),
+            gil_switch_interval_ms=max(float(args.get(
+                "gilSwitchIntervalMs",
+                defaults.gil_switch_interval_ms)), 0.0),
             schedule_heads=max(int(args.get(
                 "scheduleHeads", defaults.schedule_heads)), 1),
             head_dispatch_depth=max(int(args.get(

@@ -639,10 +639,36 @@ class Scheduler:
             except Exception:  # pragma: no cover - defensive: a broken
                 self._native = None  # ctypes env must not kill the engine
                 self._incremental = None
+        # native COMMIT plane (nativeplane.CommitKernels, nativeCommit
+        # knob): the per-candidate topology packing/blend as one
+        # GIL-releasing call, plus the incremental fold/refresh kernels
+        # even when the fused scan plane is off — the two knobs compose
+        # independently, and each degrades alone on a stale .so.
+        self._commitk = None
+        if self._columnar is not None and self.config.native_commit:
+            try:
+                from .nativeplane import CommitKernels, IncrementalKernels
+
+                self._commitk = CommitKernels.load()
+                if self._incremental is None:
+                    self._incremental = IncrementalKernels.load()
+            except Exception:  # pragma: no cover - defensive, as above
+                self._commitk = None
         if self._columnar is not None and self._incremental is not None:
             self._columnar.native_refresh = self._incremental
         self.metrics.set_gauge("native_plane_active",
                                1.0 if self._native is not None else 0.0)
+        self.metrics.set_gauge("native_commit_active",
+                               1.0 if self._commitk is not None else 0.0)
+        if self.config.native_commit:
+            # arm plugins carrying a commit-plane batch form (today:
+            # TopologyScore). Armed even when the .so lacks the kernels —
+            # the pure-Python half (in-place contribution patch, array
+            # usage map) stands on its own.
+            for p in list(self.profile.score) + list(self.profile.pre_score):
+                hook = getattr(p, "enable_commit_plane", None)
+                if hook is not None:
+                    hook(self._commitk)
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
@@ -2707,6 +2733,12 @@ class Scheduler:
         if hit is not None and hit[1] == mv_t and hit[3] == names_set:
             _, dirty_s = self._changes_since_vers(hit[0])
         cached_usage = hit[2] if hit is not None else {}
+        if scorers:
+            # equilibrium memo-churn gauge: how often steady-state
+            # arrivals land on a replayable score memo vs forcing a full
+            # rescore (bench.run_serve_steady folds these into a rate)
+            self.metrics.inc("score_memo_hits_total" if dirty_s is not None
+                             else "score_memo_misses_total")
         # columnar batch scoring: on memo-MISS cycles (first of a class,
         # maxima moved, candidate set changed) plugins exposing
         # score_batch evaluate ALL candidates in one array expression
@@ -2720,7 +2752,13 @@ class Scheduler:
         # memo-miss cycle that can use batch scoring (sync is idempotent
         # per version vector — the repair path usually already paid it)
         col_rows = None
-        if (nat is None and dirty_s is None and self._columnar is not None
+        # under the commit plane, batch scoring also arms on fused-native
+        # cycles: the fused kernel carries no topology term, so on a nat
+        # memo-miss TopologyScore would otherwise scalar-loop over every
+        # candidate — exactly the per-pod Python the plane removes (the
+        # sync below is a version-vector no-op there; the nat scan paid it)
+        if ((nat is None or self._commitk is not None) and dirty_s is None
+                and self._columnar is not None
                 and vers is not None and scorers):
             if self._columnar.sync(snapshot, vers, self._changes_since_vers):
                 col_rows = self._columnar.rows_for(feasible)

@@ -356,12 +356,26 @@ class FleetCoordinator:
                  validate_fence_locally: bool = True,
                  seed: int = 0,
                  rebalance_s: float | None = None,
-                 cluster_wrapper=None) -> None:
+                 cluster_wrapper=None,
+                 proc_index: int | None = None,
+                 proc_incarnation: int = 0) -> None:
         self.cluster = cluster
         self.config = config or SchedulerConfig()
         self.clock = clock or Clock()
         self.n = max(replicas if replicas is not None
                      else self.config.fleet_replicas, 1)
+        # process-fleet mode (fleetProcesses): this coordinator IS one
+        # replica slot of an N-process fleet — it builds only replica
+        # `proc_index` while keeping self.n = the FLEET size, so the
+        # preferred-shard math (s % n == idx), identities, and rng seeds
+        # come out identical to the threaded fleet's slot. Nothing is
+        # shared with sibling processes but the apiserver: leases fence,
+        # 409s adjudicate, accepts() partitions intake.
+        self.proc_index = (None if proc_index is None or proc_index < 0
+                           else proc_index)
+        if self.proc_index is not None and self.proc_index >= self.n:
+            raise ValueError(
+                f"fleetProcIndex {self.proc_index} >= fleet size {self.n}")
         self.mode = mode or self.config.fleet_mode
         if self.mode not in ("sharded", "free-for-all"):
             raise ValueError(f"unknown fleet mode {self.mode!r}")
@@ -449,8 +463,11 @@ class FleetCoordinator:
         # membership reconciliation adopts the dead owner's arrivals
         self._cap_provider = None
         self._cap_pools: tuple = ()
-        self.replicas: list[_Replica] = [
-            self._build_replica(i) for i in range(self.n)]
+        self.replicas: list[_Replica] = (
+            [self._build_replica(self.proc_index,
+                                 incarnation=proc_incarnation)]
+            if self.proc_index is not None
+            else [self._build_replica(i) for i in range(self.n)])
         sub = getattr(cluster, "subscribe", None)
         if sub is not None:
             sub(lambda ev: self.wake.set())
@@ -758,7 +775,35 @@ class FleetCoordinator:
     def claims(self, scheduler_name: str) -> bool:
         return scheduler_name == self.config.scheduler_name
 
+    def accepts(self, pod: Pod) -> bool:
+        """Process-fleet intake partition: each pod hashes to exactly ONE
+        process of the fleet (gang members ride their gang name, the
+        _route discipline, so assembly never splits across processes).
+        Advisory like tracks() — the authority's pod-level 409 is what
+        actually prevents a double bind if two processes ever disagree."""
+        if self.proc_index is None:
+            return True
+        gang = pod.labels.get(GANG_NAME_LABEL)
+        if gang:
+            # stable index mapping, the _route gang discipline
+            return shard_of(gang, self.n) == self.proc_index
+        s = shard_of(pod.key, self.shard_count)
+        if self.sharded and self.config.reflector_sharding:
+            # mirror _route: only shards whose pools hold nodes may own
+            # intake — a pod keyed onto a pool-less shard would strand
+            # forever on a process whose sharded view has no capacity
+            # (pools hash coarsely; a small cluster can land every pool
+            # on one shard)
+            pop = self._populated_shards()
+            if pop:
+                s = pop[s % len(pop)]
+        return s % self.n == self.proc_index
+
     def _route(self, pod: Pod) -> _Replica:
+        if self.proc_index is not None:
+            # this process IS one replica slot; accepts() already
+            # partitioned intake, so everything submitted here is ours
+            return self.replicas[0]
         # gangs ride their gang name in EVERY mode: gang state (permit
         # parking, slice plans) is engine-local, so members split across
         # replicas would each wait forever for peers the other engine
@@ -931,8 +976,12 @@ class FleetCoordinator:
         bn = getattr(self.cluster, "bound_node_of", None)
         m = self.replicas[0].engine.metrics
         for pod in pods:
+            # process fleets reconcile only their OWN partition: every
+            # sibling process runs this same pass at startup, and without
+            # the accepts() guard each would adopt/requeue the whole
+            # cluster's pods onto its one local replica
             if pod.scheduler_name != self.config.scheduler_name \
-                    or self.tracks(pod.key):
+                    or not self.accepts(pod) or self.tracks(pod.key):
                 continue
             node = bn(pod.key) if bn is not None else None
             if node is not None:
@@ -1204,3 +1253,215 @@ class FleetCoordinator:
         out["authority_rejections"] = dict(
             getattr(self.cluster, "bind_conflicts", {}) or {})
         return out
+
+
+# ======================================================================
+# process fleet (fleetProcesses): real OS processes, off the GIL
+# ======================================================================
+#
+# The threaded fleet shares one interpreter, so N replicas still share
+# ONE GIL: past the native-kernel fraction, cycles serialize. A process
+# fleet runs each replica slot as its own OS process — own interpreter,
+# own GIL, own watch cache — against the same wire apiserver. The fleet
+# grammar already assumed nothing shared but the authority (sharded
+# reflection, per-shard leases, pipelined bind wire, 409 adoption), so
+# the slot inside each child is just FleetCoordinator(proc_index=i):
+# identities, preferred shards, and rng seeds come out identical to the
+# threaded fleet's slot i. Intake partitions by accepts() (crc32 over
+# pod key / gang name), restarts re-derive a slot's partition from
+# cluster truth through the ordinary startup reconcile, and the only
+# cross-process metric plane is the per-child /metrics pull.
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_prom(text: str) -> dict[str, float]:
+    """Prometheus text exposition -> {series: value} (labels kept in the
+    key so per-labelset series aggregate independently)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _fleet_proc_main(server_url: str, config: SchedulerConfig, enabled,
+                     idx: int, total: int, incarnation: int,
+                     metrics_port: int, poll_s: float) -> None:
+    """Child-process entry (spawn target): serve ONE replica slot of a
+    process fleet against the wire apiserver at `server_url`. Runs until
+    the parent terminates the process — all durable state lives on the
+    server, so teardown needs no handshake."""
+    import sys
+
+    from ..k8s.client import KubeClient, run_scheduler_against_cluster
+
+    cfg = config.with_(fleet_processes=total, fleet_proc_index=idx)
+    if cfg.gil_switch_interval_ms > 0:
+        # children bypass cli.cmd_serve, so the knob is applied here too
+        sys.setswitchinterval(cfg.gil_switch_interval_ms / 1000.0)
+    client = KubeClient(server_url)
+    run_scheduler_against_cluster(client, [(cfg, enabled)],
+                                  metrics_port=metrics_port,
+                                  poll_s=poll_s,
+                                  proc_incarnation=incarnation)
+
+
+class ProcessFleet:
+    """Parent-side controller: spawn `procs` OS processes, each one
+    replica slot of the fleet, against the wire apiserver; restart
+    crashed children with a bumped incarnation (their startup reconcile
+    re-derives the slot's partition from cluster truth); aggregate the
+    shared-nothing metrics plane by scraping each child's /metrics."""
+
+    def __init__(self, server_url: str, config: SchedulerConfig,
+                 procs: int | None = None, enabled: dict | None = None,
+                 poll_s: float = 0.25, restart: bool = True,
+                 max_restarts: int = 16) -> None:
+        import multiprocessing
+
+        self.server_url = server_url
+        self.config = config
+        self.n = max(procs if procs is not None
+                     else config.fleet_processes, 1)
+        self.enabled = enabled
+        self.poll_s = poll_s
+        self.restart_enabled = restart
+        # spawn, never fork: the parent holds live HTTP connections and
+        # threads (bench harness, test runner) a forked child would
+        # inherit mid-state; spawn re-imports, which is also what a real
+        # process manager (systemd, kubelet) does
+        self._ctx = multiprocessing.get_context("spawn")
+        self.procs: list = [None] * self.n
+        self.ports = [_free_port() for _ in range(self.n)]
+        self.incarnations = [0] * self.n
+        self.restarts = 0
+        # crash-loop cap: a child that cannot start (bad config, broken
+        # spawn environment) would otherwise restart forever
+        self.max_restarts = max_restarts
+        self._stop = threading.Event()
+        self._monitor: threading.Thread | None = None
+
+    def _spawn(self, idx: int) -> None:
+        p = self._ctx.Process(
+            target=_fleet_proc_main,
+            args=(self.server_url, self.config, self.enabled, idx,
+                  self.n, self.incarnations[idx], self.ports[idx],
+                  self.poll_s),
+            daemon=True, name=f"yoda-proc-{idx}")
+        p.start()
+        self.procs[idx] = p
+
+    def start(self) -> "ProcessFleet":
+        for i in range(self.n):
+            self._spawn(i)
+        if self.restart_enabled:
+            self._monitor = threading.Thread(target=self._watch,
+                                             daemon=True,
+                                             name="proc-fleet-monitor")
+            self._monitor.start()
+        return self
+
+    def _watch(self) -> None:
+        while not self._stop.wait(0.25):
+            for i, p in enumerate(self.procs):
+                if p is None or p.is_alive() or self._stop.is_set():
+                    continue
+                if self.restarts >= self.max_restarts:
+                    log.error("fleet process %d died (exit %s) but the "
+                              "restart budget (%d) is spent — crash "
+                              "loop, giving up on this slot", i,
+                              p.exitcode, self.max_restarts)
+                    self.procs[i] = None
+                    continue
+                self.incarnations[i] += 1
+                self.restarts += 1
+                log.warning("fleet process %d died (exit %s); "
+                            "restarting as incarnation %d", i,
+                            p.exitcode, self.incarnations[i])
+                self._spawn(i)
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        """Block until every child's /metrics answers (the metrics server
+        starts after the child's cluster cache syncs and reconcile ran —
+        answering means the slot is serving)."""
+        deadline = time.time() + timeout
+        pending = set(range(self.n))
+        while pending and time.time() < deadline:
+            for i in list(pending):
+                if self._scrape_raw(i) is not None:
+                    pending.discard(i)
+            if pending:
+                time.sleep(0.25)
+        if pending:
+            raise TimeoutError(
+                f"fleet processes never became ready: {sorted(pending)}")
+
+    def _scrape_raw(self, idx: int) -> str | None:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.ports[idx]}/metrics",
+                    timeout=2.0) as r:
+                return r.read().decode()
+        except Exception:
+            return None
+
+    def scrape(self) -> list[dict[str, float]]:
+        """Per-process parsed /metrics; a dead or mid-restart child
+        contributes an empty dict (the aggregate is a live pull, exactly
+        like a Prometheus scrape of a real fleet)."""
+        out = []
+        for i in range(self.n):
+            raw = self._scrape_raw(i)
+            out.append(_parse_prom(raw) if raw else {})
+        return out
+
+    def aggregate(self) -> dict[str, float]:
+        """Fleet-wide series sums over the per-process scrapes — the
+        shared-nothing answer to fleet_stats(): counters add; gauges add
+        too (callers that need per-slot gauges read scrape())."""
+        agg: dict[str, float] = {}
+        for d in self.scrape():
+            for k, v in d.items():
+                agg[k] = agg.get(k, 0.0) + v
+        return agg
+
+    @staticmethod
+    def series_sum(scraped: dict[str, float], name: str,
+                   prefix: str = "yoda_tpu_") -> float:
+        """Sum every labelset of one metric family in a parsed scrape
+        (the merged fleet view labels series per replica/head)."""
+        full = prefix + name
+        return sum(v for k, v in scraped.items()
+                   if k == full or k.startswith(full + "{"))
+
+    def kill(self, idx: int) -> None:
+        """Chaos hook: SIGKILL one child mid-serve (no cleanup, no
+        goodbye — the crash the restart monitor exists for)."""
+        p = self.procs[idx]
+        if p is not None and p.is_alive():
+            p.kill()
+            p.join(timeout=10)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        for p in self.procs:
+            if p is not None and p.is_alive():
+                p.terminate()
+        for p in self.procs:
+            if p is not None:
+                p.join(timeout=10)

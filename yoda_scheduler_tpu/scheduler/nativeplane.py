@@ -166,6 +166,38 @@ class IncrementalKernels:
             totals.ctypes.data, ties.ctypes.data)
 
 
+_COMMIT_ABI = 1
+
+
+class CommitKernels:
+    """ctypes bridge to the commit-plane kernels (commitplane.cc),
+    gated by the `nativeCommit` knob. Today's one kernel is the
+    topology packing/blend batch twin — the last per-candidate Python
+    loop on the hot path once the fused scan and the incremental
+    fold/refresh are native. Bound behind its own ABI handshake so a
+    stale .so degrades exactly this plane back to the scalar
+    TopologyScore.score path (parity: tests/test_native_commit.py)."""
+
+    __slots__ = ("topo_pack",)
+
+    def __init__(self, lib) -> None:
+        # c_void_p pointer params: callers pass plain .ctypes.data ints,
+        # same convention as IncrementalKernels
+        self.topo_pack = lib.yoda_topo_pack
+
+    @classmethod
+    def load(cls) -> "CommitKernels | None":
+        vp = ctypes.c_void_p
+        lib = nativeloader.bind_symbols({
+            "yoda_commit_abi": (_i64, []),
+            "yoda_topo_pack": (None, [vp, vp, vp, vp, vp, vp, vp,
+                                      _i64, _i64, _f64, vp]),
+        })
+        if lib is None or lib.yoda_commit_abi() != _COMMIT_ABI:
+            return None
+        return cls(lib)
+
+
 class FusedPlane:
     """Loaded fused kernel + its prefetch worker."""
 

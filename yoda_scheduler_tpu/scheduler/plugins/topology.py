@@ -32,7 +32,75 @@ from ...utils.labels import WorkloadSpec
 from .allocator import ChipAllocator, _node_shape
 from .prescore import SPEC_KEY
 
+try:  # commit-plane batch path only; the scalar path needs no numpy
+    import numpy as np
+except Exception:  # pragma: no cover - numpy-less install
+    np = None
+
 SLICE_USE_KEY = "slice_usage"
+
+
+class _SliceUsage:
+    """Array-backed slice-usage map (nativeCommit plane): the per-slice
+    (used, total) sums as two int64 arrays over an APPEND-ONLY shared
+    slice-id intern, so the copy-on-write the engine's memo contract
+    demands (each batch member and each cycle must publish its own
+    snapshot) is three memcpys instead of a ~#slices dict rebuild.
+    Quacks like the dict it replaces for every live consumer: .get
+    returns the same (int, int) tuples (the engine's memo compares and
+    score()'s pack key hash them), __setitem__ serves _patch, truthiness
+    via __len__, and copy() is the COW point — a published view is never
+    mutated afterwards (pre_score/pre_score_update copy BEFORE patching,
+    exactly like the dict form)."""
+
+    __slots__ = ("_intern", "_used", "_total", "_has", "_count")
+
+    def __init__(self, intern_map, used, total, has, count):
+        self._intern = intern_map  # shared across copies; only grows
+        self._used = used
+        self._total = total
+        self._has = has
+        self._count = count
+
+    @classmethod
+    def empty(cls, cap: int = 64) -> "_SliceUsage":
+        return cls({}, np.zeros(cap, dtype=np.int64),
+                   np.zeros(cap, dtype=np.int64),
+                   np.zeros(cap, dtype=np.uint8), 0)
+
+    def get(self, sid, default=None):
+        i = self._intern.get(sid)
+        # the intern map outgrows older views (it is shared); an index
+        # past this view's arrays is a slice this view never held
+        if i is None or i >= len(self._has) or not self._has[i]:
+            return default
+        return (int(self._used[i]), int(self._total[i]))
+
+    def __setitem__(self, sid, ut) -> None:
+        i = self._intern.get(sid)
+        if i is None:
+            i = len(self._intern)
+            self._intern[sid] = i
+        if i >= len(self._used):
+            grow = max(len(self._used) * 2, i + 1)
+            for name in ("_used", "_total", "_has"):
+                old = getattr(self, name)
+                arr = np.zeros(grow, dtype=old.dtype)
+                arr[:len(old)] = old
+                setattr(self, name, arr)
+        if not self._has[i]:
+            self._has[i] = 1
+            self._count += 1
+        self._used[i] = ut[0]
+        self._total[i] = ut[1]
+
+    def __len__(self) -> int:
+        return self._count
+
+    def copy(self) -> "_SliceUsage":
+        return _SliceUsage(self._intern, self._used.copy(),
+                           self._total.copy(), self._has.copy(),
+                           self._count)
 
 
 class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
@@ -76,6 +144,21 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
         # map, per-node contributions) — repaired from the engine's change
         # logs instead of rescanning 1000 nodes per cycle
         self._usage_state: tuple | None = None
+        # nativeCommit plane (engine arms via enable_commit_plane):
+        # _commit_plane switches the pure-Python half on (in-place
+        # contribution patch, _SliceUsage array map); _nk carries the
+        # CommitKernels bridge for score_batch, None when the .so lacks
+        # the commit ABI (batch scoring then stays scalar)
+        self._commit_plane = False
+        self._nk = None
+        self._batch_bufs: tuple | None = None
+
+    def enable_commit_plane(self, kernels) -> None:
+        """Arm the nativeCommit plane for this plugin instance (engine
+        init, per head — instances are never shared across heads, so the
+        in-place patch needs no lock)."""
+        self._commit_plane = np is not None
+        self._nk = kernels if np is not None else None
 
     def forget_nodes(self, gone: set[str]) -> None:
         for n in gone:
@@ -103,15 +186,26 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
             _, dirty = cb(cvers)
             if dirty is not None and vers is not None:
                 if dirty:
-                    usage = dict(usage)
-                    contrib = dict(contrib)
+                    usage = usage.copy()
+                    if self._commit_plane:
+                        # commit plane: contrib never leaves this plugin
+                        # (_usage_state is its only holder), so patch it
+                        # in place — copying the per-node map (one entry
+                        # per slice host, ~50k at fleet scale) every
+                        # dirty cycle was pre-score's dominant cost.
+                        # Torn guard: drop the memo across the loop so
+                        # an exception mid-patch forces a full walk next
+                        # cycle instead of serving a half-patched map.
+                        self._usage_state = None
+                    else:
+                        contrib = dict(contrib)
                     for name in dirty:
                         node = snapshot.get(name) if snapshot else None
                         self._patch(usage, contrib, name, node)
                 self._usage_state = (vers, usage, contrib)
                 state.write(SLICE_USE_KEY, usage)
                 return Status.success()
-        usage = {}
+        usage = _SliceUsage.empty() if self._commit_plane else {}
         contrib: dict[str, tuple] = {}
         for node in nodes:
             c = self._contribution(node)
@@ -155,11 +249,12 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
             return False
         _, usage, contrib = self._usage_state
         # usage is COPIED: references escape into cycle state and the
-        # engine's score memo, which must see this member's snapshot.
+        # engine's score memo, which must see this member's snapshot
+        # (under the commit plane the copy is the _SliceUsage memcpy).
         # contrib never leaves this plugin (_usage_state is its only
         # holder), so the one-key patch mutates it in place — copying
         # its per-node map per batch member was the hook's main cost.
-        usage = dict(usage)
+        usage = usage.copy()
         self._patch(usage, contrib, node_info.name, node_info)
         self._usage_state = (vers, usage, contrib)
         state.write(SLICE_USE_KEY, usage)
@@ -201,6 +296,63 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
             self._pack_cache[node.name] = (pkey, packing)
         s = self.contiguity_frac * cont + (1.0 - self.contiguity_frac) * packing
         return s, Status.success()
+
+    def score_batch(self, state: CycleState, pod, table, rows):
+        """Commit-plane batch form of `score` (nativeCommit knob): one
+        Python gather pass re-enters the memoised inputs (allocator
+        contiguity — itself native underneath — free sets, slice usage),
+        then a single GIL-releasing yoda_topo_pack call computes the
+        packing/blend for every candidate. commitplane.cc mirrors
+        `_packing` op-for-op, so the floats agree bit-for-bit with the
+        scalar path (parity: tests/test_native_commit.py). None when the
+        plane is unarmed or the .so lacks the commit ABI."""
+        nk = self._nk
+        if nk is None:
+            return None
+        snapshot = state.read_or("snapshot")
+        if snapshot is None:
+            return None
+        spec: WorkloadSpec = state.read(SPEC_KEY)
+        m_rows = len(rows)
+        bufs = self._batch_bufs
+        if bufs is None or len(bufs[0]) < m_rows:
+            cap = max(m_rows, 256)
+            bufs = (np.empty(cap, dtype=np.float64),   # cont
+                    np.empty(cap, dtype=np.int64),     # slice used
+                    np.empty(cap, dtype=np.int64),     # slice total
+                    np.empty(cap, dtype=np.int64),     # free chips
+                    np.empty(cap, dtype=np.int64),     # chip count
+                    np.empty(cap, dtype=np.uint8),     # multi-host slice
+                    np.empty(cap, dtype=np.uint8),     # metrics present
+                    np.empty(cap, dtype=np.float64))   # out
+            self._batch_bufs = bufs
+        cont, used, total, free_c, chip_c, multi, valid, out = bufs
+        usage_map = state.read_or(SLICE_USE_KEY, {})
+        alloc = self.allocator
+        chips = spec.chips
+        for j in range(m_rows):
+            node = snapshot.get(table.name_at(rows[j]))
+            m = node.metrics if node is not None else None
+            if m is None:
+                # scalar path's `if m is None: return 0.0` early-out
+                valid[j] = 0
+                continue
+            valid[j] = 1
+            cont[j] = alloc.contiguity(node, chips)
+            u, t = usage_map.get(m.slice_id, (0, 0)) \
+                if m.slice_id else (0, 0)
+            used[j] = u
+            total[j] = t
+            free_c[j] = len(alloc.free_coords(node))
+            chip_c[j] = m.chip_count
+            multi[j] = 1 if (m.slice_id and m.num_hosts > 1) else 0
+        nk.topo_pack(cont.ctypes.data, used.ctypes.data,
+                     total.ctypes.data, free_c.ctypes.data,
+                     chip_c.ctypes.data, multi.ctypes.data,
+                     valid.ctypes.data, m_rows,
+                     1 if spec.is_gang else 0,
+                     float(self.contiguity_frac), out.ctypes.data)
+        return out[:m_rows]
 
     def _packing(self, m, node: NodeInfo, usage: tuple[int, int],
                  is_gang: bool) -> float:

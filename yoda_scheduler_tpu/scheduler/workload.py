@@ -672,6 +672,34 @@ class WorkloadAdmission:
             self.metrics.inc("workload_admission_dedup_total")
             return
         demand = w.demand()
+        # re-derive in-flight claims from CLUSTER truth before touching
+        # anything: the claim-once registry above is coordinator-local,
+        # so a PROCESS-fleet lease handover (old owner dead, new process
+        # inherits shard 0) reaches here with an empty registry even
+        # though the dead owner already materialized this workload. The
+        # members it created are on the apiserver — adopt them instead
+        # of re-materializing duplicates.
+        member_keys = w.member_keys()[1]
+        known_fn = getattr(self.engine.cluster, "known_pod_keys", None)
+        existing: set = set()
+        if known_fn is not None:
+            existing = set(known_fn()) & set(member_keys)
+        else:
+            bn0 = getattr(self.engine.cluster, "bound_node_of", None)
+            if bn0 is not None:
+                existing = {k for k in member_keys if bn0(k) is not None}
+        if existing and len(existing) == len(member_keys):
+            w.state = ADMITTED
+            w.set_condition("Admitted", "True", REASON_ADMITTED,
+                            "members already materialized by prior "
+                            "owner (adopted from cluster truth)", now)
+            self._remember(w)
+            self._refresh_progress(w)
+            self.metrics.inc("workload_handover_adoptions_total")
+            self.flight.record("workload_adopted", workload=w.key,
+                               members=len(existing))
+            self._push_status(w)
+            return
         bn = getattr(self.engine.cluster, "bound_node_of", None)
         if bn is not None and any(bn(k) is not None
                                   for k in w.member_keys()[1]):
@@ -694,6 +722,12 @@ class WorkloadAdmission:
             self._push_status(w)
             return
         pods = w.materialize()
+        if existing:
+            # partial handover: the dead owner materialized only SOME
+            # members before dying — complete the remainder; never
+            # duplicate what cluster truth already holds
+            pods = [p for p in pods if p.key not in existing]
+            self.metrics.inc("workload_handover_completions_total")
         w.state = ADMITTED
         w.set_condition(
             "Admitted", "True", REASON_ADMITTED,
@@ -705,7 +739,8 @@ class WorkloadAdmission:
         # the claim charges PER-POD demand x the unbound remainder:
         # the book already counts bound members, so a full-demand
         # charge would double-count every bind until the last one
-        per_pod = (demand[0] // len(pods), demand[1] // len(pods))
+        n_total = max(len(member_keys), 1)
+        per_pod = (demand[0] // n_total, demand[1] // n_total)
         self._inflight[w.key] = [w.tenant, per_pod, now + ttl,
                                  [p.key for p in pods]]
         for p in pods:
