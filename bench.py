@@ -851,6 +851,168 @@ def run_elastic_tier(units: int = 4) -> dict:
     }
 
 
+# --------------------- geometric torus placement (ISSUE 18) ----------------
+def run_torus_leg(torus: bool) -> dict:
+    """The torus A/B scenario: two 8x8x1 v4 slices (4x4x1 host grids,
+    16 hosts x 4 chips), every host dented by one 1-chip stray — zero
+    whole hosts anywhere — then two 8-member whole-host gangs arrive.
+    Without geometry there are no standalone nodes to move strays to
+    and no intra-slice strategy, so the defrag loop bails and every
+    gang member strands. With torusPlacement on, torus reassembly
+    compacts the strays into the grid's low corner, whole hosts
+    reassemble as a carvable block, and the carve binds both gangs."""
+    store = TelemetryStore()
+    now = time.time()
+    # one slice per generation: each gang pins its generation, so both
+    # the carve and the legacy plan are confined to ONE slice — the A/B
+    # measures single-slice geometric recovery, not cross-slice spill
+    gens = ("v4", "v5p")
+    for i, gen in enumerate(gens):
+        for m in make_slice(f"ts{i}", "8x8x1", generation=gen):
+            m.heartbeat = now + 1e8
+            store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg = SchedulerConfig(
+        telemetry_max_age_s=1e9, torus_placement=torus,
+        defrag_interval_s=5.0, defrag_cooldown_s=60.0,
+        max_migrations_per_pass=8, pod_hinted_backoff_s=30.0,
+        max_attempts=12, gang_timeout_s=30.0)
+    sched = Scheduler(cluster, cfg, clock=HybridClock())
+    strays = 0
+    for i in range(2):
+        for h in range(16):
+            _bind_seed_pod(cluster, f"tstray{i}-{h}", f"ts{i}-host-{h}",
+                           1, labels={"scv/number": "1",
+                                      "tpu/accelerator": "tpu"})
+            strays += 1
+    members = []
+    for gi, gen in enumerate(gens):
+        members.extend(Pod(f"tg{gi}-w{k}", labels={
+            "tpu/gang-name": f"tg{gi}", "tpu/gang-size": "8",
+            "scv/number": "4", "tpu/accelerator": "tpu",
+            "tpu/generation": gen})
+            for k in range(8))
+    t0 = time.perf_counter()
+    for p in members:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=50_000)
+    wall = time.perf_counter() - t0
+    bound = sum(p.phase == PodPhase.BOUND for p in members)
+    c = sched.metrics.counters
+    carves = c.get("torus_carves_total", 0)
+    gbps = c.get("torus_carve_bisection_gbps_sum", 0.0)
+    return {
+        "hosts": len(cluster.node_names()),
+        "seed_strays": strays,
+        "gang_members_submitted": len(members),
+        "gang_members_bound": bound,
+        "gang_members_stranded": len(members) - bound,
+        "torus_carves": carves,
+        "multislice_plans": c.get("torus_multislice_plans_total", 0),
+        "mean_carved_bisection_gbps": (round(gbps / carves, 1)
+                                       if carves else 0.0),
+        "wall_s": round(wall, 2),
+        **defrag_stats(sched),
+    }
+
+
+def run_carve_leg() -> dict:
+    """Direct carve placement: a dented 8x8x1 v4 slice (two interior
+    hosts pinned by unevictable residents) takes an 8-member whole-host
+    gang. The carve must land the gang as ONE contiguous block of the
+    free host grid and the bisection metric records the block's ICI
+    cut. (The recovery A/B above exercises progressive legacy assembly
+    — members trickle in as reassembly frees hosts, where the carver
+    deliberately stays out; this leg measures the carve path itself.)"""
+    from yoda_scheduler_tpu.topology.carve import carve_block, host_coord
+
+    store = TelemetryStore()
+    now = time.time()
+    for m in make_slice("cs", "8x8x1", generation="v4"):
+        m.heartbeat = now + 1e8
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    sched = Scheduler(cluster, SchedulerConfig(
+        telemetry_max_age_s=1e9, torus_placement=True,
+        gang_timeout_s=30.0), clock=HybridClock())
+    for h in (5, 6):  # interior dents: the carve must route around them
+        _bind_seed_pod(cluster, f"pin{h}", f"cs-host-{h}", 4,
+                       labels={"scv/number": "4", "scv/priority": "9",
+                               "tpu/accelerator": "tpu"})
+    gang = [Pod(f"cg-w{k}", labels={
+        "tpu/gang-name": "cg", "tpu/gang-size": "8",
+        "scv/number": "4", "tpu/accelerator": "tpu"}) for k in range(8)]
+    for p in gang:
+        sched.submit(p)
+    sched.run_until_idle(max_cycles=20_000)
+    bound = sum(p.phase == PodPhase.BOUND for p in gang)
+    coords = frozenset(
+        host_coord(int(p.node.rsplit("-host-", 1)[1]), (4, 4, 1))
+        for p in gang if p.node)
+    out = carve_block((4, 4, 1), coords, 8) if len(coords) == 8 else None
+    c = sched.metrics.counters
+    carves = c.get("torus_carves_total", 0)
+    gbps = c.get("torus_carve_bisection_gbps_sum", 0.0)
+    return {
+        "gang_members_bound": bound,
+        "contiguous_block": bool(out is not None and out[2] == coords),
+        "torus_carves": carves,
+        "mean_carved_bisection_gbps": (round(gbps / carves, 1)
+                                       if carves else 0.0),
+    }
+
+
+def run_carve_kernel_bench(trials: int = 300) -> dict:
+    """Carve-search microbench: the same randomized (grid, free, n)
+    cases through the scalar reference and the native kernel
+    (native/carveplane.cc). Parity is the test suite's job; this leg
+    records the speedup as a fact for PERFORMANCE.md."""
+    from yoda_scheduler_tpu.topology import carvenative
+    from yoda_scheduler_tpu.topology.carve import carve_block
+
+    rng = random.Random(18)
+    cases = []
+    for _ in range(trials):
+        grid = (4, 4, 4)
+        free = frozenset(
+            (x, y, z) for x in range(4) for y in range(4)
+            for z in range(4) if rng.random() < 0.7)
+        cases.append((grid, free, rng.randint(1, 16)))
+
+    def run(plane):
+        t0 = time.perf_counter()
+        for grid, free, n in cases:
+            carve_block(grid, free, n, plane=plane)
+        return (time.perf_counter() - t0) * 1e6 / trials
+
+    scalar_us = run("scalar")
+    out = {"trials": trials, "scalar_us_per_carve": round(scalar_us, 1),
+           "native_available": carvenative.available()}
+    if carvenative.available():
+        native_us = run("native")
+        out["native_us_per_carve"] = round(native_us, 1)
+        out["native_speedup"] = round(scalar_us / max(native_us, 1e-9), 1)
+    return out
+
+
+def run_torus_tier() -> dict:
+    """The committed torus artifact: geometric-vs-naive gang recovery
+    on the stray-dented slice fleet plus the carve-kernel microbench.
+    CI fences read these numbers."""
+    naive = run_torus_leg(torus=False)
+    geo = run_torus_leg(torus=True)
+    return {
+        "naive": naive,
+        "geometric": geo,
+        "members_recovered": (geo["gang_members_bound"]
+                              - naive["gang_members_bound"]),
+        "carve": run_carve_leg(),
+        "carve_kernel": run_carve_kernel_bench(),
+    }
+
+
 # ------------------- workload-tier admission (ISSUE 13) --------------------
 def _admission_cluster(nodes=50, chips=4):
     store = TelemetryStore()
@@ -2309,6 +2471,14 @@ def main():
             elastic = run_elastic_tier()
         except Exception as e:  # must never sink the run
             elastic = {"error": repr(e)}
+    # geometric torus placement (stray-dented slice A/B + carve-kernel
+    # microbench); opt out with YODA_BENCH_NO_TORUS=1
+    torus = {}
+    if not os.environ.get("YODA_BENCH_NO_TORUS"):
+        try:
+            torus = run_torus_tier()
+        except Exception as e:  # must never sink the run
+            torus = {"error": repr(e)}
     # workload-tier admission (million-pod backlog as 10k parked
     # workloads); opt out with YODA_BENCH_NO_ADMISSION=1
     admission = {}
@@ -2345,6 +2515,7 @@ def main():
         "serve_fleet": serve_fleet,
         "fairness": fairness,
         "elastic": elastic,
+        "torus": torus,
         "admission": admission,
         "capacity": capacity,
     }
@@ -2357,6 +2528,7 @@ def main():
             and serve_fleet and "error" not in serve_fleet
             and fairness and "error" not in fairness
             and elastic and "error" not in elastic
+            and torus and "error" not in torus
             and admission and "error" not in admission
             and capacity and "error" not in capacity):
         full_path = os.path.join(
@@ -2440,6 +2612,22 @@ def main():
             "migrations": s["defrag_on"]["defrag_migrations"],
         }
 
+    def torus_summary(s):
+        if not s or "geometric" not in s:
+            return s or {}
+        geo, kern = s["geometric"], s.get("carve_kernel", {})
+        carve = s.get("carve", {})
+        return {
+            "naive_bound": s["naive"]["gang_members_bound"],
+            "geometric_bound": geo["gang_members_bound"],
+            "geometric_stranded": geo["gang_members_stranded"],
+            "members_recovered": s["members_recovered"],
+            "carve_contiguous": carve.get("contiguous_block"),
+            "mean_carved_bisection_gbps":
+                carve.get("mean_carved_bisection_gbps"),
+            "carve_native_speedup": kern.get("native_speedup"),
+        }
+
     def admission_summary(s):
         if not s or "total_pods" not in s:
             return s or {}
@@ -2488,6 +2676,7 @@ def main():
         "serve_fleet": fleet_summary(serve_fleet),
         "fairness": fairness_summary(fairness),
         "elastic": elastic_summary(elastic),
+        "torus": torus_summary(torus),
         "admission": admission_summary(admission),
         "full_detail": "BENCH_FULL.json",
     }))

@@ -1,0 +1,130 @@
+"""Torus carve-map explorer (ISSUE 18): what the geometric placer sees.
+
+Renders a slice's HOST grid (topology/carve.py `host_grid` — the torus
+the carver reasons about, one cell per host) as ASCII layers, then runs
+the same `carve_block` the scheduler runs and marks the carved block:
+
+    .  free host          #  occupied host          C  carved host
+
+Occupancy comes from --occupied (explicit host indices, the order
+make_slice assigns them) or --density/--seed (reproducible random
+dents). The footer reports the carve's origin/shape, its ICI bisection
+(links x the generation's per-link GB/s), the largest still-carvable
+block before and after, and which plane (scalar/numpy/native) served
+the call — so a stranded-gang report can be reproduced as one command:
+
+    python tools/carvemap.py --generation v4 --slice 8x8x1 --gang 4 \
+        --occupied 5,6
+    python tools/carvemap.py --generation v5p --slice 4x4x4 --gang 8 \
+        --density 0.4 --seed 7 --plane scalar
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from yoda_scheduler_tpu.topology import carve as C  # noqa: E402
+from yoda_scheduler_tpu.topology.generations import generation  # noqa: E402
+
+
+def parse_occupied(spec: str, grid) -> frozenset:
+    """Host indices ("5,6" or "5;6") -> host-grid coordinates."""
+    idxs = [int(tok) for tok in spec.replace(";", ",").split(",") if tok]
+    vol = grid[0] * grid[1] * grid[2]
+    bad = [i for i in idxs if not 0 <= i < vol]
+    if bad:
+        raise SystemExit(f"host index {bad[0]} outside 0..{vol - 1}")
+    return frozenset(C.host_coord(i, grid) for i in idxs)
+
+
+def render(grid, free, carved) -> str:
+    """One ASCII panel per z-layer, x across, y down (y=0 on top)."""
+    gx, gy, gz = grid
+    panels = []
+    for z in range(gz):
+        rows = [f"z={z}"]
+        for y in range(gy):
+            cells = []
+            for x in range(gx):
+                c = (x, y, z)
+                cells.append("C" if c in carved
+                             else "." if c in free else "#")
+            rows.append(" ".join(cells))
+        panels.append("\n".join(rows))
+    return "\n\n".join(panels)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="render a slice's host-grid torus and one carve")
+    ap.add_argument("--generation", default="v4")
+    ap.add_argument("--slice", dest="slice_topology", default="8x8x1",
+                    help="slice topology in CHIPS, e.g. 8x8x1 (v4) or "
+                         "8x8 (v5e)")
+    ap.add_argument("--gang", type=int, default=0,
+                    help="hosts to carve (0 = just render occupancy)")
+    ap.add_argument("--occupied", default="",
+                    help="occupied host indices, e.g. 5,6 "
+                         "(make_slice host_index order)")
+    ap.add_argument("--density", type=float, default=0.0,
+                    help="random occupied fraction (with --seed)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--plane", choices=("scalar", "numpy", "native"),
+                    default=None,
+                    help="force a carve plane (default: the fallback "
+                         "chain the scheduler uses)")
+    args = ap.parse_args()
+
+    gen = generation(args.generation)
+    shape = gen.validate_slice_topology(args.slice_topology)
+    grid = C.host_grid(shape, gen.host_block)
+    wrap = C.wrap_of(grid)
+    vol = grid[0] * grid[1] * grid[2]
+
+    occupied = parse_occupied(args.occupied, grid)
+    if args.density > 0:
+        rng = random.Random(args.seed)
+        rest = [C.host_coord(i, grid) for i in range(vol)]
+        rest = [c for c in rest if c not in occupied]
+        occupied = occupied | frozenset(
+            rng.sample(rest, int(args.density * len(rest))))
+    free = frozenset(C.host_coord(i, grid) for i in range(vol)) - occupied
+
+    wrapped = "x".join("w" if w else "-" for w in wrap)
+    print(f"{gen.name} {args.slice_topology} -> host grid "
+          f"{grid[0]}x{grid[1]}x{grid[2]} (wrap {wrapped}), "
+          f"{len(free)}/{vol} hosts free, "
+          f"{gen.chips_per_host} chips/host")
+    print(f"largest carvable block: {C.largest_carvable(grid, free)} hosts")
+
+    carved = frozenset()
+    if args.gang > 0:
+        plane = args.plane or (
+            "native" if C._native_on() else
+            "numpy" if C.np is not None else "scalar")
+        got = C.carve_block(grid, free, args.gang, plane=args.plane)
+        if got is None:
+            print(f"carve({args.gang}): INFEASIBLE — no contiguous "
+                  f"axis-aligned block of {args.gang} free hosts "
+                  f"(the scheduler would fall back to the bag-of-chips "
+                  f"gang plan)")
+        else:
+            origin, block, carved, links = got
+            print(f"carve({args.gang}) via {plane}: origin {origin}, "
+                  f"block {block[0]}x{block[1]}x{block[2]}, "
+                  f"bisection {links} links = "
+                  f"{C.bisection_gbps(block, grid, wrap, gen.ici_gbps):g} "
+                  f"GB/s ({gen.ici_gbps} GB/s/link)")
+            print(f"largest carvable after: "
+                  f"{C.largest_carvable(grid, free - carved)} hosts")
+    print()
+    print(render(grid, free, carved))
+
+
+if __name__ == "__main__":
+    main()

@@ -165,6 +165,27 @@ class CapacityProvisioner:
                     f"pool {template.pool}: chips={template.chips} but "
                     f"{template.generation} slice hosts carry {per_host} "
                     f"chips ({'x'.join(map(str, block))} block)")
+            if template.slice_topology:
+                # torus-shape guard, same class as the chips-per-host
+                # check: the generation catalog rejects degenerate/zero
+                # axes, over-max volumes, rank mismatches, and per-axis
+                # host-block indivisibility; on top, the shape's volume
+                # must equal exactly hosts x chips-per-host or the pool
+                # would provision slices whose host grid disagrees with
+                # the template's own host count (carves computed on a
+                # grid that doesn't exist)
+                from ...topology.generations import generation as g_of
+                from ...topology.torus import chips_in
+
+                shape = g_of(template.generation).validate_slice_topology(
+                    template.slice_topology)
+                if chips_in(shape) != template.hosts * per_host:
+                    raise ValueError(
+                        f"pool {template.pool}: slice topology "
+                        f"{template.slice_topology} holds "
+                        f"{chips_in(shape)} chips but the template "
+                        f"provisions {template.hosts} hosts x {per_host} "
+                        "chips")
         lo, hi = self._bounds.get(template.pool,
                                   (template.min_nodes, template.max_nodes))
         pool = _Pool(template, lo, hi)
@@ -686,10 +707,14 @@ class CapacityProvisioner:
         would split an empty slice into a degraded remnant no gang can
         ever use. An armed slice where even one host took a bind (or a
         Permit reservation) during the cordoned window is handed back
-        whole; no migration consolidation for slices."""
+        whole. With the torusPlacement knob on, a scale-down blocked on
+        lightly-loaded slices migrates residents off ONE whole slice
+        (_drain_slice) — otherwise slices never consolidate."""
         sched = self.sched
         units_budget = surplus // pool.template.hosts
         units_done = 0
+        had_busy = False
+        cooling_units = 0
         for sid, hosts in sorted(self._by_slice(managed).items()):
             busy = any(pods_on(h) or h in reserved for h in hosts)
             armed = [h for h in hosts if h in pool.pending_release]
@@ -717,6 +742,7 @@ class CapacityProvisioner:
                 units_done += 1
                 continue
             if busy:
+                had_busy = True
                 for h in hosts:
                     pool.empty_since.pop(h, None)
                 continue
@@ -730,10 +756,20 @@ class CapacityProvisioner:
                 pool.empty_since.setdefault(h, now)
             if any(now - pool.empty_since[h] < self.cooldown_s
                    for h in hosts):
+                cooling_units += 1
                 continue
             for h in hosts:
                 self._cordon(h, True)
                 pool.pending_release.add(h)
+        # slice drain-and-reassemble: still over target with only busy
+        # slices left. Empty slices merely cooling (or already armed)
+        # count toward the target first — draining a busy slice while
+        # an idle one cools would release more than the surplus asks.
+        pending_units = len(pool.pending_release) // pool.template.hosts
+        if had_busy and getattr(sched.config, "torus_placement", False) \
+                and units_done + pending_units + cooling_units \
+                < units_budget:
+            self._drain_slice(pool, managed, now, summary, reserved)
 
     def _cordon(self, node: str, on: bool) -> None:
         c = self._cluster()
@@ -849,6 +885,109 @@ class CapacityProvisioner:
         pool.empty_since.setdefault(candidate, now)
         pool.pending_release.discard(candidate)
 
+    def _drain_slice(self, pool: _Pool, managed: list, now: float,
+                     summary: dict, reserved: set = frozenset()) -> None:
+        """Drain-and-reassemble ONE whole slice (torusPlacement knob):
+        migrate every resident off the least-loaded busy slice so the
+        freed slice conserves its carvable shape and releases through
+        the ordinary whole-slice cooldown pipeline. Same all-or-nothing
+        rails as _drain_one — every non-harvest resident must have a
+        dry-run-proven destination OUTSIDE the slice (moving a victim
+        onto a sibling host would just re-dirty the slice being freed)
+        BEFORE anything is evicted, and a blocked plan pins the pool's
+        drain to the version vector so the wake loop never churns the
+        same impossible drain."""
+        sched = self.sched
+        vers = self._vers()
+        if pool.drain_blocked_vers is not None \
+                and pool.drain_blocked_vers == vers:
+            return  # provably stuck since nothing changed
+        loads = []
+        for sid, hosts in sorted(self._by_slice(managed).items()):
+            if any(h in pool.pending_release or h in reserved
+                   for h in hosts):
+                continue
+            pods = [(p, h) for h in hosts
+                    for p in self._cluster().pods_on(h)
+                    if not p.terminating]
+            if not pods:
+                continue  # idle slice: the cooldown pipeline owns it
+            loads.append((len(pods), sid, hosts, pods))
+        loads.sort(key=lambda t: (t[0], t[1]))
+        candidate = None
+        for load, sid, hosts, pods in loads:
+            if load > self.max_drains:
+                continue
+            if not all(self._drainable(p) for p, _ in pods):
+                continue
+            excluded = frozenset(hosts)
+            plan_d: dict[str, str] = {}
+            plan_p: dict[str, int] = {}
+            viable = True
+            for p, h in pods:
+                if is_harvest(p):
+                    continue
+                d = self._fits_elsewhere(p, h, plan_p, exclude=excluded)
+                if d is None:
+                    viable = False
+                    break
+                plan_d[p.key] = d
+                try:
+                    plan_p[d] = plan_p.get(d, 0) + spec_for(p).chips
+                except LabelError:
+                    pass
+            if viable:
+                candidate = (sid, hosts, pods, plan_d)
+                break
+        if candidate is None:
+            pool.drain_blocked_vers = vers
+            self._skip("slice-drain-blocked")
+            return
+        pool.drain_blocked_vers = None
+        sid, hosts, pods, dests = candidate
+        # cordon the WHOLE slice up front: a bind landing on a sibling
+        # host mid-drain would leave the slice busy again after all the
+        # evictions were spent
+        for h in hosts:
+            self._cordon(h, True)
+        local = getattr(sched.cluster, "supports_local_requeue", False)
+        # harvest first — the class contract — then the proven moves
+        pods.sort(key=lambda pr: (0 if is_harvest(pr[0]) else 1))
+        for p, _ in pods:
+            harvest = is_harvest(p)
+            sched.cluster.evict(p)
+            summary["drained"] += 1
+            if harvest:
+                sched.metrics.inc("harvest_evictions_total",
+                                  labels={"reason": "scale-down"})
+            else:
+                sched.metrics.inc("provisioner_drain_evictions_total")
+                dest = dests.get(p.key)
+                if dest is not None and local \
+                        and sched.allocator is not None:
+                    try:
+                        spec = spec_for(p)
+                        sched.allocator.nominate(
+                            p.key, dest, spec.chips, spec.priority,
+                            cpu_millis=p.cpu_millis,
+                            memory_bytes=p.memory_bytes,
+                            host_ports=p.host_ports)
+                    except LabelError:
+                        pass
+            if local:
+                router = sched.victim_router or sched.submit
+                router(p)
+        # the drained slice stays CORDONED and enters the whole-slice
+        # empty-cooldown pipeline: it releases atomically through the
+        # ordinary two-phase path
+        for h in hosts:
+            pool.empty_since.setdefault(h, now)
+            pool.pending_release.discard(h)
+        sched.metrics.inc("provisioner_slice_drains_total",
+                          labels={"pool": pool.template.pool})
+        sched.flight.record("slice_drain", slice=sid,
+                            pool=pool.template.pool, pods=len(pods))
+
     def _drainable(self, pod) -> bool:
         """May scale-down move this pod? Harvest pods always (evicted
         for free, eviction IS their contract); ordinary pods under the
@@ -863,13 +1002,16 @@ class CapacityProvisioner:
 
         return movable(pod, self.sched, PROTECT_PRIORITY)
 
-    def _fits_elsewhere(self, pod, src: str, planned: dict) -> str | None:
+    def _fits_elsewhere(self, pod, src: str, planned: dict,
+                        exclude: frozenset = frozenset()) -> str | None:
         """Dry-run the live filter path for a drain victim: the first
         node outside the shrinking candidate that accepts the pod as
         things stand (minus chips already promised to earlier victims
         of this drain). Mirrors deschedule._fits_elsewhere but any
         destination qualifies — consolidation packs the survivors onto
-        whatever can hold them."""
+        whatever can hold them. `exclude` widens the off-limits set
+        beyond src: a slice drain must land victims outside the WHOLE
+        slice, not just off the victim's own host."""
         from ..framework import CycleState
 
         sched = self.sched
@@ -883,7 +1025,7 @@ class CapacityProvisioner:
         state.write("snapshot", snapshot)
         state.write("workload_spec", spec)
         for ni in snapshot.list():
-            if ni.name == src:
+            if ni.name == src or ni.name in exclude:
                 continue
             if sched.allocator is not None:
                 free = len(sched.allocator.free_coords(ni))

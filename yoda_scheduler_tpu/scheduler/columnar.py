@@ -242,6 +242,14 @@ class ColumnarTable:
         self.unsched = np.zeros(n, dtype=bool)
         self.label_class = np.zeros(n, dtype=np.int64)
         self.free_count = np.zeros(n, dtype=np.int64)
+        # per-pool torus geometry: this host's coordinate on its slice's
+        # wrapped host grid (scheduler/carve.slice_host_coord), -1 for
+        # standalone nodes / slices without coherent torus metadata.
+        # Derived from slice_topology + generation + host_index, so it
+        # rides the telemetry-identity gate like the chip attributes.
+        self.host_cx = np.full(n, -1, dtype=np.int64)
+        self.host_cy = np.full(n, -1, dtype=np.int64)
+        self.host_cz = np.full(n, -1, dtype=np.int64)
         self.hbm_total_sum = np.zeros(n, dtype=np.int64)
         self.hbm_free_sum = np.zeros(n, dtype=np.int64)
         self.claimed_hbm = np.zeros(n, dtype=np.int64)
@@ -277,6 +285,9 @@ class ColumnarTable:
                 self.heartbeat[i] = 0.0
                 self.accel[i] = -2
                 self.gen[i] = -2
+                self.host_cx[i] = -1
+                self.host_cy[i] = -1
+                self.host_cz[i] = -1
                 self.hbm_total_sum[i] = 0
                 self.hbm_free_sum[i] = 0
                 self.chip_free[i, :] = False
@@ -304,6 +315,16 @@ class ColumnarTable:
             self.heartbeat[i] = m.heartbeat
             self.accel[i] = self._intern_id(m.accelerator)
             self.gen[i] = self._intern_id(m.tpu_generation)
+            cx = cy = cz = -1
+            if m.slice_id and m.num_hosts > 1:
+                from .carve import slice_grid, slice_host_coord
+
+                gw = slice_grid(m)
+                if gw is not None:
+                    cx, cy, cz = slice_host_coord(m, gw[0])
+            self.host_cx[i] = cx
+            self.host_cy[i] = cy
+            self.host_cz[i] = cz
             self.hbm_total_sum[i] = m.hbm_total_sum
             self.hbm_free_sum[i] = m.hbm_free_sum
             self.chip_hbm_free[i, :k] = [c.hbm_free_mb for c in chips]
@@ -464,6 +485,7 @@ class ColumnarTable:
         old_row_gen, old_row_chips = self._row_gen, self._row_chips
         old_cols = [self.valid, self.heartbeat, self.accel, self.gen,
                     self.unsched, self.label_class, self.free_count,
+                    self.host_cx, self.host_cy, self.host_cz,
                     self.hbm_total_sum, self.hbm_free_sum,
                     self.claimed_hbm, self.chip_free, self.chip_hbm_free,
                     self.chip_hbm_total, self.chip_clock, self.chip_bw,
@@ -474,6 +496,7 @@ class ColumnarTable:
         self._install_shard_map()
         new_cols = [self.valid, self.heartbeat, self.accel, self.gen,
                     self.unsched, self.label_class, self.free_count,
+                    self.host_cx, self.host_cy, self.host_cz,
                     self.hbm_total_sum, self.hbm_free_sum,
                     self.claimed_hbm, self.chip_free, self.chip_hbm_free,
                     self.chip_hbm_total, self.chip_clock, self.chip_bw,

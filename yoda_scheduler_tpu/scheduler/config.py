@@ -213,6 +213,18 @@ def _elastic_default() -> bool:
     return os.environ.get("YODA_ELASTIC", "0").lower() in ("1", "true", "on")
 
 
+def _torus_default() -> bool:
+    """Geometric torus placement (topology/carve.py + scheduler/carve.py):
+    multi-host slices become wrapped host-grid tori and gang demand is
+    carved as contiguous axis-aligned blocks scored by ICI bisection
+    bandwidth, with geometric fragmentation scoring, torus-reassembly
+    defrag, and shape-conserving slice scale-down riding the same knob.
+    Default OFF; YODA_TORUS=1 enables (CI runs a tier-1 leg with it
+    spelled-out off — placements are bit-identical when unset, the same
+    parity discipline as the policy engine)."""
+    return os.environ.get("YODA_TORUS", "0").lower() in ("1", "true", "on")
+
+
 def _workload_admission_default() -> bool:
     """Workload-tier admission (scheduler/workload.py): one Workload
     object describes N gang members x M replicas; admission runs ONCE
@@ -421,6 +433,15 @@ class SchedulerConfig:
     # set is down to its LAST pair, so 2-chip jobs keep finding pairs
     # deep into a drain. 0 disables.
     fragmentation_weight: int = 1
+    # geometric torus placement (scheduler/carve.py TorusCarver): carve
+    # gang demand as contiguous axis-aligned host blocks on each slice's
+    # wrapped host grid, scored by ICI bisection bandwidth; multi-slice
+    # gangs get one carve per slice; FragmentationScore, the defrag
+    # controller, and slice scale-down all turn geometry-aware. OFF by
+    # default — with the knob off placements are bit-identical to the
+    # classic engine (tests/test_torus_carve.py knob-off parity + the CI
+    # torus-disabled tier-1 leg).
+    torus_placement: bool = field(default_factory=_torus_default)
     # batch scheduling cycles: extend the queue head to up to this many
     # pods sharing one scheduling equivalence class and place them with
     # ONE shared filter+score pass plus an incremental greedy commit
@@ -700,6 +721,8 @@ class SchedulerConfig:
                                         defaults.native_commit)),
             fragmentation_weight=int(args.get(
                 "fragmentationWeight", defaults.fragmentation_weight)),
+            torus_placement=bool(args.get(
+                "torusPlacement", defaults.torus_placement)),
             batch_max_pods=max(int(args.get(
                 "batchMaxPods", defaults.batch_max_pods)), 1),
             degraded_mode=bool(args.get("degradedMode",

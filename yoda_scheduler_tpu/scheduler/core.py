@@ -224,8 +224,17 @@ def default_profile(config: SchedulerConfig,
         from .elastic import ElasticGangs
 
         elastic = ElasticGangs(config)
+    # geometric torus placement (scheduler/carve.py): built only when the
+    # knob asks — the off default constructs the EXACT pre-torus plugin
+    # set, so placements stay bit-identical (tests/test_torus_carve.py)
+    carver = None
+    if config.torus_placement:
+        from .carve import TorusCarver
+
+        carver = TorusCarver(allocator)
     gang_permit = GangPermit(gangs, timeout_s=config.gang_timeout_s,
-                             allocator=allocator, elastic=elastic)
+                             allocator=allocator, elastic=elastic,
+                             carver=carver)
     topo = TopologyScore(allocator, weight=config.topology_weight)
     admission = NodeAdmission(allocator)
     # policy engine (scheduler/policy/): built only when a knob asks for
@@ -265,7 +274,8 @@ def default_profile(config: SchedulerConfig,
             TelemetryScore(allocator, config.weights, weight=1),
             *([topo] if config.topology_weight > 0 else []),
             *([FragmentationScore(allocator,
-                                  weight=config.fragmentation_weight)]
+                                  weight=config.fragmentation_weight,
+                                  carver=carver)]
               if config.fragmentation_weight > 0 else []),
             *hetero,
             admission,
@@ -357,6 +367,11 @@ class Scheduler:
         self.profile = profile
         self.clock = clock or Clock()
         self.metrics = Metrics()
+        # torus carver observability: the carver is built inside the
+        # profile (no Metrics exists yet there) — hand it ours
+        _carver = getattr(self.gang_permit, "carver", None)
+        if _carver is not None:
+            _carver.metrics = self.metrics
         qkw = dict(
             initial_backoff_s=self.config.pod_initial_backoff_s,
             max_backoff_s=self.config.pod_max_backoff_s,

@@ -17,6 +17,15 @@ Strategies, in order:
 2. **Intra-node compaction**: a node whose largest placeable block is
    smaller than what its free count could form, where evicting a small
    resident pod would actually enlarge that block.
+3. **Torus reassembly** (torusPlacement knob only): when no standalone
+   destination exists, a stray that is the SOLE resident of a slice host
+   migrates to an already-dented host of the SAME slice — the move
+   strictly increases the slice's count of WHOLE (fully-free) hosts, so
+   repeated passes reassemble contiguous host blocks for gang carves
+   instead of bailing the moment standalone capacity is gone. The
+   monotone whole-host gate (victim's eviction makes its host whole;
+   destination is dented and stays dented) is what makes the strategy
+   terminate instead of shuffling strays around the torus forever.
 
 Safety rails, k8s-descheduler-style: never touch gang members, pods at
 or above `protect_priority`, or other profiles' pods; never evict more
@@ -123,7 +132,21 @@ class Descheduler:
             f = len(self.sched.allocator.free_coords(ni))
             if f > 0:
                 dest_free[ni.name] = f
-        if not dest_free:
+        # torus-reassembly destinations (knob only): DENTED same-slice
+        # hosts — partially occupied, some room. Whole hosts are never
+        # destinations (stacking a stray onto one would DECREASE the
+        # slice's whole-host count, the opposite of reassembly).
+        torus = bool(getattr(self.sched.config, "torus_placement", False))
+        slice_dest: dict[str, tuple[str, int]] = {}
+        if torus:
+            for ni in snapshot.list():
+                dm = ni.metrics
+                if dm is None or not (dm.slice_id and dm.num_hosts > 1):
+                    continue
+                f = len(self.sched.allocator.free_coords(ni))
+                if 0 < f < dm.chip_count:
+                    slice_dest[ni.name] = (dm.slice_id, f)
+        if not dest_free and not slice_dest:
             return plan
         # per-plan destination memo: victims sharing a scheduling class
         # (the engine's memo key: spec + selectors + namespace) share one
@@ -139,11 +162,11 @@ class Descheduler:
         ledger = DisruptionLedger(
             budgets,
             [p for ni in snapshot.list() for p in ni.pods] if budgets else ())
-        # (pod, node, reason, is_defrag): defrag (strategy-2) benefit is
-        # computed against the node's CURRENT free set, so at most one
+        # (pod, node, reason, strategy): compaction (strategy-2) benefit
+        # is computed against the node's CURRENT free set, so at most one
         # defrag victim per node per pass — the first eviction may already
         # deliver the enlarged block a second candidate was credited with
-        candidates: list[tuple[Pod, str, str, bool]] = []
+        candidates: list[tuple[Pod, str, str, str]] = []
         # per-pass work bound: collection stops once the pool is 8x the
         # eviction budget — a 5k-node fleet mid-drain has thousands of
         # movable strays, and walking every one's block math per pass
@@ -169,7 +192,27 @@ class Descheduler:
                     candidates.append(
                         (p, ni.name,
                          f"frees gang slice {m.slice_id} ({m.num_hosts} hosts)",
-                         False))
+                         "slice-conservation"))
+                # strategy 3 (torusPlacement): the stray is this host's
+                # sole resident AND its eviction makes the host WHOLE
+                # (every chip free and healthy) — candidate for an
+                # intra-slice move onto an already-dented host. Ordered
+                # AFTER the standalone candidate for the same pod: moving
+                # the fragmentation off the slice entirely is always
+                # preferred, the intra-slice move is the fallback when
+                # standalone capacity is gone.
+                if torus:
+                    residents = [q for q in ni.pods if not q.terminating]
+                    if len(residents) == 1 and residents[0] in movable:
+                        p = residents[0]
+                        free = self.sched.allocator.free_coords(ni)
+                        if len(free | p.assigned_chips()) == m.chip_count:
+                            candidates.append(
+                                (p, ni.name,
+                                 f"torus reassembly: sole resident off "
+                                 f"{ni.name} makes a whole host on slice "
+                                 f"{m.slice_id}",
+                                 "torus-reassembly"))
             else:
                 # strategy 2: scattered free chips on a standalone node —
                 # fragmented iff the largest placeable block is smaller
@@ -204,7 +247,8 @@ class Descheduler:
                     candidates.append(
                         (p, ni.name,
                          f"defragments {ni.name}: largest free block "
-                         f"{current} -> {better} after eviction", True))
+                         f"{current} -> {better} after eviction",
+                         "compaction"))
         # round-robin the candidates ACROSS nodes: node-major order spends
         # the whole eviction budget denting ONE host deep while its
         # neighbours keep their strays — one victim per host per round
@@ -227,35 +271,94 @@ class Descheduler:
         # same free slot
         planned: dict[str, int] = {}
         defrag_done: set[str] = set()  # nodes with a planned defrag victim
+        picked: set[str] = set()  # a pod may appear under two strategies
         now = self.sched.clock.time()
-        for pod, node, reason, is_defrag in candidates:
+        for pod, node, reason, strategy in candidates:
             if len(plan.victims) >= self.max_evictions:
                 break
-            if is_defrag and node in defrag_done:
+            if pod.key in picked:
+                continue  # already a victim under an earlier strategy
+            if strategy == "compaction" and node in defrag_done:
                 continue  # benefit already claimed by this pass's eviction
             if now - self._recent.get(pod.key, -1e18) < self.cooldown_s:
                 continue  # recently moved; don't thrash the workload
             if ledger.would_violate(pod):
                 continue  # optional move never breaches a disruption budget
-            dest = self._fits_elsewhere(pod, node, snapshot, planned,
-                                        dest_free, dest_cache)
+            if strategy == "torus-reassembly":
+                dest = self._torus_dest(pod, node, snapshot, planned,
+                                        slice_dest)
+            else:
+                dest = self._fits_elsewhere(pod, node, snapshot, planned,
+                                            dest_free, dest_cache)
             if dest is not None:
-                if is_defrag:
+                if strategy == "compaction":
                     defrag_done.add(node)
+                picked.add(pod.key)
                 try:
                     planned[dest] = planned.get(dest, 0) + spec_for(pod).chips
                 except LabelError:  # _movable already parsed it
                     pass
                 plan.victims.append(pod)
                 plan.reasons[pod.key] = reason
-                plan.strategies[pod.key] = ("compaction" if is_defrag
-                                            else "slice-conservation")
+                plan.strategies[pod.key] = strategy
                 plan.destinations[pod.key] = dest
                 ledger.consume([pod])
         return plan
 
     def _movable(self, pod: Pod) -> bool:
         return movable(pod, self.sched, self.protect_priority)
+
+    def _torus_dest(self, pod: Pod, current_node: str, snapshot,
+                    planned: dict[str, int],
+                    slice_dest: dict[str, tuple[str, int]]) -> str | None:
+        """Intra-slice destination for a torus-reassembly victim: a
+        DENTED host of the SAME slice with room (net of chips promised
+        to earlier victims), validated through the live filter path like
+        _fits_elsewhere. Destinations fill in HOST-COORDINATE order (low
+        corner of the torus grid first): which hosts receive strays is
+        which hosts END UP dented, so compacting the dented set into one
+        corner is what leaves the reassembled whole hosts as a single
+        carvable block instead of a scatter that strands the very gang
+        the reassembly is for."""
+        try:
+            spec = spec_for(pod)
+        except LabelError:
+            return None
+        src = snapshot.get(current_node)
+        sid = (src.metrics.slice_id
+               if src is not None and src.metrics is not None else None)
+        if not sid:
+            return None
+        from .carve import slice_grid, slice_host_coord
+        from .framework import CycleState
+
+        def _corner_key(name: str):
+            ni = snapshot.get(name)
+            m = ni.metrics if ni is not None else None
+            if m is not None:
+                gw = slice_grid(m)
+                if gw is not None:
+                    x, y, z = slice_host_coord(m, gw[0])
+                    return (0, z, y, x, name)
+            return (1, 0, 0, 0, name)  # no coherent geometry: after all
+
+        state = CycleState()
+        state.write("now", self.sched.clock.time())
+        state.write("snapshot", snapshot)
+        state.write("workload_spec", spec)
+        for name, (dsid, f) in sorted(slice_dest.items(),
+                                      key=lambda kv: _corner_key(kv[0])):
+            if name == current_node or dsid != sid:
+                continue
+            if f - planned.get(name, 0) < spec.chips:
+                continue
+            ni = snapshot.get(name)
+            if ni is None:
+                continue
+            if all(fl.filter(state, pod, ni).ok
+                   for fl in self.sched.profile.filter):
+                return name
+        return None
 
     def _fits_elsewhere(self, pod: Pod, current_node: str, snapshot,
                         planned: dict[str, int],

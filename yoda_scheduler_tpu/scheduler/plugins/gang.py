@@ -87,6 +87,11 @@ class GangCoordinator:
         # kept across bind — a bound member still occupies its quota slot)
         self._plan: dict[str, dict[str, int]] = {}
         self._placed: dict[str, dict[str, int]] = {}
+        # geometric carve (scheduler/carve.py, torusPlacement knob):
+        # gang -> {slice_id: frozenset(host names)} — advisory narrowing
+        # for _write_candidates, torn down with the rest of the gang
+        # state so a failed assembly re-carves against fresh capacity
+        self._carve: dict[str, dict[str, frozenset]] = {}
 
     def chosen_slice(self, gang: str) -> str | None:
         with self._lock:
@@ -130,6 +135,20 @@ class GangCoordinator:
             placed = self._placed.get(gang, {})
             return {sid: q - placed.get(sid, 0) for sid, q in plan.items()}
 
+    # ------------------------------------------------- geometric carves
+    def set_carve(self, gang: str, carve: dict[str, frozenset]) -> None:
+        with self._lock:
+            self._carve[gang] = dict(carve)
+
+    def carve_of(self, gang: str) -> dict[str, frozenset] | None:
+        with self._lock:
+            c = self._carve.get(gang)
+            return dict(c) if c is not None else None
+
+    def clear_carve(self, gang: str) -> None:
+        with self._lock:
+            self._carve.pop(gang, None)
+
     def record_placement(self, gang: str, slice_id: str, delta: int = 1) -> None:
         with self._lock:
             if gang in self._plan:
@@ -153,6 +172,7 @@ class GangCoordinator:
             self._slice.pop(gang, None)
             self._plan.pop(gang, None)
             self._placed.pop(gang, None)
+            self._carve.pop(gang, None)
             return members
 
 
@@ -178,13 +198,16 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
         return QUEUE  # capacity events: a slice may now fit the gang
 
     def __init__(self, gangs: GangCoordinator, timeout_s: float = 30.0,
-                 allocator=None, elastic=None) -> None:
+                 allocator=None, elastic=None, carver=None) -> None:
         self.gangs = gangs
         self.timeout_s = timeout_s
         self.allocator = allocator  # ChipAllocator, for multi-slice planning
         # ElasticGangs controller (scheduler/elastic/): None = classic
         # all-or-nothing admission, placements bit-identical
         self.elastic = elastic
+        # TorusCarver (scheduler/carve.py): None = classic free-host-count
+        # planning, placements bit-identical (the torusPlacement knob)
+        self.carver = carver
 
     def equivalence_key(self, pod):
         """Batch-cycle contract: gang members carry cross-pod assembly
@@ -204,6 +227,7 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
         spec: WorkloadSpec = state.read("workload_spec")
         if not spec.is_gang or self.allocator is None:
             return Status.success()
+        self._maybe_carve(state, pod, snapshot, spec)
         st = self._maybe_plan(state, pod, snapshot, spec)
         if not st.ok:
             return st
@@ -217,6 +241,37 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
                 "slice narrowing (membership / chosen slice / plan "
                 f"quotas / {spec.gang_size} gang-sized slices)")
         return st
+
+    def _maybe_carve(self, state: CycleState, pod: Pod, snapshot,
+                     spec: WorkloadSpec) -> None:
+        """Geometric narrowing (torusPlacement knob): carve the gang as
+        contiguous host blocks before the legacy planner runs. A
+        multi-slice carve fixes the plan too (quota accounting rides the
+        existing machinery); a single-slice carve leaves slice choice to
+        the first Reserve as usual. Skipped once assembly is underway or
+        members are already bound — re-forming a partially-bound gang is
+        the legacy path's job (its slice is pinned by cluster truth, a
+        fresh carve could contradict it)."""
+        if self.carver is None:
+            return
+        gang = spec.gang_name
+        if (self.gangs.carve_of(gang) is not None
+                or self.gangs.plan_of(gang) is not None
+                or self.gangs.chosen_slice(gang) is not None
+                or self.gangs.waiting_members(gang)):
+            return
+        bound, _, _ = bound_gang_members(state, gang)
+        if bound:
+            return
+        carve = self.carver.carve_gang(state, pod, snapshot, spec,
+                                       state.read_or("now"),
+                                       state.read_or("degraded"))
+        if carve is None:
+            return
+        self.gangs.set_carve(gang, carve)
+        if len(carve) > 1:
+            self.gangs.set_plan(
+                gang, {sid: len(hosts) for sid, hosts in carve.items()})
 
     def _maybe_plan(self, state: CycleState, pod: Pod, snapshot,
                     spec: WorkloadSpec) -> Status:
@@ -306,6 +361,21 @@ class GangPermit(PermitPlugin, ReservePlugin, PreFilterPlugin,
             elif m.num_hosts < spec.gang_size:
                 continue
             names.append(ni.name)
+        carve = (self.gangs.carve_of(gang)
+                 if self.carver is not None else None)
+        if carve:
+            # geometric narrowing: only the carved blocks' hosts. Safety
+            # valve: if the carve no longer intersects the feasible set
+            # (host lost since the carve), drop it and keep the legacy
+            # candidates — the gang degrades instead of wedging
+            allowed = set()
+            for hosts in carve.values():
+                allowed.update(hosts)
+            narrowed = [n for n in names if n in allowed]
+            if narrowed:
+                names = narrowed
+            else:
+                self.gangs.clear_carve(gang)
         cand = frozenset(names)
         state.write(CANDIDATE_NODES_KEY, cand)
         return cand

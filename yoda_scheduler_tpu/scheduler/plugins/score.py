@@ -255,52 +255,148 @@ class FragmentationScore(ScorePlugin):
     An absolute penalty, not min-max normalized: it must only tip a
     choice when comparable alternatives exist — when the 2-free node is
     the ONLY feasible one, the pod still binds there (capacity is never
-    sacrificed to the preference)."""
+    sacrificed to the preference).
+
+    With the torusPlacement knob on (`carver` set) a GEOMETRIC term
+    rides along: a non-gang pod landing on a fully-free host of a
+    multi-host slice is penalised -100 when that host is part of the
+    slice's last largest carvable whole-host block — denting it shrinks
+    the biggest contiguous gang the slice can still take (topology/
+    carve.largest_carvable), the geometric analogue of breaking the last
+    pair. Armed, the plugin declares slice-coupled score inputs and
+    folds in Python (native/batch kernels know only the free-count
+    comparison); unarmed, every contract below is byte-identical to the
+    classic plugin."""
 
     name = "fragmentation-score"
     # score-memo contract: the raw score is a pure function of the node's
-    # free-chip count (serial + pending version) and the pod's label class
+    # free-chip count (serial + pending version) and the pod's label
+    # class. The armed (carver) instance overrides this per-instance to
+    # "node+slice_usage": the geometric term also moves when ANOTHER
+    # node of the same slice gains/loses a resident, which is exactly
+    # the slice-usage coupling the memo protocol already repairs for
+    # TopologyScore.
     score_inputs = "node"
     # normalize below deliberately returns None (absolute semantics)
     normalize_kind = "identity"
 
     def equivalence_key(self, pod):
-        """Batch-cycle contract: the penalty reads only spec.chips and
-        the node's free count."""
+        """Batch-cycle contract: the penalty reads only spec.chips /
+        spec.is_gang and per-slice state the batch commit already
+        repairs per member (slice-usage identity, see _slice_geometry)."""
         return ()
 
-    def __init__(self, allocator: ChipAllocator, weight: int = 1) -> None:
+    def __init__(self, allocator: ChipAllocator, weight: int = 1,
+                 carver=None) -> None:
         self.allocator = allocator
         self.weight = weight
+        self.carver = carver
+        if carver is not None:
+            # slice-coupled inputs: rescore when a same-slice entry moves
+            self.score_inputs = "node+slice_usage"
 
     def native_score_args(self, state: CycleState, pod, table):
         """Fused-kernel capability hook: the last-pair penalty is one
-        comparison over the free-count column — always expressible."""
+        comparison over the free-count column — always expressible.
+        The geometric term is not (whole-host sets + carve search), so
+        the armed plugin folds in Python (returning None is a fold, not
+        a veto — core.py's fused gate)."""
+        if self.carver is not None:
+            return None
         spec: WorkloadSpec = state.read(SPEC_KEY)
         return {"kind": "fragmentation",
                 "frag_single": 1 if spec.chips == 1 else 0,
                 "frag_weight": float(self.weight)}
 
     def score_relevant(self, pod, snapshot) -> bool:
-        """Hot-loop gate (core.py): the term only moves for SINGLE-chip
-        pods, so multi-chip classes drop the plugin from the per-node
-        score loop entirely instead of paying a no-op call per node."""
+        """Hot-loop gate (core.py): the classic term only moves for
+        SINGLE-chip pods, so multi-chip classes drop the plugin from the
+        per-node score loop entirely instead of paying a no-op call per
+        node. Armed, every non-gang pod can trip the geometric term."""
         from ...utils.labels import LabelError, spec_for
 
         try:
-            return spec_for(pod).chips == 1
+            spec = spec_for(pod)
         except LabelError:
             return True  # malformed pods never reach scoring anyway
+        if self.carver is not None:
+            return spec.chips == 1 or not spec.is_gang
+        return spec.chips == 1
+
+    def _slice_geometry(self, state: CycleState, snapshot):
+        """Per-slice (grid, wrap, fully-free host coords) off this
+        cycle's snapshot, cached in CycleState KEYED ON THE SLICE-USAGE
+        MAP'S OBJECT IDENTITY: the batch commit publishes a fresh usage
+        copy per member (plugins/topology.py pre_score_update), so the
+        identity changing is exactly the signal that same-slice
+        occupancy moved and the free-host sets must rebuild."""
+        from .topology import SLICE_USE_KEY
+
+        usage = state.read_or(SLICE_USE_KEY)
+        cached = state.read_or("frag_geo_hosts")
+        if (cached is not None and cached[0] is usage
+                and cached[1] is snapshot):
+            return cached[2]
+        from ..carve import slice_grid, slice_host_coord
+
+        per: dict = {}
+        for ni in snapshot.list():
+            m = ni.metrics
+            if m is None or not m.slice_id or m.num_hosts <= 1:
+                continue
+            gw = slice_grid(m)
+            if gw is None:
+                continue
+            grid, wrap = gw
+            entry = per.setdefault(m.slice_id, (grid, wrap, set()))
+            if entry[0] != grid:
+                continue
+            if (m.chip_count > 0
+                    and len(self.allocator.free_coords(ni)) == m.chip_count):
+                entry[2].add(slice_host_coord(m, grid))
+        frozen = {sid: (g, w, frozenset(c)) for sid, (g, w, c) in per.items()}
+        state.write("frag_geo_hosts", (usage, snapshot, frozen))
+        return frozen
+
+    def _geometric_term(self, state: CycleState, node: NodeInfo) -> float:
+        snapshot = state.read_or("snapshot")
+        if snapshot is None:
+            return 0.0
+        m = node.metrics
+        entry = self._slice_geometry(state, snapshot).get(m.slice_id)
+        if entry is None:
+            return 0.0
+        grid, wrap, free_hosts = entry
+        from ..carve import slice_host_coord
+        from ...topology.carve import largest_carvable
+
+        coord = slice_host_coord(m, grid)
+        if coord not in free_hosts:
+            return 0.0  # already dented: packing here is the GOOD move
+        before = largest_carvable(grid, free_hosts, wrap=wrap)
+        after = largest_carvable(grid, free_hosts - {coord}, wrap=wrap)
+        return -100.0 if after < before else 0.0
 
     def score(self, state: CycleState, pod, node: NodeInfo) -> tuple[float, Status]:
         spec: WorkloadSpec = state.read(SPEC_KEY)
         m = node.metrics
-        if m is None or spec.chips != 1:
+        if m is None:
             return 0.0, Status.success()
-        free = len(self.allocator.free_coords(node))
-        return (-100.0 if free == 2 else 0.0), Status.success()
+        total = 0.0
+        if spec.chips == 1:
+            free = len(self.allocator.free_coords(node))
+            if free == 2:
+                total -= 100.0
+        if (self.carver is not None and m.slice_id and m.num_hosts > 1
+                and not spec.is_gang):
+            total += self._geometric_term(state, node)
+        return total, Status.success()
 
     def score_batch(self, state: CycleState, pod, table, rows):
+        if self.carver is not None:
+            # geometric term needs per-slice whole-host sets — this
+            # plugin alone takes the scalar loop (None routes only it)
+            return None
         spec: WorkloadSpec = state.read(SPEC_KEY)
         if spec.chips != 1:
             return np.zeros(len(rows), dtype=np.float64)

@@ -16,6 +16,7 @@ from .config import SchedulerConfig
 from .core import Profile, default_profile
 from .plugins import (
     ChipAllocator,
+    FragmentationScore,
     GangCoordinator,
     GangPermit,
     MaxCollection,
@@ -62,10 +63,25 @@ register("telemetry-score",
 register("topology-score",
          lambda cfg, alloc, gangs, pol, el: TopologyScore(
              alloc, weight=cfg.topology_weight))
+def _carver(cfg, alloc):
+    """TorusCarver when the torusPlacement knob asks; None keeps the
+    classic (bit-identical) paths. Instances are cheap and stateless —
+    one per consuming plugin is fine."""
+    if not cfg.torus_placement:
+        return None
+    from .carve import TorusCarver
+
+    return TorusCarver(alloc)
+
+
 register("gang-permit",
          lambda cfg, alloc, gangs, pol, el: GangPermit(
              gangs, timeout_s=cfg.gang_timeout_s, allocator=alloc,
-             elastic=el))
+             elastic=el, carver=_carver(cfg, alloc)))
+register("fragmentation-score",
+         lambda cfg, alloc, gangs, pol, el: FragmentationScore(
+             alloc, weight=cfg.fragmentation_weight,
+             carver=_carver(cfg, alloc)))
 register("priority-preemption",
          lambda cfg, alloc, gangs, pol, el: PriorityPreemption(alloc, gangs))
 

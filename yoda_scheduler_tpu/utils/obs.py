@@ -598,10 +598,15 @@ TRIP_KINDS = frozenset({"breaker_open", "invariant_violation",
                         "quarantine", "webhook_deny", "webhook_fail_open",
                         "shard_takeover", "tenant_quota_breach",
                         "tenant_starvation", "defrag_pass",
-                        "provisioner_breaker_open", "pool_scaledown"})
+                        "provisioner_breaker_open", "pool_scaledown",
+                        "slice_drain"})
 # trips that mark routine (if noteworthy) operation rather than a fault
-# being absorbed: recorded + counted, but no disk dump
-RING_ONLY_TRIPS = frozenset({"defrag_pass", "pool_scaledown"})
+# being absorbed: recorded + counted, but no disk dump.
+# slice_drain (the provisioner migrating residents off a whole slice so
+# it can release shape-intact) is pool_scaledown's sibling: planned
+# consolidation, ring-worthy, never a dump per pass.
+RING_ONLY_TRIPS = frozenset({"defrag_pass", "pool_scaledown",
+                             "slice_drain"})
 
 
 class FlightRecorder:
