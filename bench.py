@@ -1231,6 +1231,216 @@ def run_diurnal_tier(horizon_s: float = 600.0, dt: float = 2.0,
     }
 
 
+def run_slo_tier(horizon_s: float = 600.0, dt: float = 2.0,
+                 period_s: float = 200.0) -> dict:
+    """SLO-guarded colocated serving (ISSUE 19): a day of diurnal
+    serving traffic over a 2-replica sharded fleet colocated with two
+    elastic training gangs, a mid-day FLASH_CROWD window tripling the
+    crowd to more chips than the free pool holds. The ONLY source of
+    chips is the SLO guard shrinking the gangs toward tpu/gang-min;
+    after the crowd, the hysteresis'd give-back must re-grow them to
+    full size. CI fences read: slo_window_violations == 0,
+    training_goodput >= 0.35, gangs_regrown, oscillation_pairs == 0,
+    and parity_identical (the YODA_SLO=0 leg places bit-identical)."""
+    from yoda_scheduler_tpu.chaos import FLASH_CROWD, FaultWindow
+    from yoda_scheduler_tpu.scheduler import FleetCoordinator
+    from yoda_scheduler_tpu.scheduler.core import FakeClock, Scheduler
+
+    import math
+
+    HYST = 20.0
+    rng = random.Random(19)
+    clock = FakeClock()
+    store = TelemetryStore()
+    # one 32-chip v4 slice (8 hosts x 4 chips): gang planning needs a
+    # slice with >= gang_size hosts, and a single pool keeps the
+    # arithmetic legible — 2 gangs x 6 members x 2 chips = 24 bound
+    # training chips, the 25% headroom caps non-serving at exactly
+    # those 24, and the 8-chip remainder is the serving valley
+    for m in make_v4_slice("sl", "4x4x2"):
+        m.heartbeat = 1e15
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=1e18,
+                        elastic_gangs=True,
+                        slo_serving=True,
+                        serving_headroom_pct=0.25,
+                        slo_target_pct=99.0,
+                        slo_fast_window_s=10.0,
+                        slo_slow_window_s=60.0,
+                        slo_guard_interval_s=1.0,
+                        slo_shrink_budget=4,
+                        slo_hysteresis_s=HYST),
+        replicas=2, clock=clock, mode="sharded", seed=0)
+    # two elastic gangs at full size hold 24 of 32 chips; the flash
+    # crowd's remainder beyond the 8-chip valley must come from
+    # shrink-to-min (4 surplus members x 2 chips = 8 chips, one
+    # budget-4 pass)
+    GANGS, SIZE, GMIN = 2, 6, 2
+    training = [Pod(f"gang{g}-{m}", labels={
+        "scv/number": "2",
+        "tpu/gang-name": f"gang{g}", "tpu/gang-size": str(SIZE),
+        "tpu/gang-min": str(GMIN)})
+        for g in range(GANGS) for m in range(SIZE)]
+    for p in training:
+        fleet.submit(p)
+    crowd = FaultWindow(FLASH_CROWD, 280.0, 320.0)
+    serving: list = []
+    serve_seq = 0
+    submit_at: dict = {}
+    latencies: list = []
+    samples: list = []  # (t, bound_serve, bound_train)
+
+    def serve_target(t: float) -> int:
+        base = max(int(round(2 + 2 * math.sin(
+            2 * math.pi * t / period_s - math.pi / 2))), 0)
+        # the crowd is a step, not a scaled sinusoid: a decaying target
+        # inside the window would shed and re-add pods, manufacturing
+        # churn the oscillation audit would then have to excuse
+        return 16 if crowd.active(t) else base
+
+    def pump_until(deadline: float) -> None:
+        while True:
+            if fleet.step(rng) is not None:
+                for p in list(serving):
+                    if p.key in submit_at and p.phase == PodPhase.BOUND:
+                        latencies.append(
+                            clock.time() - submit_at.pop(p.key))
+                continue
+            wake = fleet.next_wake_at()
+            now = clock.time()
+            if wake is None or wake >= deadline:
+                if deadline > now:
+                    clock.advance(deadline - now)
+                return
+            clock.advance(max(wake - now, 0.05))
+
+    def bound_by_gang() -> dict:
+        out = {f"gang{g}": 0 for g in range(GANGS)}
+        for p in training:
+            if p.phase == PodPhase.BOUND:
+                out[p.labels["tpu/gang-name"]] += 1
+        return out
+
+    pre_crowd: dict = {}
+    t = 0.0
+    while t < horizon_s:
+        if not pre_crowd and t >= crowd.start - dt:
+            pre_crowd = bound_by_gang()
+        want = serve_target(t)
+        while len(serving) < want:
+            serve_seq += 1
+            # same priority as training: priority preemption must never
+            # be the thing that makes room — the guard's shrink pass is
+            # the only source of crowd chips (the tier's whole point)
+            p = Pod(f"serve-{serve_seq}", labels={
+                "scv/number": "1",
+                "scv/serving": "1", "scv/slo-ms": "15000"})
+            serving.append(p)
+            submit_at[p.key] = clock.time()
+            fleet.submit(p)
+        while len(serving) > want:
+            p = serving.pop(0)  # oldest request completes
+            submit_at.pop(p.key, None)
+            fleet.forget(p.key)
+            if p.phase == PodPhase.BOUND:
+                cluster.evict(p)
+        pump_until(t + dt)
+        t += dt
+        samples.append((
+            t,
+            sum(1 for p in serving if p.phase == PodPhase.BOUND),
+            sum(1 for p in training if p.phase == PodPhase.BOUND)))
+    # guard-transition oscillation audit: a press within one hysteresis
+    # window of the preceding release = the flap the two-direction
+    # hysteresis exists to forbid (fenced at zero)
+    osc = 0
+    for rep in fleet.replicas:
+        guard = rep.engine.sloguard
+        if guard is None:
+            continue
+        last_release = None
+        for ts, kind in guard.transitions:
+            if kind == "release":
+                last_release = ts
+            elif last_release is not None and ts - last_release < HYST:
+                osc += 1
+    lat = sorted(latencies)
+
+    def pct(q: float) -> float:
+        return lat[min(int(q * len(lat)), len(lat) - 1)] if lat else 0.0
+
+    def ctr(name: str) -> int:
+        return sum(r.engine.metrics.counters.get(name, 0)
+                   for r in fleet.replicas)
+
+    end_sizes = bound_by_gang()
+    shrink_by_reason = {}
+    for rep in fleet.replicas:
+        fam = rep.engine.metrics.labeled_counters.get(
+            "gang_shrink_total", {})
+        for k, v in fam.items():
+            reason = dict(k).get("reason")
+            shrink_by_reason[reason] = shrink_by_reason.get(reason, 0) + v
+
+    # knob-off parity: the same mixed workload placed twice on a single
+    # engine — once under the pristine default config, once with every
+    # satellite field set but the master knob off. Identical pod->node
+    # maps = the off path constructs nothing (the bit-identical fence).
+    def _parity_map(cfg) -> dict:
+        st = TelemetryStore()
+        for i in range(4):
+            m = make_tpu_node(f"p-{i}", chips=4)
+            m.heartbeat = 1e15
+            st.put(m)
+        cl = FakeCluster(st)
+        cl.add_nodes_from_telemetry()
+        eng = Scheduler(cl, cfg, clock=FakeClock())
+        pods = [Pod(f"t-{i}", labels={"scv/number": "1"})
+                for i in range(10)]
+        pods += [Pod(f"s-{i}", labels={
+            "scv/number": "1", "scv/serving": "1",
+            "scv/slo-ms": "1000"}) for i in range(4)]
+        for p in pods:
+            eng.submit(p)
+        eng.run_until_idle(max_cycles=2000)
+        return {p.key: p.node for p in pods}
+
+    parity = (_parity_map(SchedulerConfig(telemetry_max_age_s=1e18,
+                                          slo_serving=False))
+              == _parity_map(SchedulerConfig(telemetry_max_age_s=1e18,
+                                             slo_serving=False,
+                                             serving_headroom_pct=0.3,
+                                             slo_target_pct=99.9,
+                                             slo_fast_window_s=5.0,
+                                             slo_hysteresis_s=5.0)))
+    return {
+        "horizon_s": horizon_s,
+        "serve_binds": len(latencies),
+        "serve_bind_p50_s": round(pct(0.50), 3),
+        "serve_bind_p99_s": round(pct(0.99), 3),
+        "slo_window_violations": ctr("slo_window_violations_total"),
+        "slo_requests": ctr("slo_requests_total"),
+        "slo_violations": ctr("slo_violations_total"),
+        "shrink_passes": ctr("slo_shrink_passes_total"),
+        "givebacks": ctr("slo_giveback_total"),
+        "gang_shrink_by_reason": shrink_by_reason,
+        "growth_holds": ctr("serving_growth_holds_total"),
+        "headroom_rejections": ctr("serving_headroom_rejections_total"),
+        "training_goodput": round(
+            sum(s[2] for s in samples)
+            / (len(samples) * GANGS * SIZE), 3),
+        "pre_crowd_gang_sizes": pre_crowd,
+        "end_gang_sizes": end_sizes,
+        "gangs_regrown": bool(pre_crowd) and end_sizes == pre_crowd,
+        "oscillation_pairs": osc,
+        "parity_identical": parity,
+    }
+
+
 def run_admission_tier(n_workloads=10_000, pods_per=100) -> dict:
     """The million-pod backlog tier (ISSUE 13): 1M queued pods arrive as
     10k workloads. Measures (a) parked memory — O(1) per workload, the
@@ -2495,6 +2705,14 @@ def main():
             capacity = run_diurnal_tier()
         except Exception as e:  # must never sink the run
             capacity = {"error": repr(e)}
+    # SLO-guarded colocated serving (diurnal + flash crowd over elastic
+    # gangs with a serving headroom); opt out with YODA_BENCH_NO_SLO=1
+    slo = {}
+    if not os.environ.get("YODA_BENCH_NO_SLO"):
+        try:
+            slo = run_slo_tier()
+        except Exception as e:  # must never sink the run
+            slo = {"error": repr(e)}
     if args.trace_out:
         # dedicated fully-sampled leg: every pod span-traced, exported as
         # one Chrome/Perfetto document — the visual answer to "where does
@@ -2518,6 +2736,7 @@ def main():
         "torus": torus,
         "admission": admission,
         "capacity": capacity,
+        "slo": slo,
     }
     # only a FULL, error-free run may overwrite the committed artifact: a
     # smoke run (YODA_BENCH_NO_SCALE/NO_SERVE, e.g. ci.yaml's
@@ -2530,7 +2749,8 @@ def main():
             and elastic and "error" not in elastic
             and torus and "error" not in torus
             and admission and "error" not in admission
-            and capacity and "error" not in capacity):
+            and capacity and "error" not in capacity
+            and slo and "error" not in slo):
         full_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
         try:
@@ -2641,6 +2861,20 @@ def main():
             "ttfb_speedup_vs_pod_intake": s["ttfb_speedup"],
         }
 
+    def slo_summary(s):
+        if not s or "serve_binds" not in s:
+            return s or {}
+        return {
+            "slo_window_violations": s["slo_window_violations"],
+            "training_goodput": s["training_goodput"],
+            "gangs_regrown": s["gangs_regrown"],
+            "shrink_passes": s["shrink_passes"],
+            "givebacks": s["givebacks"],
+            "gang_shrink_by_reason": s["gang_shrink_by_reason"],
+            "oscillation_pairs": s["oscillation_pairs"],
+            "parity_identical": s["parity_identical"],
+        }
+
     def fleet_summary(s):
         if not s or "legs" not in s:
             return s or {}
@@ -2678,6 +2912,7 @@ def main():
         "elastic": elastic_summary(elastic),
         "torus": torus_summary(torus),
         "admission": admission_summary(admission),
+        "slo": slo_summary(slo),
         "full_detail": "BENCH_FULL.json",
     }))
 

@@ -390,6 +390,85 @@ class TestFleetMergedMetrics:
         assert "yoda_tpu_watch_confirm_ms_count" in fams
 
 
+# ------------------------------------------------- SLO serving (ISSUE 19)
+class TestSloObservability:
+    """The serving-resilience families are first-class citizens of the
+    scrape: HELP'd, parser-round-trippable, and the burn trip auto-dumps
+    the flight ring exactly like the breaker's."""
+
+    def test_slo_families_carry_help_and_round_trip(self):
+        from yoda_scheduler_tpu.utils.obs import SloMonitor
+
+        m = Metrics()
+        mon = SloMonitor(m, target_pct=99.0, fast_window_s=10.0,
+                         slow_window_s=60.0)
+        mon.observe(100.0, 10.0, 1.0)   # violation
+        mon.observe(1.0, 10.0, 2.0)
+        mon.evaluate(15.0)              # closes the fixed window
+        m.set_gauge("serving_headroom_chips", 8.0)
+        m.inc("serving_headroom_rejections_total")
+        m.inc("gang_shrink_total", labels={"reason": "slo"})
+        m.inc("gang_shrink_total", labels={"reason": "preemption"})
+        m.set_gauge("slo_pressure", 1.0)
+        m.inc("slo_shrink_passes_total")
+        m.inc("slo_giveback_total")
+        m.inc("slo_guard_skips_total", labels={"reason": "hysteresis"})
+        m.inc("slo_guard_errors_total")
+        m.inc("serving_growth_holds_total")
+        m.inc("workload_serving_fastpath_total",
+              labels={"check": "rate-limit"})
+        text = m.render_prometheus()
+        for fam in ("slo_burn_rate", "slo_requests_total",
+                    "slo_violations_total", "slo_window_violations_total",
+                    "serving_headroom_chips",
+                    "serving_headroom_rejections_total",
+                    "gang_shrink_total", "slo_pressure",
+                    "slo_shrink_passes_total", "slo_giveback_total",
+                    "slo_guard_skips_total", "slo_guard_errors_total",
+                    "serving_growth_holds_total",
+                    "workload_serving_fastpath_total"):
+            assert f"# HELP yoda_tpu_{fam}" in text, fam
+        fams = parse(text)
+        # the burn gauge is per-window labeled; both windows render
+        burn = fams["yoda_tpu_slo_burn_rate"]
+        assert {dict(k)["window"] for k in burn} == {"fast", "slow"}
+        # shrink reasons stay distinct series (the PromQL contract)
+        shrink = fams["yoda_tpu_gang_shrink_total"]
+        assert shrink[frozenset([("reason", "slo")])] == 1
+        assert shrink[frozenset([("reason", "preemption")])] == 1
+        assert list(
+            fams["yoda_tpu_slo_window_violations_total"].values()) == [1]
+
+    def test_slo_burn_is_a_trip_kind_and_auto_dumps(self, tmp_path):
+        from yoda_scheduler_tpu.utils.obs import TRIP_KINDS
+
+        assert "slo_burn" in TRIP_KINDS
+        f = FlightRecorder(dump_dir=str(tmp_path), min_dump_interval_s=60)
+        f.record("slo_shrink", evictions=4)     # planned work: no dump
+        assert not f.dumps
+        f.record("slo_burn", fast=3.2, slow=2.1)
+        assert len(f.dumps) == 1
+        doc = json.loads(open(f.dumps[0]).read())
+        assert doc["reason"] == "slo_burn"
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds == ["slo_shrink", "slo_burn"]
+
+    def test_monitor_burn_gauges_track_windows(self):
+        from yoda_scheduler_tpu.utils.obs import SloMonitor
+
+        m = Metrics()
+        mon = SloMonitor(m, target_pct=50.0, burn_threshold=2.0,
+                         fast_window_s=10.0, slow_window_s=100.0)
+        for t in range(10):
+            mon.observe(500.0, 100.0, float(t))   # all violating
+        mon.evaluate(10.0)
+        fams = parse(m.render_prometheus())
+        burn = {dict(k)["window"]: v
+                for k, v in fams["yoda_tpu_slo_burn_rate"].items()}
+        assert burn["fast"] == pytest.approx(2.0)  # 100% bad / 50% budget
+        assert burn["slow"] == pytest.approx(2.0)
+
+
 # ------------------------------------------- long-run memory guard (ISSUE 16)
 class TestLongRunMemoryGuard:
     """A serve process at equilibrium runs indefinitely: every

@@ -1,0 +1,776 @@
+"""SLO-guarded colocated serving (ISSUE 19).
+
+Covers the tentpole end to end plus the satellites:
+
+- the serving workload class: scv/serving parsing, scv/slo-ms requires
+  serving, the serving x harvest exclusion;
+- SloMonitor: rolling multi-window burn rates (pressure needs BOTH fast
+  and slow above threshold), fixed-window violation counting, the
+  slo_burn flight trip with re-arm;
+- SloGuard: shrink-to-min (never below tpu/gang-min, bounded bites,
+  largest-surplus first), reason="slo" accounting DISTINCT from
+  reason="preemption", breaker/degraded/hysteresis interlocks, the
+  growth hold while pressed, the hysteresis'd give-back re-growing the
+  gangs, and give-back surviving a shard-ownership handover;
+- serving-headroom reservation: non-serving pods rejected past the
+  reserve, serving always passes, and elastic RE-growth gated on the
+  gang's unbound remainder (whole-gang demand would wedge it);
+- workload-admission serving fastpath: rate-limit and queue-depth
+  backpressure bypassed, no token consumed;
+- knob-off bit-identical parity (every satellite field set, master knob
+  off -> same placements as the pristine default profile);
+- a 48-seed chaos fuzz (8-seed tier-1 smoke) over SLO_KINDS: flash
+  crowds x provider stockouts x lease expiry x replica crashes, pinning
+  the gang-min floor, serving convergence, zero shrink/give-back
+  oscillation pairs inside one hysteresis window, and the four global
+  invariants fleet-wide.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from yoda_scheduler_tpu.chaos import (
+    ChaosCluster,
+    FLASH_CROWD,
+    FaultPlan,
+    LEASE_EXPIRY,
+    REPLICA_CRASH,
+    SLO_KINDS,
+    SimulatedProvider,
+)
+from yoda_scheduler_tpu.scheduler import (
+    FakeCluster,
+    FleetCoordinator,
+    Scheduler,
+    SchedulerConfig,
+)
+from yoda_scheduler_tpu.scheduler.capacity import FakeBackend, NodeTemplate
+from yoda_scheduler_tpu.scheduler.core import FakeClock, HybridClock
+from yoda_scheduler_tpu.scheduler.workload import ADMITTED, PARKED, Workload
+from yoda_scheduler_tpu.telemetry import (
+    TelemetryStore,
+    make_tpu_node,
+    make_v4_slice,
+)
+from yoda_scheduler_tpu.utils.labels import LabelError, spec_for
+from yoda_scheduler_tpu.utils.obs import Metrics, SloMonitor
+from yoda_scheduler_tpu.utils.pod import Pod, PodPhase
+
+MAX_AGE = 1e18  # virtual clocks: never stale
+
+
+# ------------------------------------------------------------------ helpers
+def _slice_sched(topology="4x4x2", **cfg_kw):
+    """One v4 slice (8 hosts x 4 chips = 32 chips at 4x4x2) under an
+    SLO-armed engine on a fake clock. Gang planning needs slices with
+    >= gang_size HOSTS, hence slices rather than standalone nodes."""
+    store = TelemetryStore()
+    for m in make_v4_slice("sl", topology):
+        m.heartbeat = 1e15
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg_kw.setdefault("telemetry_max_age_s", MAX_AGE)
+    cfg_kw.setdefault("elastic_gangs", True)
+    cfg_kw.setdefault("slo_serving", True)
+    cfg_kw.setdefault("slo_guard_interval_s", 1.0)
+    cfg_kw.setdefault("slo_fast_window_s", 5.0)
+    cfg_kw.setdefault("slo_slow_window_s", 15.0)
+    cfg_kw.setdefault("slo_hysteresis_s", 4.0)
+    sched = Scheduler(cluster, SchedulerConfig(**cfg_kw),
+                      clock=FakeClock())
+    return sched, cluster
+
+
+def _node_sched(n=1, chips=4, **cfg_kw):
+    store = TelemetryStore()
+    for i in range(n):
+        m = make_tpu_node(f"t{i}", chips=chips)
+        m.heartbeat = 1e15
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    cfg_kw.setdefault("telemetry_max_age_s", MAX_AGE)
+    cfg_kw.setdefault("slo_serving", True)
+    sched = Scheduler(cluster, SchedulerConfig(**cfg_kw),
+                      clock=FakeClock())
+    return sched, cluster
+
+
+def _gang(name, size=6, gmin=2, chips=2):
+    return [Pod(f"{name}-{m}", labels={
+        "scv/number": str(chips),
+        "tpu/gang-name": name, "tpu/gang-size": str(size),
+        "tpu/gang-min": str(gmin)}) for m in range(size)]
+
+
+def _serving_pod(name, chips=1, slo_ms=60_000):
+    return Pod(name, labels={"scv/number": str(chips),
+                             "scv/serving": "1",
+                             "scv/slo-ms": str(slo_ms)})
+
+
+def _bound_by_gang(pods):
+    out: dict = {}
+    for p in pods:
+        g = p.labels.get("tpu/gang-name")
+        out.setdefault(g, 0)
+        if p.phase == PodPhase.BOUND:
+            out[g] += 1
+    return out
+
+
+def _press(sched, n=3):
+    """Feed the monitor hard violations: with a 99% target one all-bad
+    window burns at 100x, far past any threshold on both windows."""
+    now = sched.clock.time()
+    for _ in range(n):
+        sched.slo.observe(1_000.0, 10.0, now)
+
+
+def _tick_guard(sched):
+    """Advance past the guard's interval gate and run one tick."""
+    clock = sched.clock
+    clock.advance(sched.sloguard.interval_s + 0.01)
+    return sched.sloguard.maybe_run(clock.time())
+
+
+def _drive_for(sched, seconds, step=0.5):
+    """Run cycles while advancing the fake clock in small steps — the
+    guard ticks from inside run_one every interval."""
+    clock = sched.clock
+    end = clock.time() + seconds
+    while clock.time() < end:
+        while sched.run_one() is not None:
+            pass
+        clock.advance(step)
+    while sched.run_one() is not None:
+        pass
+
+
+def _reason_counts(metrics, family):
+    out: dict = {}
+    for k, v in metrics.labeled_counters.get(family, {}).items():
+        out[dict(k).get("reason") or dict(k).get("check")] = \
+            out.get(dict(k).get("reason") or dict(k).get("check"), 0) + v
+    return out
+
+
+# ================================================== the serving label class
+class TestServingLabels:
+    def test_serving_and_slo_ms_parse(self):
+        spec = spec_for(Pod("s", labels={"scv/serving": "1",
+                                         "scv/slo-ms": "500"}))
+        assert spec.serving and spec.slo_ms == 500
+
+    def test_default_is_not_serving(self):
+        spec = spec_for(Pod("p", labels={"scv/number": "1"}))
+        assert not spec.serving and spec.slo_ms == 0
+
+    def test_slo_ms_requires_serving(self):
+        with pytest.raises(LabelError):
+            spec_for(Pod("x", labels={"scv/slo-ms": "500"}))
+
+    def test_serving_excludes_harvest(self):
+        with pytest.raises(LabelError):
+            spec_for(Pod("x", labels={"scv/serving": "1",
+                                      "scv/harvest": "1"}))
+
+    def test_malformed_serving_value_rejected(self):
+        with pytest.raises(LabelError):
+            spec_for(Pod("x", labels={"scv/serving": "yes"}))
+
+
+# ======================================================== burn-rate monitor
+class _FlightStub:
+    def __init__(self):
+        self.kinds: list = []
+
+    def record(self, kind, **detail):
+        self.kinds.append(kind)
+
+
+class TestSloMonitor:
+    def test_no_traffic_no_pressure(self):
+        mon = SloMonitor(Metrics())
+        assert mon.burn(30.0, 100.0) == 0.0
+        assert not mon.evaluate(100.0)
+
+    def test_pressure_requires_both_windows(self):
+        """Fast-only burn is a straggler blip; pressure asserts only
+        once the slow window agrees. target 50% -> budget 0.5, so burn
+        2.0 == every request violating."""
+        mon = SloMonitor(Metrics(), target_pct=50.0, burn_threshold=2.0,
+                         fast_window_s=10.0, slow_window_s=60.0)
+        for t in range(6):          # good history, t=0..5
+            mon.observe(1.0, 100.0, float(t))
+        for t in range(50, 56):     # all-bad recent, t=50..55
+            mon.observe(500.0, 100.0, float(t))
+        assert mon.burn(10.0, 55.0) == pytest.approx(2.0)
+        assert not mon.evaluate(55.0)   # slow window still holds the good
+        for t in range(60, 66):     # violations continue
+            mon.observe(500.0, 100.0, float(t))
+        # good history has rolled out of the slow window: both burn >= 2
+        assert mon.evaluate(70.0)
+
+    def test_fixed_window_violation_counting(self):
+        m = Metrics()
+        mon = SloMonitor(m, target_pct=99.0, fast_window_s=10.0,
+                         slow_window_s=60.0)
+        mon.observe(100.0, 10.0, 1.0)   # violation in window [1, 11)
+        mon.observe(1.0, 10.0, 2.0)     # good
+        mon.evaluate(12.0)              # closes the window: 50% > 1%
+        assert mon.window_violations == 1
+        assert m.counters["slo_window_violations_total"] == 1
+        mon.evaluate(200.0)             # empty windows close silently
+        assert mon.window_violations == 1
+        assert m.counters["slo_requests_total"] == 2
+        assert m.counters["slo_violations_total"] == 1
+
+    def test_burn_trip_records_once_and_rearms(self):
+        flight = _FlightStub()
+        mon = SloMonitor(Metrics(), flight=flight, target_pct=99.0,
+                         fast_window_s=5.0, slow_window_s=10.0)
+        mon.observe(100.0, 10.0, 1.0)
+        assert mon.evaluate(1.5) and flight.kinds == ["slo_burn"]
+        assert mon.evaluate(2.0) and flight.kinds == ["slo_burn"]
+        assert not mon.evaluate(50.0)   # recovered: events rolled out
+        mon.observe(100.0, 10.0, 51.0)
+        assert mon.evaluate(51.5)
+        assert flight.kinds == ["slo_burn", "slo_burn"]  # re-armed
+
+
+# ============================================================ the SLO guard
+class TestSloGuard:
+    def test_shrink_to_min_never_below_and_reason_is_slo(self):
+        sched, cluster = _slice_sched(slo_shrink_budget=16)
+        pods = _gang("ga") + _gang("gb")
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=2000)
+        assert _bound_by_gang(pods) == {"ga": 6, "gb": 6}
+        _press(sched)
+        victims = _tick_guard(sched)
+        assert len(victims) == 8        # surplus 4 per gang, budget 16
+        assert _bound_by_gang(pods) == {"ga": 2, "gb": 2}
+        # a second pressed pass finds no surplus: the min is a floor
+        _press(sched)
+        assert _tick_guard(sched) == []
+        assert _bound_by_gang(pods) == {"ga": 2, "gb": 2}
+        shrinks = _reason_counts(sched.metrics, "gang_shrink_total")
+        assert shrinks.get("slo") == 8
+        assert "preemption" not in shrinks
+        assert sched.metrics.counters["slo_shrink_passes_total"] == 1
+
+    def test_shrink_budget_bounds_one_bite(self):
+        sched, _ = _slice_sched(slo_shrink_budget=3)
+        pods = _gang("ga") + _gang("gb")
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=2000)
+        _press(sched)
+        assert len(_tick_guard(sched)) == 3
+        sizes = _bound_by_gang(pods)
+        assert all(n >= 2 for n in sizes.values())
+        assert sum(sizes.values()) == 9
+
+    def test_hysteresis_blocks_shrink_after_giveback(self):
+        sched, _ = _slice_sched()
+        guard = sched.sloguard
+        guard._last_giveback = sched.clock.time()
+        assert guard.run_shrink_pass(sched.clock.time() + 1.0) is None
+        skips = _reason_counts(sched.metrics, "slo_guard_skips_total")
+        assert skips.get("hysteresis") == 1
+
+    def test_breaker_open_skips_shrink(self):
+        sched, _ = _slice_sched()
+        now = sched.clock.time()
+        sched._breaker_until = now + 60.0
+        assert sched.sloguard.run_shrink_pass(now) is None
+        skips = _reason_counts(sched.metrics, "slo_guard_skips_total")
+        assert skips.get("breaker-open") == 1
+
+    def test_degraded_skips_shrink(self):
+        sched, _ = _slice_sched()
+        sched._detect_degraded = lambda now: True
+        assert sched.sloguard.run_shrink_pass(sched.clock.time()) is None
+        skips = _reason_counts(sched.metrics, "slo_guard_skips_total")
+        assert skips.get("degraded") == 1
+
+    def test_parked_serving_presses_even_before_any_burn(self):
+        """A starved serving class never binds, so its latency never
+        reaches the monitor — parked serving demand IS pressure."""
+        sched, cluster = _node_sched(n=1, chips=4)
+        blocker = Pod("blk", labels={"scv/number": "4"})
+        sched.submit(blocker)
+        sched.run_until_idle(max_cycles=20)
+        assert blocker.phase == PodPhase.BOUND
+        sched.submit(_serving_pod("srv"))
+        sched.run_until_idle(max_cycles=30)
+        _tick_guard(sched)
+        assert sched.sloguard.pressed
+
+    def test_growth_hold_then_giveback_regrows(self):
+        """The tentpole loop on one engine: press -> shrink-to-min ->
+        requeued members HELD while pressure lasts -> pressure fades ->
+        hysteresis'd give-back -> gangs re-grow to full size. The
+        transition log shows exactly one press/release pair and the
+        give-back lands >= one hysteresis window after the release."""
+        HYST = 4.0
+        sched, cluster = _slice_sched(slo_shrink_budget=8,
+                                      slo_hysteresis_s=HYST)
+        pods = _gang("ga") + _gang("gb")
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=2000)
+        _press(sched)
+        victims = _tick_guard(sched)
+        assert len(victims) == 8
+        # while the hold lasts, the requeued members must NOT re-absorb
+        # the freed chips
+        _drive_for(sched, 2.0)
+        assert _bound_by_gang(pods) == {"ga": 2, "gb": 2}
+        assert sched.metrics.counters.get(
+            "serving_growth_holds_total", 0) >= 1
+        assert sched.sloguard.holding(sched.clock.time())
+        # pressure fades (fast window 5s empties), give-back after the
+        # healthy window AND one window past the shrink
+        _drive_for(sched, 30.0)
+        sched.run_until_idle(max_cycles=2000)
+        assert sched.metrics.counters["slo_giveback_total"] == 1
+        assert not sched.sloguard._shrunk
+        assert _bound_by_gang(pods) == {"ga": 6, "gb": 6}
+        kinds = [k for _, k in sched.sloguard.transitions]
+        assert kinds == ["press", "release"]
+
+    def test_giveback_survives_ownership_handover(self):
+        """Ownership gates the SHRINK side only: a replica whose lease
+        moved away after it shrank still owes its own give-back — gating
+        that on the lease would latch the growth hold forever."""
+        sched, _ = _slice_sched()
+        guard = sched.sloguard
+        guard.owner_check = lambda: False   # lease moved away
+        guard._shrunk = {"ga": 0.0}
+        guard._healthy_since = 0.0
+        now = sched.clock.time() + 100.0
+        guard.next_at = now
+        assert guard.maybe_run(now) == "giveback"
+        assert not guard._shrunk
+        assert sched.metrics.counters["slo_giveback_total"] == 1
+
+    def test_guard_is_a_wake_source_only_while_demanded(self):
+        sched, _ = _slice_sched()
+        guard = sched.sloguard
+        assert not guard.demanded()
+        guard._shrunk = {"ga": 0.0}
+        assert guard.demanded()
+        wake = sched.next_wake_at()
+        assert wake is not None and wake <= guard.next_at
+
+
+# ============================================== serving bind -> monitor feed
+class TestBindObservation:
+    def test_serving_bind_feeds_the_monitor(self):
+        sched, _ = _node_sched(n=1, chips=4)
+        sched.submit(_serving_pod("srv", slo_ms=10_000))
+        sched.submit(Pod("train", labels={"scv/number": "1"}))
+        sched.run_until_idle(max_cycles=30)
+        # exactly the serving bind observed; the training bind is not
+        assert sched.metrics.counters["slo_requests_total"] == 1
+        assert sched.metrics.counters.get("slo_violations_total", 0) == 0
+
+    def test_knob_off_observes_nothing(self):
+        sched, _ = _node_sched(n=1, chips=4, slo_serving=False)
+        assert sched.slo is None and sched.sloguard is None
+        sched.submit(_serving_pod("srv"))
+        sched.run_until_idle(max_cycles=30)
+        assert "slo_requests_total" not in sched.metrics.counters
+
+
+# ================================================= serving-headroom reserve
+class TestServingHeadroom:
+    def test_reserve_caps_nonserving_and_admits_serving(self):
+        sched, cluster = _node_sched(n=4, chips=4,
+                                     serving_headroom_pct=0.5)
+        training = [Pod(f"t{i}", labels={"scv/number": "2"})
+                    for i in range(5)]
+        for p in training:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=100)
+        bound = [p for p in training if p.phase == PodPhase.BOUND]
+        assert len(bound) == 4          # 8 of 16 chips: the ceiling
+        assert sched.metrics.counters[
+            "serving_headroom_rejections_total"] >= 1
+        # serving pods always pass: the reserve is THEIR floor
+        serving = [_serving_pod(f"s{i}", chips=2) for i in range(4)]
+        for p in serving:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=100)
+        assert all(p.phase == PodPhase.BOUND for p in serving)
+        # a non-serving departure frees aggregate share event-driven
+        cluster.evict(bound[0])
+        sched.run_until_idle(max_cycles=100)
+        assert sum(1 for p in training
+                   if p.phase == PodPhase.BOUND) == 4
+
+    def test_regrowth_passes_reserve_via_unbound_remainder(self):
+        """The satellite-2 regression: after a crowd the shrunk gang
+        re-grows while the book already counts its bound members —
+        whole-gang demand would double-count them, overshoot the
+        reserve, and wedge re-growth. 32 chips, 25% reserved: two
+        6-member gangs hold exactly the 24-chip non-serving ceiling, so
+        every re-grown member passes ONLY if gated on the remainder."""
+        HYST = 3.0
+        sched, cluster = _slice_sched(serving_headroom_pct=0.25,
+                                      slo_hysteresis_s=HYST,
+                                      slo_fast_window_s=4.0,
+                                      slo_slow_window_s=8.0,
+                                      slo_shrink_budget=1)
+        pods = _gang("ga") + _gang("gb")
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=2000)
+        assert _bound_by_gang(pods) == {"ga": 6, "gb": 6}
+        # flash crowd: 10 one-chip serving pods against 8 free chips —
+        # the guard's shrink is the only source of the last two
+        serving = [_serving_pod(f"s{i}") for i in range(10)]
+        for p in serving:
+            sched.submit(p)
+        _drive_for(sched, 10.0)
+        assert all(p.phase == PodPhase.BOUND for p in serving)
+        sizes = _bound_by_gang(pods)
+        assert all(n >= 2 for n in sizes.values())
+        assert sum(sizes.values()) < 12
+        # the crowd completes; the give-back must re-grow to full size
+        for p in serving:
+            sched.forget(p.key)
+            if p.phase == PodPhase.BOUND:
+                cluster.evict(p)
+        _drive_for(sched, 20.0)
+        sched.run_until_idle(max_cycles=2000)
+        assert _bound_by_gang(pods) == {"ga": 6, "gb": 6}
+        assert sched.metrics.counters["slo_giveback_total"] >= 1
+
+    def test_zero_pct_builds_no_gate(self):
+        sched, _ = _node_sched(n=1, serving_headroom_pct=0.0)
+        names = {type(p).__name__ for p in sched.profile.pre_filter}
+        assert "ServingHeadroomGate" not in names
+
+
+# ====================================== workload-admission serving fastpath
+class TestServingFastpath:
+    def _admission_sched(self, cluster, **cfg_kw):
+        cfg_kw.setdefault("workload_admission", True)
+        cfg_kw.setdefault("slo_serving", True)
+        cfg_kw.setdefault("telemetry_max_age_s", MAX_AGE)
+        cfg_kw.setdefault("max_attempts", 0)
+        return Scheduler(cluster, SchedulerConfig(**cfg_kw),
+                         clock=HybridClock())
+
+    def _cluster(self, n=4, chips=4):
+        store = TelemetryStore()
+        import time as _t
+        for i in range(n):
+            m = make_tpu_node(f"t{i}", chips=chips)
+            m.heartbeat = _t.time()
+            store.put(m)
+        c = FakeCluster(store)
+        c.add_nodes_from_telemetry()
+        return c
+
+    def test_serving_workload_bypasses_rate_limit(self):
+        s = self._admission_sched(self._cluster(),
+                                  admission_rate_per_s=1e-9,
+                                  admission_burst=1)
+        t1 = Workload("t1", labels={"scv/number": "1"})
+        s.submit_workload(t1)
+        s.run_until_idle(max_cycles=100)
+        assert t1.state == ADMITTED     # spent the only token
+        srv = Workload("srv", replicas=2,
+                       labels={"scv/number": "1", "scv/serving": "1",
+                               "scv/slo-ms": "5000"})
+        s.submit_workload(srv)
+        s.run_until_idle(max_cycles=100)
+        assert srv.state == ADMITTED
+        assert s.workloads._tokens >= 0.0   # serving consumed no token
+        fast = _reason_counts(s.metrics, "workload_serving_fastpath_total")
+        assert fast.get("rate-limit", 0) >= 1
+        t2 = Workload("t2", labels={"scv/number": "1"})
+        s.submit_workload(t2)
+        s.run_until_idle(max_cycles=50)
+        assert t2.state == PARKED       # training still metered
+
+    def test_serving_workload_bypasses_queue_depth_cap(self):
+        s = self._admission_sched(self._cluster(n=1, chips=8),
+                                  max_materialized_pods=4)
+        # a 6-member gang on one host: capacity-feasible (6 <= 8 chips)
+        # so it admits into the empty queue, but unplaceable (one member
+        # per HOST) — all 6 park and the window fills past the cap
+        t1 = Workload("t1", members=6, labels={"scv/number": "1"})
+        s.submit_workload(t1)
+        s.run_until_idle(max_cycles=100)
+        assert t1.state == ADMITTED     # empty queue admits regardless
+        assert s.queue.pending() >= 4   # 6 parked: window full
+        t2 = Workload("t2", labels={"scv/number": "1"})
+        s.submit_workload(t2)
+        s.run_until_idle(max_cycles=50)
+        assert t2.state == PARKED       # queue-depth backpressure
+        # srv sits BEHIND the backpressured training head — the serving
+        # sweep must carry it past (head-of-line lane), and _decide's
+        # own queue-depth fastpath clears the window check
+        srv = Workload("srv", labels={"scv/number": "1",
+                                      "scv/serving": "1"})
+        s.submit_workload(srv)
+        s.run_until_idle(max_cycles=50)
+        assert srv.state == ADMITTED
+        assert t2.state == PARKED       # training still held in order
+        fast = _reason_counts(s.metrics, "workload_serving_fastpath_total")
+        assert fast.get("queue-depth", 0) >= 1
+        assert fast.get("head-of-line", 0) >= 1
+
+
+# ======================================================== knob-off parity
+class TestKnobOffParity:
+    def test_default_off_env_opt_in(self, monkeypatch):
+        monkeypatch.delenv("YODA_SLO", raising=False)
+        assert SchedulerConfig().slo_serving is False
+        monkeypatch.setenv("YODA_SLO", "1")
+        assert SchedulerConfig().slo_serving is True
+
+    def test_profile_camelcase_knobs(self):
+        cfg = SchedulerConfig.from_profile({"pluginConfig": [
+            {"name": "yoda-tpu", "args": {
+                "sloServing": True, "servingHeadroomPct": 0.2,
+                "sloTargetPct": 99.9, "sloBurnThreshold": 3.0,
+                "sloFastWindowSeconds": 7.0,
+                "sloSlowWindowSeconds": 70.0,
+                "sloGuardIntervalSeconds": 2.0,
+                "sloShrinkBudget": 6, "sloHysteresisSeconds": 9.0}}]})
+        assert cfg.slo_serving is True
+        assert cfg.serving_headroom_pct == pytest.approx(0.2)
+        assert cfg.slo_target_pct == pytest.approx(99.9)
+        assert cfg.slo_burn_threshold == pytest.approx(3.0)
+        assert cfg.slo_fast_window_s == pytest.approx(7.0)
+        assert cfg.slo_slow_window_s == pytest.approx(70.0)
+        assert cfg.slo_guard_interval_s == pytest.approx(2.0)
+        assert cfg.slo_shrink_budget == 6
+        assert cfg.slo_hysteresis_s == pytest.approx(9.0)
+
+    def _placement(self, cfg):
+        store = TelemetryStore()
+        for i in range(4):
+            m = make_tpu_node(f"p{i}", chips=4)
+            m.heartbeat = 1e15
+            store.put(m)
+        cluster = FakeCluster(store)
+        cluster.add_nodes_from_telemetry()
+        sched = Scheduler(cluster, cfg, clock=FakeClock())
+        pods = [Pod(f"t{i}", labels={"scv/number": str(1 + i % 2)})
+                for i in range(8)]
+        pods += [_serving_pod(f"s{i}") for i in range(4)]
+        for p in pods:
+            sched.submit(p)
+        sched.run_until_idle(max_cycles=2000)
+        return {p.name: (p.phase, p.node,
+                         tuple(sorted(p.assigned_chips())))
+                for p in pods}
+
+    def test_knob_off_places_bit_identically(self):
+        """Every satellite field set but the master knob off: nothing
+        may be constructed, placements identical to the default."""
+        base = self._placement(
+            SchedulerConfig(telemetry_max_age_s=MAX_AGE,
+                            slo_serving=False))
+        loaded = self._placement(
+            SchedulerConfig(telemetry_max_age_s=MAX_AGE,
+                            slo_serving=False,
+                            serving_headroom_pct=0.3,
+                            slo_target_pct=99.9,
+                            slo_fast_window_s=5.0,
+                            slo_slow_window_s=50.0,
+                            slo_guard_interval_s=0.5,
+                            slo_shrink_budget=2,
+                            slo_hysteresis_s=5.0))
+        assert base == loaded
+
+
+# ============================================================== chaos fuzz
+_SLO_SMOKE = 8
+_SLO_FULL = 48
+
+
+def _slo_seed_params():
+    return [s if s < _SLO_SMOKE
+            else pytest.param(s, marks=pytest.mark.slow)
+            for s in range(_SLO_FULL)]
+
+
+@pytest.mark.parametrize("seed", _slo_seed_params())
+def test_slo_chaos_fuzz(seed):
+    """One seeded serving scenario end to end: a 2-3 replica sharded
+    fleet colocating two elastic gangs with a serving class, under the
+    SLO_KINDS mix — FLASH_CROWD windows scale the serving generator past
+    the free pool, provider stockouts choke the capacity loop (the
+    guard's shrink is then the only source of chips), lease expiry moves
+    the guard's shrink ownership mid-pass, replica crashes rebuild
+    engines outright. At convergence the four global invariants hold
+    fleet-wide PLUS the SLO three: no gang ever sampled below its
+    tpu/gang-min once it reached it, the serving class converges bound,
+    and no guard logged a press within one hysteresis window of the
+    preceding release (zero oscillation pairs)."""
+    from test_chaos import _assert_invariants
+
+    HYST = 3.0
+    # 32 slice chips - 16 training = 16 free; the provider pool adds at
+    # most 8 more. CROWD=26 one-chip pods therefore ALWAYS overruns
+    # capacity until the guard shrinks the gangs to min (frees 8): the
+    # crowd seeds genuinely exercise degradation, not just provisioning
+    GANGS, SIZE, GMIN, BASE, CROWD = 2, 4, 2, 2, 26
+    rng = random.Random(77_000 + seed)
+    plan = FaultPlan(seed, horizon_s=20.0, kinds=SLO_KINDS,
+                     max_windows=3)
+    clock = FakeClock()
+    store = TelemetryStore()
+    for m in make_v4_slice("sl", "4x4x2"):
+        m.heartbeat = 1e9
+        store.put(m)
+    cluster = ChaosCluster(store, plan=plan, clock=clock)
+    cluster.add_nodes_from_telemetry()
+    n_replicas = rng.choice((2, 3))
+    fleet = FleetCoordinator(
+        cluster,
+        SchedulerConfig(telemetry_max_age_s=1e9,
+                        elastic_gangs=True,
+                        slo_serving=True,
+                        slo_target_pct=99.0,
+                        slo_fast_window_s=2.0,
+                        slo_slow_window_s=6.0,
+                        slo_guard_interval_s=0.5,
+                        slo_shrink_budget=4,
+                        slo_hysteresis_s=HYST,
+                        breaker_cooldown_s=1.0,
+                        provisioner_interval_s=1.0,
+                        scale_down_cooldown_s=4.0,
+                        provisioner_hysteresis_s=3.0,
+                        provisioner_backoff_s=0.5,
+                        provisioner_backoff_max_s=4.0,
+                        provision_timeout_s=8.0),
+        replicas=n_replicas, clock=clock, mode="sharded", seed=seed)
+    provider = SimulatedProvider(
+        FakeBackend(cluster, orphan_router=fleet.submit),
+        clock=clock, plan=plan, seed=seed, latency_s=(0.2, 1.0))
+    fleet.set_capacity_provider(
+        provider, pools=[NodeTemplate(pool="vp", chips=4, max_nodes=2)])
+    training = [p for g in range(GANGS)
+                for p in _gang(f"g{g}", size=SIZE, gmin=GMIN, chips=2)]
+    for p in training:
+        fleet.submit(p)
+    crowd_windows = plan.windows_of(FLASH_CROWD)
+    serving: list = []
+    seq = 0
+    fired: set = set()
+    reached: dict = {}
+    floor_breaks: list = []
+    tag = f"slo-{seed}"
+
+    def serve_want(now: float) -> int:
+        return (CROWD if any(w.active(now) for w in crowd_windows)
+                else BASE)
+
+    def pump_until(deadline: float) -> None:
+        while True:
+            if fleet.step(rng) is not None:
+                continue
+            wake = fleet.next_wake_at()
+            now = clock.time()
+            if wake is None or wake >= deadline:
+                if deadline > now:
+                    clock.advance(deadline - now)
+                return
+            clock.advance(max(wake - now, 0.05))
+
+    t, dt = 0.0, 0.5
+    horizon = plan.fault_end() + 2.0
+    while t < horizon:
+        now = clock.time()
+        for w in plan.windows:
+            key = (w.kind, w.start)
+            if w.start > now or key in fired:
+                continue
+            if w.kind == REPLICA_CRASH:
+                fired.add(key)
+                fleet.crash_replica(rng.randrange(fleet.n),
+                                    training + serving)
+            elif w.kind == LEASE_EXPIRY:
+                fired.add(key)
+                fleet.revoke_replica_leases(rng.randrange(fleet.n))
+        want = serve_want(now)
+        while len(serving) < want:
+            seq += 1
+            serving.append(_serving_pod(f"serve-{seq}"))
+            fleet.submit(serving[-1])
+        while len(serving) > want:
+            p = serving.pop(0)      # oldest request completes
+            fleet.forget(p.key)
+            if p.phase == PodPhase.BOUND:
+                cluster.evict(p)
+        pump_until(t + dt)
+        t += dt
+        sizes = _bound_by_gang(training)
+        for g, n in sizes.items():
+            if n >= GMIN:
+                reached[g] = True
+            elif reached.get(g):
+                floor_breaks.append((t, g, n))
+    assert not floor_breaks, (
+        f"{tag}: gangs sampled below tpu/gang-min: {floor_breaks[:5]}")
+    # drain: the crowd is over — every guard must give back, the gangs
+    # re-grow to full size, and the base serving class stays bound.
+    # Churn one serving pod per window so capacity events keep flowing
+    # (real serving traffic completes; parked pods also hold backoff
+    # timers, so this only shortens the tail).
+    deadline = clock.time() + 90.0
+    while clock.time() < deadline:
+        done = (all(p.phase == PodPhase.BOUND for p in training)
+                and all(p.phase == PodPhase.BOUND for p in serving)
+                and not any(r.engine.sloguard._shrunk
+                            for r in fleet.replicas
+                            if r.engine.sloguard is not None))
+        if done:
+            break
+        p = serving.pop(0)
+        fleet.forget(p.key)
+        if p.phase == PodPhase.BOUND:
+            cluster.evict(p)
+        seq += 1
+        serving.append(_serving_pod(f"serve-{seq}"))
+        fleet.submit(serving[-1])
+        pump_until(clock.time() + 2.0)
+    sizes = _bound_by_gang(training)
+    assert sizes == {f"g{g}": SIZE for g in range(GANGS)}, (
+        f"{tag}: gangs did not re-grow after the crowd: {sizes}")
+    assert all(p.phase == PodPhase.BOUND for p in serving), (
+        f"{tag}: serving class starved at convergence")
+    _assert_invariants(training + serving, store, cluster, tag,
+                       sched=fleet)
+    # zero oscillation pairs: no press within one hysteresis window of
+    # the preceding release, on any replica's guard
+    for rep in fleet.replicas:
+        guard = rep.engine.sloguard
+        if guard is None:
+            continue
+        last_release = None
+        for ts, kind in guard.transitions:
+            if kind == "release":
+                last_release = ts
+            elif last_release is not None:
+                assert ts - last_release >= HYST - 1e-6, (
+                    f"{tag}: press@{ts:.2f} inside one hysteresis "
+                    f"window of release@{last_release:.2f}")
+    # shrink accounting: serving pressure never books as preemption
+    for rep in fleet.replicas:
+        shrinks = _reason_counts(rep.engine.metrics, "gang_shrink_total")
+        assert "preemption" not in shrinks, f"{tag}: {shrinks}"

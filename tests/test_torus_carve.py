@@ -356,6 +356,81 @@ class TestGangCarve:
         assert not sched.gang_permit.gangs.carve_of("g")
 
 
+class TestDcnAwareMultiSlice:
+    """ISSUE 19 satellite: multi-slice carve plans rank follow-up slices
+    by DCN proximity to the already-chosen set. The distance is a proxy
+    over slice ids (pool prefix + numeric suffix = provisioning
+    adjacency); the single-slice path is untouched."""
+
+    def test_dcn_distance_proxy(self):
+        from yoda_scheduler_tpu.scheduler.carve import (_DCN_FAR,
+                                                        dcn_distance)
+
+        assert dcn_distance("vp-3", "vp-3") == 0
+        assert dcn_distance("vp-3", "vp-5") == 2
+        assert dcn_distance("vp-5", "vp-3") == 2      # symmetric
+        assert dcn_distance("vp-3", "wq-3") == _DCN_FAR
+        assert dcn_distance("vp-3", "vp-x") == _DCN_FAR
+        assert dcn_distance("solo", "vp-3") == _DCN_FAR
+        # any finite suffix gap ranks below one pool cross
+        assert dcn_distance("vp-0", "vp-999999") < _DCN_FAR
+
+    def test_multislice_prefers_dcn_near_slices(self):
+        """Three equal slices: vp-0, vp-1, vp-9. A gang of 8 needs two.
+        The anchor (largest carvable, tie on id) is vp-0; the DCN term
+        must pick vp-1 over vp-9 — without it the tie would fall to
+        carvable volume + id and still pass, so the far slice is made
+        IDENTICAL in capacity and the near one is only reachable
+        through the distance key."""
+        nodes = (make_slice("vp-0", "2x2x4", generation="v4")
+                 + make_slice("vp-1", "2x2x4", generation="v4")
+                 + make_slice("vp-9", "2x2x4", generation="v4"))
+        sched = mk(nodes, gang_timeout_s=30.0)
+        gang = gang_pods("g", 8)
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang)
+        used = {p.node.rsplit("-host-", 1)[0] for p in gang}
+        assert used == {"vp-0", "vp-1"}
+        # the observed DCN span is the suffix gap of the chosen pair
+        h = sched.metrics.histograms.get("torus_multislice_dcn_span")
+        assert h is not None and max(h.samples()) == 1.0
+
+    def test_single_slice_carve_ignores_dcn(self):
+        """A gang that fits one slice must never pay the multi-slice
+        machinery: same three slices, gang of 4 — single carve, no
+        multislice plan, no span observation (the parity leg of the
+        satellite: _carve_single is untouched by the DCN change)."""
+        nodes = (make_slice("vp-0", "2x2x4", generation="v4")
+                 + make_slice("vp-9", "2x2x4", generation="v4"))
+        sched = mk(nodes, gang_timeout_s=30.0)
+        gang = gang_pods("g", 4)
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang)
+        assert len({p.node.rsplit("-host-", 1)[0] for p in gang}) == 1
+        assert sched.metrics.counters.get(
+            "torus_multislice_plans_total", 0) == 0
+        assert "torus_multislice_dcn_span" not in sched.metrics.histograms
+
+    def test_foreign_pool_slices_still_combine_when_forced(self):
+        """DCN-far is a preference, not a veto: when only foreign-pool
+        slices remain, the plan still covers the gang."""
+        nodes = (make_slice("vp-0", "2x2x4", generation="v4")
+                 + make_slice("wq-0", "2x2x4", generation="v4"))
+        sched = mk(nodes, gang_timeout_s=30.0)
+        gang = gang_pods("g", 8)
+        for p in gang:
+            sched.submit(p)
+        sched.run_until_idle()
+        assert all(p.phase == PodPhase.BOUND for p in gang)
+        from yoda_scheduler_tpu.scheduler.carve import _DCN_FAR
+        h = sched.metrics.histograms.get("torus_multislice_dcn_span")
+        assert h is not None and max(h.samples()) == float(_DCN_FAR)
+
+
 class TestGeometricFragTerm:
     def _plugin(self, sched):
         return next(p for p in sched.profile.score

@@ -103,6 +103,15 @@ PROVISION_FLAP = "ProvisionFlap"
 # and the one-pass withdraw retirement are the only things standing
 # between that and a double-materialized workload / leaked quota claim.
 ADMISSION_RACE = "AdmissionRace"
+# SLO-serving kind (ISSUE 19): a FLASH_CROWD window multiplies the
+# serving class's open-loop arrival rate (the driver reads the plan's
+# windows and scales its own generator — the window IS the crowd).
+# Crossed with provider stockouts (capacity cannot arrive), lease
+# expiry (the guard's shard-0 ownership moves mid-shrink), and replica
+# crashes, the burn-rate trip, the shrink-to-min pass, and the
+# hysteresis'd give-back are the only things standing between a traffic
+# spike and a starved serving class / an oscillating training fleet.
+FLASH_CROWD = "FlashCrowd"
 
 ALL_KINDS = (APISERVER_STORM, BIND_LOST, TELEMETRY_BLACKOUT, PLUGIN_ERROR,
              ENGINE_CRASH)
@@ -140,6 +149,14 @@ PROVISIONER_KINDS = (APISERVER_STORM, BIND_LOST, REPLICA_CRASH,
                      LEASE_EXPIRY, NETWORK_PARTITION, PROVIDER_STOCKOUT,
                      PROVIDER_QUOTA_DENIED, PROVISION_LOST_RESPONSE,
                      PROVISION_FLAP)
+# the SLO-serving fuzz's mix (tests/test_slo.py): flash crowds landing
+# inside provider stockouts (shrink is the ONLY source of chips), lease
+# expiry moving the guard's ownership mid-pass, and replica crashes —
+# "no gang below min, serving never starves once pressure registers,
+# zero shrink/give-back oscillation pairs inside one hysteresis window"
+# join the four global invariants
+SLO_KINDS = (FLASH_CROWD, PROVIDER_STOCKOUT, LEASE_EXPIRY,
+             REPLICA_CRASH)
 
 
 class LostResponseError(ConnectionError):

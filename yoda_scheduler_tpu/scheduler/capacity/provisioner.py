@@ -552,10 +552,15 @@ class CapacityProvisioner:
             if now < pool.backoff_until:
                 self._skip("pool-backoff")
                 continue
-            if not floor_deficit \
-                    and now - pool.last_scale_down < self.hysteresis_s:
+            guard = getattr(self.sched, "sloguard", None)
+            if (not floor_deficit
+                    and now - pool.last_scale_down < self.hysteresis_s
+                    and not (guard is not None and guard.holding(now))):
                 # hysteresis: never scale up within one window of our
-                # own scale-down (flap damping; min-floor repair exempt)
+                # own scale-down (flap damping; min-floor repair exempt,
+                # and so is live SLO pressure — a flash crowd arriving
+                # right after a valley scale-down must not wait out the
+                # flap window while the serving class burns)
                 self._skip("hysteresis")
                 continue
             room = pool.max - size - len(pool.in_flight) * unit
@@ -586,6 +591,14 @@ class CapacityProvisioner:
             return
         if sched._detect_degraded(now):
             self._skip("degraded")
+            return
+        guard = getattr(sched, "sloguard", None)
+        if guard is not None and guard.holding(now):
+            # SLO pressure (or shrunk capacity still owed back): every
+            # chip is spoken for — releasing nodes now would force the
+            # guard into deeper gang shrinks, and the give-back needs
+            # the capacity intact to re-grow them
+            self._skip("slo-pressure")
             return
         demand_pools = self._demanded_pools(demand)
         for name, pool in self.pools.items():

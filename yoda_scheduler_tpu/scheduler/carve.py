@@ -12,9 +12,19 @@ carve never claims a host the legacy planner would reject — and carves:
   carve of exactly gang_size; the winner maximises ICI bisection links
   (ties break on slice id, deterministic across processes).
 - multi-slice: when no single slice can host the gang, one carve per
-  slice, largest-carvable-first (fewest slices, largest chunks — the
-  same DCN-hop minimisation as the legacy fewest-slices plan, but each
-  chunk is now a contiguous block instead of an arbitrary host set).
+  slice. The anchor is the largest-carvable slice (fewest slices,
+  largest chunks — the same DCN-hop minimisation as the legacy
+  fewest-slices plan, but each chunk is a contiguous block instead of
+  an arbitrary host set); every SUBSEQUENT slice is ranked by DCN
+  distance to the already-chosen set first, carvable volume second —
+  a gang split across slices pays its all-reduce over the data-center
+  network, and two slices a rack apart beat two across the hall.
+  Distance is a topology-free proxy derived from slice ids (see
+  ``dcn_distance``): same pool prefix -> numeric suffix gap (slices
+  are provisioned in adjacency order), different pools -> far. When
+  every candidate is equidistant the order degenerates to exactly the
+  legacy largest-carvable-first (the parity fence in
+  tests/test_torus_carve.py).
 
 The result is advisory narrowing, not a reservation: GangPermit
 intersects its candidate nodes with the carved hosts and the ordinary
@@ -66,6 +76,28 @@ def slice_host_coord(m, grid):
     assigned in host_blocks enumeration order — telemetry/fake.py and
     the provisioner both derive it from the same tiling)."""
     return host_coord(m.host_index, grid)
+
+
+# inter-pool hops dominate intra-pool ones by orders of magnitude on a
+# DCN fabric; any finite suffix gap must still rank below a pool cross
+_DCN_FAR = 1 << 20
+
+
+def dcn_distance(sid_a: str, sid_b: str) -> int:
+    """Inter-slice DCN distance PROXY. Telemetry carries no fabric
+    coordinates (telemetry/schema.py), but slice ids encode provisioning
+    adjacency: the capacity loop names a pool's slices with a shared
+    pool prefix and a monotone numeric suffix, and consecutively
+    provisioned slices land on adjacent fabric attachment points. Same
+    prefix -> absolute suffix gap; anything else (foreign pools,
+    non-numeric ids) -> ``_DCN_FAR``. Zero for identical ids."""
+    if sid_a == sid_b:
+        return 0
+    pa, _, na = sid_a.rpartition("-")
+    pb, _, nb = sid_b.rpartition("-")
+    if pa and pa == pb and na.isdigit() and nb.isdigit():
+        return abs(int(na) - int(nb))
+    return _DCN_FAR
 
 
 class TorusCarver:
@@ -165,23 +197,31 @@ class TorusCarver:
         return {sid: names}
 
     def _carve_multi(self, slices, spec):
-        """Greedy largest-carvable-first partition; every chunk an exact
-        carve. None unless >1 slice covers the gang completely."""
-        order = sorted(
-            ((largest_carvable(grid, frozenset(hosts), wrap=wrap), sid)
-             for sid, (grid, wrap, _, hosts) in slices.items()),
-            key=lambda kv: (-kv[0], kv[1]))
+        """Greedy DCN-aware partition; every chunk an exact carve. The
+        anchor slice is the largest carvable (ties on id); each further
+        slice minimises (distance to the chosen set, -carvable, id) —
+        the gang's cross-slice all-reduce spans the narrowest stretch
+        of DCN fabric that still covers it. None unless >1 slice covers
+        the gang completely."""
+        caps = {sid: largest_carvable(grid, frozenset(hosts), wrap=wrap)
+                for sid, (grid, wrap, _, hosts) in slices.items()}
+        candidates = {sid for sid, cap in caps.items() if cap > 0}
         remaining = spec.gang_size
         result: dict = {}
         noted = []
-        for cap, sid in order:
-            if remaining <= 0:
-                break
-            if cap <= 0:
-                continue
+        chosen: list = []
+        while remaining > 0 and candidates:
+            if not chosen:
+                sid = min(candidates, key=lambda s: (-caps[s], s))
+            else:
+                sid = min(candidates,
+                          key=lambda s: (min(dcn_distance(s, c)
+                                             for c in chosen),
+                                         -caps[s], s))
+            candidates.discard(sid)
             grid, wrap, gen_name, hosts = slices[sid]
             free = frozenset(hosts)
-            n = min(cap, remaining)
+            n = min(caps[sid], remaining)
             out = None
             # n below the largest carvable volume may have no fitting
             # factor shape (3 hosts on a 2x2 grid) — shrink to the
@@ -195,6 +235,7 @@ class TorusCarver:
             _, block, coords, _ = out
             result[sid] = frozenset(hosts[c] for c in coords)
             noted.append((sid, grid, wrap, block, gen_name))
+            chosen.append(sid)
             remaining -= len(coords)
         if remaining > 0 or len(result) <= 1:
             return None
@@ -202,4 +243,8 @@ class TorusCarver:
             self._note(sid, grid, wrap, block, gen_name)
         if self.metrics is not None:
             self.metrics.inc("torus_multislice_plans_total")
+            self.metrics.observe(
+                "torus_multislice_dcn_span",
+                float(max(dcn_distance(a, b)
+                          for a in result for b in result)))
         return result

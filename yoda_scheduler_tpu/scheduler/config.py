@@ -238,6 +238,19 @@ def _workload_admission_default() -> bool:
         "1", "true", "on")
 
 
+def _slo_default() -> bool:
+    """SLO-guarded colocated serving (scheduler/elastic/sloguard.py):
+    scv/serving pods get burn-rate-monitored scheduling latency, flash
+    crowds shrink elastic training gangs toward tpu/gang-min (the PR 10
+    predicate, not just harvest eviction), admission reserves serving
+    headroom as a DRF quota level, and a hysteresis'd give-back returns
+    surplus to training in valleys. Default OFF; YODA_SLO=1 enables (CI
+    runs a tier-1 leg with it spelled-out off — placements are
+    bit-identical when unset, the same parity discipline as the policy
+    engine)."""
+    return os.environ.get("YODA_SLO", "0").lower() in ("1", "true", "on")
+
+
 def _drf_default() -> bool:
     """DRF fairness layer (tenant-fairness queue ordering + quota gate
     + preemption budgets): default OFF; YODA_DRF=1 enables."""
@@ -657,6 +670,39 @@ class SchedulerConfig:
     # (failure-path backoff applies); a node that arrives later anyway
     # is adopted through membership reconciliation, never leaked
     provision_timeout_s: float = 120.0
+    # ---- SLO-guarded colocated serving (scheduler/elastic/sloguard.py,
+    # utils/obs.py SloMonitor, scheduler/policy/headroom.py) ----
+    # master knob: OFF (the default) constructs none of it — no monitor,
+    # no guard, no headroom gate, placements bit-identical
+    # (tests/test_slo.py parity + the CI slo job's YODA_SLO=0 tier-1
+    # leg, the elastic/torus discipline).
+    slo_serving: bool = field(default_factory=_slo_default)
+    # reserved serving headroom as a fraction of cluster chips: the
+    # non-serving aggregate (training + harvest) may never occupy more
+    # than (1 - pct) of capacity, expressed as a quota level ABOVE every
+    # tenant in the DRF hierarchy. 0 (default) reserves nothing.
+    serving_headroom_pct: float = 0.0
+    # SLO objective: the fraction of serving binds that must land inside
+    # their scv/slo-ms budget. Burn rate = violation-fraction /
+    # (1 - target); 100x burn means every request is violating.
+    slo_target_pct: float = 99.0
+    # multi-window burn-rate trip (the Google SRE workbook discipline):
+    # pressure asserts only when BOTH the fast and slow windows burn
+    # above threshold — fast-only is noise, slow-only is stale history.
+    slo_burn_threshold: float = 2.0
+    slo_fast_window_s: float = 30.0
+    slo_slow_window_s: float = 300.0
+    # guard cadence on the engine clock (0 never ticks the guard even
+    # when sloServing is on — monitor-only mode)
+    slo_guard_interval_s: float = 1.0
+    # max elastic-gang members shrunk per guard pass: degradation is
+    # gradual by construction, one budgeted bite per interval
+    slo_shrink_budget: int = 4
+    # two-direction hysteresis (the PR 14 provisioner discipline): no
+    # shrink within this window of the last give-back and no give-back
+    # within it of the last shrink OR while pressure persists — flapping
+    # traffic must never oscillate training gang sizes.
+    slo_hysteresis_s: float = 30.0
     # lifecycle span tracing (utils/obs.py SpanRing): record the full
     # queued/cycle/bind_wire/watch_confirm span tree for 1-in-N pods
     # (deterministic by pod key). 0 disables, 1 traces every pod; env
@@ -803,6 +849,28 @@ class SchedulerConfig:
                 defaults.provisioner_backoff_max_s)),
             provision_timeout_s=float(args.get(
                 "provisionTimeoutSeconds", defaults.provision_timeout_s)),
+            slo_serving=bool(args.get(
+                "sloServing", defaults.slo_serving)),
+            serving_headroom_pct=min(max(float(args.get(
+                "servingHeadroomPct",
+                defaults.serving_headroom_pct)), 0.0), 0.9),
+            slo_target_pct=min(max(float(args.get(
+                "sloTargetPct", defaults.slo_target_pct)), 0.0), 100.0),
+            slo_burn_threshold=max(float(args.get(
+                "sloBurnThreshold", defaults.slo_burn_threshold)), 0.0),
+            slo_fast_window_s=max(float(args.get(
+                "sloFastWindowSeconds",
+                defaults.slo_fast_window_s)), 1.0),
+            slo_slow_window_s=max(float(args.get(
+                "sloSlowWindowSeconds",
+                defaults.slo_slow_window_s)), 1.0),
+            slo_guard_interval_s=max(float(args.get(
+                "sloGuardIntervalSeconds",
+                defaults.slo_guard_interval_s)), 0.0),
+            slo_shrink_budget=max(int(args.get(
+                "sloShrinkBudget", defaults.slo_shrink_budget)), 1),
+            slo_hysteresis_s=max(float(args.get(
+                "sloHysteresisSeconds", defaults.slo_hysteresis_s)), 0.0),
             trace_sampling=max(int(args.get(
                 "traceSampling", defaults.trace_sampling)), 0),
             flight_dump_dir=str(args.get(

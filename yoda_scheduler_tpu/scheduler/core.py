@@ -204,6 +204,12 @@ class Profile:
         self.elastic = None
 
 
+def _headroom_gate(policy):
+    from .policy.headroom import ServingHeadroomGate
+
+    return ServingHeadroomGate(policy)
+
+
 def default_profile(config: SchedulerConfig,
                     allocator: ChipAllocator | None = None,
                     gangs: GangCoordinator | None = None,
@@ -241,8 +247,13 @@ def default_profile(config: SchedulerConfig,
     # it — the unset default constructs the EXACT pre-policy plugin set,
     # so placements stay bit-identical (pinned by tests/test_policy.py)
     policy = None
+    # serving headroom (ISSUE 19) rides the policy engine's DRF book:
+    # reserving capacity for scv/serving forces the engine on even with
+    # no objective/tenants configured
+    headroom_on = (config.slo_serving
+                   and config.serving_headroom_pct > 0.0)
     policy_enabled = (config.policy_objective or config.drf_fairness
-                      or config.tenant_quotas)
+                      or config.tenant_quotas or headroom_on)
     if policy_enabled:
         from .policy import (HeterogeneityScore, PolicyEngine,
                              TenantFairnessSort, TenantQuotaGate)
@@ -259,9 +270,12 @@ def default_profile(config: SchedulerConfig,
         queue_sort=(TenantFairnessSort(policy) if drf_on
                     else PrioritySort()),
         # quota gate first (one node-independent check per cycle, before
-        # gang planning pays anything); GangPermit.pre_filter computes
-        # multi-slice plans for gangs no single slice can host
+        # gang planning pays anything); the serving-headroom gate sits
+        # beside it — the quota level above every tenant; GangPermit.
+        # pre_filter computes multi-slice plans for gangs no single
+        # slice can host
         pre_filter=([TenantQuotaGate(policy)] if drf_on else [])
+        + ([_headroom_gate(policy)] if headroom_on else [])
         + [gang_permit],
         # admission first: nodeSelector/taint rejections are cheap and spare
         # the telemetry filter's capacity math on excluded nodes
@@ -721,6 +735,29 @@ class Scheduler:
 
             self.provisioner = CapacityProvisioner(
                 self, self.config.provisioner_interval_s)
+        # SLO-guarded colocated serving (ISSUE 19): the burn-rate
+        # monitor measures every serving bind against its scv/slo-ms
+        # budget; the guard degrades training toward gang-min under
+        # pressure and gives the surplus back in the valleys. Both None
+        # when the knob is off — the monitor observes nothing, the
+        # cycle carries no SLO hook, placements bit-identical.
+        self.slo = None
+        self.sloguard = None
+        if self.config.slo_serving:
+            from ..utils.obs import SloMonitor
+            from .elastic import SloGuard
+
+            self.slo = SloMonitor(
+                self.metrics, flight=self.flight,
+                target_pct=self.config.slo_target_pct,
+                burn_threshold=self.config.slo_burn_threshold,
+                fast_window_s=self.config.slo_fast_window_s,
+                slow_window_s=self.config.slo_slow_window_s)
+            if self.config.slo_guard_interval_s > 0:
+                self.sloguard = SloGuard(
+                    self, self.slo, self.config.slo_guard_interval_s,
+                    shrink_budget=self.config.slo_shrink_budget,
+                    hysteresis_s=self.config.slo_hysteresis_s)
         # shard-lease fencing (scheduler/fleet.py): when set, called as
         # fence_provider(pod, node) right before every bind dispatch.
         # Returns a fencing token to carry on the bind (owned shard), None
@@ -2292,6 +2329,27 @@ class Scheduler:
             return "failed"
         state.write("workload_spec", spec)
 
+        # serving-pressure growth hold (SloGuard): while the guard is
+        # pressed OR shrunk capacity is still owed back, elastic GROWTH
+        # members (gang already running at >= tpu/gang-min) park instead
+        # of re-absorbing the chips the shrink pass just freed for
+        # serving. The give-back publishes a POD_DELETED wake through
+        # the elastic-grow hint class, releasing them in the valley.
+        if (self.sloguard is not None and self.elastic is not None
+                and spec.is_gang and spec.gang_min > 0
+                and self.sloguard.holding(now)
+                and spec.gang_name not in self.doomed_gangs
+                and self._bound_members_of(spec.gang_name)
+                >= spec.gang_min):
+            from .elastic import ELASTIC_GROW_HINT
+
+            self.metrics.inc("serving_growth_holds_total")
+            return self._unschedulable(
+                info, trace,
+                f"gang {spec.gang_name}: growth held while serving "
+                "pressure holds the freed chips",
+                rejected_by=(ELASTIC_GROW_HINT,), gang_doom=False)
+
         # telemetry-blackout degraded mode: when even the NEWEST stored
         # heartbeat is past the staleness gate, the whole feed is dark —
         # one node's dead sniffer never trips this — and the engine keeps
@@ -3374,6 +3432,17 @@ class Scheduler:
             cname = "schedule_latency_ms_class_" + cls
             _LABEL1_CACHE[("_lat_cls", cls)] = cname
         self.metrics.observe(cname, e2e_ms)
+        if self.slo is not None:
+            # serving-SLO feed: every scv/serving bind's enqueue->bind
+            # latency scores against its scv/slo-ms target — the burn-
+            # rate monitor's only input signal (starvation is caught
+            # separately by the guard's parked-serving check)
+            try:
+                sspec = spec_for(pod)
+            except LabelError:
+                sspec = None
+            if sspec is not None and sspec.serving and sspec.slo_ms > 0:
+                self.slo.observe(e2e_ms, sspec.slo_ms, now_b)
         # e2e latency decomposition: the queue/engine stamps partition this
         # pod's enqueue->bind interval into queue-wait (active + backoff),
         # cycle compute (every attempt's pre-commit work), commit
@@ -4204,6 +4273,15 @@ class Scheduler:
                 self.workloads.tick(self.clock.time())
             except Exception:
                 self.metrics.inc("workload_admission_errors_total")
+        if self.sloguard is not None:
+            # SLO guard tick (engine thread): evaluate burn-rate
+            # pressure and shrink/give-back when due — behind the
+            # breaker gate (its evictions ride the bind wire's health)
+            # and contained like every controller tick
+            try:
+                self.sloguard.maybe_run(self.clock.time())
+            except Exception:
+                self.metrics.inc("slo_guard_errors_total")
         maxp = self.config.batch_max_pods
         if maxp > 1:
             if self.allocator is None or self.allocator.has_holds():
@@ -4302,6 +4380,12 @@ class Scheduler:
             # NOT floored at the breaker: the capacity tick runs before
             # the breaker gate in run_one (scale-up continues degraded)
             wakes.append(self.provisioner.next_at)
+        if self.sloguard is not None and self.sloguard.demanded():
+            # the guard is a wake source while pressure is live, shrunk
+            # capacity awaits give-back, or burn windows must close —
+            # floored at the breaker like the defrag wake (the tick
+            # runs behind the gate)
+            wakes.append(max(self.sloguard.next_at, self._breaker_until))
         return min(wakes) if wakes else None
 
     def run_until_idle(self, max_cycles: int = 100_000) -> int:

@@ -1,7 +1,7 @@
 """Elastic gangs + active defragmentation (ROADMAP item 4, Tesserae's
 scalable placement policies, arXiv:2508.04953).
 
-Two cooperating controllers, both OFF by default:
+Cooperating controllers, all OFF by default:
 
 - :class:`ElasticGangs` (gangs.py): gangs labeled ``tpu/gang-min`` admit
   at min replicas when the full size does not fit, park the remaining
@@ -16,10 +16,16 @@ Two cooperating controllers, both OFF by default:
   compaction strategies through the existing victim-drain path —
   migration plans with eviction budgets, per-pod cooldowns, and a
   breaker/degraded interlock; fleet-aware (shard-0 owner only).
+- :class:`SloGuard` (sloguard.py): serving-SLO graceful degradation
+  (ISSUE 19) — while the burn-rate monitor trips or serving pods park
+  unschedulable, bound elastic gangs shrink toward ``tpu/gang-min``
+  (``gang_shrink_total{reason="slo"}``) with a two-direction-hysteresis
+  give-back that re-grows them through ``elastic-grow`` in the valleys.
 """
 
 from .defrag import DefragController
 from .gangs import ELASTIC_GROW_HINT, ElasticGangs, bound_member_count
+from .sloguard import SloGuard
 
 __all__ = ["DefragController", "ELASTIC_GROW_HINT", "ElasticGangs",
-           "bound_member_count"]
+           "SloGuard", "bound_member_count"]

@@ -62,7 +62,7 @@ from .registry import build_profile
 # the engine side (here) and the authority (fake_apiserver / the Lease
 # API via ShardLeaseManager) — a drifted copy would 409 every fenced bind
 from ..k8s.leaderelect import REPLICA_HB_PREFIX, SHARD_LEASE_PREFIX
-from ..utils.labels import GANG_NAME_LABEL
+from ..utils.labels import GANG_NAME_LABEL, is_serving
 from ..utils.pod import Pod
 
 log = logging.getLogger("yoda-tpu.fleet")
@@ -555,6 +555,36 @@ class FleetCoordinator:
                 # GIL-atomic cross-thread reads, like tracks())
                 lambda: sum(r.engine.queue.pending() + len(r.engine.waiting)
                             for r in self.replicas))
+        if engine.sloguard is not None:
+            # exactly ONE replica runs the SLO-degradation loop's SHRINK
+            # side at a time (the defrag/provisioner ownership
+            # discipline): two guards shrinking the same gangs would
+            # double-evict past the shrink budget and fight each other's
+            # hysteresis. Non-owners keep EVALUATING their own monitor
+            # each interval (the workload-admission pattern) — serving
+            # binds burn on whichever replica owns them, and the owner
+            # ORs every peer's local verdict.
+            if self.sharded:
+                engine.sloguard.owner_check = (lambda r=rep: 0 in r.owned)
+            elif idx != 0:
+                engine.sloguard.owner_check = (lambda: False)
+            engine.sloguard.pressure_check = (
+                # peers' LOCAL evaluations only (local_pressed), never
+                # their OR'd `pressed` — two guards OR-ing each other's
+                # combined state would latch fleet-wide pressure forever
+                # (advisory GIL-atomic cross-thread reads, like defrag)
+                lambda _eng=engine: any(
+                    r.engine is not None and r.engine is not _eng
+                    and r.engine.sloguard is not None
+                    and r.engine.sloguard.local_pressed
+                    for r in self.replicas))
+            engine.sloguard.serving_pending_check = (
+                # starved serving demand parks on whichever replica
+                # owns its shard, not necessarily the guard owner's
+                lambda: any(
+                    is_serving(i.pod)
+                    for r in self.replicas if r.engine is not None
+                    for i in r.engine.queue.parked_infos()))
         if engine.provisioner is not None:
             # exactly ONE replica runs the capacity loop at a time —
             # the defrag ownership discipline: sharded fleets key it on

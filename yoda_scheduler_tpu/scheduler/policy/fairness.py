@@ -84,7 +84,8 @@ class DRFBook:
     like the memos: refresh() runs inside the cycle/bind paths."""
 
     def __init__(self, cluster, metrics=None, flight=None,
-                 quotas: dict[str, TenantQuota] | None = None) -> None:
+                 quotas: dict[str, TenantQuota] | None = None,
+                 serving_reserve_pct: float = 0.0) -> None:
         self.cluster = cluster
         self.metrics = metrics
         self.flight = flight
@@ -96,6 +97,15 @@ class DRFBook:
         # all-tenant totals, maintained delta-wise alongside _usage —
         # the workload-admission tier's live free-capacity read
         self._total = [0, 0]
+        # serving-headroom reservation (ISSUE 19): the scv/serving class
+        # is carved its own quota LEVEL above every tenant — the
+        # NON-serving aggregate may never occupy more than
+        # (1 - pct) of cluster chips. 0 tracks nothing (bit-identical
+        # to the pre-SLO book).
+        self._serve_pct = serving_reserve_pct
+        # node -> (chips, hbm) used by serving pods; total is the fold
+        self._node_serving: dict[str, tuple[int, int]] = {}
+        self._serving_total = [0, 0]
         # share-movement listeners (queue.TenantShareBands.mark_dirty):
         # called with each quota LEVEL whose usage moved, or None when
         # capacity rescaled every share. Engine-thread like refresh().
@@ -159,7 +169,32 @@ class DRFBook:
             for cb in self._share_listeners:
                 cb(level)
 
+    def _scan_serving(self, node: str) -> tuple[int, int]:
+        c = h = 0
+        for p in self.cluster.pods_on(node):
+            try:
+                if not spec_for(p).serving:
+                    continue
+            except LabelError:
+                continue
+            dc, dh = self._pod_demand(p)
+            c += dc
+            h += dh
+        return (c, h)
+
     def _apply_node(self, node: str, fresh: dict) -> None:
+        if self._serve_pct > 0.0:
+            # BEFORE the tenant-view early return: a pod's serving flag
+            # can move without moving its tenant's usage slice
+            s = self._scan_serving(node)
+            old_s = self._node_serving.get(node, (0, 0))
+            if s != old_s:
+                self._serving_total[0] += s[0] - old_s[0]
+                self._serving_total[1] += s[1] - old_s[1]
+                if s == (0, 0):
+                    self._node_serving.pop(node, None)
+                else:
+                    self._node_serving[node] = s
         old = self._node_usage.get(node, {})
         if old == fresh:
             return
@@ -180,6 +215,8 @@ class DRFBook:
         self._usage = {}
         self._levels = {}
         self._total = [0, 0]
+        self._node_serving = {}
+        self._serving_total = [0, 0]
         for node in self.cluster.node_names():
             self._apply_node(node, self._scan_node(node))
         for cb in self._share_listeners:
@@ -298,10 +335,43 @@ class DRFBook:
                 return level
         return None
 
+    def serving_usage(self) -> tuple[int, int]:
+        """(chips, hbm_mb) used by the scv/serving class cluster-wide
+        (tracked only when a headroom reservation is configured)."""
+        return (self._serving_total[0], self._serving_total[1])
+
+    def serving_headroom_chips(self) -> float:
+        """Unused reserved headroom: reservation minus serving usage,
+        floored at zero (serving may legitimately spill past its
+        reservation — the reservation is a floor for serving, a ceiling
+        for everyone else)."""
+        if self._serve_pct <= 0.0:
+            return 0.0
+        return max(self._serve_pct * self._capacity[0]
+                   - self._serving_total[0], 0.0)
+
+    def nonserving_over_reserve(self, chips_demand: int) -> bool:
+        """Whether adding `chips_demand` non-serving chips would push
+        the NON-serving aggregate past its ceiling of
+        (1 - reserve) * capacity — the serving-headroom quota level's
+        admission check. Capacity-less clusters gate nothing (the
+        ordinary filters own that case)."""
+        if self._serve_pct <= 0.0:
+            return False
+        cap_c = self._capacity[0]
+        if not cap_c:
+            return False
+        ceiling = (1.0 - self._serve_pct) * cap_c
+        used = self._total[0] - self._serving_total[0]
+        return used + chips_demand > ceiling + 1e-9
+
     # ---------------------------------------------------------- observability
     def _publish(self) -> None:
         if self.metrics is None:
             return
+        if self._serve_pct > 0.0:
+            self.metrics.set_gauge("serving_headroom_chips",
+                                   round(self.serving_headroom_chips(), 3))
         live = self.tenants()
         for gone in self._published - live:
             self.metrics.set_gauge("tenant_dominant_share", 0.0,
@@ -570,8 +640,11 @@ class PolicyEngine:
         self.flight = flight
         self.clock = clock
         self.budgets.metrics = metrics
+        reserve = (getattr(self.config, "serving_headroom_pct", 0.0)
+                   if getattr(self.config, "slo_serving", False) else 0.0)
         self.book = DRFBook(cluster, metrics=metrics, flight=flight,
-                            quotas=self.quotas)
+                            quotas=self.quotas,
+                            serving_reserve_pct=reserve)
 
     # ------------------------------------------------------------- fair share
     def fair_share(self, tenant: str) -> float:

@@ -106,6 +106,12 @@ def _quota_gate(pol):
     return TenantQuotaGate(pol)
 
 
+def _headroom_gate(pol):
+    from .policy.headroom import ServingHeadroomGate
+
+    return ServingHeadroomGate(pol)
+
+
 # policy-engine plugins (scheduler/policy/): not in DEFAULT_ENABLED —
 # the knobs (policyObjective / drfFairness / tenants) or an explicit
 # `plugins:` enablement opt a deployment in
@@ -115,9 +121,12 @@ register("tenant-fairness-sort",
          lambda cfg, alloc, gangs, pol, el: _fair_sort(pol))
 register("tenant-quota-gate",
          lambda cfg, alloc, gangs, pol, el: _quota_gate(pol))
+register("serving-headroom-gate",
+         lambda cfg, alloc, gangs, pol, el: _headroom_gate(pol))
 
 _POLICY_PLUGINS = frozenset({
-    "heterogeneity-score", "tenant-fairness-sort", "tenant-quota-gate"})
+    "heterogeneity-score", "tenant-fairness-sort", "tenant-quota-gate",
+    "serving-headroom-gate"})
 
 
 # the default enablement per extension point (mirrors default_profile);
@@ -173,8 +182,10 @@ def build_profile(config: SchedulerConfig,
     # explicitly-enabled policy plugin need it (the sort, gate, and
     # scorer must read the same DRF book)
     policy = None
+    headroom_on = (config.slo_serving
+                   and config.serving_headroom_pct > 0.0)
     if (config.policy_objective or config.drf_fairness
-            or config.tenant_quotas
+            or config.tenant_quotas or headroom_on
             or any(n in _POLICY_PLUGINS
                    for names in (enabled or {}).values() for n in names)):
         from .policy import PolicyEngine
@@ -239,6 +250,17 @@ def build_profile(config: SchedulerConfig,
         if drf_on and not any(isinstance(p, TenantQuotaGate)
                               for p in pre_filters):
             pre_filters.insert(0, get("tenant-quota-gate"))
+        if headroom_on:
+            from .policy.headroom import ServingHeadroomGate
+
+            if not any(isinstance(p, ServingHeadroomGate)
+                       for p in pre_filters):
+                # same fold position as default_profile: after any quota
+                # gate, before gang planning pays anything
+                at = (1 if pre_filters
+                      and isinstance(pre_filters[0], TenantQuotaGate)
+                      else 0)
+                pre_filters.insert(at, get("serving-headroom-gate"))
         if drf_on and type(queue_sort) is PrioritySort:
             # only the DEFAULT sort is upgraded; a custom comparator the
             # operator explicitly enabled keeps its ordering

@@ -423,14 +423,25 @@ class WorkloadAdmission:
         while exams > 0 and self._bands.n:
             if rate > 0 and self._tokens < 1.0:
                 got = self._bands.next(self._live)
-                if got is not None:
-                    # surface WHY the head is not admitting (peek only
-                    # — next() detaches nothing)
-                    self._note_parked(got[4], REASON_RATE_LIMITED,
-                                      "admission rate limit", now)
-                self.metrics.inc("workload_backpressure_total",
-                                 labels={"reason": "rate-limit"})
-                break
+                if (self.config.slo_serving and got is not None
+                        and self._serving_workload(got[4])):
+                    # serving fastpath (ISSUE 19): the rate limit damps
+                    # training submission storms, but a flash crowd's
+                    # replica turn-ups are exactly the demand the SLO
+                    # tier must not meter — admit without tokens
+                    self.metrics.inc("workload_serving_fastpath_total",
+                                     labels={"check": "rate-limit"})
+                else:
+                    if got is not None:
+                        # surface WHY the head is not admitting (peek
+                        # only — next() detaches nothing)
+                        self._note_parked(got[4], REASON_RATE_LIMITED,
+                                          "admission rate limit", now)
+                    self.metrics.inc("workload_backpressure_total",
+                                     labels={"reason": "rate-limit"})
+                    if self.config.slo_serving:
+                        admitted += self._serving_sweep(exams, now)
+                    break
             got = self._bands.next(self._live)
             if got is None:
                 break
@@ -444,17 +455,24 @@ class WorkloadAdmission:
             if verdict == "admit":
                 self._admit(w, now)
                 admitted += 1
-                if rate > 0:
+                if rate > 0 and not (self.config.slo_serving
+                                     and self._serving_workload(w)):
+                    # serving rides outside the token budget entirely:
+                    # a crowd's admissions must not starve the next
+                    # training admission's tokens either
                     self._tokens -= 1.0
             elif verdict == "reject":
                 self._reject(w, detail, now)
             elif verdict == REASON_BACKPRESSURE:
-                # head-of-line: nothing admits past a backpressured
-                # head, so band/DRF order is preserved — the queue
-                # draining (binds move the version) re-opens the pass
+                # head-of-line: nothing NON-serving admits past a
+                # backpressured head, so band/DRF order is preserved —
+                # the queue draining (binds move the version) re-opens
+                # the pass
                 self._note_parked(w, REASON_BACKPRESSURE, detail, now)
                 self.metrics.inc("workload_backpressure_total",
                                  labels={"reason": "queue-depth"})
+                if self.config.slo_serving:
+                    admitted += self._serving_sweep(exams, now)
                 break
             else:
                 # quota/capacity/oversized: set the condition, move
@@ -476,6 +494,44 @@ class WorkloadAdmission:
 
     def _live(self, w, _seq) -> bool:
         return self._parked.get(w.key) is w
+
+    def _serving_sweep(self, exams: int, now: float) -> int:
+        """The serving lane past a blocked head (ISSUE 19): rate-limit
+        and queue-depth backpressure both break the admission pass at
+        the HEAD of the band order, so the per-decision fastpaths in
+        _decide never even see a serving workload parked BEHIND a
+        backpressured training head — exactly the moment a flash
+        crowd's replica turn-ups must not wait for a training backlog
+        to drain. Decide parked serving workloads directly (quota and
+        capacity still enforce; only the two backpressure checks are
+        bypassed, and _decide's fastpath handles those). Bounded by the
+        tick's remaining exam budget; non-admit verdicts leave the
+        workload parked in band order for the ordinary pass."""
+        admitted = 0
+        for w in [p for p in self._parked.values()
+                  if self._serving_workload(p)]:
+            if exams <= 0:
+                break
+            verdict, detail = self._decide(w, now)
+            self.decisions += 1
+            exams -= 1
+            if verdict == "admit":
+                self.metrics.inc("workload_serving_fastpath_total",
+                                 labels={"check": "head-of-line"})
+                self._admit(w, now)
+                admitted += 1
+            elif verdict == "reject":
+                self._reject(w, detail, now)
+            else:
+                self._note_parked(w, verdict, detail, now)
+        return admitted
+
+    @staticmethod
+    def _serving_workload(w) -> bool:
+        try:
+            return w.spec.serving
+        except LabelError:
+            return False
 
     def _drain_inbox(self, now: float) -> None:
         while True:
@@ -538,16 +594,26 @@ class WorkloadAdmission:
             # a workload bigger than the whole window still admits into
             # an EMPTY queue — the cap bounds concurrency, not size
             if pending and pending + w.total_pods > cap:
-                if w.total_pods > cap:
-                    # oversized: only an EMPTY queue ever fits it, so
-                    # head-of-line blocking on it would stall every
-                    # other admission for as long as any intake
-                    # trickles — park it ASIDE like a quota verdict
-                    return ("backpressure-aside",
-                            f"workload wider than window {cap}; "
-                            f"waiting for an empty queue")
-                return (REASON_BACKPRESSURE,
-                        f"{pending} pods pending >= window {cap}")
+                if self.config.slo_serving and self._serving_workload(w):
+                    # serving fastpath (ISSUE 19): queue-depth
+                    # backpressure protects cycle latency from training
+                    # backlogs, but holding a crowd's replicas OUT of
+                    # the queue guarantees the SLO burns — let the
+                    # headroom gate and guard make room instead
+                    self.metrics.inc("workload_serving_fastpath_total",
+                                     labels={"check": "queue-depth"})
+                else:
+                    if w.total_pods > cap:
+                        # oversized: only an EMPTY queue ever fits it,
+                        # so head-of-line blocking on it would stall
+                        # every other admission for as long as any
+                        # intake trickles — park it ASIDE like a quota
+                        # verdict
+                        return ("backpressure-aside",
+                                f"workload wider than window {cap}; "
+                                f"waiting for an empty queue")
+                    return (REASON_BACKPRESSURE,
+                            f"{pending} pods pending >= window {cap}")
         book = self._book
         pol = self.engine.policy
         if pol is not None and pol.quotas:

@@ -58,6 +58,21 @@ DEADLINE_LABEL = "scv/deadline-seconds"
 # sound: a harvest pod and its non-harvest twin never share a class.
 HARVEST_LABEL = "scv/harvest"
 
+# SLO serving class (scheduler/elastic/sloguard.py, ISSUE 19). A pod
+# labeled ``scv/serving: "1"`` carries latency-sensitive user traffic:
+# its e2e scheduling latency is measured against ``scv/slo-ms`` by the
+# burn-rate monitor, it is exempt from workload-tier rate limiting and
+# queue-depth backpressure, and under SLO pressure the guard shrinks
+# elastic training gangs toward tpu/gang-min to make room. Riding the
+# WorkloadSpec keeps every spec-keyed surface (class memos, batch keys)
+# sound: a serving pod and its batch twin never share a class. A
+# scheduling input only when the sloServing knob is on.
+SERVING_LABEL = "scv/serving"
+# per-request scheduling-latency SLO in milliseconds; requires
+# scv/serving (an SLO without the serving class would never be
+# monitored — strict parsing rejects the silent no-op).
+SLO_MS_LABEL = "scv/slo-ms"
+
 # Policy-engine labels (scheduler/policy/). The workload CLASS names the
 # job's throughput profile across accelerator generations (Gavel's
 # job-type axis, arXiv:2008.09213) — it rides the WorkloadSpec so every
@@ -133,6 +148,14 @@ class WorkloadSpec:
     # preemption budgets and the PDB ledger, first victim of scale-down
     # drains. False/absent = ordinary pod.
     harvest: bool = False
+    # SLO serving class (scv/serving): latency-sensitive user traffic —
+    # exempt from workload-tier rate limiting/backpressure, measured by
+    # the burn-rate monitor, protected by the serving-headroom quota
+    # level. False/absent = batch/training.
+    serving: bool = False
+    # scheduling-latency SLO, ms (scv/slo-ms): 0 = unmonitored. Only
+    # valid together with scv/serving.
+    slo_ms: int = 0
     # declared throughput-profile class (scv/class); None = classless —
     # the heterogeneity model then falls back to a coarse spec-derived
     # class. A scheduling input ONLY when the policy engine is enabled;
@@ -188,6 +211,21 @@ class WorkloadSpec:
             elif harvest_raw not in ("0", "false", "False"):
                 raise LabelError(HARVEST_LABEL, harvest_raw,
                                  'must be "1"/"true" or "0"/"false"')
+        serving_raw = labels.get(SERVING_LABEL)
+        serving = False
+        if serving_raw is not None:
+            if serving_raw in ("1", "true", "True"):
+                serving = True
+            elif serving_raw not in ("0", "false", "False"):
+                raise LabelError(SERVING_LABEL, serving_raw,
+                                 'must be "1"/"true" or "0"/"false"')
+        slo_ms = _parse_uint(labels, SLO_MS_LABEL, 0)
+        if slo_ms and not serving:
+            raise LabelError(SLO_MS_LABEL, labels[SLO_MS_LABEL],
+                             "scv/slo-ms requires scv/serving")
+        if serving and harvest:
+            raise LabelError(SERVING_LABEL, serving_raw or "",
+                             "a pod cannot be both serving and harvest")
         return cls(
             chips=_parse_uint(labels, NUMBER_LABEL, 1),
             min_free_mb=_parse_uint(labels, MEMORY_LABEL, 0),
@@ -201,6 +239,8 @@ class WorkloadSpec:
             gang_min=gang_min,
             deadline_s=_parse_uint(labels, DEADLINE_LABEL, 0),
             harvest=harvest,
+            serving=serving,
+            slo_ms=slo_ms,
             workload_class=wclass,
         )
 
@@ -218,7 +258,7 @@ class WorkloadSpec:
                       self.priority, self.accelerator, self.tpu_generation,
                       self.topology, self.gang_name, self.gang_size,
                       self.gang_min, self.deadline_s, self.harvest,
-                      self.workload_class))
+                      self.serving, self.slo_ms, self.workload_class))
             object.__setattr__(self, "_hash_memo", h)
         return h
 
@@ -229,7 +269,7 @@ _SPEC_LABELS = (
     NUMBER_LABEL, MEMORY_LABEL, CLOCK_LABEL, PRIORITY_LABEL,
     ACCELERATOR_LABEL, GENERATION_LABEL, TOPOLOGY_LABEL,
     GANG_NAME_LABEL, GANG_SIZE_LABEL, GANG_MIN_LABEL, DEADLINE_LABEL,
-    HARVEST_LABEL, WORKLOAD_CLASS_LABEL,
+    HARVEST_LABEL, SERVING_LABEL, SLO_MS_LABEL, WORKLOAD_CLASS_LABEL,
 )
 _SPEC_LABEL_SET = frozenset(_SPEC_LABELS)
 
@@ -248,6 +288,8 @@ def workload_class(pod) -> str:
         spec = spec_for(pod)
     except LabelError:
         return "malformed"
+    if spec.serving:
+        return "serving"
     if spec.is_gang:
         return "gang"
     if spec.topology is not None:
@@ -268,6 +310,17 @@ def is_harvest(pod) -> bool:
     protections removed by accident."""
     try:
         return spec_for(pod).harvest
+    except LabelError:
+        return False
+
+
+def is_serving(pod) -> bool:
+    """Whether the pod belongs to the SLO serving class (see
+    SERVING_LABEL). Malformed labels read as non-serving: a pod that
+    cannot declare its class never acquires the stronger admission
+    fastpath by accident."""
+    try:
+        return spec_for(pod).serving
     except LabelError:
         return False
 

@@ -317,7 +317,8 @@ class Metrics:
                                    "labeled by reason (not-owner|"
                                    "breaker-open|degraded|hysteresis|"
                                    "pool-backoff|pool-breaker-open|"
-                                   "pool-at-max|drain-blocked).",
+                                   "pool-at-max|drain-blocked|"
+                                   "slo-pressure).",
         "provisioner_errors_total": "Capacity passes aborted by a "
                                     "contained controller crash (the "
                                     "engine thread survives).",
@@ -333,12 +334,55 @@ class Metrics:
                            "binds).",
         "gang_shrink_total": "Elastic-gang members evicted from a "
                              "running gang, labeled by reason "
-                             "(preemption).",
+                             "(preemption|slo) — slo marks serving-"
+                             "pressure degradation, never conflated "
+                             "with preemption in PromQL.",
         "gang_elastic_admissions_total": "Gangs admitted below desired "
                                          "size, labeled by reason "
                                          "(no-fit|deadline).",
         "gang_elastic_completions_total": "Elastic gangs grown back to "
                                           "their desired size.",
+        "slo_burn_rate": "Serving SLO burn rate (violation fraction / "
+                         "error budget) per window (fast|slow); 1.0 "
+                         "burns the budget exactly at the target.",
+        "slo_requests_total": "Serving binds measured against an "
+                              "scv/slo-ms budget.",
+        "slo_violations_total": "Serving binds that landed outside "
+                                "their scv/slo-ms budget.",
+        "slo_window_violations_total": "Fixed evaluation windows whose "
+                                       "serving violation fraction "
+                                       "exceeded the error budget "
+                                       "(burn > 1) — the bench fence "
+                                       "pins this at zero.",
+        "serving_headroom_chips": "Unused reserved serving headroom, "
+                                  "chips (reservation minus serving "
+                                  "usage, floored at zero).",
+        "serving_headroom_rejections_total": "Non-serving pods refused "
+                                             "by the serving-headroom "
+                                             "quota level.",
+        "slo_pressure": "SLO guard pressure state (1 = degrading "
+                        "training toward gang-min).",
+        "slo_shrink_passes_total": "SLO guard passes that evicted at "
+                                   "least one elastic-gang member "
+                                   "under serving pressure.",
+        "slo_giveback_total": "Hysteresis-expired give-back passes "
+                              "returning shrunk capacity to training.",
+        "slo_guard_skips_total": "SLO guard passes skipped, labeled by "
+                                 "reason (not-owner|breaker-open|"
+                                 "degraded|hysteresis).",
+        "slo_guard_errors_total": "SLO guard passes aborted by a "
+                                  "contained controller crash (the "
+                                  "engine thread survives).",
+        "serving_growth_holds_total": "Elastic growth binds parked "
+                                      "because the SLO guard is "
+                                      "holding capacity for serving.",
+        "workload_serving_fastpath_total": "Serving workloads admitted "
+                                           "past rate-limit/"
+                                           "backpressure holds, "
+                                           "labeled by waived check.",
+        "torus_multislice_dcn_span": "Greedy multi-slice carve plans' "
+                                     "max inter-slice DCN distance "
+                                     "(proxy units).",
     }
 
     def __init__(self) -> None:
@@ -563,6 +607,100 @@ def export_chrome_trace(rings, path: str | None = None) -> dict:
     return doc
 
 
+# ---------------------------------------------------------- SLO monitor
+class SloMonitor:
+    """Multi-window serving SLO burn-rate monitor (ISSUE 19).
+
+    Burn rate = (violation fraction) / (error budget), the SRE-workbook
+    normalization: 1.0 spends the budget exactly at the target, 100x
+    means every request violates a 99% objective. Pressure asserts only
+    when BOTH a fast and a slow window burn above threshold — fast-only
+    is noise a single straggler can cause, slow-only is stale history a
+    recovered crowd leaves behind. Alongside the rolling windows, time
+    partitions into FIXED evaluation windows of fast_window_s: a closed
+    window whose violation fraction exceeded the budget counts one
+    `slo_window_violations_total` (the bench fence pins this at zero).
+    The fast->pressed transition records the `slo_burn` flight trip
+    (auto-dumping, rate-limited like every trip); recovery re-arms it.
+
+    Observations and evaluations run on the engine clock and the engine
+    thread — no locking beyond the Metrics registry's own."""
+
+    def __init__(self, metrics: Metrics, flight=None, *,
+                 target_pct: float = 99.0, burn_threshold: float = 2.0,
+                 fast_window_s: float = 30.0,
+                 slow_window_s: float = 300.0) -> None:
+        self.metrics = metrics
+        self.flight = flight
+        self.budget = max(1.0 - target_pct / 100.0, 1e-9)
+        self.burn_threshold = burn_threshold
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = max(slow_window_s, fast_window_s)
+        self._events: deque[tuple[float, bool]] = deque()
+        self.pressed = False
+        self._win_start: float | None = None
+        self._win_total = 0
+        self._win_bad = 0
+        self.window_violations = 0  # fence/test convenience mirror
+
+    def observe(self, latency_ms: float, slo_ms: float,
+                now: float) -> None:
+        """One serving bind's e2e latency against its scv/slo-ms budget."""
+        bad = latency_ms > slo_ms
+        self._events.append((now, bad))
+        self.metrics.inc("slo_requests_total")
+        if bad:
+            self.metrics.inc("slo_violations_total")
+        self._roll_fixed(now)
+        self._win_total += 1
+        self._win_bad += 1 if bad else 0
+
+    def _roll_fixed(self, now: float) -> None:
+        # close every fixed window the clock has fully passed; empty
+        # windows close silently (no traffic cannot violate an SLO)
+        if self._win_start is None:
+            self._win_start = now
+        while now - self._win_start >= self.fast_window_s:
+            if (self._win_total
+                    and self._win_bad / self._win_total > self.budget):
+                self.window_violations += 1
+                self.metrics.inc("slo_window_violations_total")
+            self._win_total = self._win_bad = 0
+            self._win_start += self.fast_window_s
+
+    def burn(self, window_s: float, now: float) -> float:
+        """Rolling burn rate over the trailing `window_s` seconds."""
+        total = bad = 0
+        for ts, b in reversed(self._events):  # newest first; early out
+            if now - ts > window_s:
+                break
+            total += 1
+            bad += 1 if b else 0
+        if not total:
+            return 0.0
+        return (bad / total) / self.budget
+
+    def evaluate(self, now: float) -> bool:
+        """Refresh gauges, close idle fixed windows, return pressure."""
+        self._roll_fixed(now)
+        ev = self._events
+        while ev and now - ev[0][0] > self.slow_window_s:
+            ev.popleft()
+        fast = self.burn(self.fast_window_s, now)
+        slow = self.burn(self.slow_window_s, now)
+        self.metrics.set_gauge("slo_burn_rate", round(fast, 4),
+                               labels={"window": "fast"})
+        self.metrics.set_gauge("slo_burn_rate", round(slow, 4),
+                               labels={"window": "slow"})
+        pressed = (fast >= self.burn_threshold
+                   and slow >= self.burn_threshold)
+        if pressed and not self.pressed and self.flight is not None:
+            self.flight.record("slo_burn", fast=round(fast, 3),
+                               slow=round(slow, 3))
+        self.pressed = pressed
+        return pressed
+
+
 # --------------------------------------------------------- flight recorder
 # event kinds that auto-trigger a disk dump when a dump dir is configured.
 # webhook_deny / webhook_fail_open (the bind-authority webhook catching a
@@ -594,12 +732,17 @@ def export_chrome_trace(rings, path: str | None = None) -> dict:
 # planned recurring behavior an operator reconstructing "where did my
 # node go" needs in the ring, but never a dump file per window on a
 # healthy diurnal cluster.
+# slo_burn (the serving SLO burning above threshold in BOTH the fast
+# and slow windows — the multi-window trip that starts graceful
+# degradation) dumps like breaker_open: it is user-facing latency
+# actively failing, and the rate limiter already bounds a sustained
+# flash crowd to one file per window.
 TRIP_KINDS = frozenset({"breaker_open", "invariant_violation",
                         "quarantine", "webhook_deny", "webhook_fail_open",
                         "shard_takeover", "tenant_quota_breach",
                         "tenant_starvation", "defrag_pass",
                         "provisioner_breaker_open", "pool_scaledown",
-                        "slice_drain"})
+                        "slice_drain", "slo_burn"})
 # trips that mark routine (if noteworthy) operation rather than a fault
 # being absorbed: recorded + counted, but no disk dump.
 # slice_drain (the provisioner migrating residents off a whole slice so
