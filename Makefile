@@ -10,7 +10,7 @@ local: native test
 
 native: native/libyodaplace.so
 
-native/libyodaplace.so: native/placement.cc native/fusedplane.cc native/commitplane.cc native/carveplane.cc
+native/libyodaplace.so: native/placement.cc native/fusedplane.cc native/commitplane.cc native/carveplane.cc native/eventplane.cc
 	g++ -O2 -std=c++17 -shared -fPIC -o $@ $^
 
 test:

@@ -1918,6 +1918,41 @@ class _SteadyPipelinedCluster(PipelinedPacedCluster):
             hook(pod)
 
 
+_SERVE_LEAK_REFS: list = []  # weakrefs to per-leg cluster/fleet (leak fence)
+
+
+def serve_leak_fence(thread_baseline: int, grace_s: float = 3.0) -> dict:
+    """Bench-harness leak fence (ISSUE 20 satellite): between serve legs,
+    live thread count must return to the pre-leg baseline and every
+    cluster/fleet a finished leg built must be collectable (weakref dead
+    after gc.collect — the refcount-back-to-baseline check that catches
+    a leaked RTT worker or completer pinning a 50k-node cluster for the
+    rest of the process). A short grace loop absorbs daemon threads
+    mid-join; past it, the fence RAISES and fails the whole bench run —
+    a leak here silently poisons every later leg's numbers."""
+    import gc
+    import threading
+
+    deadline = time.perf_counter() + grace_s
+    while True:
+        gc.collect()
+        alive = [r() for r in _SERVE_LEAK_REFS if r() is not None]
+        threads = threading.active_count()
+        if not alive and threads <= thread_baseline:
+            break
+        if time.perf_counter() >= deadline:
+            names = sorted(t.name for t in threading.enumerate())
+            pinned = [type(o).__name__ for o in alive]
+            raise RuntimeError(
+                "serve leak fence tripped: "
+                f"threads={threads} (baseline {thread_baseline}) "
+                f"live={names}; uncollected leg objects={pinned}")
+        time.sleep(0.05)
+    _SERVE_LEAK_REFS.clear()
+    return {"threads": threads, "thread_baseline": thread_baseline,
+            "leg_objects_alive": 0}
+
+
 def run_serve_steady(n_replicas: int = 4, heads: int = 1,
                      units: int = 250, arrival_per_s: float = 2000.0,
                      warmup_s: float = 3.0, measure_s: float = 10.0,
@@ -2071,6 +2106,12 @@ def _run_serve_steady_nogc(n_replicas, heads, units, arrival_per_s,
         shut = getattr(cluster, "shutdown", None)
         if shut is not None:
             shut()  # leaked RTT workers pin the cluster for the process life
+        # leak fence registration: after this leg returns, nothing should
+        # keep the cluster or fleet alive — serve_leak_fence() checks
+        # these weakrefs (plus the live thread count) between legs
+        import weakref
+        _SERVE_LEAK_REFS.append(weakref.ref(cluster))
+        _SERVE_LEAK_REFS.append(weakref.ref(fleet))
 
         w0, w1 = t0 + warmup_s, t0 + horizon_s
         window_lat = [l for (ta, l) in lat_all if w0 <= ta < w1]
@@ -2108,12 +2149,43 @@ def _run_serve_steady_nogc(n_replicas, heads, units, arrival_per_s,
         # memo should mostly HIT — its hit-rate is the measured fraction
         # of cycles that skipped the full rescore walk
         memo_hits = memo_misses = 0
+        # churn-plane attribution (ISSUE 20): continuation/guard counters
+        # plus the drop audit, summed fleet-wide like the memo counters
+        fast_cycles = fast_misses = fast_fallbacks = requeue_dropped = 0
+        # per-cycle phase attribution: merged totals/counts of the phase
+        # histograms the engine and queue stamp — event application
+        # (inbox drain + columnar sync), queue wait, scan (pre-commit
+        # cycle compute), commit bookkeeping, and the wire RTT
+        phase_names = (("event_apply", "cycle_event_apply_ms"),
+                       ("queue", "e2e_queue_wait_ms"),
+                       ("scan", "e2e_cycle_compute_ms"),
+                       ("commit", "e2e_commit_ms"),
+                       ("wire", "e2e_wire_ms"))
+        phase_tot = {k: 0.0 for k, _ in phase_names}
+        phase_n = {k: 0 for k, _ in phase_names}
+        flight_tail: list = []
         for r in fleet.replicas:
             for e in (r.headset.heads if r.headset is not None
                       else (r.engine,)):
                 c = e.metrics.counters
                 memo_hits += c.get("score_memo_hits_total", 0)
                 memo_misses += c.get("score_memo_misses_total", 0)
+                fast_cycles += c.get("fast_cycles_total", 0)
+                fast_misses += c.get("fast_cycle_guard_misses_total", 0)
+                fast_fallbacks += c.get("fast_cycle_fallbacks_total", 0)
+                requeue_dropped += c.get("requeue_events_dropped_total", 0)
+                for key, hname in phase_names:
+                    h = e.metrics.histograms.get(hname)
+                    if h is not None and h.n:
+                        phase_tot[key] += h.total
+                        phase_n[key] += h.n
+                flight_tail.extend(e.flight.snapshot()[-100:])
+        phase_breakdown = {}
+        for key, _ in phase_names:
+            phase_breakdown[key + "_ms_mean"] = (
+                round(phase_tot[key] / phase_n[key], 4)
+                if phase_n[key] else None)
+            phase_breakdown[key + "_ms_total"] = round(phase_tot[key], 1)
         return {
             "replicas": n_replicas,
             "schedule_heads": heads,
@@ -2149,6 +2221,12 @@ def _run_serve_steady_nogc(n_replicas, heads, units, arrival_per_s,
             "score_memo_misses": memo_misses,
             "score_memo_hit_rate": round(
                 memo_hits / max(memo_hits + memo_misses, 1), 4),
+            "fast_cycles": fast_cycles,
+            "fast_cycle_guard_misses": fast_misses,
+            "fast_cycle_fallbacks": fast_fallbacks,
+            "requeue_events_dropped": requeue_dropped,
+            "phase_breakdown": phase_breakdown,
+            "flight_tail": flight_tail[-400:],
             "double_bound": double_bound,
             "chip_double_booked": chip_conflicts,
             "wire_pace_ms": wire_pace_ms,

@@ -960,6 +960,12 @@ class FakeApiServer:
         return self
 
     def __exit__(self, *exc):
+        # end in-flight watch long-polls FIRST: a parked watch handler
+        # thread otherwise lingers until its timeoutSeconds deadline
+        # (up to 30s) after the last client dies — long enough to trip
+        # a between-legs leak fence on handler threads that were always
+        # going to exit
+        self.state.cut_watches()
         self.httpd.shutdown()
         self.httpd.server_close()
         return False

@@ -1,0 +1,447 @@
+"""ISSUE 20 — the churn plane: batched event application + the
+fast-cycle path (``config.churn_plane``, env ``YODA_CHURN_PLANE``,
+default OFF).
+
+Contracts under test:
+
+- **200-case parity fuzz**: with the knob ON (batched inbox drain,
+  columnar delta-vector sync, deferred counter folds, fast-cycle
+  continuation armed) every pod's fate, the requeue counter totals
+  (events/wakeups/hint-skips/drops), and the feasible/score memo states
+  are BIT-IDENTICAL to the knob-OFF scalar paths — including cases with
+  node membership churn and second-wave submissions mid-drain;
+- **wake order**: the batched drain activates parked pods in exactly
+  the order the per-event scalar drain would (heap stint order pinned
+  by popping both queues dry), with identical counter totals;
+- **fast cycle**: a homogeneous same-class stream actually engages the
+  continuation (fast_cycles_total > 0) and still places every pod
+  exactly as the knob-OFF engine; each entry guard falls back cleanly —
+  a degraded-regime flip, a gang pod at the head, foreign dirt between
+  batches — with the miss reason on the flight ring, and a mid-batch
+  conflict falls back inline without losing or reordering pods;
+- **knob off**: churn_plane defaults OFF, the queue drains per-event,
+  and no churn machinery runs (gauge 0, fast counters absent);
+- **drop audit** (satellite fix): under the batched drain,
+  requeue_events_dropped_total counts exactly the notify()-time
+  overflow past _INBOX_CAP — same totals as the scalar drain, because
+  drops are accounted at ENQUEUE, never at drain;
+- **copy-on-write slice usage**: the churn-mode _SliceUsage overlay
+  (TopologyScore.enable_churn_plane) quacks like the dict it replaces
+  across get/set/copy/len/bool, isolates copies, and survives the
+  overlay -> flatten transition past _OVERLAY_FLATTEN overrides.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+
+import pytest
+
+np = pytest.importorskip("numpy")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from yoda_scheduler_tpu.scheduler import FakeCluster, Scheduler, SchedulerConfig
+from yoda_scheduler_tpu.scheduler.core import FakeClock
+from yoda_scheduler_tpu.scheduler.framework import (
+    ClusterEvent,
+    NODE_ADDED,
+    NODE_TELEMETRY_UPDATED,
+    POD_DELETED,
+    QUEUE,
+    SKIP,
+)
+from yoda_scheduler_tpu.scheduler.queue import SchedulingQueue
+from yoda_scheduler_tpu.scheduler.plugins.topology import (
+    _OVERLAY_FLATTEN,
+    _SliceUsage,
+)
+from yoda_scheduler_tpu.telemetry import TelemetryStore, make_tpu_node
+from yoda_scheduler_tpu.utils import Pod, PodPhase
+from yoda_scheduler_tpu.utils.obs import Metrics
+
+from test_columnar import T0, build_burst, build_cluster, end_state
+
+REQUEUE_COUNTERS = (
+    "requeue_events_total",
+    "requeue_wakeups_total",
+    "requeue_hint_skips_total",
+    "requeue_events_dropped_total",
+)
+
+
+def drive(cluster, pods, churn: bool, *, rng=None, max_cycles=10_000,
+          **cfg):
+    """Drain a burst through run_one (the batch-pop loop). When ``rng``
+    is given, inject membership churn + a second submission wave
+    mid-drain — the event-heavy shape the batched drain serves — using
+    the SAME deterministic mutations for both knob states."""
+    cfg.setdefault("max_attempts", 3)
+    cfg.setdefault("columnar", True)
+    cfg.setdefault("batch_max_pods", 16)
+    cfg.setdefault("pod_hinted_backoff_s", 0.0)
+    sched = Scheduler(cluster, SchedulerConfig(churn_plane=churn, **cfg),
+                      clock=FakeClock(start=T0))
+    wave2 = []
+    if rng is not None:
+        cut = max(1, len(pods) // 2)
+        pods, wave2 = pods[:cut], pods[cut:]
+    for p in pods:
+        sched.submit(p)
+    n = 0
+    while sched.run_one() is not None and n < max_cycles:
+        n += 1
+        if rng is not None and n == 3:
+            # mid-drain churn: a node joins, one leaves, telemetry moves
+            m = make_tpu_node(f"join{rng.randint(0, 9)}",
+                              chips=rng.choice((2, 4, 8)))
+            m.heartbeat = T0
+            cluster.telemetry.put(m)
+            cluster.add_node(m.node)
+            gone = rng.choice(cluster.node_names())
+            cluster.remove_node(gone)
+            for p in wave2:
+                sched.submit(p)
+            wave2 = []
+    for p in wave2:  # drain ended before cycle 3 (tiny case)
+        sched.submit(p)
+    while sched.run_one() is not None and n < max_cycles:
+        n += 1
+    return sched
+
+
+def memo_state(sched):
+    """Normalized memo dump: feasible-class entries as (vers, name set),
+    score entries as (vers, maxima tuple) — the bit-identity surface
+    that survives knob-dependent container types (the churn plane's COW
+    usage views compare by content, not identity)."""
+    feas = {k: (v[0], v[2]) for k, v in sched._feas_memo.items()}
+    score = {k: (v[0], v[1]) for k, v in sched._score_memo.items()}
+    return feas, score
+
+
+def requeue_totals(sched):
+    return {k: sched.metrics.counters.get(k, 0) for k in REQUEUE_COUNTERS}
+
+
+# --------------------------------------------------------- the parity fuzz
+def test_parity_fuzz_churn_plane():
+    """>=200 randomized (cluster, burst) cases — every third with
+    mid-drain membership churn and a second submission wave — knob ON vs
+    knob OFF: pod fates, requeue counter totals, and memo states must
+    be bit-identical."""
+    mismatches = []
+    for case in range(210):
+        churny = case % 3 == 0
+        runs = {}
+        for churn in (True, False):
+            rng = random.Random(31_000 + case)
+            cluster = build_cluster(rng)
+            pods = build_burst(rng)
+            sched = drive(cluster, pods, churn,
+                          rng=rng if churny else None)
+            runs[churn] = (end_state(pods), requeue_totals(sched),
+                           memo_state(sched))
+        if runs[True] != runs[False]:
+            mismatches.append((case, runs[True], runs[False]))
+    assert not mismatches, mismatches[:2]
+
+
+# ------------------------------------------------------------- wake order
+def _hint_queue(metrics):
+    q = SchedulingQueue(lambda a, b: False, metrics=metrics,
+                        hinted_backoff_s=30.0)
+    q.register_hint("chips", (POD_DELETED,), lambda ev, pod: QUEUE)
+    q.register_hint("telemetry", (NODE_TELEMETRY_UPDATED,),
+                    lambda ev, pod: SKIP)
+    q.register_hint("capacity", (NODE_ADDED, POD_DELETED),
+                    lambda ev, pod: QUEUE if ev.kind == NODE_ADDED else SKIP)
+    return q
+
+
+def _park(q, name, rejected_by, now=0.0):
+    q.add(Pod(name), now=now)
+    info = q.pop(now=now)
+    q.requeue_backoff(info, now=now, rejected_by=rejected_by)
+    return info
+
+
+def test_batched_drain_wake_order_bit_identical():
+    """Same parked lot, same event stream through notify(): the batched
+    drain and the scalar drain must activate the SAME pods in the SAME
+    order (popped dry and compared), with identical counter totals —
+    including hint-less rejectors, wildcard skips, and origin
+    self-wake suppression."""
+    kinds = (POD_DELETED, NODE_ADDED, NODE_TELEMETRY_UPDATED)
+    rejectors = (("chips",), ("telemetry",), ("capacity",),
+                 ("chips", "telemetry"), ("no-hint-plugin",))
+    for trial in range(40):
+        results = {}
+        for batch in (True, False):
+            rng = random.Random(7 + trial)  # same stream both modes
+            m = Metrics()
+            q = _hint_queue(m)
+            q.batch_drain = batch
+            lot = [_park(q, f"p{i}", rng_r)
+                   for i, rng_r in enumerate(
+                       rejectors[:rng.randint(2, len(rejectors))])]
+            events = [ClusterEvent(rng.choice(kinds), node=f"n{j % 3}",
+                                   origin=(lot[0].pod.key
+                                           if rng.random() < 0.2 else None))
+                      for j in range(rng.randint(1, 12))]
+            for ev in events:
+                q.notify(ev)
+            q._drain_inbox(now=0.5)
+            order = []
+            while True:
+                info = q.pop(now=0.5)
+                if info is None:
+                    break
+                order.append(info.pod.name)
+            results[batch] = (order,
+                              {k: m.counters.get(k, 0)
+                               for k in REQUEUE_COUNTERS})
+        assert results[True] == results[False], (trial, results)
+
+
+# ------------------------------------------------------------- fast cycle
+def _flat_cluster(n=8, chips=4):
+    store = TelemetryStore()
+    for i in range(n):
+        m = make_tpu_node(f"n{i}", chips=chips)
+        m.heartbeat = T0
+        store.put(m)
+    cluster = FakeCluster(store)
+    cluster.add_nodes_from_telemetry()
+    return cluster
+
+
+def _serving_pods(n, start=0):
+    return [Pod(f"s{start + i}", labels={"scv/number": "1",
+                                         "tpu/accelerator": "tpu"})
+            for i in range(n)]
+
+
+def _homogeneous_run(churn: bool, n_pods=24):
+    cluster = _flat_cluster()
+    pods = _serving_pods(n_pods)
+    sched = drive(cluster, pods, churn, batch_max_pods=4)
+    return sched, pods
+
+
+def test_fast_cycle_engages_on_homogeneous_stream():
+    """Same-class batches back to back: the continuation must actually
+    run (fast_cycles_total > 0, zero guard misses on a quiet cluster)
+    and place every pod exactly as the knob-OFF engine."""
+    s_on, p_on = _homogeneous_run(True)
+    s_off, p_off = _homogeneous_run(False)
+    assert end_state(p_on) == end_state(p_off)
+    assert all(p.phase == PodPhase.BOUND for p in p_on)
+    c = s_on.metrics.counters
+    assert c.get("fast_cycles_total", 0) > 0
+    assert c.get("fast_cycle_guard_misses_total", 0) == 0
+    off = s_off.metrics.counters
+    assert off.get("fast_cycles_total", 0) == 0
+
+
+def _armed_sched():
+    """A scheduler whose previous batch committed clean — _fast_resume
+    armed, next same-class batch would ride the continuation."""
+    cluster = _flat_cluster()
+    sched = Scheduler(
+        cluster,
+        SchedulerConfig(max_attempts=3, columnar=True, batch_max_pods=4,
+                        churn_plane=True, pod_hinted_backoff_s=0.0),
+        clock=FakeClock(start=T0))
+    for p in _serving_pods(4):
+        sched.submit(p)
+    while sched.run_one() is not None:
+        pass
+    assert sched._fast_resume is not None
+    return sched, cluster
+
+
+def _miss_reasons(sched):
+    return [rec.get("reason") for rec in sched.flight.snapshot()
+            if rec.get("kind") == "fast_cycle_guard_miss"]
+
+
+def test_fast_cycle_guard_degraded_flip():
+    """A degraded-regime flip between batches must miss the guard (the
+    full cycle owns memo clears and staleness waivers) and the pod must
+    still bind through the ordinary path."""
+    sched, _ = _armed_sched()
+    sched._degraded = True
+    pods = _serving_pods(2, start=100)
+    for p in pods:
+        sched.submit(p)
+    while sched.run_one() is not None:
+        pass
+    assert "degraded" in _miss_reasons(sched)
+    assert all(p.phase == PodPhase.BOUND for p in pods)
+
+
+def test_fast_cycle_guard_gang_pod():
+    """A gang member at the head of the next batch must miss the guard —
+    gangs break class equivalence — and still schedule correctly."""
+    sched, _ = _armed_sched()
+    gang = [Pod(f"g{i}", labels={"scv/number": "1",
+                                 "tpu/accelerator": "tpu",
+                                 "tpu/gang-name": "band",
+                                 "tpu/gang-size": "2"})
+            for i in range(2)]
+    for p in gang:
+        sched.submit(p)
+    while sched.run_one() is not None:
+        pass
+    assert "gang" in _miss_reasons(sched)
+    # nobody lost to the fallback: every member is still accounted for
+    # (bound, or parked by gang admission on this sliceless cluster)
+    assert all(p.phase in (PodPhase.PENDING, PodPhase.BOUND) for p in gang)
+    assert sched.metrics.counters.get("fast_cycles_total", 0) == 0
+
+
+def test_fast_cycle_guard_foreign_dirt():
+    """Cluster dirt between batches on a node OTHER than the resume
+    node (here: a membership change) must miss the attribution guard;
+    the ordinary cycle takes a fresh snapshot and still binds."""
+    sched, cluster = _armed_sched()
+    m = make_tpu_node("late-join", chips=4)
+    m.heartbeat = T0
+    cluster.telemetry.put(m)
+    cluster.add_node("late-join")
+    pods = _serving_pods(2, start=200)
+    for p in pods:
+        sched.submit(p)
+    while sched.run_one() is not None:
+        pass
+    assert set(_miss_reasons(sched)) & {"foreign_dirt", "class_moved"}
+    assert all(p.phase == PodPhase.BOUND for p in pods)
+
+
+def test_fast_cycle_mid_batch_conflict_falls_back():
+    """A continuation batch that exhausts capacity mid-commit must fall
+    back inline (fast_cycle_fallbacks_total), with the leftover members
+    handled by ordinary cycles — nobody lost, nobody double-bound."""
+    cluster = _flat_cluster(n=2, chips=2)  # 4 chips total
+    pods = _serving_pods(8)
+    sched = drive(cluster, pods, True, batch_max_pods=4, max_attempts=2)
+    c = sched.metrics.counters
+    bound = [p for p in pods if p.phase == PodPhase.BOUND]
+    assert len(bound) == 4  # capacity, exactly
+    assert len({p.node for p in bound}) == 2
+    assert c.get("fast_cycle_fallbacks_total", 0) >= 1
+    # parity against the scalar engine on the same starved shape
+    cluster2 = _flat_cluster(n=2, chips=2)
+    pods2 = _serving_pods(8)
+    drive(cluster2, pods2, False, batch_max_pods=4, max_attempts=2)
+    assert end_state(pods) == end_state(pods2)
+
+
+# ---------------------------------------------------------------- knob off
+def test_knob_defaults_off_and_scalar_drain_runs():
+    env_on = os.environ.get("YODA_CHURN_PLANE", "0").strip().lower() in (
+        "1", "true", "yes", "on")
+    assert SchedulerConfig().churn_plane is env_on
+    sched, pods = _homogeneous_run(False)
+    assert sched.queue.batch_drain is False
+    assert sched.metrics.gauges.get("churn_plane_active") == 0.0
+    assert "fast_cycles_total" not in sched.metrics.counters
+    on = Scheduler(_flat_cluster(),
+                   SchedulerConfig(churn_plane=True, columnar=True),
+                   clock=FakeClock(start=T0))
+    assert on.queue.batch_drain is True
+    assert on.metrics.gauges.get("churn_plane_active") == 1.0
+
+
+# -------------------------------------------------- drop audit (satellite)
+@pytest.mark.parametrize("batch", (True, False))
+def test_dropped_events_counted_at_enqueue(batch):
+    """Storm past _INBOX_CAP: drops happen (and are counted) at
+    notify() time, so the batched drain accounts them EXACTLY like the
+    scalar drain — overflow count, accepted count, and the events_total
+    fold all match."""
+    m = Metrics()
+    q = _hint_queue(m)
+    q.batch_drain = batch
+    _park(q, "parked", ("chips",))
+    cap = SchedulingQueue._INBOX_CAP
+    extra = 37
+    for i in range(cap + extra):
+        q.notify(ClusterEvent(NODE_TELEMETRY_UPDATED, node=f"n{i % 5}"))
+    assert m.counters.get("requeue_events_dropped_total", 0) == extra
+    assert len(q._inbox) == cap
+    q._drain_inbox(now=1.0)
+    assert not q._inbox
+    # accepted events all routed; none double-counted, none dropped late
+    assert m.counters.get("requeue_events_total", 0) == cap
+    assert m.counters.get("requeue_events_dropped_total", 0) == extra
+    # capacity freed: the next notify is accepted again
+    q.notify(ClusterEvent(POD_DELETED, node="n0"))
+    assert len(q._inbox) == 1
+    assert m.counters.get("requeue_events_dropped_total", 0) == extra
+
+
+# --------------------------------------------- copy-on-write slice usage
+def test_slice_usage_overlay_quacks_like_dict():
+    """Churn-mode _SliceUsage (cow=True): observational parity with a
+    plain dict across randomized op streams following the production
+    write discipline — a view is PUBLISHED (frozen) at copy() and all
+    further writes go to the copy, exactly like pre_score_update's
+    copy-before-patch chain. Every published view must keep replaying
+    its frozen state bit-for-bit, through overlay copies and the
+    flatten transition past _OVERLAY_FLATTEN entries alike."""
+    rng = random.Random(42)
+    for trial in range(30):
+        cur = _SliceUsage.empty(cow=True)
+        model: dict = {}
+        published = []
+        for step in range(rng.randint(20, 300)):
+            r = rng.random()
+            key = f"slice-{rng.randint(0, _OVERLAY_FLATTEN + 40)}"
+            if r < 0.6:
+                val = (rng.randint(0, 64), 64)
+                cur[key] = val
+                model[key] = val
+            elif r < 0.85:
+                assert cur.get(key) == model.get(key)
+                assert cur.get(key, (0, 0)) == model.get(key, (0, 0))
+            else:
+                # publish: freeze `cur`, keep writing the copy — the
+                # memo-contract shape (pre_score_update copies BEFORE
+                # patching; the published view is never written again)
+                published.append((cur, dict(model)))
+                cur = cur.copy()
+        assert len(cur) == len(model)
+        assert bool(cur) == bool(model)
+        for k, v in model.items():
+            assert cur.get(k) == v
+        for snap, snap_model in published:
+            assert len(snap) == len(snap_model)
+            for k, v in snap_model.items():
+                assert snap.get(k) == v, (trial, k)
+
+
+def test_slice_usage_overlay_flatten_exact():
+    """Force > _OVERLAY_FLATTEN overrides, then copy: the flattened
+    result must carry every override and base entry exactly."""
+    base = _SliceUsage.empty(cow=True)
+    for i in range(20):
+        base[f"b{i}"] = (i, 64)
+    view = base.copy()
+    expect = {f"b{i}": (i, 64) for i in range(20)}
+    for i in range(_OVERLAY_FLATTEN + 10):
+        view[f"o{i}"] = (i + 1, 128)
+        expect[f"o{i}"] = (i + 1, 128)
+    flat = view.copy()  # past the threshold: flattens
+    assert len(flat) == len(expect)
+    for k, v in expect.items():
+        assert flat.get(k) == v, k
+    # the flatten is a true fork: writes no longer reach `view`
+    flat["b0"] = (63, 64)
+    assert view.get("b0") == (0, 64)
+    # and the original base never saw any of it
+    assert base.get("o0") is None
+    assert base.get("b0") == (0, 64)

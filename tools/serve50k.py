@@ -24,8 +24,18 @@ honestly:
   GIL-bound compute path gains nothing and conflicts cost a little) —
   reported as measured, not as hoped.
 
-Run:  python tools/serve50k.py           (full 50k tier)
-      python tools/serve50k.py --smoke   (12.5k-node CI fence tier)
+Every leg runs behind the leak fence (ISSUE 20 satellite): live threads
+and the previous leg's cluster/fleet refcounts must return to baseline
+before the next leg starts, or the run FAILS — a leaked completer or
+RTT worker silently poisons every later leg's numbers.
+
+Run:  python tools/serve50k.py                (full 50k tier)
+      python tools/serve50k.py --smoke        (12.5k-node CI fence tier)
+      python tools/serve50k.py --churn-fence  (churn-plane A/B fence
+                                               only: adjacent ceiling
+                                               legs at the smoke tier,
+                                               exit 1 + flight dump on
+                                               a missed fence)
 """
 
 from __future__ import annotations
@@ -34,14 +44,20 @@ import json
 import os
 import resource
 import sys
+import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import run_serve_procs, run_serve_steady  # noqa: E402
+from bench import (run_serve_procs, run_serve_steady,  # noqa: E402
+                   serve_leak_fence)
 
 TARGET_BINDS_PER_S = 10_000.0
 SLO_P99_MS = 1000.0
 NATIVE_SPEEDUP_TARGET = 1.3
+CHURN_SPEEDUP_TARGET = 1.25
+
+_BASE_THREADS = [1]       # set in main()/churn_fence() before the first leg
+_FENCED_LEGS = [0]        # legs that passed the leak fence
 
 
 def peak_rss_mb() -> float:
@@ -58,34 +74,134 @@ def _slim(r: dict) -> dict:
             "schedule_heads", "arrival_per_s_target", "service_s",
             "pipeline_window", "reflector_sharding", "async_binding",
             "score_memo_hits", "score_memo_misses",
-            "score_memo_hit_rate")
+            "score_memo_hit_rate", "phase_breakdown",
+            "fast_cycles", "fast_cycle_guard_misses",
+            "fast_cycle_fallbacks", "requeue_events_dropped")
     return {k: r[k] for k in keep if k in r}
 
 
-def _with_native_commit(flag: bool, fn, *a, **kw):
-    """Run one leg with the native commit plane forced on/off — the
-    knob's default is read from YODA_NATIVE_COMMIT at SchedulerConfig
-    construction, so flipping the env var in-process is the whole
-    switch (placements are bit-identical either way, pinned by
-    tests/test_native_commit.py; this measures only the speed)."""
-    prev = os.environ.get("YODA_NATIVE_COMMIT")
-    os.environ["YODA_NATIVE_COMMIT"] = "1" if flag else "0"
+def _leg(fn, *a, **kw):
+    """Run one serve leg, then hold it to the leak fence: threads and
+    the leg's cluster/fleet refs must be back to baseline before the
+    next leg. The fence RAISES (failing the whole run) on a leak."""
+    r = fn(*a, **kw)
+    # 20s grace: each gc.collect() poll over a 50k-node heap takes
+    # seconds, and worker-head wind-down rides the same loaded core —
+    # the loop exits early when clean, so the grace only costs time on
+    # a slow teardown. A genuinely stranded thread still trips it.
+    serve_leak_fence(_BASE_THREADS[0], grace_s=20.0)
+    _FENCED_LEGS[0] += 1
+    return r
+
+
+def _with_env(env: dict, fn, *a, **kw):
+    """Run one leg with scheduler knobs forced via the environment —
+    knob defaults are read from the env at SchedulerConfig construction,
+    so flipping the vars in-process is the whole switch (placements are
+    bit-identical either way, pinned by tests/test_native_commit.py and
+    tests/test_churn_plane.py; this measures only the speed)."""
+    prev = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: v for k, v in env.items()})
     try:
         return fn(*a, **kw)
     finally:
-        if prev is None:
-            os.environ.pop("YODA_NATIVE_COMMIT", None)
-        else:
-            os.environ["YODA_NATIVE_COMMIT"] = prev
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _with_native_commit(flag: bool, fn, *a, **kw):
+    """Back-compat shim over _with_env for the native-commit A/B."""
+    return _with_env({"YODA_NATIVE_COMMIT": "1" if flag else "0"},
+                     fn, *a, **kw)
+
+
+def _ceiling_pair(units: int) -> tuple[dict, dict]:
+    """The churn-plane A/B: ceiling_h1 with the native commit plane ON
+    in both legs, churnPlane flipped between them, run ADJACENT (a ratio
+    whose legs run many legs apart compares process states, not planes).
+    Returns (off_leg, on_leg) FULL dicts (flight_tail included)."""
+    common = dict(n_replicas=1, heads=1, units=units,
+                  arrival_per_s=2000.0, warmup_s=3.0, measure_s=8.0,
+                  utilization=0.8, seed=0)
+    off = _leg(_with_env, {"YODA_NATIVE_COMMIT": "1",
+                           "YODA_CHURN_PLANE": "0"},
+               run_serve_steady, **common)
+    on = _leg(_with_env, {"YODA_NATIVE_COMMIT": "1",
+                          "YODA_CHURN_PLANE": "1"},
+              run_serve_steady, **common)
+    return off, on
+
+
+def churn_fence() -> None:
+    """CI fence for the churn plane (ISSUE 20): THREE adjacent
+    smoke-tier ceiling pairs, native commit on in every leg, churnPlane
+    flipped within each pair (alternating, so drift hits both sides).
+    The fence judges the RATIO OF MEDIANS — single pairs on a noisy
+    runner swing +/-15-20%, well past the effect size; medians over
+    three alternating pairs are the smallest protocol that measures the
+    plane instead of the host — and requires ON >=
+    CHURN_SPEEDUP_TARGET x OFF binds/s with ZERO double binds / chip
+    double-bookings judged from the authority book on every leg. On
+    failure the last pair's flight-recorder tails are dumped next to
+    the verdict for the CI artifact, and the process exits 1."""
+    _BASE_THREADS[0] = threading.active_count()
+    units = 1563  # 12.5k-node smoke tier
+    pairs = [_ceiling_pair(units) for _ in range(3)]
+    off, on = pairs[-1]
+    offs = sorted(p[0]["binds_per_s"] for p in pairs)
+    ons = sorted(p[1]["binds_per_s"] for p in pairs)
+    speedup = round(ons[1] / max(offs[1], 1e-9), 2)
+    invariants_clean = all(
+        leg["double_bound"] == 0 and leg["chip_double_booked"] == 0
+        for pair in pairs for leg in pair)
+    ok = speedup >= CHURN_SPEEDUP_TARGET and invariants_clean
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = {
+        "metric": "churn_fence",
+        "nodes": units * 8,
+        "off_binds_per_s": offs[1],
+        "on_binds_per_s": ons[1],
+        "pair_ratios": [round(p[1]["binds_per_s"]
+                              / max(p[0]["binds_per_s"], 1e-9), 3)
+                        for p in pairs],
+        "speedup": speedup,
+        "target": CHURN_SPEEDUP_TARGET,
+        "protocol": "median of 3 alternating adjacent pairs",
+        "invariants_clean": invariants_clean,
+        "fast_cycles": on["fast_cycles"],
+        "fast_cycle_guard_misses": on["fast_cycle_guard_misses"],
+        "fast_cycle_fallbacks": on["fast_cycle_fallbacks"],
+        "requeue_events_dropped": on["requeue_events_dropped"],
+        "phase_breakdown_on": on["phase_breakdown"],
+        "phase_breakdown_off": off["phase_breakdown"],
+        "legs_fenced": _FENCED_LEGS[0],
+        "ok": ok,
+    }
+    with open(os.path.join(root, "CHURN_FENCE.json"), "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps(out))
+    if not ok:
+        # flight-dump artifact: the last engine events of both legs —
+        # guard-miss reasons, conflict fallbacks, breaker flips — are
+        # the first thing to read on a missed fence
+        with open(os.path.join(root, "churn_fence_flight.json"), "w") as f:
+            json.dump({"off": off.get("flight_tail", []),
+                       "on": on.get("flight_tail", [])}, f, indent=1)
+        sys.exit(1)
 
 
 def main() -> None:
     smoke = "--smoke" in sys.argv
     units = 1563 if smoke else 6250          # 12_504 / 50_000 nodes
+    _BASE_THREADS[0] = threading.active_count()
     legs: dict = {}
 
     # --- ceiling probes: arrivals outrun the fleet on purpose ---------
-    legs["ceiling_h1"] = _slim(run_serve_steady(
+    legs["ceiling_h1"] = _slim(_leg(
+        run_serve_steady,
         n_replicas=1, heads=1, units=units, arrival_per_s=2000.0,
         warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
     # --- native commit plane attribution (ISSUE 17) -------------------
@@ -97,18 +213,34 @@ def main() -> None:
     # process states, not planes (an earlier cut of this script ran the
     # native leg ~15 legs in and read 0.12x; the same pair adjacent in
     # a fresh process reads >1x)
-    from yoda_scheduler_tpu.scheduler.nativeplane import CommitKernels
-    legs["ceiling_h1_native_commit"] = _slim(_with_native_commit(
-        True, run_serve_steady,
+    from yoda_scheduler_tpu.scheduler.nativeplane import (CommitKernels,
+                                                          EventKernels)
+    legs["ceiling_h1_native_commit"] = _slim(_leg(
+        _with_native_commit, True, run_serve_steady,
         n_replicas=1, heads=1, units=units, arrival_per_s=2000.0,
         warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
     native_speedup = round(
         legs["ceiling_h1_native_commit"]["binds_per_s"]
         / max(legs["ceiling_h1"]["binds_per_s"], 1e-9), 2)
-    legs["ceiling_fleet_r4"] = _slim(run_serve_steady(
+    # --- churn plane attribution (ISSUE 20) ---------------------------
+    # the same probe again with churnPlane ON on top of the commit
+    # plane: batched event application + the fast-cycle continuation.
+    # Measured ADJACENT to the native-commit leg (same knobs otherwise,
+    # same seed), so the ratio is the churn plane alone.
+    legs["ceiling_h1_churn"] = _slim(_leg(
+        _with_env, {"YODA_NATIVE_COMMIT": "1", "YODA_CHURN_PLANE": "1"},
+        run_serve_steady,
+        n_replicas=1, heads=1, units=units, arrival_per_s=2000.0,
+        warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
+    churn_speedup = round(
+        legs["ceiling_h1_churn"]["binds_per_s"]
+        / max(legs["ceiling_h1_native_commit"]["binds_per_s"], 1e-9), 2)
+    legs["ceiling_fleet_r4"] = _slim(_leg(
+        run_serve_steady,
         n_replicas=4, heads=1, units=units, arrival_per_s=2000.0,
         warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
-    legs["ceiling_fleet_r4h4"] = _slim(run_serve_steady(
+    legs["ceiling_fleet_r4h4"] = _slim(_leg(
+        run_serve_steady,
         n_replicas=4, heads=4, units=units, arrival_per_s=2000.0,
         warmup_s=3.0, measure_s=8.0, utilization=0.8, seed=0))
     ceiling = max(legs["ceiling_h1"]["binds_per_s"],
@@ -125,7 +257,8 @@ def main() -> None:
     # which is exactly the story the ceiling legs tell
     eq_arrival = max(50.0, round(0.35 * ceiling, 0))
     chips_total = units * 24
-    legs["equilibrium_50k"] = _slim(run_serve_steady(
+    legs["equilibrium_50k"] = _slim(_leg(
+        run_serve_steady,
         n_replicas=1, heads=1, units=units, arrival_per_s=eq_arrival,
         warmup_s=4.0, measure_s=12.0,
         utilization=4.0 * eq_arrival / chips_total, seed=1))
@@ -134,7 +267,8 @@ def main() -> None:
     # the tier where arrival capacity meets chip capacity: 240 chips at
     # 300 pods/s with ~0.64s service holds measured utilization ~0.8
     # and must keep post-warmup p99 under the 1s SLO
-    legs["equilibrium_80util"] = _slim(run_serve_steady(
+    legs["equilibrium_80util"] = _slim(_leg(
+        run_serve_steady,
         n_replicas=2, heads=2, units=30, arrival_per_s=300.0,
         warmup_s=3.0, measure_s=8.0, utilization=0.8,
         wire_pace_ms=2.0, seed=2))
@@ -144,7 +278,8 @@ def main() -> None:
     for h in (1, 2, 4):
         # synchronous binds: every cycle blocks a full 4ms RTT — the
         # regime parallel heads exist for (overlapped wire waits)
-        curve["sync_wire"][f"h{h}"] = _slim(run_serve_steady(
+        curve["sync_wire"][f"h{h}"] = _slim(_leg(
+            run_serve_steady,
             n_replicas=1, heads=h, units=30, arrival_per_s=600.0,
             warmup_s=2.0, measure_s=6.0, utilization=0.6,
             wire_pace_ms=4.0, pipeline_window=0, reflector_sharding=False,
@@ -152,7 +287,8 @@ def main() -> None:
         # async pipelined binds at the CPU-bound tier: the wire never
         # blocks, the GIL serializes scoring, so extra heads only add
         # contention — measured and reported as-is
-        curve["async_pipelined"][f"h{h}"] = _slim(run_serve_steady(
+        curve["async_pipelined"][f"h{h}"] = _slim(_leg(
+            run_serve_steady,
             n_replicas=1, heads=h, units=units if smoke else 1563,
             arrival_per_s=1200.0, warmup_s=2.0, measure_s=6.0,
             utilization=0.8, seed=7))
@@ -175,7 +311,8 @@ def main() -> None:
     procs_curve: dict = {}
     for np_ in proc_grid:
         for h in (1, 2):
-            procs_curve[f"p{np_}h{h}"] = run_serve_procs(
+            procs_curve[f"p{np_}h{h}"] = _leg(
+                run_serve_procs,
                 procs=np_, heads=h, units=proc_units, n_pods=proc_pods)
     proc_rates = [r["binds_per_s_window"] or r["binds_per_s"]
                   for r in procs_curve.values()]
@@ -219,6 +356,21 @@ def main() -> None:
                 "into GIL-releasing kernels (placements bit-identical; "
                 "tests/test_native_commit.py)"),
         },
+        "churn_plane": {
+            "kernels_loaded": EventKernels.load() is not None,
+            "speedup_vs_off_h1": churn_speedup,
+            "target": CHURN_SPEEDUP_TARGET,
+            "target_met": churn_speedup >= CHURN_SPEEDUP_TARGET,
+            "attribution": (
+                "adjacent ceiling_h1 legs, native commit ON in both, "
+                "churnPlane flipped: batched event application (inbox "
+                "drain + one eventplane call per dirty batch + wake "
+                "coalescing) plus the fast-cycle continuation that "
+                "skips the ordinary head cycle at memo-hit equilibrium "
+                "(placements bit-identical; tests/test_churn_plane.py). "
+                "Guard misses fall back to the ordinary cycle — see "
+                "legs.ceiling_h1_churn.fast_cycle_guard_misses."),
+        },
         "process_fleet": {
             "host_cpus": os.cpu_count(),
             "curve": procs_curve,
@@ -233,6 +385,14 @@ def main() -> None:
                 "correctness half (zero double binds / chip "
                 "double-bookings judged from the authority book) must "
                 "hold regardless, and invariants_clean says it did."),
+        },
+        "leak_fence": {
+            "legs_fenced": _FENCED_LEGS[0],
+            "thread_baseline": _BASE_THREADS[0],
+            "note": ("every leg above passed serve_leak_fence: threads "
+                     "and leg cluster/fleet refs back to baseline "
+                     "before the next leg (a trip raises and fails "
+                     "the run)"),
         },
         "legs": legs,
         "head_scaling": curve,
@@ -249,11 +409,17 @@ def main() -> None:
         "head_speedup_sync_wire_h4_vs_h1", "peak_rss_mb")}
         | {"native_commit_speedup":
            out["native_commit"]["speedup_vs_python_h1"],
+           "churn_plane_speedup":
+           out["churn_plane"]["speedup_vs_off_h1"],
            "proc_fleet_ceiling":
            out["process_fleet"]["aggregate_ceiling_binds_per_s"],
            "proc_invariants_clean":
-           out["process_fleet"]["invariants_clean"]}))
+           out["process_fleet"]["invariants_clean"],
+           "legs_fenced": out["leak_fence"]["legs_fenced"]}))
 
 
 if __name__ == "__main__":
-    main()
+    if "--churn-fence" in sys.argv:
+        churn_fence()
+    else:
+        main()

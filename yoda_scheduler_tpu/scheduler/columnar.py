@@ -31,6 +31,7 @@ returns None and the pod takes the scalar path unchanged.
 
 from __future__ import annotations
 
+import time
 import zlib
 
 try:  # numpy ships with the jax toolchain this image bakes in, but the
@@ -88,6 +89,14 @@ class ColumnarTable:
         # call instead of a numpy op per column. None = numpy path.
         self.native_refresh = None
         self._idx_scratch = None
+        # churn plane (config.churn_plane): attached by the engine —
+        # sync() applies multi-row dirt as ONE batched delta-vector pass
+        # (_sync_batched) instead of a _fill_row per row. event_kernels
+        # (nativeplane.EventKernels) folds the whole batch in one C call;
+        # None degrades the batch to a numpy scatter, and batch_events
+        # False keeps the per-row scalar path (the ground truth).
+        self.batch_events = False
+        self.event_kernels = None
         # set by the engine: dirty node names since a version vector,
         # IGNORING membership movement (the ordinary changes_since
         # refuses across membership changes; the sharded rebuild needs
@@ -118,6 +127,12 @@ class ColumnarTable:
         self._shard_serials = None
         self._row_shard = None
         # observability (tests + bench)
+        # engine metrics sink (set by the engine): when present, sync()
+        # stamps its wall time into the cycle_event_apply_ms histogram —
+        # the "event application" share of the cycle-phase breakdown
+        # (ISSUE 20 satellite; bench run_serve_steady folds it into
+        # BENCH_SERVE50K.json). None keeps sync stamp-free.
+        self.metrics = None
         self.rebuilds = 0
         self.row_updates = 0
         self.shard_rebuilds = 0   # membership rebuilds served sharded
@@ -376,6 +391,19 @@ class ColumnarTable:
             return False
         if self._vers == vers:
             return len(self._names) == len(snapshot)
+        if self.metrics is None:
+            return self._sync_apply(snapshot, vers, changes_since_fn)
+        # phase attribution: only real application work is stamped — the
+        # version-vector no-op above costs two tuple compares and stays
+        # out of the histogram
+        t0 = time.perf_counter()
+        try:
+            return self._sync_apply(snapshot, vers, changes_since_fn)
+        finally:
+            self.metrics.observe("cycle_event_apply_ms",
+                                 (time.perf_counter() - t0) * 1e3)
+
+    def _sync_apply(self, snapshot, vers, changes_since_fn) -> bool:
         if self._vers is None or vers[2] != self._vers[2] \
                 or len(snapshot) != len(self._names):
             # membership moved (or first sync): the sharded fast path
@@ -391,6 +419,8 @@ class ColumnarTable:
         _, dirty = changes_since_fn(self._vers)
         if dirty is None:
             return self._rebuild(snapshot, vers)
+        if self.batch_events and len(dirty) > 1:
+            return self._sync_batched(snapshot, vers, dirty)
         for name in dirty:
             i = self.index.get(name)
             if i is None:
@@ -404,6 +434,92 @@ class ColumnarTable:
             self._row_dirtied(i)
         self._vers = vers
         return True
+
+    def _sync_batched(self, snapshot, vers, dirty) -> bool:
+        """Churn-plane sync (config.churn_plane): apply a multi-row dirty
+        set as one batched delta-vector pass. Rows whose telemetry
+        identity is UNCHANGED — the equilibrium common case: binds and
+        completions only move the free mask and the dynamic scalars — are
+        gathered into flat vectors and applied together
+        (_apply_rows_batched); rows whose identity moved (telemetry
+        publish, node cleared) still take the scalar _fill_row, which is
+        the only writer of the chip-attribute columns. Final table bytes,
+        row_updates, and dirty-serial counts are identical to the scalar
+        loop in sync() — only the per-row numpy/ctypes dispatch is
+        amortized (parity fuzz: tests/test_churn_plane.py)."""
+        fast: list = []
+        for name in dirty:
+            i = self.index.get(name)
+            if i is None:
+                # telemetry for a non-member node: no row to update (the
+                # object snapshot skips these identically)
+                continue
+            ni = snapshot.get(name)
+            if ni is None:
+                return self._rebuild(snapshot, vers)
+            m = ni.metrics
+            if m is not None and len(m.chips) > self._width:
+                return self._rebuild(snapshot, vers)
+            if m is None \
+                    or self._row_gen[i] != (id(m), m.generation,
+                                            len(m.chips)):
+                if not self._fill_row(i, ni):
+                    return self._rebuild(snapshot, vers)
+            else:
+                fast.append((i, ni))
+            self.row_updates += 1
+            self._row_dirtied(i)
+        if fast:
+            self._apply_rows_batched(fast)
+        self._vers = vers
+        return True
+
+    def _apply_rows_batched(self, fast) -> None:
+        """Write a batch of identity-unchanged dirty rows from flat delta
+        vectors: per-row scalars (unsched, label class, free count,
+        claimed HBM) plus the concatenated free-chip indices with
+        offsets. One eventplane C call when the kernel is bound; a numpy
+        scatter otherwise. Both are store-for-store twins of
+        _fill_row's dynamic-column branch."""
+        n = len(fast)
+        free_coords = self.allocator.free_coords
+        rows = np.empty(n, dtype=np.int64)
+        unsched_v = np.empty(n, dtype=np.uint8)
+        scalars = np.empty((n, 3), dtype=np.int64)
+        idx_all: list[int] = []
+        offs = np.empty(n + 1, dtype=np.int64)
+        offs[0] = 0
+        for r, (i, ni) in enumerate(fast):
+            rows[r] = i
+            unsched_v[r] = ni.unschedulable
+            scalars[r, 0] = self._label_id(ni.labels)
+            free = free_coords(ni)
+            idx_all.extend(j for j, (h, co)
+                           in enumerate(self._row_chips[i])
+                           if h and co in free)
+            offs[r + 1] = len(idx_all)
+            scalars[r, 1] = len(free)
+            scalars[r, 2] = ni.claimed_hbm_mb()
+        ek = self.event_kernels
+        if ek is not None:
+            idx = np.asarray(idx_all, dtype=np.int64)
+            ek.apply_fn(self._chip_free_base, self._width,
+                        rows.ctypes.data, n,
+                        idx.ctypes.data, offs.ctypes.data,
+                        unsched_v.ctypes.data, scalars.ctypes.data,
+                        self.unsched.ctypes.data,
+                        self.label_class.ctypes.data,
+                        self.free_count.ctypes.data,
+                        self.claimed_hbm.ctypes.data)
+        else:
+            self.unsched[rows] = unsched_v.astype(bool)
+            self.label_class[rows] = scalars[:, 0]
+            self.free_count[rows] = scalars[:, 1]
+            self.claimed_hbm[rows] = scalars[:, 2]
+            mask = np.zeros((n, self._width), dtype=bool)
+            for r in range(n):
+                mask[r, idx_all[offs[r]:offs[r + 1]]] = True
+            self.chip_free[rows] = mask
 
     def refresh_row(self, name: str, ni, old_vers, new_vers) -> bool:
         """In-place single-row refresh for the batch commit loop

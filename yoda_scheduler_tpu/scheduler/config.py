@@ -96,6 +96,20 @@ def _native_commit_default() -> bool:
         "1", "true", "on")
 
 
+def _churn_plane_default() -> bool:
+    """Opt-in knob for the CHURN plane: batched event application (the
+    watch/notify inbox drained into per-kind delta vectors applied in
+    one pass per cycle — columnar row refreshes through one native
+    eventplane call, one vectorized queue-hint walk, one amortized
+    memo/unbind fold) plus the guarded fast-cycle path that carries a
+    batch's commit context across cycle boundaries when the class memo
+    is still exact. Default OFF; YODA_CHURN_PLANE=1 enables —
+    placements are bit-identical either way (parity fuzz in
+    tests/test_churn_plane.py; CI runs a knob-off tier-1 leg)."""
+    return os.environ.get("YODA_CHURN_PLANE", "0").lower() in (
+        "1", "true", "on")
+
+
 def _fleet_procs_default() -> int:
     """Process-fleet width (scheduler/fleet.py ProcessFleet): run this
     many scheduler PROCESSES against the wire apiserver, nothing shared
@@ -441,6 +455,18 @@ class SchedulerConfig:
     # env YODA_NATIVE_COMMIT unset): the Python/numpy paths run
     # end-to-end, bit-identical placements (the CI parity leg).
     native_commit: bool = field(default_factory=_native_commit_default)
+    # churn plane (ISSUE 20): batched event application + the fast-cycle
+    # commit continuation. The engine drains its event inbox once per
+    # cycle into per-kind batches (columnar rows refreshed by one
+    # native/eventplane.cc call, queue hints evaluated over the whole
+    # batch, memo invalidation folded once), and a fully-consumed batch
+    # commit leaves its context armed so the NEXT same-class cycle can
+    # skip the ordinary head cycle when every guard holds (no degraded
+    # flip, no foreign dirt, no gang/policy/defrag involvement). Off
+    # (default, or env YODA_CHURN_PLANE unset): per-event scalar
+    # application and strict per-batch head cycles — bit-identical
+    # placements (tests/test_churn_plane.py parity fuzz).
+    churn_plane: bool = field(default_factory=_churn_plane_default)
     # fragmentation-aware packing weight (plugins/score.py
     # FragmentationScore): steer 1-chip pods away from nodes whose free
     # set is down to its LAST pair, so 2-chip jobs keep finding pairs
@@ -765,6 +791,8 @@ class SchedulerConfig:
                                           defaults.native_prefetch)),
             native_commit=bool(args.get("nativeCommit",
                                         defaults.native_commit)),
+            churn_plane=bool(args.get("churnPlane",
+                                      defaults.churn_plane)),
             fragmentation_weight=int(args.get(
                 "fragmentationWeight", defaults.fragmentation_weight)),
             torus_placement=bool(args.get(

@@ -407,6 +407,10 @@ class Scheduler:
                 subkey_fn=profile.queue_sort.subkey, **qkw)
         else:
             self.queue = SchedulingQueue(profile.queue_sort.less, **qkw)
+        # churn plane half (a): drain the notify inbox in batched slices
+        # (queue._drain_batch) instead of one on_event walk per event
+        self._churn = bool(config.churn_plane)
+        self.queue.batch_drain = self._churn
         # event-driven requeue: register every plugin's EnqueueExtensions
         # (queueing hints) with the queue's event index, plus the engine's
         # own hint for pods waiting on preemption victims to drain
@@ -520,6 +524,12 @@ class Scheduler:
         # _commit_batch's current member, for crash attribution when a
         # plugin raises inside the batch commit loop
         self._batch_cursor: QueuedPodInfo | None = None
+        # churn-plane fast cycle (config.churn_plane): the resume state a
+        # clean fully-bound batch commit leaves behind — (ctx, last bound
+        # node, exit version vector). The next same-class batch re-enters
+        # _commit_batch directly off it when every guard holds
+        # (schedule_batch), skipping the ordinary head cycle.
+        self._fast_resume: tuple | None = None
         # poison-vs-systemic discriminator for quarantine: a crash is
         # SYSTEMIC when, since the last crash, no cycle completed
         # cleanly AND the last crash was a DIFFERENT pod — i.e. the
@@ -644,6 +654,11 @@ class Scheduler:
             # can't describe joins — the sharded rebuild handles joins
             # itself and only needs the surviving rows' dirt)
             self._columnar.membership_dirty_fn = self._membership_dirty
+        if self._columnar is not None:
+            # cycle-phase attribution: table sync stamps its wall time
+            # into cycle_event_apply_ms (the row-refresh half of event
+            # application; the queue-drain half stamps the same series)
+            self._columnar.metrics = self.metrics
         # native data plane (scheduler/nativeplane.py): the fused C++
         # kernel running the memo-miss full scan in one GIL-releasing
         # call. Requires the columnar table (it consumes those arrays
@@ -685,10 +700,28 @@ class Scheduler:
                 self._commitk = None
         if self._columnar is not None and self._incremental is not None:
             self._columnar.native_refresh = self._incremental
+        # churn plane, columnar half: multi-row dirt applied as one
+        # batched delta-vector pass, through the eventplane kernel when
+        # the .so carries it (a stale .so degrades just this plane to
+        # the numpy scatter; knob off keeps the per-row ground truth)
+        self._eventk = None
+        if self._columnar is not None and self._churn:
+            self._columnar.batch_events = True
+            try:
+                from .nativeplane import EventKernels
+
+                self._eventk = EventKernels.load()
+            except Exception:  # pragma: no cover - defensive, as above
+                self._eventk = None
+            self._columnar.event_kernels = self._eventk
         self.metrics.set_gauge("native_plane_active",
                                1.0 if self._native is not None else 0.0)
         self.metrics.set_gauge("native_commit_active",
                                1.0 if self._commitk is not None else 0.0)
+        self.metrics.set_gauge("churn_plane_active",
+                               1.0 if self._churn else 0.0)
+        self.metrics.set_gauge("event_plane_native",
+                               1.0 if self._eventk is not None else 0.0)
         if self.config.native_commit:
             # arm plugins carrying a commit-plane batch form (today:
             # TopologyScore). Armed even when the .so lacks the kernels —
@@ -698,6 +731,14 @@ class Scheduler:
                 hook = getattr(p, "enable_commit_plane", None)
                 if hook is not None:
                     hook(self._commitk)
+        if self._churn:
+            # churn-plane plugin arming (today: TopologyScore's
+            # copy-on-write slice-usage views) — pure-Python data-plane
+            # amortization, observationally identical outputs
+            for p in list(self.profile.score) + list(self.profile.pre_score):
+                hook = getattr(p, "enable_churn_plane", None)
+                if hook is not None:
+                    hook()
         # shared across co-hosted profiles (multi.py) to serialize cycles;
         # private (uncontended) when this engine runs alone
         self.cycle_lock = cycle_lock or threading.RLock()
@@ -823,6 +864,11 @@ class Scheduler:
             # whether the whole gang is gone and retires its elastic
             # bookkeeping (run_one drains this deque)
             self._elastic_retires.append(event.gang)
+        # churn plane: coalesce redundant wake signals — safe because
+        # every serve loop clears the flag BEFORE its next run_one, and
+        # run_one drains the inbox this event was appended to above
+        if self._churn and self.wake.is_set():
+            return
         self.wake.set()
 
     def _on_telemetry_change(self, node: str, old, new) -> None:
@@ -1771,10 +1817,25 @@ class Scheduler:
         EXACTLY as a per-pod cycle would — a concurrent event moved the
         version vector, candidates exhausted, the cluster maxima shifted —
         falls back to the ordinary per-pod cycle inline, right here, so
-        no pod is ever lost or reordered behind the rest of the queue."""
-        if len(infos) == 1:
+        no pod is ever lost or reordered behind the rest of the queue.
+
+        Churn-plane fast cycle (config.churn_plane): when the PREVIOUS
+        same-class batch's commit ran clean end to end, its context is
+        still armed (_fast_resume) — this batch re-enters the commit
+        loop directly (_fast_cycle), skipping the ordinary head cycle,
+        and only falls back here on a guard miss or mid-batch conflict.
+        With the knob on, single-pod batches also run through this body
+        (not schedule_one) so their cycles arm and consume the context
+        too — at equilibrium the queue often drains one pod at a time."""
+        if len(infos) == 1 and not self._churn:
             return self.schedule_one(infos[0])
         with self.cycle_lock:
+            if self._fast_resume is not None:
+                done = self._fast_cycle(infos)
+                if done:
+                    if done == len(infos):
+                        return "bound"
+                    infos = infos[done:]
             ctx = _BatchCtx()
             try:
                 first = self._schedule_one_locked(infos[0], batch_ctx=ctx)
@@ -1783,7 +1844,8 @@ class Scheduler:
             rest = infos[1:]
             done = 0
             if first == "bound" and ctx.armed:
-                self.metrics.inc("batch_cycles_total")
+                if rest:
+                    self.metrics.inc("batch_cycles_total")
                 self._batch_cursor = None
                 try:
                     done = self._commit_batch(ctx, rest)
@@ -1844,6 +1906,87 @@ class Scheduler:
                     self._contain_crash(info, e)
             return first
 
+    def _fast_cycle(self, infos: list[QueuedPodInfo]) -> int:
+        """Churn-plane fast cycle: consume the resume state a clean,
+        fully-bound batch commit left behind (_fast_resume) and run this
+        batch straight through the incremental commit loop, skipping the
+        ordinary head cycle. The entry guards re-establish everything a
+        head cycle re-derives OUTSIDE the version vector — regime, holds,
+        nominations, policy gates, the pod's equivalence class; the
+        attribution check inside _commit_batch proves everything inside
+        it (foreign dirt of any kind falls back). Returns how many
+        members were handled; 0 = nothing consumed (guard miss or
+        first-member conflict), the caller runs the ordinary cycle."""
+        ctx, r_node, r_vers = self._fast_resume
+        self._fast_resume = None
+        now = self.clock.time()
+        reason = None
+        p0 = infos[0].pod
+        if p0.phase == PodPhase.BOUND and p0.node:
+            reason = "foreign_bound"  # the full cycle owns the drop
+        elif self._degraded or self._detect_degraded(now):
+            reason = "degraded"  # regime (or a pending flip): full
+            # cycles own the memo clears and the staleness waivers
+        elif self.defrag is not None:
+            reason = "defrag"  # pins land between cycles, outside vers
+        elif self.allocator is not None and (
+                self.allocator.has_holds()
+                or self.allocator.nomination_of(p0.key) is not None):
+            reason = "holds"  # per-pod holds break class equivalence
+        elif getattr(self.profile, "policy", None) is not None:
+            reason = "policy"  # fairness gates re-read live shares
+        else:
+            try:
+                spec = spec_for(p0)
+            except LabelError:
+                reason = "spec"
+            else:
+                if spec.is_gang:
+                    reason = "gang"
+                elif self._memo_key_of(p0, spec) != ctx.memo_key:
+                    reason = "class_moved"
+        if reason is None:
+            # attribution pre-check, the same test _commit_batch applies
+            # per member — run it BEFORE paying the commit loop's matrix
+            # setup, because at equilibrium a completion between batches
+            # is the COMMON miss (foreign dirt) and the ordinary cycle
+            # is about to take a fresh snapshot anyway
+            vers, dirty, _grew = self._changes_since_directed(r_vers)
+            if vers is None or dirty is None or not dirty <= {r_node}:
+                conflicted = True
+                if dirty is not None and vers is not None:
+                    snap_infos = (self._snap[0]._node_infos
+                                  if self._snap is not None else None)
+                    if snap_infos is not None:
+                        conflicted = any(n != r_node and n in snap_infos
+                                         for n in dirty)
+                if conflicted:
+                    reason = "foreign_dirt"
+        if reason is not None:
+            self.metrics.inc("fast_cycle_guard_misses_total")
+            self.flight.record("fast_cycle_guard_miss", pod=p0.key,
+                               reason=reason)
+            return 0
+        self.metrics.inc("fast_cycles_total")
+        self._batch_cursor = None
+        try:
+            done = self._commit_batch(ctx, infos, prev_node=r_node,
+                                      prev_vers=r_vers)
+        except Exception as e:
+            # same crash attribution as schedule_batch's commit call
+            cur = self._batch_cursor
+            if cur is not None and cur in infos:
+                done = infos.index(cur) + 1
+                self._contain_crash(cur, e)
+            else:
+                done = 0
+                self.metrics.inc("cycle_crashes_total")
+        finally:
+            self._batch_cursor = None
+        if done < len(infos):
+            self.metrics.inc("fast_cycle_fallbacks_total")
+        return done
+
     def _batch_fast_fail(self, info: QueuedPodInfo) -> bool:
         """Fail one batchmate off the unschedulable-class memo without a
         per-pod cycle — bit-identical to the memo-hit path in
@@ -1884,7 +2027,9 @@ class Scheduler:
         self._unschedulable(info, trace, hit[1], rejected_by=hit[2])
         return True
 
-    def _commit_batch(self, ctx: _BatchCtx, infos: list[QueuedPodInfo]) -> int:
+    def _commit_batch(self, ctx: _BatchCtx, infos: list[QueuedPodInfo],
+                      prev_node: str | None = None,
+                      prev_vers=None) -> int:
         """Greedy batch commit: place each classmate against the shared
         candidate ranking, updating ONLY what the previous bind touched —
         the bound node's row (NodeInfo rebuild + re-filter + re-score),
@@ -1894,7 +2039,15 @@ class Scheduler:
         parity fuzz in tests/test_batch.py pins placements identical), so
         a batched drain and a per-pod drain of the same trace bind the
         same pods to the same chips. Returns how many infos were fully
-        handled; the caller runs per-pod cycles for the rest."""
+        handled; the caller runs per-pod cycles for the rest.
+
+        Churn-plane fast cycle: `prev_node`/`prev_vers` resume a PRIOR
+        batch's fully-consumed commit context across the cycle boundary
+        (schedule_batch guards the entry), so an equilibrium drain of one
+        equivalence class pays ONE ordinary head cycle and then commits
+        every later batch through this loop. The attribution check below
+        is the safety net either way: any dirt not on the previously
+        bound node sends the caller back to the ordinary cycle."""
         state = ctx.state
         spec = ctx.spec
         candidates = ctx.candidates
@@ -1904,8 +2057,6 @@ class Scheduler:
         max_age = self.config.telemetry_max_age_s
         floor_fn = getattr(self.cluster.telemetry, "heartbeat_floor", None)
         table = self._columnar
-        prev_node = ctx.chosen
-        prev_cycle_vers = ctx.vers
         # exit-time memo state: the class memos must end up EXACTLY where
         # the equivalent per-pod chain would leave them, or the next
         # classmate's repair produces a differently-ordered candidate
@@ -1914,8 +2065,19 @@ class Scheduler:
         # score entry tracks the latest completed rescore (per-pod stores
         # it after scoring) — a bail between the two stores the mixed
         # state the per-pod chain would also be in at that point.
-        mem_feas = (ctx.vers, list(candidates))
-        mem_score = (ctx.vers, ctx.mv_t, ctx.usage)
+        if prev_node is None:
+            prev_node = ctx.chosen
+            prev_cycle_vers = ctx.vers
+            mem_feas = (ctx.vers, list(candidates))
+            mem_score = (ctx.vers, ctx.mv_t, ctx.usage)
+        else:
+            # resumed continuation: the memos already sit at the previous
+            # commit's exit vector — re-seed the exit state from THERE,
+            # not from the head cycle's long-stale vector
+            prev_cycle_vers = prev_vers
+            mem_feas = (prev_vers, list(candidates))
+            mem_score = (prev_vers, ctx.mv_t,
+                         state.read_or(SLICE_USE_KEY) or {})
         raws_ok = True  # False only when a rescore ERROR left raws torn
         handled = 0
         kinds = [(p, raws[p.name],
@@ -1954,8 +2116,15 @@ class Scheduler:
         # per-member frozenset then builds off this set instead of
         # re-walking 100 NodeInfo.name attributes
         cand_names = {ni.name for ni in candidates}
+        completed = True  # False once any member falls off the loop
+        # churn plane: per-member counter bumps are batched into one inc
+        # per call ("metrics sampled, not stamped") — totals identical,
+        # minus two locked dict updates per member
+        defer = self._churn
+        n_hits = n_binds = 0
         for info in infos:
             self._batch_cursor = info  # crash attribution (schedule_batch)
+            completed = False  # back True only when this member BINDS
             pod = info.pod
             now = self.clock.time()
             # conflict detection by ATTRIBUTION, not by version equality:
@@ -2021,7 +2190,7 @@ class Scheduler:
                     # per-pod repair path re-verifies staleness per node
                     self.metrics.inc("batch_conflict_fallbacks_total")
                     break
-            if table is not None:
+            if table is not None and not self._churn:
                 # keep the columnar twin hot: one in-place row refresh
                 # from the rebuilt NodeInfo instead of a changes_since
                 # walk at the next sync. Sound because the attribution
@@ -2031,6 +2200,13 @@ class Scheduler:
                 # cordon absorbed into the bind window refills correctly).
                 # The free_coords/claimed_hbm work is memoized on
                 # new_prev, so the re-filter below reuses it.
+                # Churn plane: SKIP the per-member refresh — the loop
+                # never reads the table, and letting the dirt accumulate
+                # means the next ordinary cycle's sync applies the whole
+                # batch in one eventplane call (_sync_batched) instead of
+                # a _fill_row here per member. Same final table bytes:
+                # refresh_row is a declared shortcut, never a source of
+                # truth (its own docstring).
                 table.refresh_row(prev_node, new_prev, prev_cycle_vers,
                                   vers)
             # ---- candidate list: exactly _repair_feasible for a single
@@ -2156,7 +2332,10 @@ class Scheduler:
             # batch commit IS the feasible-class repair path, fused — the
             # counter keeps meaning "classmate placed off the class memo
             # instead of a fresh scan" for dashboards and tests alike.
-            self.metrics.inc("feas_memo_hits_total")
+            if defer:
+                n_hits += 1
+            else:
+                self.metrics.inc("feas_memo_hits_total")
             mem_score = (vers, mv_t, usage)
             prev_cycle_vers = vers
             # ---- Reserve -> (Permit) -> Bind, the ordinary sub-steps
@@ -2216,9 +2395,27 @@ class Scheduler:
                 self.metrics.inc("batch_conflict_fallbacks_total")
                 handled += 1
                 break
-            self.metrics.inc("batched_binds_total")
+            if defer:
+                n_binds += 1
+            else:
+                self.metrics.inc("batched_binds_total")
             handled += 1
             prev_node = chosen
+            completed = True
+        if n_hits:
+            self.metrics.inc("feas_memo_hits_total", n_hits)
+        if n_binds:
+            self.metrics.inc("batched_binds_total", n_binds)
+        # churn-plane fast cycle: a commit loop that ran CLEAN end to end
+        # (every member bound — or no members at all, the single-pod
+        # head) leaves its context armed for the next same-class batch;
+        # schedule_batch re-guards at consume time and the attribution
+        # check above re-proves soundness against whatever happened in
+        # between. Any fall-off means the ordinary cycle owns the class
+        # again until a fresh commit re-arms.
+        self._fast_resume = ((ctx, prev_node, prev_cycle_vers)
+                             if self._churn and completed and raws_ok
+                             else None)
         # exit-time memo refresh (see mem_feas/mem_score above): the next
         # classmate — batched, or the per-pod fallback the caller runs for
         # the rest of this batch — must see the memos the equivalent
@@ -2274,6 +2471,11 @@ class Scheduler:
 
     def _schedule_one_locked(self, info: QueuedPodInfo,
                              batch_ctx: "_BatchCtx | None" = None) -> str:
+        # churn-plane fast cycle: any ordinary cycle invalidates the
+        # carried commit context — it may bind, repair memos, or mutate
+        # the score dicts the context aliases. A batch that re-arms does
+        # so at _commit_batch exit, AFTER this clear.
+        self._fast_resume = None
         if self._native is not None and self._native.inflight:
             # thread-safety contract (nativeplane.py): the table must be
             # quiescent before this cycle's first sync — wait for the

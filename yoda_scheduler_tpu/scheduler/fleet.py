@@ -1156,6 +1156,14 @@ class FleetCoordinator:
         for rep in self.replicas:
             if rep.thread is not None:
                 rep.thread.join(timeout=timeout)
+            if rep.headset is not None:
+                # worker heads MUST be down before the caller tears the
+                # wire down: a head that dispatches an async bind after
+                # the RTT workers exit never gets its completion callback,
+                # so its dispatch-window slot is never released and the
+                # head strands forever in _dispatch_sem.acquire(),
+                # pinning engine + cluster for the life of the process
+                rep.headset.join(timeout=timeout)
 
     # ----------------------------------------------------------- chaos hooks
     def crash_replica(self, idx: int, pods=None) -> _Replica:

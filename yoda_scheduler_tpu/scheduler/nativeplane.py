@@ -166,6 +166,38 @@ class IncrementalKernels:
             totals.ctypes.data, ties.ctypes.data)
 
 
+_EVENT_ABI = 1
+
+
+class EventKernels:
+    """ctypes bridge to the event-plane kernel (eventplane.cc), gated by
+    the `churnPlane` knob: a whole batch of dirty columnar rows — the
+    equilibrium churn of completions answering binds — applied in ONE
+    GIL-releasing call from flat delta vectors, instead of a Python
+    _fill_row plus a per-row refresh call each. Bound behind its own ABI
+    handshake so a stale .so degrades exactly this plane back to the
+    numpy scatter (parity: tests/test_churn_plane.py)."""
+
+    __slots__ = ("apply_fn",)
+
+    def __init__(self, lib) -> None:
+        # c_void_p pointer params: callers pass plain .ctypes.data ints,
+        # same convention as IncrementalKernels
+        self.apply_fn = lib.yoda_event_apply
+
+    @classmethod
+    def load(cls) -> "EventKernels | None":
+        vp = ctypes.c_void_p
+        lib = nativeloader.bind_symbols({
+            "yoda_event_abi": (_i64, []),
+            "yoda_event_apply": (None, [vp, _i64, vp, _i64, vp, vp,
+                                        vp, vp, vp, vp, vp, vp]),
+        })
+        if lib is None or lib.yoda_event_abi() != _EVENT_ABI:
+            return None
+        return cls(lib)
+
+
 _COMMIT_ABI = 1
 
 

@@ -40,6 +40,12 @@ except Exception:  # pragma: no cover - numpy-less install
 SLICE_USE_KEY = "slice_usage"
 
 
+# churn plane: overlay views flatten back to materialized arrays once
+# the override dict outgrows this — the array memcpy is then amortized
+# over that many copy() calls instead of paid per batch member
+_OVERLAY_FLATTEN = 128
+
+
 class _SliceUsage:
     """Array-backed slice-usage map (nativeCommit plane): the per-slice
     (used, total) sums as two int64 arrays over an APPEND-ONLY shared
@@ -51,28 +57,54 @@ class _SliceUsage:
     score()'s pack key hash them), __setitem__ serves _patch, truthiness
     via __len__, and copy() is the COW point — a published view is never
     mutated afterwards (pre_score/pre_score_update copy BEFORE patching,
-    exactly like the dict form)."""
+    exactly like the dict form).
 
-    __slots__ = ("_intern", "_used", "_total", "_has", "_count")
+    Under the churn plane (config.churn_plane; the plugin arms `cow` via
+    enable_churn_plane) copy() gets cheaper still: instead of three
+    array memcpys per batch member — at 50k single-host slices that is
+    ~1MB of memcpy per bind — a copy is an OVERLAY view sharing the
+    parent's arrays with a small {slot: (used, total)} override dict on
+    top. get() consults the overlay first; __setitem__ writes only the
+    overlay; once the overlay outgrows _OVERLAY_FLATTEN the next copy()
+    materializes fresh arrays, so the memcpy is amortized across that
+    many members. Observationally identical to the memcpy form for
+    every consumer (tests/test_churn_plane.py runs the quacks-like-a-
+    dict fuzz in overlay mode; placements stay bit-identical because
+    only .get values reach any scoring arithmetic)."""
 
-    def __init__(self, intern_map, used, total, has, count):
+    __slots__ = ("_intern", "_used", "_total", "_has", "_count",
+                 "_over", "_cow")
+
+    def __init__(self, intern_map, used, total, has, count,
+                 over=None, cow=False):
         self._intern = intern_map  # shared across copies; only grows
         self._used = used
         self._total = total
         self._has = has
         self._count = count
+        # overlay override dict (None = direct mode: setitem writes the
+        # arrays). Any overlay instance is implicitly cow.
+        self._over = over
+        self._cow = cow or over is not None
 
     @classmethod
-    def empty(cls, cap: int = 64) -> "_SliceUsage":
+    def empty(cls, cap: int = 64, cow: bool = False) -> "_SliceUsage":
         return cls({}, np.zeros(cap, dtype=np.int64),
                    np.zeros(cap, dtype=np.int64),
-                   np.zeros(cap, dtype=np.uint8), 0)
+                   np.zeros(cap, dtype=np.uint8), 0, None, cow)
 
     def get(self, sid, default=None):
         i = self._intern.get(sid)
+        if i is None:
+            return default
+        ov = self._over
+        if ov is not None:
+            hit = ov.get(i)
+            if hit is not None:
+                return hit
         # the intern map outgrows older views (it is shared); an index
         # past this view's arrays is a slice this view never held
-        if i is None or i >= len(self._has) or not self._has[i]:
+        if i >= len(self._has) or not self._has[i]:
             return default
         return (int(self._used[i]), int(self._total[i]))
 
@@ -81,6 +113,14 @@ class _SliceUsage:
         if i is None:
             i = len(self._intern)
             self._intern[sid] = i
+        ov = self._over
+        if ov is not None:
+            # overlay mode: the shared base arrays are frozen — the
+            # write lands in this view's override dict alone
+            if i not in ov and not (i < len(self._has) and self._has[i]):
+                self._count += 1
+            ov[i] = (int(ut[0]), int(ut[1]))
+            return
         if i >= len(self._used):
             grow = max(len(self._used) * 2, i + 1)
             for name in ("_used", "_total", "_has"):
@@ -98,9 +138,41 @@ class _SliceUsage:
         return self._count
 
     def copy(self) -> "_SliceUsage":
+        ov = self._over
+        if ov is not None:
+            if len(ov) <= _OVERLAY_FLATTEN:
+                return _SliceUsage(self._intern, self._used, self._total,
+                                   self._has, self._count, dict(ov))
+            return self._flatten()
+        if self._cow:
+            # first copy of a direct-fill map under the churn plane:
+            # share the arrays and start an overlay chain. Sound because
+            # published views are never mutated (writers only touch
+            # objects they just created via empty() or copy() — the same
+            # contract the memcpy form already relies on).
+            return _SliceUsage(self._intern, self._used, self._total,
+                               self._has, self._count, {})
         return _SliceUsage(self._intern, self._used.copy(),
                            self._total.copy(), self._has.copy(),
                            self._count)
+
+    def _flatten(self) -> "_SliceUsage":
+        """Materialize overlay + base into fresh arrays (the amortized
+        memcpy); the result starts a new, empty overlay chain."""
+        ov = self._over
+        n = max(len(self._used), max(ov) + 1)
+        used = np.zeros(n, dtype=np.int64)
+        total = np.zeros(n, dtype=np.int64)
+        has = np.zeros(n, dtype=np.uint8)
+        used[:len(self._used)] = self._used
+        total[:len(self._total)] = self._total
+        has[:len(self._has)] = self._has
+        for i, (u, t) in ov.items():
+            used[i] = u
+            total[i] = t
+            has[i] = 1
+        return _SliceUsage(self._intern, used, total, has,
+                           self._count, {})
 
 
 class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
@@ -152,6 +224,10 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
         self._commit_plane = False
         self._nk = None
         self._batch_bufs: tuple | None = None
+        # churn plane (engine arms via enable_churn_plane): slice-usage
+        # snapshots become copy-on-write overlay views — the per-member
+        # array memcpy amortizes across _OVERLAY_FLATTEN copies
+        self._churn_plane = False
 
     def enable_commit_plane(self, kernels) -> None:
         """Arm the nativeCommit plane for this plugin instance (engine
@@ -159,6 +235,14 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
         in-place patch needs no lock)."""
         self._commit_plane = np is not None
         self._nk = kernels if np is not None else None
+
+    def enable_churn_plane(self) -> None:
+        """Arm the churn plane (config.churn_plane) for this instance:
+        _SliceUsage maps built here are flagged copy-on-write, so each
+        batch member's usage snapshot is an overlay view instead of
+        three array memcpys (observationally identical — see
+        _SliceUsage; parity pinned by tests/test_churn_plane.py)."""
+        self._churn_plane = np is not None
 
     def forget_nodes(self, gone: set[str]) -> None:
         for n in gone:
@@ -205,7 +289,8 @@ class TopologyScore(ScorePlugin, PreScorePlugin, EnqueueExtensions):
                 self._usage_state = (vers, usage, contrib)
                 state.write(SLICE_USE_KEY, usage)
                 return Status.success()
-        usage = _SliceUsage.empty() if self._commit_plane else {}
+        usage = (_SliceUsage.empty(cow=self._churn_plane)
+                 if self._commit_plane else {})
         contrib: dict[str, tuple] = {}
         for node in nodes:
             c = self._contribution(node)
